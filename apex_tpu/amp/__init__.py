@@ -1,0 +1,40 @@
+"""apex_tpu.amp — automatic mixed precision (reference: apex/amp).
+
+JAX-native surface:
+  initialize(params, opt_level=...)       -> (params, AmpState)
+  scaled_value_and_grad(loss_fn, state..) -> loss, unscaled grads, found_inf
+  conditional_step / update_state         -> scaler-driven skip logic
+  Policy / Properties / opt_level_properties
+
+The reference's op-classification lists (which torch ops run fp16 vs fp32,
+apex/amp/lists/) live in apex_tpu.amp.lists and drive both the torch-CPU
+compatibility frontend and the JAX policy's notion of "norm-like" ops.
+"""
+
+from apex_tpu.amp.policies import Policy, Properties, opt_level_properties
+from apex_tpu.amp.scaler import (
+    LossScaler,
+    LossScaleConfig,
+    LossScaleState,
+    check_finite,
+    conditional_step,
+    scale_loss,
+    scaled_value_and_grad,
+    unscale_grads,
+    update_state,
+)
+from apex_tpu.amp.frontend import (
+    AmpState,
+    initialize,
+    master_params_to_model_params,
+    update_scaler,
+)
+
+__all__ = [
+    "Policy", "Properties", "opt_level_properties",
+    "LossScaler", "LossScaleConfig", "LossScaleState",
+    "check_finite", "conditional_step", "scale_loss",
+    "scaled_value_and_grad", "unscale_grads", "update_state",
+    "AmpState", "initialize", "master_params_to_model_params",
+    "update_scaler",
+]

@@ -1,0 +1,131 @@
+"""amp.initialize and the amp serialization contract, JAX-native.
+
+Reference: apex/amp/frontend.py + _initialize.py + _amp_state.py
+(SURVEY.md §3.1).  The reference mutates torch models/optimizers in place
+(weight casts, forward patching, optimizer.step patching).  The JAX
+contract is functional: ``initialize`` takes a params pytree, returns the
+cast params plus an ``AmpState`` carrying the policy, optional f32
+masters, and the loss-scaler state; train steps thread AmpState through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policies import (Policy, Properties, opt_level_properties)
+from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
+                                 update_state)
+
+Pytree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AmpState:
+    """Carried amp state (a pytree; static config in `properties`)."""
+    master_params: Optional[Pytree]
+    scaler: LossScaleState
+    properties: Properties = dataclasses.field(
+        metadata=dict(static=True), default_factory=Properties)
+    scaler_config: LossScaleConfig = dataclasses.field(
+        metadata=dict(static=True), default_factory=LossScaleConfig)
+
+    @property
+    def policy(self) -> Policy:
+        return self.properties.policy(self._half_dtype())
+
+    def _half_dtype(self):
+        cast = self.properties.cast_model_type
+        return cast if cast is not None else jnp.bfloat16
+
+    # --- apex serialization contract: amp.state_dict() round-trips the
+    # loss scaler (scale + unskipped count), frontend.py parity ---
+    def state_dict(self):
+        return {
+            "loss_scaler0": {
+                "loss_scale": float(self.scaler.loss_scale),
+                "unskipped": int(self.scaler.growth_tracker),
+            }
+        }
+
+    def load_state_dict(self, sd):
+        entry = sd.get("loss_scaler0", {})
+        return dataclasses.replace(
+            self,
+            scaler=LossScaleState(
+                loss_scale=jnp.float32(entry.get("loss_scale",
+                                                 self.scaler_config.init_scale)),
+                growth_tracker=jnp.int32(entry.get("unskipped", 0)),
+                found_inf=jnp.int32(0),
+            ))
+
+
+def initialize(params: Pytree,
+               opt_level: str = "O1",
+               half_dtype=jnp.bfloat16,
+               cast_model_type=None,
+               keep_batchnorm_fp32=None,
+               master_weights=None,
+               loss_scale: Union[str, float, None] = None,
+               enabled: bool = True,
+               ) -> Tuple[Pytree, AmpState]:
+    """Resolve an opt level to a precision configuration and cast params.
+
+    Mirrors apex.amp.initialize's signature shape (model, optimizers →
+    params pytree here); per-kwarg overrides beat the table defaults, as in
+    the reference.  Returns (cast_params, amp_state).
+    """
+    props = opt_level_properties(opt_level, half_dtype)
+    if cast_model_type is not None:
+        props.cast_model_type = cast_model_type
+    if keep_batchnorm_fp32 is not None:
+        props.keep_batchnorm_fp32 = keep_batchnorm_fp32
+    if master_weights is not None:
+        props.master_weights = master_weights
+    if loss_scale is not None:
+        props.loss_scale = loss_scale
+    props.enabled = enabled
+    if not enabled:
+        return params, AmpState(master_params=None,
+                                scaler=LossScaleState.create(1.0),
+                                properties=props,
+                                scaler_config=LossScaleConfig(dynamic=False))
+
+    masters = None
+    cast_params = params
+    if props.cast_model_type is not None:
+        cast_params = jax.tree_util.tree_map(
+            lambda x: x.astype(props.cast_model_type)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        if props.master_weights:
+            masters = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+    dynamic = props.loss_scale == "dynamic"
+    init_scale = 2.0 ** 16 if dynamic else float(props.loss_scale)
+    cfg = LossScaleConfig(init_scale=init_scale, dynamic=dynamic)
+    scaler = LossScaleState.create(init_scale)
+    return cast_params, AmpState(master_params=masters, scaler=scaler,
+                                 properties=props, scaler_config=cfg)
+
+
+def master_params_to_model_params(model_params: Pytree,
+                                  master_params: Pytree) -> Pytree:
+    """Copy f32 masters back into the model-dtype params (O2 step tail).
+
+    Reference: apex/amp/_process_optimizer.py::_master_params_to_model_params.
+    """
+    return jax.tree_util.tree_map(
+        lambda mp, m: m.astype(mp.dtype), model_params, master_params)
+
+
+def update_scaler(state: AmpState, found_inf) -> AmpState:
+    return dataclasses.replace(
+        state, scaler=update_state(state.scaler,
+                                   jnp.asarray(found_inf, jnp.int32),
+                                   state.scaler_config))

@@ -1,0 +1,99 @@
+"""Precision policies: the O0-O3 opt-level tables.
+
+Reference: apex/amp/frontend.py (Properties + O0..O3 option bundles,
+SURVEY.md §3.1).  The reference implements O1 by monkey-patching torch
+functions per whitelist/blacklist; on TPU the same contract becomes a
+tracing-time dtype policy consulted by modules: matmul/conv-shaped ops run
+in ``compute_dtype`` (bf16 → MXU), reductions/norms/losses in f32, params
+stored in ``param_dtype`` with optional f32 masters.
+
+bf16 replaces fp16 as the half type: same MXU throughput, fp32-range
+exponent, so O2's *dynamic* loss scaling degenerates to static scale 1.0
+by default (the scaler API is kept — fp16 is still selectable).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """jmp-style dtype policy applied at trace time."""
+    param_dtype: Dtype = jnp.float32
+    compute_dtype: Dtype = jnp.float32
+    output_dtype: Dtype = jnp.float32
+    # master_weights: keep an f32 copy updated by the optimizer while the
+    # model computes with param_dtype (reference O2 semantics)
+    master_weights: bool = False
+    # keep norms/statistics in f32 regardless of compute dtype
+    keep_norm_fp32: bool = True
+
+    def cast_to_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast_to_param(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.param_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast_to_output(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.output_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+@dataclasses.dataclass
+class Properties:
+    """Reference-shaped option bundle (apex/amp/frontend.py::Properties)."""
+    opt_level: str = "O0"
+    cast_model_type: Optional[Dtype] = None
+    patch_torch_functions: bool = False
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Union[str, float] = 1.0
+    enabled: bool = True
+
+    def policy(self, half_dtype: Dtype = jnp.bfloat16) -> Policy:
+        half = half_dtype
+        if self.opt_level == "O0":
+            return Policy(jnp.float32, jnp.float32, jnp.float32,
+                          master_weights=False)
+        if self.opt_level == "O1":
+            # params stay f32; selected ops compute in half
+            return Policy(jnp.float32, half, jnp.float32,
+                          master_weights=False)
+        if self.opt_level == "O2":
+            return Policy(half, half, jnp.float32, master_weights=True,
+                          keep_norm_fp32=bool(self.keep_batchnorm_fp32))
+        if self.opt_level == "O3":
+            return Policy(half, half, half, master_weights=False,
+                          keep_norm_fp32=False)
+        raise ValueError(f"unknown opt_level {self.opt_level!r}")
+
+
+def opt_level_properties(opt_level: str,
+                         half_dtype: Dtype = jnp.bfloat16) -> Properties:
+    """The reference's O0..O3 defaults (apex/amp/frontend.py tables)."""
+    fp16_like = half_dtype == jnp.float16
+    default_dynamic = "dynamic" if fp16_like else 1.0
+    tables = {
+        "O0": Properties("O0", None, False, None, False, 1.0),
+        "O1": Properties("O1", None, True, None, None, default_dynamic),
+        "O2": Properties("O2", half_dtype, False, True, True,
+                         "dynamic" if fp16_like else default_dynamic),
+        "O3": Properties("O3", half_dtype, False, False, False, 1.0),
+    }
+    if opt_level not in tables:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r}; "
+            "options are 'O0', 'O1', 'O2', 'O3'.")
+    return tables[opt_level]

@@ -1,0 +1,199 @@
+"""Dynamic loss scaling as carried pytree state.
+
+Reference: apex/amp/scaler.py + csrc/update_scale_hysteresis.cu
+(SURVEY.md §2.1, §3.2).  Semantics preserved: scale the loss before
+backward; unscale grads; if any grad is non-finite, skip the step and
+multiply the scale by ``backoff_factor`` (0.5); after ``growth_interval``
+(2000) consecutive clean steps multiply by ``growth_factor`` (2.0).
+
+TPU redesign: the reference reads the overflow flag on the host every step
+(a device sync).  Here the flag, the skip decision (lax.cond) and the
+scale update are all traced into the jitted train step; the scaler state
+is a pytree the caller threads through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LossScaleState:
+    """Carried state of one dynamic loss scaler (a pytree)."""
+    loss_scale: jax.Array      # f32 scalar
+    growth_tracker: jax.Array  # i32 scalar: consecutive clean steps
+    found_inf: jax.Array       # i32 scalar: last step's overflow flag
+
+    @staticmethod
+    def create(init_scale: float = 2.0 ** 16) -> "LossScaleState":
+        return LossScaleState(
+            loss_scale=jnp.float32(init_scale),
+            growth_tracker=jnp.int32(0),
+            found_inf=jnp.int32(0),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaleConfig:
+    init_scale: float = 2.0 ** 16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    min_loss_scale: float = 1.0
+    max_loss_scale: float = 2.0 ** 24
+    dynamic: bool = True
+
+
+def scale_loss(loss: jax.Array, state: LossScaleState) -> jax.Array:
+    return loss * state.loss_scale.astype(loss.dtype)
+
+
+def unscale_grads(grads: Pytree, state: LossScaleState) -> Pytree:
+    inv = 1.0 / state.loss_scale
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+
+
+def check_finite(grads: Pytree) -> jax.Array:
+    """i32 flag: 1 iff any grad element is non-finite.  Stays on device."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.int32(0)
+    bad = jnp.stack([
+        jnp.logical_not(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+        for g in leaves])
+    return jnp.any(bad).astype(jnp.int32)
+
+
+def update_state(state: LossScaleState, found_inf: jax.Array,
+                 config: LossScaleConfig = LossScaleConfig()) -> LossScaleState:
+    """update_scale_hysteresis semantics, branch-free on device."""
+    if not config.dynamic:
+        return dataclasses.replace(state, found_inf=found_inf)
+    overflowed = found_inf > 0
+    tracker = jnp.where(overflowed, 0, state.growth_tracker + 1)
+    grow = tracker >= config.growth_interval
+    new_scale = jnp.where(
+        overflowed,
+        jnp.maximum(state.loss_scale * config.backoff_factor,
+                    config.min_loss_scale),
+        jnp.where(grow,
+                  jnp.minimum(state.loss_scale * config.growth_factor,
+                              config.max_loss_scale),
+                  state.loss_scale),
+    )
+    tracker = jnp.where(grow, 0, tracker)
+    return LossScaleState(
+        loss_scale=new_scale,
+        growth_tracker=tracker,
+        found_inf=found_inf,
+    )
+
+
+def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
+                          has_aux: bool = False, **kwargs):
+    """value_and_grad of a LOSS-SCALED objective, then unscale.
+
+    The canonical TPU replacement for the reference's
+    ``with amp.scale_loss(loss, optimizer) as scaled: scaled.backward()``
+    idiom (apex/amp/handle.py): grads come back already unscaled plus the
+    on-device found_inf flag for the conditional optimizer step.
+
+    Returns ((loss, aux?), grads, found_inf).
+    """
+    def scaled_fn(*a, **kw):
+        out = loss_fn(*a, **kw)
+        if has_aux:
+            loss, aux = out
+            return scale_loss(loss, state), aux
+        return scale_loss(out, state)
+
+    if has_aux:
+        (scaled, aux), grads = jax.value_and_grad(
+            scaled_fn, has_aux=True)(*args, **kwargs)
+    else:
+        scaled, grads = jax.value_and_grad(scaled_fn)(*args, **kwargs)
+        aux = None
+    found_inf = check_finite(grads)
+    grads = unscale_grads(grads, state)
+    loss = scaled / state.loss_scale
+    if has_aux:
+        return (loss, aux), grads, found_inf
+    return loss, grads, found_inf
+
+
+def conditional_step(state: LossScaleState, found_inf: jax.Array,
+                     step_fn, params: Pytree, opt_state: Pytree,
+                     config: LossScaleConfig = LossScaleConfig()
+                     ) -> Tuple[Pytree, Pytree, LossScaleState]:
+    """Apply ``step_fn(params, opt_state) -> (params, opt_state)`` only when
+    grads were finite; always update scaler state.  The skip is a
+    lax.cond — no host sync (contrast: reference optimizer.step patching in
+    apex/amp/_process_optimizer.py reads the flag on host)."""
+    def do_step(operand):
+        p, s = operand
+        return step_fn(p, s)
+
+    def skip(operand):
+        return operand
+
+    params, opt_state = jax.lax.cond(
+        found_inf == 0, do_step, skip, (params, opt_state))
+    return params, opt_state, update_state(state, found_inf, config)
+
+
+class LossScaler:
+    """Reference-shaped stateful facade over the functional core
+    (apex/amp/scaler.py::LossScaler).  Host-side convenience only; jitted
+    code should use the functional API above."""
+
+    def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
+                 scale_factor=2.0, scale_window=2000,
+                 min_loss_scale=None, max_loss_scale=2.0 ** 24):
+        self._dynamic = loss_scale == "dynamic"
+        init = init_scale if self._dynamic else float(loss_scale)
+        self.config = LossScaleConfig(
+            init_scale=init,
+            growth_factor=scale_factor,
+            backoff_factor=1.0 / scale_factor,
+            growth_interval=scale_window,
+            min_loss_scale=min_loss_scale if min_loss_scale is not None else 1.0,
+            max_loss_scale=max_loss_scale,
+            dynamic=self._dynamic,
+        )
+        self.state = LossScaleState.create(init)
+
+    def loss_scale(self):
+        return float(self.state.loss_scale)
+
+    def scale(self, loss):
+        return scale_loss(loss, self.state)
+
+    def unscale(self, grads):
+        return unscale_grads(grads, self.state)
+
+    def update_scale(self, found_inf):
+        self.state = update_state(self.state,
+                                  jnp.asarray(found_inf, jnp.int32),
+                                  self.config)
+
+    # apex serialization contract (amp.state_dict round-trips scaler state)
+    def state_dict(self):
+        return {
+            "loss_scale": float(self.state.loss_scale),
+            "unskipped": int(self.state.growth_tracker),
+        }
+
+    def load_state_dict(self, sd):
+        self.state = LossScaleState(
+            loss_scale=jnp.float32(sd["loss_scale"]),
+            growth_tracker=jnp.int32(sd.get("unskipped", 0)),
+            found_inf=jnp.int32(0),
+        )

@@ -1,0 +1,168 @@
+"""Device-mesh ownership and collective helpers.
+
+TPU-native replacement for the reference's NCCL process-group plumbing
+(upstream-expected apex/transformer/parallel_state.py and the ad-hoc
+``new_group`` calls in apex/parallel/distributed.py — see SURVEY.md §2.6).
+Where the reference builds torch.distributed process groups per parallelism
+axis, we own ONE global ``jax.sharding.Mesh`` whose named axes play the role
+of the groups; collectives are XLA collectives (psum / all_gather /
+psum_scatter / ppermute / all_to_all) that ride ICI intra-slice and DCN
+inter-slice.  Axis-minor ordering puts the model (tensor-parallel) axis on
+adjacent devices so its collectives stay on ICI.
+
+Axes (any may be size 1):
+  "data"  — data parallel (reference: data-parallel group)
+  "pipe"  — pipeline parallel (reference: pipeline-model-parallel group)
+  "ctx"   — context/sequence-block parallel (ring attention; no reference
+            equivalent — apex has no context parallelism, SURVEY.md §2.5)
+  "model" — tensor model parallel (reference: tensor-model-parallel group)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_DATA = "data"
+AXIS_PIPE = "pipe"
+AXIS_CTX = "ctx"
+AXIS_MODEL = "model"
+MESH_AXES = (AXIS_DATA, AXIS_PIPE, AXIS_CTX, AXIS_MODEL)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    data: int
+    pipe: int = 1
+    ctx: int = 1
+    model: int = 1
+
+    @property
+    def world_size(self) -> int:
+        return self.data * self.pipe * self.ctx * self.model
+
+
+_MESH: Optional[Mesh] = None
+_CONFIG: Optional[MeshConfig] = None
+
+
+def initialize(
+    data: int = -1,
+    pipe: int = 1,
+    ctx: int = 1,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build and install the global mesh.
+
+    ``data=-1`` infers the data axis from the device count (reference
+    behavior: data-parallel size = world_size / (tp * pp)).  The device
+    array is laid out so that the "model" axis is minor: tensor-parallel
+    collectives (the chattiest) land on physically adjacent chips.
+    """
+    global _MESH, _CONFIG
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data == -1:
+        denom = pipe * ctx * model
+        if n % denom != 0:
+            raise ValueError(
+                f"device count {n} not divisible by pipe*ctx*model={denom}"
+            )
+        data = n // denom
+    cfg = MeshConfig(data=data, pipe=pipe, ctx=ctx, model=model)
+    if cfg.world_size != n:
+        raise ValueError(
+            f"mesh {dataclasses.asdict(cfg)} wants {cfg.world_size} devices, "
+            f"have {n}"
+        )
+    dev_array = np.asarray(devices).reshape(data, pipe, ctx, model)
+    _MESH = Mesh(dev_array, MESH_AXES)
+    _CONFIG = cfg
+    return _MESH
+
+
+def is_initialized() -> bool:
+    return _MESH is not None
+
+
+def mesh() -> Mesh:
+    """The global mesh, auto-initialized all-data-parallel if unset."""
+    if _MESH is None:
+        initialize()
+    return _MESH
+
+
+def config() -> MeshConfig:
+    if _CONFIG is None:
+        initialize()
+    return _CONFIG
+
+
+def destroy() -> None:
+    """Reference parity: parallel_state.destroy_model_parallel()."""
+    global _MESH, _CONFIG
+    _MESH = None
+    _CONFIG = None
+
+
+@contextlib.contextmanager
+def use_mesh(m: Mesh):
+    """Temporarily install ``m`` as the global mesh (tests, nested configs)."""
+    global _MESH, _CONFIG
+    prev_mesh, prev_cfg = _MESH, _CONFIG
+    _MESH = m
+    shape = dict(zip(m.axis_names, m.devices.shape))
+    _CONFIG = MeshConfig(
+        data=shape.get(AXIS_DATA, 1),
+        pipe=shape.get(AXIS_PIPE, 1),
+        ctx=shape.get(AXIS_CTX, 1),
+        model=shape.get(AXIS_MODEL, 1),
+    )
+    try:
+        yield m
+    finally:
+        _MESH = prev_mesh
+        _CONFIG = prev_cfg
+
+
+def axis_size(name: str) -> int:
+    """Size of a mesh axis (outside traced code)."""
+    m = mesh()
+    return dict(zip(m.axis_names, m.devices.shape)).get(name, 1)
+
+
+def data_parallel_size() -> int:
+    return axis_size(AXIS_DATA)
+
+
+def model_parallel_size() -> int:
+    return axis_size(AXIS_MODEL)
+
+
+def pipeline_parallel_size() -> int:
+    return axis_size(AXIS_PIPE)
+
+
+def context_parallel_size() -> int:
+    return axis_size(AXIS_CTX)
+
+
+def sharding(*spec) -> NamedSharding:
+    """NamedSharding on the global mesh from a PartitionSpec-style tuple."""
+    return NamedSharding(mesh(), PartitionSpec(*spec))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(mesh(), PartitionSpec())
+
+
+def num_devices() -> int:
+    return math.prod(mesh().devices.shape)

@@ -1,0 +1,121 @@
+"""Flat-buffer dispatch over tensor lists / pytrees.
+
+Replaces both reference pieces:
+  - apex/multi_tensor_apply/multi_tensor_apply.py (MultiTensorApply)
+  - csrc/flatten_unflatten.cpp (apex_C.flatten / apex_C.unflatten)
+
+JAX arrays are immutable, so unlike the reference (which mutates tensors
+in place) every applier RETURNS the updated lists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def flatten(tensors: Sequence[jax.Array]) -> jax.Array:
+    """apex_C.flatten parity: concatenate raveled tensors (common dtype)."""
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten(flat: jax.Array, like: Sequence[jax.Array]) -> List[jax.Array]:
+    """apex_C.unflatten parity: split a flat buffer back to shapes of `like`."""
+    sizes = [int(t.size) for t in like]
+    splits = []
+    offset = 0
+    for t, n in zip(like, sizes):
+        splits.append(jax.lax.dynamic_slice_in_dim(flat, offset, n).reshape(t.shape))
+        offset += n
+    return splits
+
+
+# torch-parity aliases used by apex.parallel.distributed
+flatten_tensors = flatten
+unflatten_tensors = unflatten
+
+
+def _group_by_dtype(tensors: Sequence[jax.Array]):
+    groups = {}
+    for idx, t in enumerate(tensors):
+        groups.setdefault(jnp.dtype(t.dtype), []).append(idx)
+    return groups
+
+
+def multi_tensor_applier(op: Callable, noop_flag: Any,
+                         tensor_lists: Sequence[Sequence[jax.Array]],
+                         *args, **kwargs):
+    """API-parity entry point.
+
+    ``op`` is a flat-buffer kernel from apex_tpu.ops.multi_tensor taking
+    positional flat buffers (one per tensor list) followed by kwargs.
+    ``noop_flag`` is accepted for signature parity with the reference's
+    overflow buffer and ignored (non-finite detection is returned
+    functionally by the ops that support it).
+
+    Returns whatever ``op`` returns, with flat buffers split back into the
+    original tensor shapes.
+    """
+    del noop_flag
+    lists = [list(tl) for tl in tensor_lists]
+    n_lists = len(lists)
+    if n_lists == 0 or len(lists[0]) == 0:
+        return None
+    # Group by dtype of the FIRST list (the reference dispatches on the
+    # tuple of dtypes; in practice lists are dtype-homogeneous per group).
+    groups = _group_by_dtype(lists[0])
+    # result slots per original tensor position
+    out_lists: List[List[Any]] = None
+    extra = None
+    for _, idxs in groups.items():
+        flats = [flatten([lists[k][i] for i in idxs]) for k in range(n_lists)]
+        result = op(*flats, *args, **kwargs)
+        if not isinstance(result, tuple):
+            result = (result,)
+        # split array results that match the flat buffer size back out
+        flat_size = flats[0].size
+        split_results = []
+        extras = []
+        for r in result:
+            if isinstance(r, jax.Array) and r.ndim == 1 and r.size == flat_size:
+                split_results.append(unflatten(r, [lists[0][i] for i in idxs]))
+            else:
+                extras.append(r)
+        if out_lists is None:
+            out_lists = [[None] * len(lists[0]) for _ in split_results]
+        for j, sr in enumerate(split_results):
+            for slot, i in enumerate(idxs):
+                out_lists[j][i] = sr[slot]
+        if extras:
+            extra = extras if extra is None else [
+                _combine_extra(a, b) for a, b in zip(extra, extras)]
+    outs = tuple(out_lists or ())
+    if extra:
+        return outs + tuple(extra)
+    return outs
+
+
+def _combine_extra(a, b):
+    # non-finite flags combine by max; norms combine by rss
+    if a.dtype == jnp.int32:
+        return jnp.maximum(a, b)
+    return jnp.sqrt(a * a + b * b)
+
+
+class MultiTensorApply:
+    """Reference-shaped callable (apex/multi_tensor_apply).
+
+    The chunk_size ctor arg is kept for parity; Pallas tiling supersedes it.
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args, **kwargs):
+        return multi_tensor_applier(op, noop_flag_buffer, tensor_lists,
+                                    *args, **kwargs)

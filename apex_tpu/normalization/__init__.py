@@ -1,0 +1,19 @@
+"""apex_tpu.normalization (reference: apex/normalization)."""
+
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+    fused_layer_norm,
+    fused_rms_norm,
+    layer_norm_ref,
+    rms_norm_ref,
+)
+
+__all__ = [
+    "FusedLayerNorm", "FusedRMSNorm",
+    "MixedFusedLayerNorm", "MixedFusedRMSNorm",
+    "fused_layer_norm", "fused_rms_norm",
+    "layer_norm_ref", "rms_norm_ref",
+]

@@ -1,0 +1,94 @@
+"""Module-level normalization API (reference:
+apex/normalization/fused_layer_norm.py).
+
+``FusedLayerNorm`` / ``FusedRMSNorm`` are flax.linen modules with the
+reference's constructor surface (normalized_shape, eps,
+elementwise_affine, memory_efficient).  The "Mixed" variants keep params
+in f32 while the input may be bf16 — on TPU this is simply param_dtype
+pinned to f32 (the kernels accumulate in f32 regardless), matching
+MixedFusedLayerNorm/MixedFusedRMSNorm semantics.
+
+Functional forms (fused_layer_norm / fused_rms_norm) live in
+apex_tpu.ops.layer_norm and are re-exported here.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import (fused_layer_norm, fused_rms_norm,
+                                     layer_norm_ref, rms_norm_ref)
+
+Shape = Union[int, Iterable[int]]
+
+
+def _normalize_shape(s: Shape) -> Tuple[int, ...]:
+    if isinstance(s, int):
+        return (s,)
+    return tuple(s)
+
+
+class FusedLayerNorm(nn.Module):
+    normalized_shape: Shape = None
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _normalize_shape(self.normalized_shape)
+        h = math.prod(shape)
+        lead = x.shape[:x.ndim - len(shape)]
+        x2 = x.reshape(lead + (h,))
+        if self.elementwise_affine:
+            w = self.param("weight", nn.initializers.ones, (h,),
+                           self.param_dtype)
+            b = self.param("bias", nn.initializers.zeros, (h,),
+                           self.param_dtype)
+        else:
+            w = b = None
+        y = fused_layer_norm(x2, w, b, self.eps, self.memory_efficient)
+        return y.reshape(x.shape)
+
+
+class FusedRMSNorm(nn.Module):
+    normalized_shape: Shape = None
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _normalize_shape(self.normalized_shape)
+        h = math.prod(shape)
+        lead = x.shape[:x.ndim - len(shape)]
+        x2 = x.reshape(lead + (h,))
+        w = (self.param("weight", nn.initializers.ones, (h,),
+                        self.param_dtype)
+             if self.elementwise_affine else None)
+        y = fused_rms_norm(x2, w, self.eps, self.memory_efficient)
+        return y.reshape(x.shape)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """bf16-input / f32-param LayerNorm (reference MixedFusedLayerNorm)."""
+    param_dtype: jnp.dtype = jnp.float32
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    """bf16-input / f32-param RMSNorm (reference MixedFusedRMSNorm)."""
+    param_dtype: jnp.dtype = jnp.float32
+
+
+__all__ = [
+    "FusedLayerNorm", "FusedRMSNorm",
+    "MixedFusedLayerNorm", "MixedFusedRMSNorm",
+    "fused_layer_norm", "fused_rms_norm",
+    "layer_norm_ref", "rms_norm_ref",
+]

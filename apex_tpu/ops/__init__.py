@@ -1,0 +1,18 @@
+"""apex_tpu.ops — the Pallas/XLA kernel layer.
+
+TPU-native replacement for the reference's ``csrc/`` CUDA extension suite
+(SURVEY.md §2.4).  Every reference extension module maps to a submodule
+here; Python callers get `jax.custom_vjp`-wired functions instead of
+pybind11 modules.
+
+  amp_C (multi_tensor_*)        -> apex_tpu.ops.multi_tensor
+  fused_layer_norm_cuda         -> apex_tpu.ops.layer_norm
+  scaled_*_softmax_cuda         -> apex_tpu.ops.softmax
+  fused_rotary_positional_emb.. -> apex_tpu.ops.rope
+  xentropy_cuda                 -> apex_tpu.ops.xentropy
+  fast_multihead_attn / fmhalib -> apex_tpu.ops.attention
+  syncbn (welford)              -> apex_tpu.ops.welford
+  transducer_*_cuda             -> apex_tpu.ops.transducer
+"""
+
+from apex_tpu.ops._dispatch import interpret_mode, on_tpu

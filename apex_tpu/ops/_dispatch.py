@@ -1,0 +1,32 @@
+"""Kernel dispatch policy: real Mosaic on TPU, interpreter elsewhere.
+
+Plays the role of the reference's dtype-dispatch/build-flag glue
+(csrc/type_shim.h, setup.py extension gating): decide at trace time whether
+a Pallas kernel compiles for hardware or runs interpreted (CPU CI), and
+whether to prefer the plain-XLA path where fusion already wins.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_FORCE_INTERPRET = os.environ.get("APEX_TPU_PALLAS_INTERPRET", "") == "1"
+_DISABLE_PALLAS = os.environ.get("APEX_TPU_DISABLE_PALLAS", "") == "1"
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """True when pallas_call must run interpreted (non-TPU backends)."""
+    if _FORCE_INTERPRET:
+        return True
+    return not on_tpu()
+
+
+def pallas_enabled() -> bool:
+    """Global escape hatch: fall back to pure-XLA reference paths."""
+    return not _DISABLE_PALLAS

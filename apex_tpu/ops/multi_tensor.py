@@ -1,0 +1,383 @@
+"""Fused "foreach" kernels over flat parameter buffers.
+
+TPU-native replacement for the reference's ``amp_C`` extension
+(upstream-expected csrc/amp_C_frontend.cpp + multi_tensor_*.cu kernels,
+SURVEY.md §2.4): scale with non-finite detection, axpby, L2 norm, and the
+optimizer step math (Adam/SGD/...).  The reference chunks a list of CUDA
+tensors into one grid launch to amortize launch overhead; the TPU design
+concatenates pytree leaves into one flat HBM buffer (see
+apex_tpu.multi_tensor_apply) and runs ONE pallas_call whose grid walks
+(rows, 128)-shaped VMEM tiles.  All math accumulates in f32 regardless of
+storage dtype; non-finite detection is an on-device i32 flag (never a host
+sync — the reference's host-side overflow read is a known sync point,
+SURVEY.md §3.2).
+
+Every kernel has a pure-jnp oracle (suffix ``_ref``) used for testing and
+as the XLA fallback when Pallas is disabled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+
+LANE = 128
+SUBLANE = 8
+BLOCK_ROWS = 256  # 256x128 f32 = 128 KiB per operand tile
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _as_tiles(x: jax.Array) -> Tuple[jax.Array, int]:
+    """Pad a 1-D buffer with zeros and view it as (rows, 128) tiles.
+
+    Rows are padded to a whole grid block so kernels never read
+    out-of-bounds garbage (it would poison the non-finite flag).
+    """
+    n = x.size
+    rows = _round_up(max(pl.cdiv(n, LANE), 1), BLOCK_ROWS)
+    pad = rows * LANE - n
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    return x.reshape(rows, LANE), n
+
+
+def _from_tiles(x2d: jax.Array, n: int) -> jax.Array:
+    return x2d.reshape(-1)[:n]
+
+
+def _grid(rows: int) -> int:
+    return pl.cdiv(rows, BLOCK_ROWS)
+
+
+def _vec_spec():
+    return pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0))
+
+
+def _scalar_out_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# scale (+ non-finite check)   [reference: multi_tensor_scale_kernel.cu]
+# ---------------------------------------------------------------------------
+
+def _scale_kernel(s_ref, x_ref, o_ref, flag_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        flag_ref[0] = 0
+
+    x = _f32(x_ref[...])
+    y = x * s_ref[0]
+    o_ref[...] = y.astype(o_ref.dtype)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(y))).astype(jnp.int32)
+    flag_ref[0] = jnp.maximum(flag_ref[0], bad)
+
+
+def flat_scale(x: jax.Array, scale: jax.Array, out_dtype=None):
+    """out = x * scale over a flat buffer; returns (out, found_inf i32).
+
+    found_inf mirrors amp_C.multi_tensor_scale's overflow buffer but stays
+    on device.
+    """
+    out_dtype = out_dtype or x.dtype
+    if not pallas_enabled():
+        return flat_scale_ref(x, scale, out_dtype)
+    x2d, n = _as_tiles(x)
+    scale = jnp.asarray([scale], jnp.float32).reshape(1)
+    out, flag = pl.pallas_call(
+        _scale_kernel,
+        grid=(_grid(x2d.shape[0]),),
+        in_specs=[_smem_spec(), _vec_spec()],
+        out_specs=[_vec_spec(), _scalar_out_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2d.shape, out_dtype),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_scale",
+    )(scale, x2d)
+    return _from_tiles(out, n), flag[0]
+
+
+def flat_scale_ref(x, scale, out_dtype=None):
+    out_dtype = out_dtype or x.dtype
+    y = _f32(x) * jnp.float32(scale)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(y))).astype(jnp.int32)
+    return y.astype(out_dtype), bad
+
+
+# ---------------------------------------------------------------------------
+# axpby (+ non-finite check)   [reference: multi_tensor_axpby_kernel.cu]
+# ---------------------------------------------------------------------------
+
+def _axpby_kernel(s_ref, x_ref, y_ref, o_ref, flag_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        flag_ref[0] = 0
+
+    r = s_ref[0] * _f32(x_ref[...]) + s_ref[1] * _f32(y_ref[...])
+    o_ref[...] = r.astype(o_ref.dtype)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(r))).astype(jnp.int32)
+    flag_ref[0] = jnp.maximum(flag_ref[0], bad)
+
+
+def flat_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
+    """out = a*x + b*y over flat buffers; returns (out, found_inf)."""
+    out_dtype = out_dtype or x.dtype
+    if not pallas_enabled():
+        return flat_axpby_ref(a, x, b, y, out_dtype)
+    x2d, n = _as_tiles(x)
+    y2d, _ = _as_tiles(y)
+    s = jnp.stack([jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)])
+    out, flag = pl.pallas_call(
+        _axpby_kernel,
+        grid=(_grid(x2d.shape[0]),),
+        in_specs=[_smem_spec(), _vec_spec(), _vec_spec()],
+        out_specs=[_vec_spec(), _scalar_out_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2d.shape, out_dtype),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_axpby",
+    )(s, x2d, y2d)
+    return _from_tiles(out, n), flag[0]
+
+
+def flat_axpby_ref(a, x, b, y, out_dtype=None):
+    out_dtype = out_dtype or x.dtype
+    r = jnp.float32(a) * _f32(x) + jnp.float32(b) * _f32(y)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(r))).astype(jnp.int32)
+    return r.astype(out_dtype), bad
+
+
+# ---------------------------------------------------------------------------
+# L2 norm   [reference: multi_tensor_l2norm_kernel.cu]
+# ---------------------------------------------------------------------------
+
+def _l2norm_kernel(x_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[0] = jnp.float32(0.0)
+
+    x = _f32(x_ref[...])
+    acc_ref[0] += jnp.sum(x * x)
+
+
+def flat_l2norm(x: jax.Array) -> jax.Array:
+    """Global L2 norm of a flat buffer (f32 accumulation)."""
+    if not pallas_enabled():
+        return flat_l2norm_ref(x)
+    x2d, _ = _as_tiles(x)
+    acc = pl.pallas_call(
+        _l2norm_kernel,
+        grid=(_grid(x2d.shape[0]),),
+        in_specs=[_vec_spec()],
+        out_specs=_scalar_out_spec(),
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.float32),
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_l2norm",
+    )(x2d)
+    return jnp.sqrt(acc[0])
+
+
+def flat_l2norm_ref(x):
+    x = _f32(x)
+    return jnp.sqrt(jnp.sum(x * x))
+
+
+# ---------------------------------------------------------------------------
+# Adam / AdamW step   [reference: multi_tensor_adam.cu]
+# ---------------------------------------------------------------------------
+
+def _adam_kernel(adam_w_mode, s_ref, p_ref, g_ref, m_ref, v_ref,
+                 po_ref, mo_ref, vo_ref):
+    lr, b1, b2, eps, wd, c1r, c2r, inv_scale = (
+        s_ref[0], s_ref[1], s_ref[2], s_ref[3],
+        s_ref[4], s_ref[5], s_ref[6], s_ref[7],
+    )
+    p = _f32(p_ref[...])
+    g = _f32(g_ref[...]) * inv_scale
+    if not adam_w_mode:  # classic Adam: L2 term folded into the gradient
+        g = g + wd * p
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    update = (m * c1r) / (jnp.sqrt(v * c2r) + eps)
+    if adam_w_mode:  # decoupled weight decay
+        update = update + wd * p
+    po_ref[...] = (p - lr * update).astype(po_ref.dtype)
+    mo_ref[...] = m
+    vo_ref[...] = v
+
+
+def flat_adam(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay, step,
+              adam_w_mode: bool = True, bias_correction: bool = True,
+              grad_scale=1.0):
+    """One fused Adam/AdamW step over flat buffers.
+
+    p may be bf16 or f32; m/v must be f32.  ``step`` is the 1-based step
+    count (traced scalar ok).  Returns (p, m, v).
+    """
+    if not pallas_enabled():
+        return flat_adam_ref(
+            p, g, m, v, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+            weight_decay=weight_decay, step=step, adam_w_mode=adam_w_mode,
+            bias_correction=bias_correction, grad_scale=grad_scale)
+    step = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        c1r = 1.0 / (1.0 - jnp.asarray(beta1, jnp.float32) ** step)
+        c2r = 1.0 / (1.0 - jnp.asarray(beta2, jnp.float32) ** step)
+    else:
+        c1r = jnp.float32(1.0)
+        c2r = jnp.float32(1.0)
+    s = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32), c1r, c2r,
+        1.0 / jnp.asarray(grad_scale, jnp.float32),
+    ])
+    p2d, n = _as_tiles(p)
+    g2d, _ = _as_tiles(g)
+    m2d, _ = _as_tiles(m)
+    v2d, _ = _as_tiles(v)
+    kernel = functools.partial(_adam_kernel, adam_w_mode)
+    po, mo, vo = pl.pallas_call(
+        kernel,
+        grid=(_grid(p2d.shape[0]),),
+        in_specs=[_smem_spec()] + [_vec_spec()] * 4,
+        out_specs=[_vec_spec()] * 3,
+        out_shape=[
+            jax.ShapeDtypeStruct(p2d.shape, p.dtype),
+            jax.ShapeDtypeStruct(m2d.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v2d.shape, jnp.float32),
+        ],
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_adam",
+    )(s, p2d, g2d, m2d, v2d)
+    return _from_tiles(po, n), _from_tiles(mo, n), _from_tiles(vo, n)
+
+
+def flat_adam_ref(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay, step,
+                  adam_w_mode=True, bias_correction=True, grad_scale=1.0):
+    step = jnp.asarray(step, jnp.float32)
+    b1 = jnp.asarray(beta1, jnp.float32)
+    b2 = jnp.asarray(beta2, jnp.float32)
+    wd = jnp.asarray(weight_decay, jnp.float32)
+    pf = _f32(p)
+    gf = _f32(g) / jnp.asarray(grad_scale, jnp.float32)
+    if not adam_w_mode:
+        gf = gf + wd * pf
+    m = b1 * m + (1 - b1) * gf
+    v = b2 * v + (1 - b2) * gf * gf
+    if bias_correction:
+        c1r = 1.0 / (1.0 - b1 ** step)
+        c2r = 1.0 / (1.0 - b2 ** step)
+    else:
+        c1r = c2r = jnp.float32(1.0)
+    update = (m * c1r) / (jnp.sqrt(v * c2r) + jnp.asarray(eps, jnp.float32))
+    if adam_w_mode:
+        update = update + wd * pf
+    return (pf - jnp.asarray(lr, jnp.float32) * update).astype(p.dtype), m, v
+
+
+# ---------------------------------------------------------------------------
+# SGD (momentum/nesterov/wd) step   [reference: multi_tensor_sgd_kernel.cu]
+# ---------------------------------------------------------------------------
+
+def _sgd_kernel(nesterov, use_momentum, first_run,
+                s_ref, p_ref, g_ref, b_ref, po_ref, bo_ref):
+    lr, momentum, dampening, wd, inv_scale = (
+        s_ref[0], s_ref[1], s_ref[2], s_ref[3], s_ref[4])
+    p = _f32(p_ref[...])
+    g = _f32(g_ref[...]) * inv_scale + wd * p
+    if use_momentum:
+        if first_run:
+            buf = g
+        else:
+            buf = momentum * b_ref[...] + (1.0 - dampening) * g
+        step_dir = (g + momentum * buf) if nesterov else buf
+        bo_ref[...] = buf
+    else:
+        step_dir = g
+        bo_ref[...] = b_ref[...]
+    po_ref[...] = (p - lr * step_dir).astype(po_ref.dtype)
+
+
+def flat_sgd(p, g, momentum_buf, *, lr, momentum=0.0, dampening=0.0,
+             weight_decay=0.0, nesterov=False, first_run=False,
+             grad_scale=1.0):
+    """One fused SGD step over flat buffers; returns (p, momentum_buf)."""
+    if not pallas_enabled():
+        return flat_sgd_ref(
+            p, g, momentum_buf, lr=lr, momentum=momentum, dampening=dampening,
+            weight_decay=weight_decay, nesterov=nesterov, first_run=first_run,
+            grad_scale=grad_scale)
+    s = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(momentum, jnp.float32),
+        jnp.asarray(dampening, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        1.0 / jnp.asarray(grad_scale, jnp.float32),
+    ])
+    p2d, n = _as_tiles(p)
+    g2d, _ = _as_tiles(g)
+    b2d, _ = _as_tiles(momentum_buf)
+    kernel = functools.partial(
+        _sgd_kernel, bool(nesterov), momentum != 0.0, bool(first_run))
+    po, bo = pl.pallas_call(
+        kernel,
+        grid=(_grid(p2d.shape[0]),),
+        in_specs=[_smem_spec()] + [_vec_spec()] * 3,
+        out_specs=[_vec_spec()] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct(p2d.shape, p.dtype),
+            jax.ShapeDtypeStruct(b2d.shape, jnp.float32),
+        ],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_sgd",
+    )(s, p2d, g2d, b2d)
+    return _from_tiles(po, n), _from_tiles(bo, n)
+
+
+def flat_sgd_ref(p, g, momentum_buf, *, lr, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False, first_run=False,
+                 grad_scale=1.0):
+    pf = _f32(p)
+    gf = _f32(g) / jnp.asarray(grad_scale, jnp.float32)
+    gf = gf + jnp.asarray(weight_decay, jnp.float32) * pf
+    mom = jnp.asarray(momentum, jnp.float32)
+    if momentum != 0.0:
+        if first_run:
+            buf = gf
+        else:
+            buf = mom * momentum_buf + (1 - jnp.asarray(dampening, jnp.float32)) * gf
+        step_dir = gf + mom * buf if nesterov else buf
+    else:
+        buf = momentum_buf
+        step_dir = gf
+    return (pf - jnp.asarray(lr, jnp.float32) * step_dir).astype(p.dtype), buf

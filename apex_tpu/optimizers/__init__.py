@@ -1,0 +1,20 @@
+"""apex_tpu.optimizers — fused optimizers (reference: apex/optimizers).
+
+Each class keeps the reference's constructor surface and `step` idiom but
+is a thin stateful facade over a pure jitted pytree update
+(see _base.FusedOptimizerBase).  For fully-functional training loops, use
+``opt.functional_step`` inside your own jit, or the per-leaf math in
+apex_tpu.optimizers._functional.
+"""
+
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, FusedMixedPrecisionLamb
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad
+from apex_tpu.optimizers import _functional as functional
+
+__all__ = [
+    "FusedAdam", "FusedSGD", "FusedLAMB", "FusedMixedPrecisionLamb",
+    "FusedNovoGrad", "FusedAdagrad", "functional",
+]

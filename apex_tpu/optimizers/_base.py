@@ -1,0 +1,128 @@
+"""Shared machinery for the fused optimizer facades.
+
+The reference optimizers subclass torch.optim.Optimizer and mutate params
+in place via one multi-tensor launch (e.g. apex/optimizers/fused_adam.py,
+SURVEY.md §3.3).  The JAX facade keeps that class shape — construct with a
+params pytree, call ``step(grads)`` — but is a thin stateful wrapper over
+a pure, jitted ``(params, opt_state, grads, scalars) -> (params,
+opt_state)`` function, so the same math can also be embedded directly in a
+user's jitted train step via the ``functional_step`` attribute.
+
+Master weights: when params are bf16/fp16 and ``master_weights=True`` the
+facade keeps f32 masters, steps those, and writes back model-dtype params
+(reference O2 contract, apex/amp/_process_optimizer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+tree_map = jax.tree_util.tree_map
+
+
+def _is_low_precision(tree) -> bool:
+    return any(l.dtype in (jnp.bfloat16, jnp.float16)
+               for l in jax.tree_util.tree_leaves(tree)
+               if jnp.issubdtype(l.dtype, jnp.floating))
+
+
+class FusedOptimizerBase:
+    """Subclasses set ``defaults`` and implement ``_step_math``."""
+
+    def __init__(self, params: Pytree, master_weights: Optional[bool] = None,
+                 **hypers):
+        self.hypers: Dict[str, Any] = dict(self.defaults)
+        unknown = set(hypers) - set(self.hypers)
+        if unknown:
+            raise TypeError(f"unexpected arguments {sorted(unknown)}")
+        self.hypers.update(hypers)
+        if master_weights is None:
+            master_weights = _is_low_precision(params)
+        self.master_weights = master_weights and _is_low_precision(params)
+        self.params = params
+        masters = None
+        if self.master_weights:
+            masters = tree_map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        self.masters = masters
+        self.opt_state = self.init_state(masters if masters is not None
+                                         else params)
+        self.step_count = jnp.int32(0)
+        self._jit_step = jax.jit(self._full_step)
+
+    # ---- functional core -------------------------------------------------
+    def init_state(self, params: Pytree) -> Pytree:
+        raise NotImplementedError
+
+    def _step_math(self, params, grads, opt_state, step, grad_scale, hypers):
+        """Pure update on the (possibly master) params."""
+        raise NotImplementedError
+
+    def _full_step(self, params, masters, opt_state, grads, step, grad_scale,
+                   hypers):
+        work = masters if masters is not None else params
+        new_work, opt_state = self._step_math(
+            work, grads, opt_state, step, grad_scale, hypers)
+        if masters is not None:
+            new_params = tree_map(lambda p, m: m.astype(p.dtype)
+                                  if jnp.issubdtype(p.dtype, jnp.floating)
+                                  else m, params, new_work)
+            return new_params, new_work, opt_state
+        return new_work, None, opt_state
+
+    def functional_step(self, params, opt_state, grads, step, grad_scale=1.0):
+        """Embed-in-your-own-jit entry point (no master handling)."""
+        return self._step_math(params, grads, opt_state, step,
+                               jnp.asarray(grad_scale, jnp.float32),
+                               dict(self.hypers))
+
+    # ---- stateful facade -------------------------------------------------
+    def step(self, grads: Pytree, grad_scale=1.0) -> Pytree:
+        """Apply one update; returns (and stores) the new params."""
+        self.step_count = self.step_count + 1
+        self.params, self.masters, self.opt_state = self._jit_step(
+            self.params, self.masters, self.opt_state, grads,
+            self.step_count, jnp.asarray(grad_scale, jnp.float32),
+            {k: jnp.asarray(v, jnp.float32) if isinstance(v, float) else v
+             for k, v in self.hypers.items()
+             if isinstance(v, (int, float)) and not isinstance(v, bool)})
+        return self.params
+
+    def zero_grad(self):
+        """No-op for parity: JAX grads are freshly computed, never stored."""
+
+    # ---- serialization (torch Optimizer.state_dict shape) ---------------
+    def state_dict(self):
+        return {
+            "step": int(self.step_count),
+            "hypers": dict(self.hypers),
+            "state": self.opt_state,
+            "masters": self.masters,
+        }
+
+    def load_state_dict(self, sd):
+        self.step_count = jnp.int32(sd["step"])
+        self.hypers.update(sd["hypers"])
+        self.opt_state = sd["state"]
+        if sd.get("masters") is not None:
+            self.masters = sd["masters"]
+
+    # hyper access in the torch param_group idiom: opt.lr = ...
+    @property
+    def lr(self):
+        return self.hypers["lr"]
+
+    @lr.setter
+    def lr(self, value):
+        self.hypers["lr"] = value
+
+    def _merge_hypers(self, traced_hypers):
+        """Traced float hypers override statics inside the jitted step."""
+        merged = dict(self.hypers)
+        merged.update(traced_hypers)
+        return merged

@@ -1,0 +1,33 @@
+"""FusedAdagrad (reference: apex/optimizers/fused_adagrad.py);
+cf. csrc/multi_tensor_adagrad.cu."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers import _functional as F
+from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map
+
+
+class FusedAdagrad(FusedOptimizerBase):
+    defaults = dict(lr=1e-2, eps=1e-10, weight_decay=0.0,
+                    adagrad_w_mode=False, set_grad_none=True)
+
+    def init_state(self, params):
+        return {"sum": tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)}
+
+    def _step_math(self, params, grads, opt_state, step, grad_scale, hypers):
+        h = self._merge_hypers(hypers)
+
+        def leaf(p, g, s):
+            return F.adagrad_step(p, g, s, lr=h["lr"], eps=h["eps"],
+                                  weight_decay=h["weight_decay"],
+                                  grad_scale=grad_scale)
+
+        out = tree_map(leaf, params, grads, opt_state["sum"])
+        new_p = tree_map(lambda o: o[0], out,
+                         is_leaf=lambda x: isinstance(x, tuple))
+        new_s = tree_map(lambda o: o[1], out,
+                         is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {"sum": new_s}

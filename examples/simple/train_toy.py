@@ -1,0 +1,73 @@
+"""Minimal end-to-end training with apex_tpu (reference: examples/simple).
+
+A user-style script: tiny MLP regression, amp O2 (bf16 params + f32
+masters + loss scaling), FusedAdam, FusedLayerNorm — the whole train step
+jitted, scaler-driven skip logic on device.
+"""
+
+import jax
+import jax.numpy as jnp
+
+import apex_tpu
+from apex_tpu import amp
+from apex_tpu.normalization import fused_layer_norm
+from apex_tpu.optimizers import FusedAdam
+
+
+def init_params(key, din=64, dh=128, dout=1):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.05,
+        "b1": jnp.zeros((dh,)),
+        "ln_w": jnp.ones((dh,)),
+        "ln_b": jnp.zeros((dh,)),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.05,
+        "b2": jnp.zeros((dout,)),
+    }
+
+
+def forward(params, x):
+    h = x @ params["w1"] + params["b1"]
+    h = fused_layer_norm(h, params["ln_w"], params["ln_b"])
+    h = jax.nn.relu(h)
+    return h @ params["w2"] + params["b2"]
+
+
+def main():
+    print(f"apex_tpu {apex_tpu.__version__} on {jax.default_backend()}")
+    key = jax.random.key(0)
+    params = init_params(key)
+
+    # amp O2: bf16 model weights, f32 masters, loss scaling
+    params, amp_state = amp.initialize(params, opt_level="O2",
+                                       loss_scale="dynamic")
+    opt = FusedAdam(params, lr=1e-2, weight_decay=1e-4)
+
+    xk, yk = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(xk, (256, 64))
+    y = jnp.sum(x[:, :4], axis=1, keepdims=True) + \
+        0.1 * jax.random.normal(yk, (256, 1))
+
+    def loss_fn(p, x, y):
+        pred = forward(p, x.astype(jnp.bfloat16))
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    losses = []
+    for step in range(60):
+        loss, grads, found_inf = amp.scaled_value_and_grad(
+            loss_fn, amp_state.scaler, opt.params, x, y)
+        if int(found_inf) == 0:
+            opt.step(grads)
+        amp_state = amp.update_scaler(amp_state, found_inf)
+        losses.append(float(loss))
+        if step % 10 == 0:
+            print(f"step {step:3d} loss {losses[-1]:.4f} "
+                  f"scale {float(amp_state.scaler.loss_scale):.0f} "
+                  f"inf {int(found_inf)}")
+
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    print(f"OK: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+
+if __name__ == "__main__":
+    main()

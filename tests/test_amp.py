@@ -1,0 +1,139 @@
+"""amp frontend + dynamic loss scaler semantics.
+
+Reference test models: tests/L0/run_amp/* (SURVEY.md §4) — opt-level
+property resolution, scaler grow/backoff behavior, state_dict round-trip,
+and a tiny end-to-end train step with conditional skip.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+def test_opt_level_tables():
+    p0 = amp.opt_level_properties("O0")
+    assert p0.cast_model_type is None and p0.loss_scale == 1.0
+    p2 = amp.opt_level_properties("O2")
+    assert p2.cast_model_type == jnp.bfloat16
+    assert p2.master_weights is True
+    # fp16 selects dynamic scaling; bf16 defaults static
+    p2h = amp.opt_level_properties("O2", half_dtype=jnp.float16)
+    assert p2h.loss_scale == "dynamic"
+    with pytest.raises(ValueError):
+        amp.opt_level_properties("O9")
+
+
+def test_initialize_o2_casts_and_keeps_masters():
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,)),
+              "step": jnp.int32(0)}
+    cast, state = amp.initialize(params, opt_level="O2")
+    assert cast["w"].dtype == jnp.bfloat16
+    assert cast["step"].dtype == jnp.int32  # non-float untouched
+    assert state.master_params["w"].dtype == jnp.float32
+
+
+def test_initialize_o1_noop_params():
+    params = {"w": jnp.ones((2,))}
+    cast, state = amp.initialize(params, opt_level="O1")
+    assert cast["w"].dtype == jnp.float32
+    assert state.master_params is None
+
+
+def test_scaler_growth_and_backoff():
+    cfg = amp.LossScaleConfig(init_scale=8.0, growth_interval=3)
+    s = amp.LossScaleState.create(8.0)
+    # clean steps grow after interval
+    for _ in range(3):
+        s = amp.update_state(s, jnp.int32(0), cfg)
+    assert float(s.loss_scale) == 16.0
+    assert int(s.growth_tracker) == 0
+    # overflow halves and resets tracker
+    s = amp.update_state(s, jnp.int32(1), cfg)
+    assert float(s.loss_scale) == 8.0
+    assert int(s.growth_tracker) == 0
+
+
+def test_scaler_min_clamp():
+    cfg = amp.LossScaleConfig(init_scale=1.0, min_loss_scale=1.0)
+    s = amp.LossScaleState.create(1.0)
+    s = amp.update_state(s, jnp.int32(1), cfg)
+    assert float(s.loss_scale) == 1.0
+
+
+def test_state_dict_roundtrip():
+    params = {"w": jnp.ones((2,))}
+    _, state = amp.initialize(params, opt_level="O2",
+                              half_dtype=jnp.float16)
+    sd = state.state_dict()
+    assert sd["loss_scaler0"]["loss_scale"] == 2.0 ** 16
+    state2 = state.load_state_dict(
+        {"loss_scaler0": {"loss_scale": 4.0, "unskipped": 7}})
+    assert float(state2.scaler.loss_scale) == 4.0
+    assert int(state2.scaler.growth_tracker) == 7
+
+
+def test_scaled_value_and_grad_and_conditional_step():
+    params = {"w": jnp.asarray(2.0)}
+
+    def loss_fn(p, x):
+        return (p["w"] * x - 1.0) ** 2
+
+    scaler = amp.LossScaleState.create(1024.0)
+    loss, grads, found_inf = amp.scaled_value_and_grad(
+        loss_fn, scaler, params, 3.0)
+    # grads come back UNscaled
+    np.testing.assert_allclose(float(grads["w"]), 2 * (2 * 3 - 1) * 3,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(loss), (2 * 3 - 1) ** 2, rtol=1e-6)
+    assert int(found_inf) == 0
+
+    def step_fn(p, s):
+        return {"w": p["w"] - 0.1}, s
+
+    # finite: step applies
+    p2, _, s2 = amp.conditional_step(scaler, found_inf, step_fn, params, None)
+    np.testing.assert_allclose(float(p2["w"]), 1.9)
+    # overflow: step skipped, scale halves
+    p3, _, s3 = amp.conditional_step(scaler, jnp.int32(1), step_fn,
+                                     params, None)
+    np.testing.assert_allclose(float(p3["w"]), 2.0)
+    assert float(s3.loss_scale) == 512.0
+
+
+def test_overflow_detection_in_grads():
+    def loss_fn(p, x):
+        return jnp.log(p["w"] * x)  # w*x <= 0 -> nan/inf grads
+
+    scaler = amp.LossScaleState.create(2.0)
+    params = {"w": jnp.asarray(0.0)}
+    _, grads, found_inf = amp.scaled_value_and_grad(loss_fn, scaler,
+                                                    params, 1.0)
+    assert int(found_inf) == 1
+
+
+def test_conditional_step_jits():
+    """The whole skip-or-step path must trace into one jitted program."""
+    def train_step(params, scaler, x):
+        def loss_fn(p, x):
+            return (p["w"] * x) ** 2
+        loss, grads, found_inf = amp.scaled_value_and_grad(
+            loss_fn, scaler, params, x)
+
+        def step_fn(p, s):
+            return jax.tree_util.tree_map(
+                lambda a, g: a - 0.1 * g, p, grads), s
+
+        params, _, scaler = amp.conditional_step(
+            scaler, found_inf, step_fn, params, None)
+        return params, scaler, loss
+
+    params = {"w": jnp.asarray(1.0)}
+    scaler = amp.LossScaleState.create(16.0)
+    jitted = jax.jit(train_step)
+    params, scaler, loss = jitted(params, scaler, 2.0)
+    assert np.isfinite(float(loss))

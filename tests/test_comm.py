@@ -1,0 +1,53 @@
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu import comm
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_initialize_shapes():
+    m = comm.initialize(data=2, pipe=2, ctx=1, model=2)
+    assert m.devices.shape == (2, 2, 1, 2)
+    assert comm.data_parallel_size() == 2
+    assert comm.model_parallel_size() == 2
+    assert comm.pipeline_parallel_size() == 2
+    assert comm.num_devices() == 8
+
+
+def test_auto_data_axis():
+    comm.initialize(model=4)
+    assert comm.data_parallel_size() == 2
+
+
+def test_bad_shape_raises():
+    with pytest.raises(ValueError):
+        comm.initialize(data=3, model=3)
+
+
+def test_psum_over_data_axis(mesh8):
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    x = np.arange(8.0, dtype=np.float32)
+
+    def f(x):
+        return jax.lax.psum(x, comm.AXIS_MODEL)
+
+    y = jax.jit(shard_map(
+        f, mesh=mesh8,
+        in_specs=P(comm.AXIS_MODEL),
+        out_specs=P(comm.AXIS_MODEL)))(x)
+    # model axis is 4 wide; groups (0..3) and (4..7) under dp=2 ordering
+    assert y.shape == (8,)
+
+
+def test_use_mesh_restores():
+    m = comm.initialize(data=8)
+    with comm.use_mesh(m):
+        assert comm.data_parallel_size() == 8
+    comm.destroy()
+    assert not comm.is_initialized()

@@ -1,0 +1,146 @@
+"""Pallas multi-tensor kernels vs jnp oracles.
+
+Mirrors the reference's dominant test pattern (SURVEY.md §4): fused kernel
+vs stock oracle, allclose under per-dtype tolerances, over a small
+shape x dtype grid.  Kernels run in interpreter mode on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import multi_tensor as mt
+from apex_tpu.multi_tensor_apply import (flatten, unflatten,
+                                         multi_tensor_applier)
+
+SIZES = [1, 100, 128, 1024, 5000]
+DTYPES = [jnp.float32, jnp.bfloat16]
+
+
+def tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", SIZES)
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_flat_scale(n, dtype):
+    x = jax.random.normal(jax.random.key(0), (n,), jnp.float32).astype(dtype)
+    out, flag = mt.flat_scale(x, 2.5)
+    ref, rflag = mt.flat_scale_ref(x, 2.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), **tol(dtype))
+    assert int(flag) == int(rflag) == 0
+
+
+def test_flat_scale_detects_inf():
+    x = jnp.array([1.0, jnp.inf, 3.0], jnp.float32)
+    _, flag = mt.flat_scale(x, 1.0)
+    assert int(flag) == 1
+    x = jnp.array([1.0, jnp.nan], jnp.float32)
+    _, flag = mt.flat_scale(x, 1.0)
+    assert int(flag) == 1
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_flat_axpby(n):
+    k1, k2 = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(k1, (n,))
+    y = jax.random.normal(k2, (n,))
+    out, flag = mt.flat_axpby(0.5, x, -1.5, y)
+    ref, _ = mt.flat_axpby_ref(0.5, x, -1.5, y)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    assert int(flag) == 0
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_flat_l2norm(n):
+    x = jax.random.normal(jax.random.key(2), (n,))
+    got = mt.flat_l2norm(x)
+    want = mt.flat_l2norm_ref(x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("adam_w", [True, False])
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_flat_adam_matches_ref(adam_w, dtype):
+    n = 3000
+    keys = jax.random.split(jax.random.key(3), 4)
+    p = jax.random.normal(keys[0], (n,), jnp.float32).astype(dtype)
+    g = jax.random.normal(keys[1], (n,), jnp.float32).astype(dtype)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.01, step=1, adam_w_mode=adam_w)
+    po, mo, vo = mt.flat_adam(p, g, m, v, **kw)
+    pr, mr, vr = mt.flat_adam_ref(p, g, m, v, **kw)
+    np.testing.assert_allclose(np.asarray(po, np.float32),
+                               np.asarray(pr, np.float32), **tol(dtype))
+    np.testing.assert_allclose(mo, mr, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(vo, vr, rtol=1e-5, atol=1e-6)
+
+
+def test_flat_adam_matches_torch_adamw():
+    torch = pytest.importorskip("torch")
+    n = 512
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(n).astype(np.float32)
+    g0 = rng.randn(n).astype(np.float32)
+
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    opt = torch.optim.AdamW([tp], lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                            weight_decay=0.01)
+    tp.grad = torch.tensor(g0)
+    opt.step()
+
+    p = jnp.asarray(p0)
+    g = jnp.asarray(g0)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    po, _, _ = mt.flat_adam(p, g, m, v, lr=1e-3, beta1=0.9, beta2=0.999,
+                            eps=1e-8, weight_decay=0.01, step=1,
+                            adam_w_mode=True)
+    np.testing.assert_allclose(np.asarray(po), tp.detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("momentum,nesterov", [(0.0, False), (0.9, False),
+                                               (0.9, True)])
+def test_flat_sgd_matches_torch(momentum, nesterov):
+    torch = pytest.importorskip("torch")
+    n = 257
+    rng = np.random.RandomState(1)
+    p0 = rng.randn(n).astype(np.float32)
+
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    opt = torch.optim.SGD([tp], lr=0.1, momentum=momentum,
+                          nesterov=nesterov, weight_decay=1e-4)
+    p = jnp.asarray(p0)
+    buf = jnp.zeros((n,), jnp.float32)
+    for step in range(3):
+        g0 = rng.randn(n).astype(np.float32)
+        tp.grad = torch.tensor(g0)
+        opt.step()
+        p, buf = mt.flat_sgd(p, jnp.asarray(g0), buf, lr=0.1,
+                             momentum=momentum, nesterov=nesterov,
+                             weight_decay=1e-4, first_run=(step == 0))
+    np.testing.assert_allclose(np.asarray(p), tp.detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flatten_unflatten_roundtrip():
+    ts = [jnp.arange(6.0).reshape(2, 3), jnp.ones((4,)), jnp.zeros((1, 1))]
+    flat = flatten(ts)
+    assert flat.shape == (11,)
+    back = unflatten(flat, ts)
+    for a, b in zip(ts, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_multi_tensor_applier_scale():
+    ts = [jnp.full((5,), 2.0), jnp.full((3, 3), -1.0)]
+    outs, flag = multi_tensor_applier(mt.flat_scale, None, [ts], 3.0)
+    np.testing.assert_allclose(outs[0], jnp.full((5,), 6.0))
+    np.testing.assert_allclose(outs[1], jnp.full((3, 3), -3.0))
+    assert int(flag) == 0
