@@ -1,0 +1,97 @@
+"""Rotary position embedding application (reference:
+csrc/megatron/fused_rotary_positional_embedding.h/.cpp, SURVEY.md §2.4).
+
+On TPU this op is pure elementwise math that XLA fuses into the
+surrounding QKV matmuls, so a hand-written kernel buys nothing; the value
+of the reference ext was avoiding CUDA launch+materialization overhead.
+We keep the fusion guarantee with a ``jax.custom_vjp`` whose backward
+applies the inverse rotation analytically (rotation matrices are
+orthogonal: the VJP is rotation by -theta), sidestepping autodiff
+residuals entirely — zero saved activations, like the reference's
+in-place backward.
+
+Layout matches the reference: t (s, b, np, hn), freqs (s, 1, 1, hn).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _rotate_half(t):
+    half = t.shape[-1] // 2
+    t1 = t[..., :half]
+    t2 = t[..., half:]
+    return jnp.concatenate([-t2, t1], axis=-1)
+
+
+def _rotate_half_interleaved(t):
+    t1 = t[..., 0::2]
+    t2 = t[..., 1::2]
+    return jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+
+
+def _rotate_half_T(t):
+    # transpose of _rotate_half: (u1, u2) -> (u2, -u1)
+    half = t.shape[-1] // 2
+    return jnp.concatenate([t[..., half:], -t[..., :half]], axis=-1)
+
+
+def _rotate_half_interleaved_T(t):
+    t1 = t[..., 0::2]
+    t2 = t[..., 1::2]
+    return jnp.stack([t2, -t1], axis=-1).reshape(t.shape)
+
+
+def _apply(t, cos, sin, interleaved):
+    rot = _rotate_half_interleaved(t) if interleaved else _rotate_half(t)
+    return t * cos + rot * sin
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_apply_rotary_pos_emb(t, freqs, interleaved=False):
+    """t (s, b, np, hn) rotated by freqs (s, 1, 1, hn); rotary dim =
+    freqs' last dim (trailing channels pass through, reference
+    behavior)."""
+    return _rope_fwd(t, freqs, interleaved)[0]
+
+
+def _split_rotary(t, freqs):
+    rot_dim = freqs.shape[-1]
+    return t[..., :rot_dim], t[..., rot_dim:]
+
+
+def _rope_fwd(t, freqs, interleaved):
+    t_rot, t_pass = _split_rotary(t, freqs)
+    cos = jnp.cos(freqs).astype(t.dtype)
+    sin = jnp.sin(freqs).astype(t.dtype)
+    y = _apply(t_rot, cos, sin, interleaved)
+    out = jnp.concatenate([y, t_pass], axis=-1) if t_pass.shape[-1] else y
+    return out, freqs
+
+
+def _rope_bwd(interleaved, freqs, dy):
+    dy_rot, dy_pass = _split_rotary(dy, freqs)
+    cos = jnp.cos(freqs).astype(dy.dtype)
+    sin = jnp.sin(freqs).astype(dy.dtype)
+    # exact transpose of y = (C + S.R) t:  dt = C dy + R^T (S dy)
+    rot_T = (_rotate_half_interleaved_T if interleaved else _rotate_half_T)
+    dt = dy_rot * cos + rot_T(dy_rot * sin)
+    if dy_pass.shape[-1]:
+        dt = jnp.concatenate([dt, dy_pass], axis=-1)
+    return dt, None
+
+
+fused_apply_rotary_pos_emb.defvjp(_rope_fwd, _rope_bwd)
+
+
+def rope_ref(t, freqs, interleaved=False):
+    """Autodiff-friendly oracle."""
+    t_rot, t_pass = _split_rotary(t, freqs)
+    cos = jnp.cos(freqs).astype(t.dtype)
+    sin = jnp.sin(freqs).astype(t.dtype)
+    y = _apply(t_rot, cos, sin, interleaved)
+    return jnp.concatenate([y, t_pass], axis=-1) if t_pass.shape[-1] else y

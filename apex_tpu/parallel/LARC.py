@@ -1,0 +1,76 @@
+"""LARC — Layer-wise Adaptive Rate Clipping (reference:
+apex/parallel/LARC.py).
+
+Wraps any apex_tpu fused optimizer: before delegating to the inner
+``step``, each leaf's gradient is rescaled by the layer's adaptive LR
+  adaptive_lr = trust_coefficient * ||p|| / (||g|| + wd * ||p|| + eps)
+clipped at the group LR when ``clip=True`` (so the effective LR never
+exceeds the scheduled one).  Weight decay is folded into the gradient
+here and zeroed in the inner optimizer for that step — the reference does
+the same dance with param_groups.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class LARC:
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    # delegate the optimizer surface
+    @property
+    def params(self):
+        return self.optim.params
+
+    def state_dict(self):
+        return self.optim.state_dict()
+
+    def load_state_dict(self, sd):
+        self.optim.load_state_dict(sd)
+
+    def zero_grad(self):
+        self.optim.zero_grad()
+
+    def _adapt(self, params, grads):
+        lr = jnp.float32(self.optim.hypers["lr"])
+        wd = jnp.float32(self.optim.hypers.get("weight_decay", 0.0))
+        trust = jnp.float32(self.trust_coefficient)
+
+        def leaf(p, g):
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(pf * pf))
+            g_norm = jnp.sqrt(jnp.sum(gf * gf))
+            adaptive = trust * p_norm / (g_norm + wd * p_norm + self.eps)
+            # undefined ratio (zero norms) -> no adaptation, as reference
+            adaptive = jnp.where((p_norm > 0) & (g_norm > 0), adaptive, 1.0)
+            if self.clip:
+                adaptive = jnp.minimum(adaptive / lr, 1.0)
+            return ((gf + wd * pf) * adaptive).astype(g.dtype)
+
+        return jax.tree_util.tree_map(leaf, params, grads)
+
+    def step(self, grads, grad_scale=1.0):
+        work = self.optim.masters if self.optim.masters is not None \
+            else self.optim.params
+        # Unscale BEFORE adapting: the trust ratio and the folded-in decay
+        # must see true gradients, not loss-scaled ones.
+        if grad_scale != 1.0:
+            inv = 1.0 / jnp.float32(grad_scale)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                grads)
+        grads = self._adapt(work, grads)
+        saved_wd = self.optim.hypers.get("weight_decay", 0.0)
+        self.optim.hypers["weight_decay"] = 0.0
+        try:
+            return self.optim.step(grads, grad_scale=1.0)
+        finally:
+            self.optim.hypers["weight_decay"] = saved_wd

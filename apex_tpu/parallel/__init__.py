@@ -1,0 +1,28 @@
+"""apex_tpu.parallel (reference: apex/parallel).
+
+Data-parallel utilities over the global mesh's "data" axis: DDP-shaped
+gradient reduction, SyncBatchNorm with cross-device Welford stats, LARC.
+``multiproc`` has no TPU analog (SPMD replaces process-per-GPU launch);
+``jax.distributed.initialize()`` is the multi-host entry point.
+"""
+
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    all_reduce_gradients,
+    broadcast_params,
+    flat_dist_call,
+)
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    convert_syncbn_model,
+    sync_batch_norm_stats,
+)
+from apex_tpu.parallel.LARC import LARC
+
+__all__ = [
+    "DistributedDataParallel", "Reducer", "all_reduce_gradients",
+    "broadcast_params", "flat_dist_call",
+    "SyncBatchNorm", "convert_syncbn_model", "sync_batch_norm_stats",
+    "LARC",
+]

@@ -1,0 +1,142 @@
+"""Data-parallel training utilities (reference: apex/parallel/distributed.py).
+
+The reference DDP registers per-parameter backward hooks, buckets grads,
+and overlaps NCCL all-reduce with the rest of backward (SURVEY.md §3.4).
+Under SPMD on TPU that whole mechanism disappears: the train step runs
+inside shard_map/pjit over the "data" mesh axis, gradients are reduced by
+ONE psum that XLA schedules and overlaps itself.  This module keeps the
+reference's API shape on top of that reality:
+
+  - ``DistributedDataParallel`` wraps an apply_fn; its
+    ``reduce_gradients`` is the explicit psum/pmean (for shard_map-style
+    steps).  Bucketing knobs (message_size, delay_allreduce,
+    allreduce_trigger_params) are accepted and ignored — XLA's collective
+    scheduler owns that decision.
+  - ``flat_dist_call`` / ``broadcast_params`` mirror the ctor broadcast.
+  - ``Reducer`` is the raw-reduction facade.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+
+Pytree = Any
+
+
+def _in_shard_map(axis_name: str) -> bool:
+    """True when called under shard_map/pmap with `axis_name` bound."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def all_reduce_gradients(grads: Pytree, axis_name: str = comm.AXIS_DATA,
+                         average: bool = True,
+                         gradient_predivide_factor: float = 1.0) -> Pytree:
+    """Reduce grads over the data axis (the reference's allreduce_bucket +
+    divide-by-world-size, collapsed to one fused collective).
+
+    Must be called inside shard_map/pmap with ``axis_name`` bound; if the
+    axis is not bound (pjit/GSPMD auto-reduction context) grads are
+    returned unchanged, since XLA already inserted the reduction.
+    """
+    if not _in_shard_map(axis_name):
+        return grads
+    world = jax.lax.axis_size(axis_name)
+    pre = gradient_predivide_factor
+    post = world / pre if average else 1.0 / pre
+
+    def reduce_leaf(g):
+        gf = g.astype(jnp.float32)
+        if pre != 1.0:
+            gf = gf / pre
+        gf = jax.lax.psum(gf, axis_name)
+        if post != 1.0:
+            gf = gf / post
+        return gf.astype(g.dtype)
+
+    return jax.tree_util.tree_map(reduce_leaf, grads)
+
+
+def broadcast_params(params: Pytree) -> Pytree:
+    """Ctor-time rank-0 broadcast parity.  Under SPMD, "broadcast" means
+    "replicate onto the mesh": device_put with a replicated sharding."""
+    if not comm.is_initialized():
+        return params
+    sharding = comm.replicated_sharding()
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), params)
+
+
+def flat_dist_call(tensors, op: Callable, args=None):
+    """Reference-shaped helper (flatten → collective → unflatten).  The
+    flatten step is unnecessary under XLA (collectives take pytrees), so
+    this simply maps ``op`` over the tensors."""
+    if args is not None:
+        return [op(t, *args) for t in tensors]
+    return [op(t) for t in tensors]
+
+
+class Reducer:
+    """Raw gradient reducer (reference: apex/parallel/distributed.py::
+    Reducer) — explicitly-invoked reduction, no hooks."""
+
+    def __init__(self, module_or_grads_list=None,
+                 axis_name: str = comm.AXIS_DATA):
+        self.axis_name = axis_name
+
+    def reduce(self, grads: Pytree, average: bool = True) -> Pytree:
+        return all_reduce_gradients(grads, self.axis_name, average=average)
+
+
+class DistributedDataParallel:
+    """apex.parallel.DistributedDataParallel-shaped wrapper.
+
+    Wraps an ``apply_fn(params, *args) -> out`` (or a flax module's
+    ``.apply``).  Forward is a passthrough; ``reduce_gradients`` performs
+    the data-parallel mean that the reference performed via backward-hook
+    buckets.  Intended use inside a shard_map-decorated train step:
+
+        ddp = DistributedDataParallel(model.apply)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch_shard)
+        grads = ddp.reduce_gradients(grads)
+    """
+
+    def __init__(self, apply_fn: Callable = None,
+                 message_size: int = 10_000_000,
+                 delay_allreduce: bool = False,
+                 shared_param: Optional[bool] = None,
+                 allreduce_trigger_params=None,
+                 retain_allreduce_buffers: bool = False,
+                 allreduce_always_fp32: bool = False,
+                 gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 axis_name: str = comm.AXIS_DATA):
+        # bucketing/overlap knobs accepted for parity; XLA owns scheduling
+        del message_size, delay_allreduce, shared_param
+        del allreduce_trigger_params, retain_allreduce_buffers
+        self.apply_fn = apply_fn
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.axis_name = axis_name
+
+    def __call__(self, *args, **kwargs):
+        return self.apply_fn(*args, **kwargs)
+
+    def reduce_gradients(self, grads: Pytree) -> Pytree:
+        if self.allreduce_always_fp32:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+        return all_reduce_gradients(
+            grads, self.axis_name, average=self.gradient_average,
+            gradient_predivide_factor=self.gradient_predivide_factor)

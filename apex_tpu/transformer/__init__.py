@@ -1,0 +1,21 @@
+"""apex_tpu.transformer — Megatron-style parallelism stack (reference:
+apex/transformer, SURVEY.md §2.2)."""
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer import pipeline_parallel
+from apex_tpu.transformer import functional
+from apex_tpu.transformer import amp
+from apex_tpu.transformer.enums import (AttnMaskType, AttnType, LayerType,
+                                        ModelType)
+from apex_tpu.transformer.log_util import (get_transformer_logger,
+                                           set_logging_level)
+from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
+
+__all__ = [
+    "parallel_state", "tensor_parallel", "pipeline_parallel", "functional",
+    "amp",
+    "AttnMaskType", "AttnType", "LayerType", "ModelType",
+    "get_transformer_logger", "set_logging_level",
+    "build_num_microbatches_calculator",
+]

@@ -1,0 +1,3 @@
+from apex_tpu.transformer.amp.grad_scaler import GradScaler
+
+__all__ = ["GradScaler"]

@@ -1,0 +1,40 @@
+"""Grad scaler variant used by the transformer stack (reference:
+apex/transformer/amp/grad_scaler.py).
+
+The reference subclasses torch.cuda.amp.GradScaler to all-reduce the
+found_inf flag across the model-parallel group (so every pipeline/tensor
+rank skips in lockstep).  Here the flag is already a traced value; the
+sync is a pmax over every bound mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
+                                 LossScaler, update_state)
+
+
+def sync_found_inf(found_inf, axes=(comm.AXIS_MODEL, comm.AXIS_PIPE,
+                                    comm.AXIS_DATA)):
+    """Max-reduce the overflow flag over all bound parallel axes."""
+    for ax in axes:
+        try:
+            found_inf = jax.lax.pmax(found_inf, ax)
+        except Exception:
+            pass
+    return found_inf
+
+
+class GradScaler(LossScaler):
+    """LossScaler whose update first syncs found_inf across the mesh."""
+
+    def update_scale(self, found_inf):
+        found_inf = sync_found_inf(jnp.asarray(found_inf, jnp.int32))
+        self.state = update_state(self.state, found_inf, self.config)
+
+
+__all__ = ["GradScaler", "sync_found_inf", "LossScaleState",
+           "LossScaleConfig"]

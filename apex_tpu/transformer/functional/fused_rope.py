@@ -1,0 +1,17 @@
+"""Fused RoPE wrappers (reference:
+apex/transformer/functional/fused_rope.py)."""
+
+from apex_tpu.ops.rope import fused_apply_rotary_pos_emb, rope_ref
+
+
+def fused_apply_rotary_pos_emb_cached(t, cos, sin, interleaved=False):
+    """Variant taking precomputed cos/sin (reference cached API).
+
+    cos/sin: (s, 1, 1, hn)."""
+    import jax.numpy as jnp
+    freqs = jnp.arctan2(sin.astype(jnp.float32), cos.astype(jnp.float32))
+    return fused_apply_rotary_pos_emb(t, freqs, interleaved)
+
+
+__all__ = ["fused_apply_rotary_pos_emb",
+           "fused_apply_rotary_pos_emb_cached", "rope_ref"]

@@ -1,0 +1,70 @@
+"""FusedScaleMaskSoftmax (reference:
+apex/transformer/functional/fused_softmax.py).
+
+Stateless callable with the reference's constructor surface, dispatching
+to the Pallas kernels in apex_tpu.ops.softmax (causal → the
+upper-triang variant, padding → the masked variant) with the same
+eligibility logic idea (kernel when shapes allow, generic XLA path
+otherwise).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import softmax as softmax_ops
+from apex_tpu.transformer.enums import AttnMaskType
+
+
+class FusedScaleMaskSoftmax:
+    def __init__(self,
+                 input_in_fp16: bool = False,
+                 input_in_bf16: bool = True,
+                 attn_mask_type: AttnMaskType = AttnMaskType.padding,
+                 scaled_masked_softmax_fusion: bool = True,
+                 mask_func: Optional[Callable] = None,
+                 softmax_in_fp32: bool = True,
+                 scale: Optional[float] = None):
+        assert not (input_in_fp16 and input_in_bf16), \
+            "both fp16 and bf16 flags cannot be active at the same time."
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        assert self.scale is None or softmax_in_fp32, \
+            "softmax should be in fp32 when scaled"
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        return (self.scaled_masked_softmax_fusion
+                and sk % 128 == 0 and sk <= softmax_ops._MAX_SK)
+
+    def __call__(self, x, mask=None):
+        scale = self.scale if self.scale is not None else 1.0
+        b, np_, sq, sk = x.shape
+        if self.attn_mask_type == AttnMaskType.causal:
+            # the reference asserts squareness here too — a silent
+            # fall-through would drop causality entirely
+            assert sq == sk, \
+                "causal mask requires square attention (sq == sk)"
+            y = softmax_ops.scaled_upper_triang_masked_softmax(
+                x.reshape(-1, sq, sk), scale)
+            return y.reshape(x.shape)
+        if self.mask_func is not None and mask is not None and \
+                not self.scaled_masked_softmax_fusion:
+            # reference "torch fallback": user mask_func + plain softmax
+            xf = self.mask_func(x.astype(jnp.float32) * scale, mask)
+            import jax
+            return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
+        return softmax_ops.scaled_masked_softmax(x, mask, scale)
+
+
+scaled_masked_softmax = softmax_ops.scaled_masked_softmax
+scaled_upper_triang_masked_softmax = \
+    softmax_ops.scaled_upper_triang_masked_softmax
+generic_scaled_masked_softmax = softmax_ops.generic_scaled_masked_softmax

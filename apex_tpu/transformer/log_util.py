@@ -1,0 +1,21 @@
+"""Logging helpers (reference: apex/transformer/log_util.py)."""
+
+import logging
+import os
+
+_LOGGER_NAME = "apex_tpu.transformer"
+
+
+def get_transformer_logger(name: str = _LOGGER_NAME) -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def set_logging_level(verbosity) -> None:
+    """Reference contract: set the package logger's level; also honors the
+    APEX_TPU_LOG_LEVEL env var at import."""
+    logging.getLogger(_LOGGER_NAME).setLevel(verbosity)
+
+
+_env_level = os.environ.get("APEX_TPU_LOG_LEVEL")
+if _env_level:
+    set_logging_level(_env_level)

@@ -1,0 +1,100 @@
+"""Microbatch calculators (reference: apex/transformer/microbatches.py).
+
+Constant and rampup-capable calculators deciding how many microbatches a
+global batch splits into, given data-parallel size — pure bookkeeping,
+identical math to the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class NumMicroBatchesCalculator:
+    def __init__(self):
+        self.num_micro_batches = None
+        self.current_global_batch_size = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        pass
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        super().__init__()
+        micro_batch_times_dp = micro_batch_size * data_parallel_size
+        assert global_batch_size % micro_batch_times_dp == 0, (
+            f"global batch size ({global_batch_size}) is not divisible by "
+            f"micro batch size ({micro_batch_size}) times data parallel "
+            f"size ({data_parallel_size})")
+        self.num_micro_batches = global_batch_size // micro_batch_times_dp
+        assert self.num_micro_batches >= 1
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 ramup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        super().__init__()
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        assert self.micro_batch_times_data_parallel_size > 0
+        assert start_batch_size % self.micro_batch_times_data_parallel_size \
+            == 0
+        diff = global_batch_size - start_batch_size
+        assert diff >= 0 and diff % batch_size_increment == 0
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool) -> None:
+        if consumed_samples > self.ramup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples * (self.global_batch_size -
+                                            self.start_batch_size) /
+                        self.ramup_samples / self.batch_size_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment)
+            self.current_global_batch_size = (
+                self.current_global_batch_size //
+                self.micro_batch_times_data_parallel_size *
+                self.micro_batch_times_data_parallel_size)
+            self.current_global_batch_size = max(
+                self.current_global_batch_size,
+                self.micro_batch_times_data_parallel_size)
+        if consistency_check:
+            assert self.current_global_batch_size % \
+                self.micro_batch_times_data_parallel_size == 0
+        self.num_micro_batches = (
+            self.current_global_batch_size //
+            self.micro_batch_times_data_parallel_size)
+
+
+def build_num_microbatches_calculator(
+        rank: int = 0,
+        rampup_batch_size: Optional[List[int]] = None,
+        global_batch_size: int = 1,
+        micro_batch_size: int = 1,
+        data_parallel_size: int = 1) -> NumMicroBatchesCalculator:
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(global_batch_size, micro_batch_size,
+                                       data_parallel_size)
+    assert len(rampup_batch_size) == 3
+    return RampupBatchsizeNumMicroBatches(
+        int(rampup_batch_size[0]), int(rampup_batch_size[1]),
+        int(rampup_batch_size[2]), global_batch_size, micro_batch_size,
+        data_parallel_size)

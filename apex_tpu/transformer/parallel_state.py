@@ -1,0 +1,168 @@
+"""Model-parallel topology state (reference:
+apex/transformer/parallel_state.py).
+
+The reference builds torch.distributed process groups for TP x PP x DP
+(plus virtual-PP bookkeeping and embedding groups).  Here the topology IS
+the global mesh (apex_tpu.comm); "groups" are mesh axes, and rank queries
+answer from ``jax.lax.axis_index`` inside traced code or from the mesh
+config outside.  The API names mirror the reference 1:1 so Megatron-style
+code ports directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu import comm
+
+_VIRTUAL_PP_SIZE: Optional[int] = None
+_VIRTUAL_PP_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+        tensor_model_parallel_size_: int = 1,
+        pipeline_model_parallel_size_: int = 1,
+        virtual_pipeline_model_parallel_size_: Optional[int] = None,
+        pipeline_model_parallel_split_rank_: Optional[int] = None,
+        context_parallel_size: int = 1,
+        *, default_backend: Optional[str] = None,
+        p2p_backend: Optional[str] = None) -> None:
+    """Build the mesh: world = dp x pp x cp x tp, tp minor (ICI-adjacent).
+
+    default_backend/p2p_backend are accepted for signature parity and
+    ignored (XLA owns the transport: ICI intra-slice, DCN inter-slice).
+    """
+    global _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    comm.initialize(data=-1,
+                    pipe=pipeline_model_parallel_size_,
+                    ctx=context_parallel_size,
+                    model=tensor_model_parallel_size_)
+    _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size_
+    _VIRTUAL_PP_RANK = 0 if virtual_pipeline_model_parallel_size_ else None
+
+
+def model_parallel_is_initialized() -> bool:
+    return comm.is_initialized()
+
+
+def destroy_model_parallel() -> None:
+    global _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    comm.destroy()
+    _VIRTUAL_PP_SIZE = None
+    _VIRTUAL_PP_RANK = None
+
+
+# --- group handles: a "group" is a mesh axis name -------------------------
+
+def get_tensor_model_parallel_group() -> str:
+    return comm.AXIS_MODEL
+
+
+def get_pipeline_model_parallel_group() -> str:
+    return comm.AXIS_PIPE
+
+
+def get_data_parallel_group() -> str:
+    return comm.AXIS_DATA
+
+
+def get_context_parallel_group() -> str:
+    return comm.AXIS_CTX
+
+
+def get_embedding_group() -> str:
+    # first+last pipeline stages share embedding grads; on the mesh this
+    # is a psum over the pipe axis masked to those stages
+    return comm.AXIS_PIPE
+
+
+# --- sizes ----------------------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return comm.model_parallel_size()
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return comm.pipeline_parallel_size()
+
+
+def get_data_parallel_world_size() -> int:
+    return comm.data_parallel_size()
+
+
+def get_context_parallel_world_size() -> int:
+    return comm.context_parallel_size()
+
+
+# --- ranks ----------------------------------------------------------------
+
+def _axis_rank(axis: str):
+    """Rank on an axis: traced value inside shard_map, 0 outside (the
+    single-controller host view)."""
+    try:
+        return jax.lax.axis_index(axis)
+    except Exception:
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(comm.AXIS_MODEL)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(comm.AXIS_PIPE)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(comm.AXIS_DATA)
+
+
+def get_context_parallel_rank():
+    return _axis_rank(comm.AXIS_CTX)
+
+
+def get_tensor_model_parallel_src_rank() -> int:
+    return 0
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        if _VIRTUAL_PP_RANK != 0:
+            return False
+    r = get_pipeline_model_parallel_rank()
+    return r == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        if _VIRTUAL_PP_RANK != _VIRTUAL_PP_SIZE - 1:
+            return False
+    r = get_pipeline_model_parallel_rank()
+    return r == get_pipeline_model_parallel_world_size() - 1
+
+
+# --- virtual pipeline bookkeeping ----------------------------------------
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PP_SIZE
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PP_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    global _VIRTUAL_PP_RANK
+    _VIRTUAL_PP_RANK = rank
+
+
+def get_pipeline_model_parallel_prev_rank():
+    world = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() - 1) % world
+
+
+def get_pipeline_model_parallel_next_rank():
+    world = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() + 1) % world

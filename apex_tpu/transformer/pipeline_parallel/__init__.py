@@ -1,0 +1,35 @@
+"""apex_tpu.transformer.pipeline_parallel (reference:
+apex/transformer/pipeline_parallel)."""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    _forward_backward_pipelining_with_interleaving,
+    get_forward_backward_func,
+)
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    P2PContext,
+)
+from apex_tpu.transformer.pipeline_parallel.spmd import (
+    spmd_pipeline,
+    spmd_pipeline_loss,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    get_kth_microbatch,
+    get_num_microbatches,
+    listify_model,
+    setup_microbatch_calculator,
+    split_into_microbatches,
+    update_num_microbatches,
+)
+
+__all__ = [
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
+    "P2PContext",
+    "spmd_pipeline", "spmd_pipeline_loss",
+    "get_kth_microbatch", "get_num_microbatches", "listify_model",
+    "setup_microbatch_calculator", "split_into_microbatches",
+    "update_num_microbatches",
+]

@@ -1,0 +1,54 @@
+"""Stage-to-stage activation/grad transfer (reference:
+apex/transformer/pipeline_parallel/p2p_communication.py).
+
+The reference wraps batched NCCL isend/irecv between pipeline ranks.
+Under a single JAX controller the host-driven schedule owns every
+stage's arrays in one process, so "send" is placing an array in the
+neighbor stage's mailbox (device placement happens lazily when the
+stage's jitted function consumes it; on a real pod the transfer rides
+ICI via the resulting device-to-device copy).  The SPMD fast path in
+``spmd.py`` replaces this module entirely with ``lax.ppermute``.
+
+The mailbox keeps the reference's API shape: send_forward/recv_forward/
+send_backward/recv_backward (+fused variants).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+
+class P2PContext:
+    """Per-schedule mailbox: {(direction, stage): tensor}."""
+
+    def __init__(self, num_stages: int):
+        self.num_stages = num_stages
+        self.fwd: Dict[int, Any] = {}    # activations destined TO stage k
+        self.bwd: Dict[int, Any] = {}    # grads destined TO stage k
+
+    # --- reference-named API (stage-explicit because single-controller) ---
+    def send_forward(self, output_tensor, from_stage: int) -> None:
+        if from_stage + 1 < self.num_stages:
+            self.fwd[from_stage + 1] = output_tensor
+
+    def recv_forward(self, at_stage: int):
+        if at_stage == 0:
+            return None
+        return self.fwd.pop(at_stage)
+
+    def send_backward(self, input_grad, from_stage: int) -> None:
+        if from_stage - 1 >= 0:
+            self.bwd[from_stage - 1] = input_grad
+
+    def recv_backward(self, at_stage: int):
+        if at_stage == self.num_stages - 1:
+            return None
+        return self.bwd.pop(at_stage)
+
+    def send_forward_recv_backward(self, output_tensor, from_stage: int):
+        self.send_forward(output_tensor, from_stage)
+        return self.recv_backward(from_stage)
+
+    def send_backward_recv_forward(self, input_grad, from_stage: int):
+        self.send_backward(input_grad, from_stage)
+        return self.recv_forward(from_stage)

@@ -1,0 +1,208 @@
+"""Pipeline-parallel microbatch schedules (reference:
+apex/transformer/pipeline_parallel/schedules/... — no-pipelining, 1F1B
+non-interleaved, 1F1B interleaved; call stack SURVEY.md §3.5).
+
+The reference's schedules are imperative host loops issuing NCCL p2p ops
+and torch autograd calls.  Here each stage's forward runs under
+``jax.vjp`` so the 1F1B dataflow can replay backwards in the reference's
+order (warmup fwds -> steady 1F1B -> cooldown bwds), exchanging
+activations/grads through the P2PContext mailbox; per-stage grads
+accumulate across microbatches.  The last stage differentiates its
+scalar loss directly (no seed plumbing).
+
+For production TPU throughput use apex_tpu.transformer.pipeline_parallel
+.spmd — ONE compiled program over the "pipe" mesh axis with ppermute
+transfers, where XLA overlaps compute and ICI traffic.  These host
+schedules are the semantics reference and run anywhere.
+
+Contract (mirroring the reference's forward_step_func):
+  forward_step_func(microbatch, input_tensor, apply_fn, params)
+      -> (output, loss_fn)
+  - input_tensor is None on the first stage (read the microbatch).
+  - loss_fn(output) -> scalar; consulted on the LAST stage only (it may
+    close over the microbatch's labels).
+  fwd_bwd(...) -> (losses_per_microbatch, grads_per_stage | None)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    P2PContext)
+
+Pytree = Any
+
+
+def _add_trees(a, b):
+    if a is None:
+        return b
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def get_forward_backward_func(
+        virtual_pipeline_model_parallel_size: Optional[int] = None,
+        pipeline_model_parallel_size: int = 1) -> Callable:
+    """Reference dispatch: schedule by pp size / virtual size."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return _forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+def forward_backward_no_pipelining(
+        forward_step_func: Callable,
+        batch: Sequence,
+        model: Sequence[Tuple[Callable, Pytree]],
+        *, forward_only: bool = False, **kwargs):
+    """Single stage: loop microbatches, accumulate grads (the reference's
+    no-sync context + final sync collapses to plain accumulation)."""
+    (apply_fn, params), = model
+    losses, grad_acc = [], None
+    for mb in batch:
+        def loss_of(p):
+            out, loss_fn = forward_step_func(mb, None, apply_fn, p)
+            return loss_fn(out)
+
+        if forward_only:
+            losses.append(loss_of(params))
+        else:
+            loss, g = jax.value_and_grad(loss_of)(params)
+            losses.append(loss)
+            grad_acc = _add_trees(grad_acc, g)
+    return losses, None if forward_only else [grad_acc]
+
+
+class _StageRunner:
+    """One pipeline stage: runs forwards under vjp, replays backwards."""
+
+    def __init__(self, stage: int, num_stages: int, apply_fn, params,
+                 forward_step_func, batch, ctx: P2PContext,
+                 forward_only: bool):
+        self.stage = stage
+        self.num_stages = num_stages
+        self.is_first = stage == 0
+        self.is_last = stage == num_stages - 1
+        self.apply_fn = apply_fn
+        self.params = params
+        self.fsf = forward_step_func
+        self.batch = batch
+        self.ctx = ctx
+        self.forward_only = forward_only
+        self.fwd_done = 0
+        self.bwd_done = 0
+        self.vjps: List[Any] = []     # FIFO
+        self.grads = None
+        self.losses: List[jax.Array] = []
+
+    def can_forward(self, prev_done: int) -> bool:
+        if self.fwd_done >= len(self.batch):
+            return False
+        return self.is_first or self.fwd_done < prev_done
+
+    def forward(self):
+        mb = self.batch[self.fwd_done]
+        x = None if self.is_first else self.ctx.recv_forward(self.stage)
+
+        if self.is_last:
+            def g(p, xx):
+                out, loss_fn = self.fsf(mb, xx, self.apply_fn, p)
+                return loss_fn(out)
+            loss, vjp = jax.vjp(g, self.params, x)
+            self.losses.append(loss)
+        else:
+            def f(p, xx):
+                out, _ = self.fsf(mb, xx, self.apply_fn, p)
+                return out
+            out, vjp = jax.vjp(f, self.params, x)
+            self.ctx.send_forward(out, self.stage)
+        if not self.forward_only:
+            self.vjps.append(vjp)
+        self.fwd_done += 1
+
+    def can_backward(self, next_bwd_done: int) -> bool:
+        if self.forward_only or self.bwd_done >= len(self.batch):
+            return False
+        if self.bwd_done >= self.fwd_done:
+            return False
+        return self.is_last or next_bwd_done > self.bwd_done
+
+    def backward(self):
+        vjp = self.vjps.pop(0)
+        if self.is_last:
+            dy = jnp.ones((), jnp.float32)
+        else:
+            dy = self.ctx.recv_backward(self.stage)
+        gp, gx = vjp(dy)
+        self.grads = _add_trees(self.grads, gp)
+        if not self.is_first:
+            self.ctx.send_backward(gx, self.stage)
+        self.bwd_done += 1
+
+
+def forward_backward_pipelining_without_interleaving(
+        forward_step_func: Callable,
+        batch: Sequence,
+        model: Sequence[Tuple[Callable, Pytree]],
+        *, forward_only: bool = False, **kwargs):
+    """Literal 1F1B (non-interleaved): warmup forwards fill the pipe,
+    then each stage alternates one-forward-one-backward, then cooldown
+    drains the backwards — the reference's schedule order, executed by a
+    dataflow-driven loop on the single controller."""
+    num_stages = len(model)
+    m = len(batch)
+    ctx = P2PContext(num_stages)
+    stages = [
+        _StageRunner(s, num_stages, model[s][0], model[s][1],
+                     forward_step_func, batch, ctx, forward_only)
+        for s in range(num_stages)
+    ]
+
+    def all_done():
+        for st in stages:
+            if st.fwd_done < m:
+                return False
+            if not forward_only and st.bwd_done < m:
+                return False
+        return True
+
+    while not all_done():
+        progressed = False
+        # 1F1B order: prefer backwards on drained stages (reverse order),
+        # then forwards (dataflow order)
+        for s in reversed(range(num_stages)):
+            nxt = stages[s + 1].bwd_done if s + 1 < num_stages else None
+            if stages[s].can_backward(nxt if nxt is not None else 0):
+                stages[s].backward()
+                progressed = True
+        for s in range(num_stages):
+            prev = stages[s - 1].fwd_done if s > 0 else 0
+            if stages[s].can_forward(prev):
+                stages[s].forward()
+                progressed = True
+        if not progressed:
+            raise RuntimeError("1F1B schedule deadlocked (bug)")
+
+    losses = stages[-1].losses
+    grads = None if forward_only else [st.grads for st in stages]
+    return losses, grads
+
+
+def _forward_backward_pipelining_with_interleaving(
+        forward_step_func: Callable,
+        batch: Sequence,
+        model: Sequence[Tuple[Callable, Pytree]],
+        *, forward_only: bool = False, **kwargs):
+    """Interleaved 1F1B (virtual stages).  ``model`` lists every model
+    CHUNK in dataflow order (chunk c of physical stage s at index
+    c*num_stages + s, as the reference assigns them).  On a single
+    controller the dataflow equals the flattened chain, so the
+    non-interleaved engine executes it; the smaller pipe bubble is a
+    wall-clock property of distributed execution, which the SPMD path
+    owns."""
+    return forward_backward_pipelining_without_interleaving(
+        forward_step_func, batch, model, forward_only=forward_only)

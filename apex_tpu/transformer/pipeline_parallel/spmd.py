@@ -1,0 +1,99 @@
+"""SPMD collective pipeline over the "pipe" mesh axis — the TPU-native
+replacement for NCCL-p2p pipelining (reference:
+apex/transformer/pipeline_parallel/*, SURVEY.md §2.5 "PP").
+
+Design: every pipeline stage lives on its own slice of the mesh's "pipe"
+axis and runs the SAME program (SPMD).  One ``lax.scan`` steps the
+pipeline clock: each tick, every stage applies its layer chunk to its
+current activation, then activations rotate one hop along the ring with
+``lax.ppermute`` (ICI-neighbor traffic, which XLA overlaps with the next
+tick's compute).  A T = M + L - 1 tick scan drains M microbatches
+through L stages (GPipe-style fill/drain); jax autodiff through the scan
++ ppermute yields the pipelined backward automatically (the transpose of
+ppermute is the reverse rotation), so fwd+bwd compile into ONE XLA
+program — no host round-trips, no schedule interpreter.
+
+Use inside shard_map over a mesh with a "pipe" axis; params are the
+stage-local chunk (sharded on "pipe" by the caller's in_specs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+
+Pytree = Any
+
+
+def spmd_pipeline(stage_fn: Callable,
+                  params_local: Pytree,
+                  microbatches: jax.Array,
+                  *, axis: str = comm.AXIS_PIPE) -> jax.Array:
+    """Run microbatches through the stage pipeline; returns last-stage
+    outputs, replicated across the pipe axis.
+
+    stage_fn(params_local, x) -> y     (same shapes for x and y)
+    microbatches: (M, mb, ...) — the caller provides the SAME stacked
+    array on every stage (replicated on "pipe"); only stage 0 reads it.
+
+    Returns (M, mb, ...) outputs of the LAST stage (zeros elsewhere are
+    masked out and psum-broadcast so every stage holds the result).
+    """
+    L = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    M = microbatches.shape[0]
+    T = M + L - 1
+    mb_shape = microbatches.shape[1:]
+
+    state0 = jnp.zeros(mb_shape, microbatches.dtype)
+    ybuf0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+    perm = [(i, (i + 1) % L) for i in range(L)]
+
+    def tick(carry, t):
+        state, ybuf = carry
+        # stage 0 ingests microbatch t (or junk past the end, masked off)
+        mb_t = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        x = jnp.where(stage == 0, mb_t, state)
+        y = stage_fn(params_local, x)
+        # last stage collects microbatch t-(L-1) at tick t
+        out_idx = t - (L - 1)
+        collect = (stage == L - 1) & (out_idx >= 0)
+        ybuf = jax.lax.dynamic_update_index_in_dim(
+            ybuf,
+            jnp.where(collect, y, jax.lax.dynamic_index_in_dim(
+                ybuf, jnp.maximum(out_idx, 0), axis=0, keepdims=False)),
+            jnp.maximum(out_idx, 0), axis=0)
+        # rotate activations one hop down the ring
+        state = jax.lax.ppermute(y, axis, perm)
+        return (state, ybuf), None
+
+    (state, ybuf), _ = jax.lax.scan(tick, (state0, ybuf0),
+                                    jnp.arange(T))
+    # Broadcast the last stage's collected outputs to every stage with
+    # the f/g mapping (fwd psum, bwd identity): the result is consumed
+    # identically on all pipe ranks, so a raw psum would multiply
+    # cotangents by the pipe world size in backward.
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        reduce_from_tensor_model_parallel_region as _reduce)
+    mask = (stage == L - 1).astype(ybuf.dtype)
+    return _reduce(ybuf * mask, axis)
+
+
+def spmd_pipeline_loss(stage_fn: Callable, loss_fn: Callable,
+                       params_local: Pytree,
+                       microbatches: jax.Array,
+                       targets: jax.Array,
+                       *, axis: str = comm.AXIS_PIPE):
+    """Mean loss over microbatches of a pipelined model.
+
+    loss_fn(y, target_mb) -> scalar.  Differentiable wrt params_local:
+    jax.grad of this function yields each stage's local grads (the
+    pipelined backward)."""
+    y = spmd_pipeline(stage_fn, params_local, microbatches, axis=axis)
+    losses = jax.vmap(loss_fn)(y, targets)
+    return jnp.mean(losses)

@@ -1,0 +1,65 @@
+"""Pipeline utilities (reference:
+apex/transformer/pipeline_parallel/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.microbatches import (
+    NumMicroBatchesCalculator, build_num_microbatches_calculator)
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR: Optional[NumMicroBatchesCalculator] \
+    = None
+
+
+def setup_microbatch_calculator(rank: int = 0,
+                                rampup_batch_size=None,
+                                global_batch_size: int = 1,
+                                micro_batch_size: int = 1,
+                                data_parallel_size: int = 1) -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def get_num_microbatches() -> int:
+    assert _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    assert _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.\
+        get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples,
+                            consistency_check: bool = True) -> None:
+    assert _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples,
+                                               consistency_check)
+
+
+def listify_model(model) -> List[Any]:
+    return model if isinstance(model, (list, tuple)) else [model]
+
+
+def get_kth_microbatch(batch, k: int):
+    """Slice the k-th microbatch out of a stacked batch pytree."""
+    if batch is None:
+        return None
+    return jax.tree_util.tree_map(lambda x: x[k], batch)
+
+
+def split_into_microbatches(batch, num_microbatches: int):
+    """(B, ...) pytree -> (num_microbatches, B/num, ...)."""
+    def split(x):
+        b = x.shape[0]
+        assert b % num_microbatches == 0
+        return x.reshape((num_microbatches, b // num_microbatches)
+                         + x.shape[1:])
+    return jax.tree_util.tree_map(split, batch)

@@ -1,0 +1,51 @@
+"""apex_tpu.transformer.tensor_parallel (reference:
+apex/transformer/tensor_parallel)."""
+
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    cross_entropy_ref,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    RNGStatesTracker,
+    checkpoint,
+    get_cuda_rng_tracker,
+    model_parallel_cuda_manual_seed,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data
+from apex_tpu.transformer.tensor_parallel.utils import (
+    VocabUtility,
+    divide,
+    ensure_divisibility,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "cross_entropy_ref", "vocab_parallel_cross_entropy",
+    "RNGStatesTracker", "checkpoint", "get_cuda_rng_tracker",
+    "model_parallel_cuda_manual_seed",
+    "broadcast_data",
+    "VocabUtility", "divide", "ensure_divisibility",
+    "split_tensor_along_last_dim",
+]

@@ -1,0 +1,40 @@
+"""Data broadcast utilities (reference:
+apex/transformer/tensor_parallel/data.py).
+
+The reference broadcasts each batch from tp-rank-0 so all tensor-parallel
+ranks see identical data.  Under single-controller SPMD every rank
+already traces the same host values, so broadcast_data reduces to
+validation + dtype checking + device_put with a replicated sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+
+
+def _build_key_size_numel(keys: List[str], data: Dict[str, jax.Array]):
+    key_size = {}
+    key_numel = {}
+    total = 0
+    for k in keys:
+        key_size[k] = data[k].shape
+        key_numel[k] = int(data[k].size)
+        total += key_numel[k]
+    return key_size, key_numel, total
+
+
+def broadcast_data(keys: List[str], data: Dict[str, jax.Array], datatype
+                   ) -> Dict[str, jax.Array]:
+    for k in keys:
+        if data[k].dtype != datatype:
+            raise ValueError(
+                f"{k} has dtype {data[k].dtype}, expected {datatype}")
+    if not comm.is_initialized():
+        return {k: jnp.asarray(data[k]) for k in keys}
+    sharding = comm.replicated_sharding()
+    return {k: jax.device_put(jnp.asarray(data[k]), sharding) for k in keys}

@@ -1,0 +1,178 @@
+"""Tensor/sequence-parallel region mappings (reference:
+apex/transformer/tensor_parallel/mappings.py).
+
+The reference implements these as autograd.Functions pairing a forward
+collective with its transpose in backward (f/g of the Megatron paper).
+Here each is a ``jax.custom_vjp`` over XLA collectives, usable inside
+shard_map over the "model" mesh axis:
+
+  copy_to_tensor_model_parallel_region      fwd identity   / bwd psum
+  reduce_from_tensor_model_parallel_region  fwd psum       / bwd identity
+  scatter_to_tensor_model_parallel_region   fwd split      / bwd all_gather
+  gather_from_tensor_model_parallel_region  fwd all_gather / bwd split
+  scatter_to_sequence_parallel_region       fwd seq-split  / bwd seq all_gather
+  gather_from_sequence_parallel_region      fwd seq all_gather / bwd r-scatter
+  reduce_scatter_to_sequence_parallel_region fwd psum_scatter / bwd all_gather
+
+Sequence-parallel mappings operate on axis 0 (the sequence dim in
+Megatron's [s, b, h] layout); tensor-parallel scatter/gather operate on
+the LAST dim.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+
+AXIS = comm.AXIS_MODEL
+
+
+def _rank(axis):
+    return jax.lax.axis_index(axis)
+
+
+def _world(axis):
+    return jax.lax.axis_size(axis)
+
+
+def _split_along(x, dim, axis):
+    """Take this rank's slice of x along `dim` (x is replicated)."""
+    world = _world(axis)
+    size = x.shape[dim] // world
+    idx = _rank(axis) * size
+    return jax.lax.dynamic_slice_in_dim(x, idx, size, axis=dim)
+
+
+def _all_gather_along(x, dim, axis):
+    return jax.lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def _reduce_scatter_along(x, dim, axis):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                                tiled=True)
+
+
+# --- tensor-parallel (last-dim) mappings ----------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis=AXIS):
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, dy):
+    return (jax.lax.psum(dy, axis),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis=AXIS):
+    return jax.lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, dy):
+    return (dy,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis=AXIS):
+    return _split_along(x, x.ndim - 1, axis)
+
+
+def _scatter_fwd(x, axis):
+    return _split_along(x, x.ndim - 1, axis), None
+
+
+def _scatter_bwd(axis, _, dy):
+    return (_all_gather_along(dy, dy.ndim - 1, axis),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis=AXIS):
+    return _all_gather_along(x, x.ndim - 1, axis)
+
+
+def _gather_fwd(x, axis):
+    return _all_gather_along(x, x.ndim - 1, axis), None
+
+
+def _gather_bwd(axis, _, dy):
+    return (_split_along(dy, dy.ndim - 1, axis),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# --- sequence-parallel (dim 0) mappings -----------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis=AXIS):
+    return _split_along(x, 0, axis)
+
+
+def _sp_scatter_fwd(x, axis):
+    return _split_along(x, 0, axis), None
+
+
+def _sp_scatter_bwd(axis, _, dy):
+    return (_all_gather_along(dy, 0, axis),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis=AXIS,
+                                         tensor_parallel_output_grad=True):
+    return _all_gather_along(x, 0, axis)
+
+
+def _sp_gather_fwd(x, axis, tensor_parallel_output_grad):
+    return _all_gather_along(x, 0, axis), None
+
+
+def _sp_gather_bwd(axis, tensor_parallel_output_grad, _, dy):
+    # column-linear fwd gathers the seq dim; its bwd REDUCE-scatters
+    # (grads from all tp ranks are partial sums).  When the consumer is
+    # not tensor-parallel, a plain split suffices (reference flag).
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter_along(dy, 0, axis),)
+    return (_split_along(dy, 0, axis),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis=AXIS):
+    return _reduce_scatter_along(x, 0, axis)
+
+
+def _sp_rs_fwd(x, axis):
+    return _reduce_scatter_along(x, 0, axis), None
+
+
+def _sp_rs_bwd(axis, _, dy):
+    return (_all_gather_along(dy, 0, axis),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

@@ -1,0 +1,92 @@
+"""Parallel RNG management + activation checkpointing (reference:
+apex/transformer/tensor_parallel/random.py).
+
+The reference keeps a CudaRNGStatesTracker so dropout inside
+tensor-parallel regions draws DIFFERENT randomness per tp rank while
+everything else stays replicated, and its ``checkpoint`` saves/restores
+those states around recomputation.  JAX's key-based RNG makes both
+structural: a key folded with the tp rank is the "model-parallel-rng"
+state, and ``jax.checkpoint`` replays identical keys on recompute by
+construction — no state juggling to get deterministic recomputation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from apex_tpu import comm
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """API-parity tracker: named base keys; ``fork`` yields a key folded
+    with the tp rank (so each rank's dropout decorrelates) and bumps a
+    counter so successive forks differ."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.counters_: Dict[str, int] = {}
+
+    def reset(self):
+        self.states_.clear()
+        self.counters_.clear()
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+        self.counters_[name] = 0
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        key = self.states_[name]
+        key = jax.random.fold_in(key, self.counters_[name])
+        try:
+            key = jax.random.fold_in(
+                key, jax.lax.axis_index(comm.AXIS_MODEL))
+        except Exception:
+            pass
+        self.counters_[name] += 1
+        yield key
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RNGStatesTracker:
+    """Reference name kept for drop-in compatibility."""
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    """Reference contract: default stream gets `seed`; the
+    model-parallel stream gets a rank-offset seed (offsetting is implicit
+    here — fork() folds the rank in)."""
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, seed + 2718)
+
+
+def checkpoint(function, *args, distribute_saved_activations: bool = False,
+               **kwargs):
+    """Activation checkpointing (reference ``tensor_parallel.checkpoint``).
+
+    jax.checkpoint replays the primal with identical RNG keys, which is
+    the whole point of the reference's RNG-state save/restore.
+    ``distribute_saved_activations`` (sharding the stashed input over tp
+    ranks) is subsumed by XLA's SPMD partitioner, which shards residuals
+    according to their producers' shardings.
+    """
+    del distribute_saved_activations
+    return jax.checkpoint(function)(*args, **kwargs)

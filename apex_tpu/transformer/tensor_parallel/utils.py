@@ -1,0 +1,42 @@
+"""Shard-arithmetic helpers (reference:
+apex/transformer/tensor_parallel/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    assert numerator % denominator == 0, (
+        f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x, num_partitions: int):
+    """Reference helper: split the last dim into `num_partitions` views."""
+    last = divide(x.shape[-1], num_partitions)
+    return jnp.split(x, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Vocab-range arithmetic for vocab-parallel embeddings/losses."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple[int, int]:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank,
+                                           world_size: int):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size)

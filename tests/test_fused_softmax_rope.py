@@ -1,0 +1,127 @@
+"""Fused softmax + RoPE kernels vs XLA oracles, values and grads
+(reference models: tests/L0/run_transformer fused softmax tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import rope as rope_ops
+from apex_tpu.ops import softmax as sm
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_scaled_masked_softmax_matches_ref(dtype):
+    b, h, sq, sk = 2, 3, 16, 128
+    x = jax.random.normal(jax.random.key(0), (b, h, sq, sk),
+                          jnp.float32).astype(dtype)
+    mask = (jax.random.uniform(jax.random.key(1), (b, 1, sq, sk))
+            < 0.3).astype(jnp.int32)
+    y = sm.scaled_masked_softmax(x, mask, 0.5)
+    want = sm.scaled_masked_softmax_ref(x, mask, 0.5)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2 if dtype == jnp.bfloat16 else 1e-5,
+                               atol=1e-3 if dtype == jnp.bfloat16 else 1e-6)
+
+
+def test_scaled_masked_softmax_no_mask():
+    x = jax.random.normal(jax.random.key(2), (2, 2, 8, 256))
+    y = sm.scaled_masked_softmax(x, None, 1.7)
+    want = sm.scaled_masked_softmax_ref(x, None, 1.7)
+    np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6)
+
+
+def test_scaled_masked_softmax_grads():
+    x = jax.random.normal(jax.random.key(3), (2, 2, 8, 128))
+    mask = (jax.random.uniform(jax.random.key(4), (2, 1, 8, 128))
+            < 0.2).astype(jnp.int32)
+
+    def f(x):
+        return jnp.sum(sm.scaled_masked_softmax(x, mask, 0.9) ** 2)
+
+    def fr(x):
+        return jnp.sum(sm.scaled_masked_softmax_ref(x, mask, 0.9) ** 2)
+
+    np.testing.assert_allclose(jax.grad(f)(x), jax.grad(fr)(x),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_causal_softmax_matches_ref_and_grads():
+    ab, sq = 4, 128
+    x = jax.random.normal(jax.random.key(5), (ab, sq, sq))
+    y = sm.scaled_upper_triang_masked_softmax(x, 0.7)
+    want = sm.scaled_upper_triang_masked_softmax_ref(x, 0.7)
+    np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6)
+    # strictly-upper entries are (numerically) zero
+    assert float(jnp.abs(jnp.triu(y[0], k=1)).max()) < 1e-4
+
+    def f(x):
+        return jnp.sum(sm.scaled_upper_triang_masked_softmax(x, 0.7) ** 2)
+
+    def fr(x):
+        return jnp.sum(
+            sm.scaled_upper_triang_masked_softmax_ref(x, 0.7) ** 2)
+
+    np.testing.assert_allclose(jax.grad(f)(x), jax.grad(fr)(x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_scale_mask_softmax_module():
+    fsm = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal,
+                                scale=0.5)
+    x = jax.random.normal(jax.random.key(6), (2, 2, 64, 64))
+    y = fsm(x)
+    want = sm.scaled_upper_triang_masked_softmax_ref(
+        x.reshape(-1, 64, 64), 0.5).reshape(x.shape)
+    np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("interleaved", [False, True])
+@pytest.mark.parametrize("rot_frac", [1.0, 0.5])
+def test_rope_matches_ref_and_grads(interleaved, rot_frac):
+    s, b, h, d = 10, 2, 3, 16
+    rot = int(d * rot_frac)
+    t = jax.random.normal(jax.random.key(7), (s, b, h, d))
+    freqs = jax.random.normal(jax.random.key(8), (s, 1, 1, rot))
+    y = rope_ops.fused_apply_rotary_pos_emb(t, freqs, interleaved)
+    want = rope_ops.rope_ref(t, freqs, interleaved)
+    np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6)
+
+    def f(t):
+        return jnp.sum(
+            rope_ops.fused_apply_rotary_pos_emb(t, freqs, interleaved)
+            * jnp.arange(t.size).reshape(t.shape))
+
+    def fr(t):
+        return jnp.sum(rope_ops.rope_ref(t, freqs, interleaved)
+                       * jnp.arange(t.size).reshape(t.shape))
+
+    np.testing.assert_allclose(jax.grad(f)(t), jax.grad(fr)(t),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fully_masked_rows_output_zeros():
+    """Reference kernel semantics: all-masked rows -> zeros, not 1/sk."""
+    b, h, sq, sk = 1, 2, 8, 128
+    x = jax.random.normal(jax.random.key(9), (b, h, sq, sk))
+    mask = jnp.zeros((b, 1, sq, sk), jnp.int32).at[:, :, 0, :].set(1)
+    y = sm.scaled_masked_softmax(x, mask, 1.0)
+    yr = sm.scaled_masked_softmax_ref(x, mask, 1.0)
+    assert float(jnp.abs(y[:, :, 0, :]).max()) == 0.0
+    assert float(jnp.abs(yr[:, :, 0, :]).max()) == 0.0
+    # grads through a zero row are zero, finite elsewhere
+    g = jax.grad(lambda x: jnp.sum(
+        sm.scaled_masked_softmax(x, mask, 1.0) ** 2))(x)
+    assert float(jnp.abs(g[:, :, 0, :]).max()) == 0.0
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_causal_requires_square():
+    from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+    fsm = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal)
+    x = jax.random.normal(jax.random.key(10), (1, 1, 1, 128))
+    with pytest.raises(AssertionError):
+        fsm(x)

@@ -1,0 +1,165 @@
+"""apex_tpu.parallel tests (reference models: tests/distributed/
+synced_batchnorm — SyncBN vs single-process BN oracle; DDP grad
+equivalence; LARC math).  Multi-chip is simulated on the 8-device CPU
+mesh, which the reference could not do (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import comm
+from apex_tpu.ops import welford
+from apex_tpu.parallel import (DistributedDataParallel, LARC,
+                               SyncBatchNorm, all_reduce_gradients,
+                               sync_batch_norm_stats)
+from apex_tpu.optimizers import FusedSGD
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+@pytest.mark.parametrize("n,c", [(32, 128), (100, 256), (7, 128)])
+def test_welford_kernel_vs_ref(n, c):
+    x = jax.random.normal(jax.random.key(0), (n, c))
+    mean, var, cnt = welford.welford_mean_var(x)
+    mref, vref, cref = welford.welford_mean_var_ref(x)
+    np.testing.assert_allclose(mean, mref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(var, vref, rtol=1e-4, atol=1e-5)
+    assert float(cnt) == float(cref) == n
+
+
+def test_welford_combine():
+    x = jax.random.normal(jax.random.key(1), (64, 4))
+    a, b = x[:20], x[20:]
+    na, ma, m2a = 20.0, jnp.mean(a, 0), jnp.sum((a - jnp.mean(a, 0))**2, 0)
+    nb, mb, m2b = 44.0, jnp.mean(b, 0), jnp.sum((b - jnp.mean(b, 0))**2, 0)
+    n, m, m2 = welford.welford_combine(na, ma, m2a, nb, mb, m2b)
+    np.testing.assert_allclose(m, jnp.mean(x, 0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m2 / n, jnp.var(x, 0), rtol=1e-4, atol=1e-6)
+
+
+def test_sync_stats_match_full_batch():
+    """Stats synced over a sharded batch == full-batch stats (the
+    reference's synced_batchnorm/two_gpu_unit_test oracle)."""
+    mesh = comm.initialize(data=8)
+    x = jax.random.normal(jax.random.key(2), (64, 16))
+
+    def f(xs):
+        mean, var, n = sync_batch_norm_stats(xs, comm.AXIS_DATA)
+        return mean, var
+
+    mean, var = jax.jit(shard_map(
+        f, mesh, in_specs=P(comm.AXIS_DATA), out_specs=P()))(x)
+    np.testing.assert_allclose(mean, jnp.mean(x, 0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(var, jnp.var(x, 0), rtol=1e-4, atol=1e-6)
+
+
+def test_syncbn_module_matches_full_batch_bn():
+    mesh = comm.initialize(data=8)
+    c = 8
+    bn = SyncBatchNorm(num_features=c)
+    x = jax.random.normal(jax.random.key(3), (32, c)) * 2.0 + 1.0
+    variables = bn.init(jax.random.key(0), x, use_running_average=False)
+
+    def f(v, xs):
+        y, updates = bn.apply(v, xs, use_running_average=False,
+                              mutable=["batch_stats"])
+        return y, updates
+
+    y, updates = jax.jit(shard_map(
+        f, mesh, in_specs=(P(), P(comm.AXIS_DATA)),
+        out_specs=(P(comm.AXIS_DATA), P())))(variables, x)
+
+    # oracle: full-batch normalization
+    mu, var = jnp.mean(x, 0), jnp.var(x, 0)
+    want = (x - mu) / jnp.sqrt(var + bn.eps)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    # running stats got the (unbiased-var) momentum update
+    rm = updates["batch_stats"]["running_mean"]
+    np.testing.assert_allclose(rm, 0.1 * mu, rtol=1e-4, atol=1e-5)
+
+
+def test_ddp_reduce_matches_full_batch_grads():
+    """Per-shard grads + DDP reduction == full-batch grads (the
+    reference's DDP contract)."""
+    mesh = comm.initialize(data=8)
+    w = jnp.ones((16,))
+    x = jax.random.normal(jax.random.key(4), (64, 16))
+    y = jax.random.normal(jax.random.key(5), (64,))
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    full_grad = jax.grad(loss_fn)(w, x, y)
+    ddp = DistributedDataParallel(None)
+
+    def step(w, xs, ys):
+        g = jax.grad(loss_fn)(w, xs, ys)
+        return ddp.reduce_gradients(g)
+
+    g = jax.jit(shard_map(
+        step, mesh, in_specs=(P(), P(comm.AXIS_DATA), P(comm.AXIS_DATA)),
+        out_specs=P()))(w, x, y)
+    np.testing.assert_allclose(g, full_grad, rtol=1e-5, atol=1e-6)
+
+
+def test_ddp_outside_shard_map_is_identity():
+    ddp = DistributedDataParallel(None)
+    g = {"w": jnp.ones((4,))}
+    out = ddp.reduce_gradients(g)
+    np.testing.assert_array_equal(out["w"], g["w"])
+
+
+def test_larc_clips_effective_lr():
+    p = {"w": jnp.full((8,), 10.0)}   # large params -> adaptive >> lr
+    g = {"w": jnp.full((8,), 0.01)}
+    opt = FusedSGD(p, lr=0.1)
+    larc = LARC(opt, trust_coefficient=0.02, clip=True)
+    new = larc.step(g)
+    # clipped: effective lr == lr, so update == lr * g
+    np.testing.assert_allclose(np.asarray(new["w"]),
+                               10.0 - 0.1 * 0.01, rtol=1e-5)
+
+
+def test_larc_adaptive_when_unclipped():
+    p = {"w": jnp.full((4,), 1.0)}
+    g = {"w": jnp.full((4,), 100.0)}  # huge grads -> adaptive < lr
+    opt = FusedSGD(p, lr=0.1)
+    larc = LARC(opt, trust_coefficient=0.02, clip=True)
+    new = larc.step(g)
+    p_norm, g_norm = 2.0, 200.0
+    adaptive = 0.02 * p_norm / g_norm      # 2e-4, /lr=2e-3 < 1 -> unclipped
+    want = 1.0 - 0.1 * (adaptive / 0.1) * 100.0
+    np.testing.assert_allclose(np.asarray(new["w"]), want, rtol=1e-4)
+
+
+def test_convert_syncbn_from_flax_batchnorm():
+    import flax.linen as nn
+    import jax.numpy as jnp
+    from apex_tpu.parallel import convert_syncbn_model
+    sbn = convert_syncbn_model(nn.BatchNorm(use_running_average=False))
+    x = jax.random.normal(jax.random.key(11), (16, 8)) + 3.0
+    v = sbn.init(jax.random.key(0), x, use_running_average=False)
+    y, _ = sbn.apply(v, x, use_running_average=False,
+                     mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, 0)), 0.0, atol=1e-5)
+
+
+def test_syncbn_large_mean_stability():
+    """Chan-combined stats survive mean >> std (sum/sumsq would not)."""
+    from apex_tpu.parallel import sync_batch_norm_stats
+    x = 300.0 + 0.05 * jax.random.normal(jax.random.key(12), (4096, 4))
+    mean, var, n = sync_batch_norm_stats(x, None)
+    np.testing.assert_allclose(np.asarray(var),
+                               np.asarray(jnp.var(x, 0)), rtol=1e-2)
+    assert float(var.min()) > 1e-4

@@ -1,0 +1,194 @@
+"""Pipeline schedules vs the unpipelined chain oracle (reference models:
+tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py): same losses,
+same grads, for the host 1F1B schedule AND the SPMD ppermute pipeline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import comm
+from apex_tpu.transformer import pipeline_parallel as pp
+
+D = 8          # feature width
+M = 6          # microbatches
+MB = 4         # microbatch size
+L = 4          # stages
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def stage_apply(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_stage_params(key, scale=0.5):
+    k1, k2 = jax.random.split(key)
+    return {"w": jax.random.normal(k1, (D, D)) * scale,
+            "b": jax.random.normal(k2, (D,)) * 0.1}
+
+
+def chain_loss(all_params, x, target):
+    h = x
+    for p in all_params:
+        h = stage_apply(p, h)
+    return jnp.mean((h - target) ** 2)
+
+
+@pytest.fixture
+def problem():
+    keys = jax.random.split(jax.random.key(0), L)
+    params = [make_stage_params(k) for k in keys]
+    x = jax.random.normal(jax.random.key(1), (M, MB, D))
+    tgt = jax.random.normal(jax.random.key(2), (M, MB, D))
+    return params, x, tgt
+
+
+def fsf_factory(x, tgt):
+    """forward_step_func closing over per-microbatch targets."""
+    def fsf(mb_index_pair, input_tensor, apply_fn, params):
+        mb_x, mb_t = mb_index_pair
+        inp = mb_x if input_tensor is None else input_tensor
+        out = apply_fn(params, inp)
+
+        def loss_fn(o):
+            return jnp.mean((o - mb_t) ** 2)
+        return out, loss_fn
+    return fsf
+
+
+def oracle(params, x, tgt):
+    """Accumulated-over-microbatches loss/grads of the full chain."""
+    losses = [chain_loss(params, x[i], tgt[i]) for i in range(M)]
+
+    def total(ps):
+        return sum(chain_loss(ps, x[i], tgt[i]) for i in range(M))
+    grads = jax.grad(total)(params)
+    return losses, grads
+
+
+def test_no_pipelining_matches_oracle(problem):
+    params, x, tgt = problem
+    # single "stage" holding the whole chain
+    def apply_all(ps, inp):
+        h = inp
+        for p in ps:
+            h = stage_apply(p, h)
+        return h
+
+    batch = [(x[i], tgt[i]) for i in range(M)]
+    losses, grads = pp.forward_backward_no_pipelining(
+        fsf_factory(x, tgt), batch, [(apply_all, params)])
+    want_losses, want_grads = oracle(params, x, tgt)
+    np.testing.assert_allclose(np.asarray(losses),
+                               np.asarray(want_losses), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-6),
+        grads[0], want_grads)
+
+
+def test_1f1b_matches_oracle(problem):
+    params, x, tgt = problem
+    batch = [(x[i], tgt[i]) for i in range(M)]
+    model = [(stage_apply, p) for p in params]
+    losses, grads = pp.forward_backward_pipelining_without_interleaving(
+        fsf_factory(x, tgt), batch, model)
+    want_losses, want_grads = oracle(params, x, tgt)
+    np.testing.assert_allclose(np.asarray(losses),
+                               np.asarray(want_losses), rtol=1e-5)
+    for s in range(L):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-6),
+            grads[s], want_grads[s])
+
+
+def test_1f1b_forward_only(problem):
+    params, x, tgt = problem
+    batch = [(x[i], tgt[i]) for i in range(M)]
+    model = [(stage_apply, p) for p in params]
+    losses, grads = pp.forward_backward_pipelining_without_interleaving(
+        fsf_factory(x, tgt), batch, model, forward_only=True)
+    want_losses, _ = oracle(params, x, tgt)
+    assert grads is None
+    np.testing.assert_allclose(np.asarray(losses),
+                               np.asarray(want_losses), rtol=1e-5)
+
+
+def test_get_forward_backward_func_dispatch():
+    f = pp.get_forward_backward_func(None, 1)
+    assert f is pp.forward_backward_no_pipelining
+    f = pp.get_forward_backward_func(None, 4)
+    assert f is pp.forward_backward_pipelining_without_interleaving
+    f = pp.get_forward_backward_func(2, 4)
+    assert f is pp._forward_backward_pipelining_with_interleaving
+
+
+def test_spmd_pipeline_matches_chain(problem):
+    """The ppermute scan pipeline == sequential chain, fwd AND grads."""
+    params, x, tgt = problem
+    mesh = comm.initialize(data=2, pipe=4)
+    # stack per-stage params on a leading axis, shard it over "pipe"
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *params)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE), params[0])
+
+    def run(stacked_local, xx):
+        # stacked_local: (1, D, D) etc — this stage's chunk
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        return pp.spmd_pipeline(stage_apply, local, xx)
+
+    y = jax.jit(shard_map(
+        run, mesh,
+        in_specs=(pspec, P()),
+        out_specs=P()))(stacked, x)
+
+    h = x
+    for p in params:
+        h = jax.vmap(stage_apply, in_axes=(None, 0))(p, h)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(h),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spmd_pipeline_grads_match_chain(problem):
+    params, x, tgt = problem
+    mesh = comm.initialize(data=2, pipe=4)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params)
+    pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE), params[0])
+
+    def loss(stacked_local, xx, tt):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        return pp.spmd_pipeline_loss(
+            stage_apply, lambda y, t: jnp.mean((y - t) ** 2),
+            local, xx, tt)
+
+    g = jax.jit(shard_map(
+        jax.grad(loss), mesh,
+        in_specs=(pspec, P(), P()),
+        out_specs=pspec))(stacked, x, tgt)
+
+    def chain_mean_loss(ps):
+        h = x
+        for p in ps:
+            h = jax.vmap(stage_apply, in_axes=(None, 0))(p, h)
+        return jnp.mean(jax.vmap(
+            lambda y, t: jnp.mean((y - t) ** 2))(h, tgt))
+
+    want = jax.grad(chain_mean_loss)(params)
+    want_stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *want)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-5),
+        g, want_stacked)

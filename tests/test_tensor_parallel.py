@@ -1,0 +1,248 @@
+"""Tensor-parallel layers/mappings/cross-entropy vs dense oracles
+(reference models: tests/L0/run_transformer/test_layers.py,
+test_mappings.py, cross-entropy tests — SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import comm
+from apex_tpu.transformer import tensor_parallel as tp
+from apex_tpu.transformer.tensor_parallel import mappings
+
+IN, OUT = 16, 32
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def tp_mesh():
+    return comm.initialize(data=2, model=4)
+
+
+def col_specs():
+    return {"params": {"weight": P(None, comm.AXIS_MODEL),
+                       "bias": P(comm.AXIS_MODEL)}}
+
+
+def row_specs():
+    return {"params": {"weight": P(comm.AXIS_MODEL, None),
+                       "bias": P()}}
+
+
+def init_sharded(mesh, module, x_spec, x, param_specs):
+    def init_fn(key, xx):
+        return module.init(key, xx)
+    return jax.jit(shard_map(init_fn, mesh, in_specs=(P(), x_spec),
+                             out_specs=param_specs))(jax.random.key(0), x)
+
+
+def test_column_parallel_matches_dense():
+    mesh = tp_mesh()
+    col = tp.ColumnParallelLinear(IN, OUT, gather_output=True)
+    x = jax.random.normal(jax.random.key(1), (6, IN))
+    params = init_sharded(mesh, col, P(), x, col_specs())
+
+    y = jax.jit(shard_map(lambda p, xx: col.apply(p, xx), mesh,
+                          in_specs=(col_specs(), P()),
+                          out_specs=P()))(params, x)
+    w = params["params"]["weight"]   # assembled (IN, OUT)
+    b = params["params"]["bias"]
+    want = x @ w + b
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_row_parallel_matches_dense():
+    mesh = tp_mesh()
+    row = tp.RowParallelLinear(IN, OUT, input_is_parallel=False)
+    x = jax.random.normal(jax.random.key(2), (6, IN))
+    params = init_sharded(mesh, row, P(), x, row_specs())
+
+    y = jax.jit(shard_map(lambda p, xx: row.apply(p, xx), mesh,
+                          in_specs=(row_specs(), P()),
+                          out_specs=P()))(params, x)
+    w = params["params"]["weight"]
+    b = params["params"]["bias"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x @ w + b),
+                               rtol=1e-5, atol=1e-5)
+
+
+class TwoLayer:
+    """Column(no-gather) -> Row(parallel-in): the canonical Megatron MLP
+    pairing with exactly one psum."""
+
+    def __init__(self, sequence_parallel=False):
+        self.col = tp.ColumnParallelLinear(
+            IN, OUT, gather_output=False,
+            sequence_parallel_enabled=sequence_parallel)
+        self.row = tp.RowParallelLinear(
+            OUT, IN, input_is_parallel=True,
+            sequence_parallel_enabled=sequence_parallel)
+
+    def init(self, key, x):
+        k1, k2 = jax.random.split(key)
+        tp_size = comm.model_parallel_size()
+        h_local_dim = OUT // tp_size
+        h_shape = x.shape[:-1] + (h_local_dim,)
+        if self.col.sequence_parallel_enabled:
+            # column output under SP carries the FULL (gathered) sequence
+            h_shape = (x.shape[0] * tp_size,) + h_shape[1:]
+        h = jnp.zeros(h_shape, x.dtype)
+        return {"col": self.col.init(k1, x), "row": self.row.init(k2, h)}
+
+    def apply(self, params, x):
+        h = self.col.apply(params["col"], x)
+        h = jax.nn.gelu(h)
+        return self.row.apply(params["row"], h)
+
+    def specs(self):
+        return {"col": col_specs(), "row": row_specs()}
+
+
+def dense_oracle(params, x):
+    w1 = params["col"]["params"]["weight"]
+    b1 = params["col"]["params"]["bias"]
+    w2 = params["row"]["params"]["weight"]
+    b2 = params["row"]["params"]["bias"]
+    return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+
+def test_tp_mlp_forward_and_grads_match_dense():
+    mesh = tp_mesh()
+    model = TwoLayer()
+    x = jax.random.normal(jax.random.key(3), (8, IN))
+
+    params = jax.jit(shard_map(model.init, mesh,
+                               in_specs=(P(), P()),
+                               out_specs=model.specs()))(
+        jax.random.key(0), x)
+
+    def loss(p, xx):
+        return jnp.sum(model.apply(p, xx) ** 2)
+
+    def dense_loss(p, xx):
+        return jnp.sum(dense_oracle(p, xx) ** 2)
+
+    l_tp, g_tp = jax.jit(shard_map(
+        jax.value_and_grad(loss), mesh,
+        in_specs=(model.specs(), P()),
+        out_specs=(P(), model.specs())))(params, x)
+    l_ref, g_ref = jax.value_and_grad(dense_loss)(params, x)
+    np.testing.assert_allclose(float(l_tp), float(l_ref), rtol=1e-4)
+    for k1 in ("col", "row"):
+        for k2 in ("weight", "bias"):
+            np.testing.assert_allclose(
+                np.asarray(g_tp[k1]["params"][k2]),
+                np.asarray(g_ref[k1]["params"][k2]),
+                rtol=1e-4, atol=1e-4,
+                err_msg=f"{k1}.{k2}")
+
+
+def test_sequence_parallel_mlp_matches_dense():
+    """SP: activations sharded on the sequence dim between TP regions;
+    all_gather before column, reduce_scatter after row."""
+    mesh = tp_mesh()
+    model = TwoLayer(sequence_parallel=True)
+    S = 8  # sequence length, sharded 4-way
+    x = jax.random.normal(jax.random.key(4), (S, 2, IN))
+
+    params = jax.jit(shard_map(model.init, mesh,
+                               in_specs=(P(), P(comm.AXIS_MODEL)),
+                               out_specs=model.specs()))(
+        jax.random.key(0), x)
+
+    y = jax.jit(shard_map(model.apply, mesh,
+                          in_specs=(model.specs(), P(comm.AXIS_MODEL)),
+                          out_specs=P(comm.AXIS_MODEL)))(params, x)
+    want = dense_oracle(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_vocab_parallel_embedding_matches_take():
+    mesh = tp_mesh()
+    V, D = 64, 16
+    emb = tp.VocabParallelEmbedding(V, D)
+    ids = jax.random.randint(jax.random.key(5), (4, 7), 0, V)
+    especs = {"params": {"weight": P(comm.AXIS_MODEL, None)}}
+    params = jax.jit(shard_map(lambda k, i: emb.init(k, i), mesh,
+                               in_specs=(P(), P()),
+                               out_specs=especs))(jax.random.key(0), ids)
+    y = jax.jit(shard_map(lambda p, i: emb.apply(p, i), mesh,
+                          in_specs=(especs, P()),
+                          out_specs=P()))(params, ids)
+    want = jnp.take(params["params"]["weight"], ids, axis=0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_vocab_parallel_cross_entropy(smoothing):
+    mesh = tp_mesh()
+    V = 32
+    logits = jax.random.normal(jax.random.key(6), (5, V)) * 3
+    target = jax.random.randint(jax.random.key(7), (5,), 0, V)
+
+    def f(lg, t):
+        return tp.vocab_parallel_cross_entropy(lg, t,
+                                               label_smoothing=smoothing)
+
+    loss = jax.jit(shard_map(f, mesh,
+                             in_specs=(P(None, comm.AXIS_MODEL), P()),
+                             out_specs=P()))(logits, target)
+    want = tp.cross_entropy_ref(logits, target, label_smoothing=smoothing)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_vocab_parallel_cross_entropy_grads():
+    mesh = tp_mesh()
+    V = 32
+    logits = jax.random.normal(jax.random.key(8), (5, V))
+    target = jax.random.randint(jax.random.key(9), (5,), 0, V)
+
+    def f(lg, t):
+        return jnp.mean(tp.vocab_parallel_cross_entropy(lg, t))
+
+    g = jax.jit(shard_map(jax.grad(f), mesh,
+                          in_specs=(P(None, comm.AXIS_MODEL), P()),
+                          out_specs=P(None, comm.AXIS_MODEL)))(
+        logits, target)
+    want = jax.grad(lambda lg: jnp.mean(
+        tp.cross_entropy_ref(lg, target)))(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mappings_roundtrip():
+    mesh = comm.initialize(data=1, model=8)
+    x = jnp.arange(32.0).reshape(4, 8)
+
+    def f(xx):
+        s = mappings.scatter_to_tensor_model_parallel_region(xx)
+        return mappings.gather_from_tensor_model_parallel_region(s)
+
+    y = jax.jit(shard_map(f, mesh, in_specs=P(), out_specs=P()))(x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_rng_tracker_forks_differ():
+    tr = tp.RNGStatesTracker()
+    tr.add("model-parallel-rng", 123)
+    with tr.fork() as k1:
+        a = jax.random.normal(k1, (4,))
+    with tr.fork() as k2:
+        b = jax.random.normal(k2, (4,))
+    assert not np.allclose(a, b)
+    with pytest.raises(Exception):
+        tr.add("model-parallel-rng", 5)
