@@ -1,0 +1,73 @@
+"""Epilogue-fused dense layers (reference: apex/fused_dense/fused_dense.py
++ csrc/fused_dense.cpp using cuBLASLt epilogues).
+
+GEMM+bias and GEMM+bias+GELU+GEMM+bias: on TPU these epilogues are
+exactly what XLA fuses into the matmul, so the module keeps the
+reference's API while a single jit region delivers the fusion
+(SURVEY.md §2.4).  f32 accumulation via preferred_element_type.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def fused_dense_function(x, weight, bias=None):
+    """y = x @ W^T + b (torch Linear weight layout: (out, in))."""
+    y = jnp.dot(x, weight.T, preferred_element_type=jnp.float32
+                ).astype(x.dtype)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+def fused_dense_gelu_dense_function(x, w1, b1, w2, b2):
+    h = fused_dense_function(x, w1, b1)
+    h = jax.nn.gelu(h, approximate=True)
+    return fused_dense_function(h, w2, b2)
+
+
+class FusedDense(nn.Module):
+    """Reference-shaped: FusedDense(in_features, out_features, bias)."""
+    in_features: int
+    out_features: int
+    bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.lecun_normal(),
+                       (self.out_features, self.in_features),
+                       self.param_dtype)
+        b = (self.param("bias", nn.initializers.zeros,
+                        (self.out_features,), self.param_dtype)
+             if self.bias else None)
+        return fused_dense_function(x, w, b)
+
+
+class FusedDenseGeluDense(nn.Module):
+    """Reference-shaped: Linear+GELU+Linear in one fused region."""
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        init = nn.initializers.lecun_normal()
+        w1 = self.param("weight1", init,
+                        (self.intermediate_features, self.in_features),
+                        self.param_dtype)
+        b1 = (self.param("bias1", nn.initializers.zeros,
+                         (self.intermediate_features,), self.param_dtype)
+              if self.bias else None)
+        w2 = self.param("weight2", init,
+                        (self.out_features, self.intermediate_features),
+                        self.param_dtype)
+        b2 = (self.param("bias2", nn.initializers.zeros,
+                         (self.out_features,), self.param_dtype)
+              if self.bias else None)
+        return fused_dense_gelu_dense_function(x, w1, b1, w2, b2)

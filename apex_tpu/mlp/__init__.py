@@ -1,0 +1,3 @@
+from apex_tpu.mlp.mlp import MLP, mlp_function
+
+__all__ = ["MLP", "mlp_function"]

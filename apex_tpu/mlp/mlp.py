@@ -1,0 +1,66 @@
+"""Fused MLP (reference: apex/mlp/mlp.py + csrc/mlp.cpp/mlp_cuda.cu).
+
+The reference chains cuBLAS GEMMs with custom bias+ReLU epilogues in one
+extension call to avoid per-layer launches.  Under XLA a chain of
+dot+bias+activation traced in one jit IS one fused pipeline on the MXU
+(SURVEY.md §2.4 maps mlp_cuda to exactly this), so the module is the
+contract and the compiler is the kernel.  bf16 inputs accumulate in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _activation(name):
+    if name == "relu":
+        return jax.nn.relu
+    if name == "sigmoid":
+        return jax.nn.sigmoid
+    if name == "none" or name is None:
+        return lambda x: x
+    raise ValueError(f"unsupported activation {name!r}")
+
+
+def mlp_function(params: Sequence, x, bias: bool = True,
+                 activation: str = "relu"):
+    """Functional form: params = [(w0, b0), (w1, b1), ...]."""
+    act = _activation(activation)
+    h = x
+    n = len(params)
+    for i, layer in enumerate(params):
+        w, b = layer if bias else (layer, None)
+        h = jnp.dot(h, w, preferred_element_type=jnp.float32
+                    ).astype(x.dtype)
+        if b is not None:
+            h = h + b.astype(h.dtype)
+        if i < n - 1:
+            h = act(h)
+    return h
+
+
+class MLP(nn.Module):
+    """Reference-shaped: MLP(mlp_sizes=[in, h1, ..., out]); activation
+    applied between layers (not after the last), as in apex."""
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = _activation(self.activation)
+        sizes = list(self.mlp_sizes)
+        h = x
+        for i in range(len(sizes) - 1):
+            h = nn.Dense(sizes[i + 1], use_bias=self.bias,
+                         param_dtype=self.param_dtype,
+                         name=f"layer_{i}")(h)
+            if i < len(sizes) - 2:
+                h = act(h)
+        return h

@@ -1,0 +1,131 @@
+"""ResNet family, TPU-first (reference model zoo context:
+examples/imagenet/main_amp.py drives torchvision resnet18/50/101 — the
+reference itself ships no models; these exist so the BASELINE configs
+run end-to-end).
+
+TPU-native choices: NHWC layout (XLA's preferred conv layout on TPU),
+bf16 compute with f32 BatchNorm statistics (amp O2's keep_batchnorm_fp32
+semantics), injectable norm_cls so convert-to-SyncBatchNorm is a
+constructor argument rather than a tree rewrite.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Type
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: ModuleDef = None
+    dtype: jnp.dtype = jnp.float32
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(x)
+        y = self.norm()(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
+        y = self.norm()(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides))(x)
+            residual = self.norm()(residual, use_running_average=not train)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """v1.5 bottleneck: stride on the 3x3 (torchvision semantics, which
+    the reference's imagenet example trains)."""
+    filters: int
+    strides: int = 1
+    norm: ModuleDef = None
+    dtype: jnp.dtype = jnp.float32
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = self.norm()(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(y)
+        y = self.norm()(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = self.norm()(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            (self.strides, self.strides))(x)
+            residual = self.norm()(residual, use_running_average=not train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: Type[nn.Module]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.float32
+    norm_cls: Optional[Callable] = None   # e.g. parallel.SyncBatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.norm_cls is not None:
+            norm = self.norm_cls
+        else:
+            norm = functools.partial(nn.BatchNorm, momentum=0.9,
+                                     epsilon=1e-5, dtype=jnp.float32,
+                                     param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), (2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = norm()(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.width * 2 ** i, strides,
+                                   norm=norm, dtype=self.dtype)(
+                    x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet([3, 4, 6, 3], Bottleneck, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet([3, 4, 23, 3], Bottleneck, **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet([3, 8, 36, 3], Bottleneck, **kw)

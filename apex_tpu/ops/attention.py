@@ -1,0 +1,226 @@
+"""Fused attention kernels (reference: apex/contrib/csrc/multihead_attn/*
+~8k LoC of per-variant CUDA, apex/contrib/csrc/fmha/ — SURVEY.md §2.4).
+
+One Pallas kernel family with flags replaces the reference's eight
+hand-specialized attention extensions: the whole
+scores->mask->softmax->context chain runs in VMEM per (batch*head,
+q-block) grid cell, so the (Sq, Sk) score matrix never touches HBM (the
+reference's kernels fuse the same chain; fmha additionally tiles — here
+Mosaic does the tiling).  bf16 inputs accumulate in f32 on the MXU.
+
+Backward: custom_vjp recomputes scores blockwise with XLA math
+(flash-style recomputation — no saved probabilities, matching the
+memory-efficient behavior the reference gets from its fused bwd kernels).
+
+Long-context path: ``ring_attention`` shards the KV sequence over the
+"ctx" mesh axis and rotates KV blocks with lax.ppermute, merging partial
+softmax statistics online — apex has NO equivalent (SURVEY.md §2.5 marks
+context parallelism out of reference scope); this is the TPU-native
+extension that makes long sequences first-class.
+
+Shapes: (B, H, S, D) throughout ("bhsd").
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu import comm
+from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+
+_NEG = -1e30
+
+
+def _default_scale(d: int) -> float:
+    return 1.0 / math.sqrt(d)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel: grid (B*H, Sq/BQ); K/V resident per grid cell
+# ---------------------------------------------------------------------------
+
+def _attn_fwd_kernel(scale, causal, q_ref, k_ref, v_ref, o_ref):
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # (BQ, D)
+    k = k_ref[0].astype(jnp.float32)          # (Sk, D)
+    v = v_ref[0].astype(jnp.float32)
+    bq = q.shape[0]
+    sk = k.shape[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        row = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 1)
+        s = jnp.where(col > row, _NEG, s)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _fwd_pallas(q, k, v, scale, causal):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = max(8, min(256, sq))
+    while sq % bq:
+        bq //= 2
+    bq = max(bq, 1)
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    out = pl.pallas_call(
+        functools.partial(_attn_fwd_kernel, scale, causal),
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret_mode(),
+        name="apex_flash_attention_fwd",
+    )(q3, k3, v3)
+    return out.reshape(b, h, sq, d)
+
+
+def _kernel_ok(q, k) -> bool:
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    # K/V resident per grid cell: keep them within a few MiB of VMEM
+    return (pallas_enabled() and d % 128 == 0 and sk % 8 == 0
+            and sq % 8 == 0 and sk * d * 4 * 2 <= 6 * 1024 * 1024)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, scale=None):
+    """Fused scaled-dot-product attention, (B, H, S, D) layout.
+
+    Replaces the reference's fast_multihead_attn softmax-chain kernels
+    and fmhalib (SURVEY.md §2.3): same math, one kernel, no HBM score
+    materialization.
+    """
+    return _fa_fwd(q, k, v, causal, scale)[0]
+
+
+def _fa_fwd(q, k, v, causal, scale):
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    if _kernel_ok(q, k):
+        o = _fwd_pallas(q, k, v, sc, causal)
+    else:
+        o = attention_ref(q, k, v, causal=causal, scale=sc)
+    return o, (q, k, v)
+
+
+def _fa_bwd(causal, scale, res, do):
+    """Flash-style backward by blockwise recomputation (XLA math)."""
+    q, k, v = res
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    f = functools.partial(attention_ref, causal=causal, scale=sc)
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(do)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def attention_ref(q, k, v, causal=False, scale=None,
+                  mask: Optional[jax.Array] = None):
+    """XLA oracle/fallback; mask: additive (B,1|H,Sq,Sk) or None."""
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sc
+    if mask is not None:
+        s = s + mask
+    if causal:
+        sq, sk = s.shape[-2:]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col > row, _NEG, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# blockwise partial attention with stats (building block of the ring)
+# ---------------------------------------------------------------------------
+
+def _partial_attention(q, k, v, scale, mask_val):
+    """Unnormalized attention of q against ONE kv block.
+
+    Returns (o_un (B,H,Sq,D), m (B,H,Sq), l (B,H,Sq)): o_un = exp(s-m)@v,
+    l = rowsum(exp(s-m)).  mask_val: additive (Sq, Sk) or None.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask_val is not None:
+        s = s + mask_val
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def ring_attention(q, k, v, causal=False, scale=None,
+                   axis: str = comm.AXIS_CTX):
+    """Context-parallel attention: sequences sharded over ``axis``.
+
+    q/k/v: (B, H, S/cp, D) per shard.  KV blocks rotate around the ring
+    with ppermute; partial softmax stats merge online, so the full
+    (S, S) score matrix never exists anywhere.  Per-step traffic is the
+    KV block on ICI neighbors, overlapped by XLA with the block compute.
+    Differentiable (scan + ppermute transpose).
+    """
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    cp = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    b, h, s_loc, d = q.shape
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+
+    def step(carry, r):
+        o, m, l, k_r, v_r = carry
+        # k_r currently holds the block owned by rank (rank - r) mod cp
+        kv_owner = (rank - r) % cp
+        if causal:
+            # global positions: q row i -> rank*s_loc + i; kv col j ->
+            # kv_owner*s_loc + j
+            qpos = rank * s_loc + row
+            kpos = kv_owner * s_loc + col
+            mask_val = jnp.where(kpos > qpos, _NEG, 0.0)
+        else:
+            mask_val = None
+        o_i, m_i, l_i = _partial_attention(q, k_r, v_r, sc, mask_val)
+        m_new = jnp.maximum(m, m_i)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(m_i - m_new)
+        o = o * c_old[..., None] + o_i * c_new[..., None]
+        l = l * c_old + l_i * c_new
+        k_r = jax.lax.ppermute(k_r, axis, perm)
+        v_r = jax.lax.ppermute(v_r, axis, perm)
+        return (o, m_new, l, k_r, v_r), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    # K/V rotate in their INPUT dtype: bf16 halves the per-step ppermute
+    # bytes on ICI; _partial_attention upcasts to f32 for the math anyway
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(cp))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

@@ -24,6 +24,14 @@ Pytree = Any
 tree_map = jax.tree_util.tree_map
 
 
+def unzip_tree(like: Pytree, tree_of_tuples: Pytree, n: int):
+    """pytree-of-n-tuples -> n-tuple of pytrees (robust to tuples INSIDE
+    the params pytree, unlike is_leaf=isinstance(tuple))."""
+    outer = jax.tree_util.tree_structure(like)
+    inner = jax.tree_util.tree_structure(tuple(range(n)))
+    return jax.tree_util.tree_transpose(outer, inner, tree_of_tuples)
+
+
 def _is_low_precision(tree) -> bool:
     return any(l.dtype in (jnp.bfloat16, jnp.float16)
                for l in jax.tree_util.tree_leaves(tree)

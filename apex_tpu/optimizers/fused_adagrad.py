@@ -6,7 +6,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from apex_tpu.optimizers import _functional as F
-from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map
+from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
 
 class FusedAdagrad(FusedOptimizerBase):
@@ -26,8 +26,5 @@ class FusedAdagrad(FusedOptimizerBase):
                                   grad_scale=grad_scale)
 
         out = tree_map(leaf, params, grads, opt_state["sum"])
-        new_p = tree_map(lambda o: o[0], out,
-                         is_leaf=lambda x: isinstance(x, tuple))
-        new_s = tree_map(lambda o: o[1], out,
-                         is_leaf=lambda x: isinstance(x, tuple))
+        new_p, new_s = unzip_tree(params, out, 2)
         return new_p, {"sum": new_s}

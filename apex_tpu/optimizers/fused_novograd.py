@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from apex_tpu.optimizers import _functional as F
-from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map
+from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
 
 class FusedNovoGrad(FusedOptimizerBase):
@@ -53,10 +53,5 @@ class FusedNovoGrad(FusedOptimizerBase):
 
         out = tree_map(leaf, params, grads, opt_state["exp_avg"],
                        opt_state["exp_avg_sq"])
-        new_p = tree_map(lambda o: o[0], out,
-                         is_leaf=lambda x: isinstance(x, tuple))
-        new_m = tree_map(lambda o: o[1], out,
-                         is_leaf=lambda x: isinstance(x, tuple))
-        new_v = tree_map(lambda o: o[2], out,
-                         is_leaf=lambda x: isinstance(x, tuple))
+        new_p, new_m, new_v = unzip_tree(params, out, 3)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}

@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from apex_tpu.optimizers import _functional as F
-from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map
+from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
 
 class FusedSGD(FusedOptimizerBase):
@@ -42,8 +42,5 @@ class FusedSGD(FusedOptimizerBase):
                 first_run=first, grad_scale=grad_scale)
 
         out = tree_map(leaf, params, grads, opt_state["momentum_buffer"])
-        new_p = tree_map(lambda o: o[0], out,
-                         is_leaf=lambda x: isinstance(x, tuple))
-        new_b = tree_map(lambda o: o[1], out,
-                         is_leaf=lambda x: isinstance(x, tuple))
+        new_p, new_b = unzip_tree(params, out, 2)
         return new_p, {"momentum_buffer": new_b}

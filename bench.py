@@ -1,0 +1,98 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput, amp O2 +
+FusedSGD (BASELINE.md north star — the reference's
+examples/imagenet/main_amp.py config, synthetic data).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N}
+
+vs_baseline compares against the A100 amp target named in BASELINE.json
+(~2500 imgs/sec/chip for ResNet-50 AMP on DGX A100, the number the
+north star says to get within 10% of).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+A100_IMGS_PER_SEC = 2500.0
+
+
+def main():
+    from apex_tpu import amp
+    from apex_tpu.models import resnet50
+    from apex_tpu.optimizers import FusedSGD
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    batch = 128 if on_tpu else 8
+    size = 224 if on_tpu else 64
+    steps = 20 if on_tpu else 3
+
+    model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.key(0)
+    x = jax.random.normal(rng, (batch, size, size, 3), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.key(1), (batch,), 0, 1000)
+
+    variables = model.init(jax.random.key(2), x, train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # amp O2: bf16 weights + f32 masters, static scale (bf16).  The
+    # masters come from amp.initialize (cast from the ORIGINAL f32
+    # init), not from re-upcasting the rounded bf16 params.
+    params_bf16, amp_state = amp.initialize(params, opt_level="O2")
+    opt = FusedSGD(params_bf16, lr=0.1, momentum=0.9, weight_decay=1e-4,
+                   master_weights=True)
+    opt.masters = amp_state.master_params
+
+    def train_step(params, masters, opt_state, batch_stats, step, x, y):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x,
+                train=True, mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(y, 1000, dtype=jnp.float32)
+            loss = -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * onehot, axis=-1))
+            return loss, updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_masters, opt_state = opt.functional_step(
+            masters, opt_state, grads, step)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: m.astype(p.dtype), params, new_masters)
+        return new_params, new_masters, opt_state, new_stats, loss
+
+    step_jit = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
+
+    params_b = params_bf16
+    masters = opt.masters
+    opt_state = opt.opt_state
+    stats = batch_stats
+
+    # warmup (compile)
+    for i in range(3):
+        params_b, masters, opt_state, stats, loss = step_jit(
+            params_b, masters, opt_state, stats, jnp.int32(i + 1), x,
+            labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params_b, masters, opt_state, stats, loss = step_jit(
+            params_b, masters, opt_state, stats, jnp.int32(i + 4), x,
+            labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_amp_o2_fused_sgd_train_throughput",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(imgs_per_sec / A100_IMGS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

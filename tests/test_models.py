@@ -1,0 +1,131 @@
+"""Model zoo smoke + correctness tests (BASELINE configs end-to-end)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import comm
+from apex_tpu.models import GPTModel, resnet18
+from apex_tpu.models.bert import BertModel
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def test_resnet18_forward_and_train_step():
+    model = resnet18(num_classes=10)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    variables = model.init(jax.random.key(1), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+    def loss_fn(params):
+        out, _ = model.apply(
+            {"params": params,
+             "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        return jnp.mean(out ** 2)
+
+    g = jax.grad(loss_fn)(variables["params"])
+    total = sum(float(jnp.sum(l)) for l in jax.tree_util.tree_leaves(g))
+    assert np.isfinite(total)
+
+
+def test_gpt_single_device_loss_decreases():
+    model = GPTModel(vocab_size=64, hidden_size=32, num_heads=4,
+                     num_layers=2, max_seq_len=16)
+    tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+    labels = jnp.roll(tokens, -1, axis=1)
+    variables = model.init(jax.random.key(1), tokens)
+
+    def loss_fn(v):
+        return model.loss(v, tokens, labels)
+
+    l0 = float(loss_fn(variables))
+    assert np.isfinite(l0)
+    # a couple of SGD steps reduce loss
+    v = variables
+    for _ in range(10):
+        g = jax.grad(loss_fn)(v)
+        v = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg, v, g)
+    l1 = float(loss_fn(v))
+    assert l1 < l0, (l0, l1)
+
+
+@pytest.mark.parametrize("sequence_parallel", [False, True])
+def test_gpt_tp_matches_tp1(sequence_parallel):
+    """GPT under tp=4 (+SP) == the same GPT with identical weights
+    replicated — the Megatron equivalence the reference's transformer
+    tests assert."""
+    V, H, NH, L, S, B = 64, 32, 4, 2, 16, 2
+    tokens = jax.random.randint(jax.random.key(0), (B, S), 0, V)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def spec_for(path, leaf):
+        name = "/".join(str(p.key) for p in path
+                        if hasattr(p, "key"))
+        if "/embed/" in f"/{name}/":
+            return P(comm.AXIS_MODEL, None)
+        if "qkv" in name or "fc1" in name:
+            return (P(None, comm.AXIS_MODEL) if leaf.ndim == 2
+                    else P(comm.AXIS_MODEL))
+        if "proj/weight" in name or "fc2/weight" in name:
+            return P(comm.AXIS_MODEL, None)
+        return P()
+
+    # tree STRUCTURE from a tp=1 trace (no collectives outside shard_map)
+    comm.initialize(data=8)
+    model1_probe = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                            num_layers=L, max_seq_len=S)
+    shape = jax.eval_shape(model1_probe.init, jax.random.key(1), tokens)
+    specs = jax.tree_util.tree_map_with_path(spec_for, shape)
+    comm.destroy()
+
+    mesh = comm.initialize(data=2, model=4)
+    model = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                     num_layers=L, max_seq_len=S,
+                     sequence_parallel=sequence_parallel)
+
+    def init_fn(key, tok):
+        return model.init(key, tok)
+
+    variables = jax.jit(shard_map(
+        init_fn, mesh, in_specs=(P(), P()), out_specs=specs))(
+        jax.random.key(1), tokens)
+
+    loss_tp = jax.jit(shard_map(
+        lambda v, t, l: model.loss(v, t, l), mesh,
+        in_specs=(specs, P(), P()), out_specs=P()))(
+        variables, tokens, labels)
+
+    # oracle: same weights, tp=1
+    comm.destroy()
+    comm.initialize(data=8)  # model axis size 1
+    model1 = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                      num_layers=L, max_seq_len=S)
+    loss_ref = model1.loss(variables, tokens, labels)
+    np.testing.assert_allclose(float(loss_tp), float(loss_ref),
+                               rtol=2e-4)
+
+
+def test_bert_forward_shapes_and_mask():
+    model = BertModel(vocab_size=64, hidden_size=32, num_heads=4,
+                      num_layers=2, max_seq_len=16)
+    tokens = jax.random.randint(jax.random.key(0), (2, 12), 0, 64)
+    amask = jnp.ones((2, 12)).at[:, 8:].set(0)
+    variables = model.init(jax.random.key(1), tokens,
+                           attention_mask=amask)
+    y = model.apply(variables, tokens, attention_mask=amask)
+    assert y.shape == (12, 2, 32)
+    logits = model.mlm_logits(variables, tokens, attention_mask=amask)
+    assert logits.shape == (12, 2, 64)
