@@ -166,3 +166,17 @@ def replicated_sharding() -> NamedSharding:
 
 def num_devices() -> int:
     return math.prod(mesh().devices.shape)
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """Version-compat ``shard_map``: jax>=0.8 `jax.shard_map(check_vma=)`,
+    older releases `jax.experimental.shard_map(check_rep=)`.  Single home
+    for the shim used by the package, tests, examples, and the driver
+    entry."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (TypeError, AttributeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)

@@ -38,7 +38,9 @@ class FusedDense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        w = self.param("weight", nn.initializers.lecun_normal(),
+        # torch Linear weight layout is (out, in): fan-in is the LAST axis
+        w = self.param("weight",
+                       nn.initializers.lecun_normal(in_axis=-1, out_axis=-2),
                        (self.out_features, self.in_features),
                        self.param_dtype)
         b = (self.param("bias", nn.initializers.zeros,
@@ -57,7 +59,7 @@ class FusedDenseGeluDense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        init = nn.initializers.lecun_normal()
+        init = nn.initializers.lecun_normal(in_axis=-1, out_axis=-2)
         w1 = self.param("weight1", init,
                         (self.intermediate_features, self.in_features),
                         self.param_dtype)

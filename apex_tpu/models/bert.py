@@ -104,6 +104,8 @@ class BertModel(nn.Module):
         x = FusedLayerNorm(normalized_shape=self.hidden_size,
                            name="embed_layernorm")(x)
         x = jnp.transpose(x, (1, 0, 2)).astype(self.dtype)   # (s, b, h)
+        if self.sequence_parallel:
+            x = tp.scatter_to_sequence_parallel_region(x)
         mask = None
         if attention_mask is not None:
             # (b, s) 1=keep -> additive (b, 1, 1, s)
@@ -113,6 +115,8 @@ class BertModel(nn.Module):
             x = BertLayer(self.hidden_size, self.num_heads,
                           sequence_parallel=self.sequence_parallel,
                           dtype=self.dtype, name=f"layer_{i}")(x, mask)
+        if self.sequence_parallel:
+            x = tp.gather_from_sequence_parallel_region(x)
         return x
 
     def mlm_logits(self, variables, tokens, **kw):

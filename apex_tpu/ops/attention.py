@@ -66,38 +66,51 @@ def _attn_fwd_kernel(scale, causal, q_ref, k_ref, v_ref, o_ref):
     o_ref[0] = o.astype(o_ref.dtype)
 
 
+def _lane_pad(d: int) -> int:
+    """Head dim rounded up to the 128-lane width of the VPU/MXU."""
+    return -(-d // 128) * 128
+
+
 def _fwd_pallas(q, k, v, scale, causal):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    # pad head dim to the 128-lane tile: real head dims (64, 80, 96...)
+    # would otherwise never reach the kernel; zero columns change nothing
+    # (scores gain 0-products, V gains zero output columns we slice off)
+    dp = _lane_pad(d)
+    if dp != d:
+        pad = ((0, 0), (0, 0), (0, 0), (0, dp - d))
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
     bq = max(8, min(256, sq))
     while sq % bq:
         bq //= 2
     bq = max(bq, 1)
-    q3 = q.reshape(b * h, sq, d)
-    k3 = k.reshape(b * h, sk, d)
-    v3 = v.reshape(b * h, sk, d)
+    q3 = q.reshape(b * h, sq, dp)
+    k3 = k.reshape(b * h, sk, dp)
+    v3 = v.reshape(b * h, sk, dp)
     out = pl.pallas_call(
         functools.partial(_attn_fwd_kernel, scale, causal),
         grid=(b * h, sq // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bq, dp), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, dp), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, dp), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=pl.BlockSpec((1, bq, dp), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, dp), q.dtype),
         interpret=interpret_mode(),
         name="apex_flash_attention_fwd",
     )(q3, k3, v3)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, dp)[..., :d]
 
 
 def _kernel_ok(q, k) -> bool:
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    dp = _lane_pad(d)
     # K/V resident per grid cell: keep them within a few MiB of VMEM
-    return (pallas_enabled() and d % 128 == 0 and sk % 8 == 0
-            and sq % 8 == 0 and sk * d * 4 * 2 <= 6 * 1024 * 1024)
+    return (pallas_enabled() and sk % 8 == 0
+            and sq % 8 == 0 and sk * dp * 4 * 2 <= 6 * 1024 * 1024)
 
 
 # ---------------------------------------------------------------------------
@@ -125,12 +138,54 @@ def _fa_fwd(q, k, v, causal, scale):
 
 
 def _fa_bwd(causal, scale, res, do):
-    """Flash-style backward by blockwise recomputation (XLA math)."""
+    """Memory-efficient backward: scan over q-chunks, recompute scores.
+
+    Peak live memory is O(chunk * Sk) per (B, H) — the full (Sq, Sk)
+    probability matrix is never materialized, matching the behavior the
+    reference gets from its fused in-place bwd kernels.  Standard flash
+    identities: dp = do @ V^T, D = rowsum(p * dp) (= rowsum(do * o)),
+    ds = p * (dp - D) * scale.
+    """
     q, k, v = res
     sc = scale if scale is not None else _default_scale(q.shape[-1])
-    f = functools.partial(attention_ref, causal=causal, scale=sc)
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(do)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    ch = max(8, min(256, sq))
+    while sq % ch:
+        ch //= 2
+    ch = max(ch, 1)
+    n = sq // ch
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # (n, b, h, ch, d) chunk-major for scan
+    qc = jnp.moveaxis(q.astype(jnp.float32).reshape(b, h, n, ch, d), 2, 0)
+    doc = jnp.moveaxis(do.astype(jnp.float32).reshape(b, h, n, ch, d), 2, 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (ch, sk), 1)
+
+    def step(carry, inp):
+        dk, dv = carry
+        qi, doi, idx = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qi, kf) * sc
+        if causal:
+            row = (idx * ch
+                   + jax.lax.broadcasted_iota(jnp.int32, (ch, sk), 0))
+            s = jnp.where(col > row, _NEG, s)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", doi, vf)
+        dval = jnp.sum(p * dp, axis=-1, keepdims=True)
+        ds = p * (dp - dval) * sc
+        dqi = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qi)
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, doi)
+        return (dk, dv), dqi
+
+    (dk, dv), dq = jax.lax.scan(
+        step, (jnp.zeros_like(kf), jnp.zeros_like(vf)),
+        (qc, doc, jnp.arange(n)))
+    dq = jnp.moveaxis(dq, 0, 2).reshape(b, h, sq, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -13,16 +13,6 @@ from apex_tpu.optimizers import FusedAdam
 from apex_tpu.transformer import tensor_parallel as tp
 
 
-def shard_map(f, mesh, in_specs, out_specs):
-    try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
-        from jax.experimental.shard_map import shard_map as sm
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-
-
 IN, HID = 32, 64
 
 
@@ -53,7 +43,7 @@ def main():
     x = jax.random.normal(jax.random.key(1), (64, IN))
     y = jnp.sum(x[:, :3], axis=1, keepdims=True)
 
-    params = jax.jit(shard_map(init_fn, mesh, in_specs=(P(), P()),
+    params = jax.jit(comm.shard_map(init_fn, mesh, in_specs=(P(), P()),
                                out_specs=pspecs))(jax.random.key(0), x)
     opt = FusedAdam(params, lr=3e-3)
     scaler = amp.LossScaleState.create(1.0)
@@ -73,7 +63,7 @@ def main():
                                                 step)
         return params, opt_state, loss
 
-    step_fn = jax.jit(shard_map(
+    step_fn = jax.jit(comm.shard_map(
         train_step, mesh,
         in_specs=(pspecs,
                   {"exp_avg": pspecs, "exp_avg_sq": pspecs},

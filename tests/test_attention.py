@@ -11,16 +11,6 @@ from apex_tpu import comm
 from apex_tpu.ops import attention as attn
 
 
-def shard_map(f, mesh, in_specs, out_specs):
-    try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
-        from jax.experimental.shard_map import shard_map as sm
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-
-
 def qkv(key, b=2, h=2, s=64, d=128, dtype=jnp.float32):
     ks = jax.random.split(key, 3)
     mk = lambda k: jax.random.normal(k, (b, h, s, d), jnp.float32
@@ -57,6 +47,26 @@ def test_flash_attention_grads_match_ref(causal):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("d", [64, 80])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_unaligned_head_dim(causal, d):
+    """Real head dims (64, 80) take the lane-padded kernel path; values
+    and grads must still match the oracle."""
+    q, k, v = qkv(jax.random.key(7), s=32, d=d)
+    o = attn.flash_attention(q, k, v, causal)
+    want = attn.attention_ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    g = jax.grad(lambda *a: jnp.sum(
+        attn.flash_attention(*a, causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(
+        attn.attention_ref(*a, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_flash_attention_cross_lengths():
     """Encoder-decoder shape: Sq != Sk."""
     kq, kk = jax.random.split(jax.random.key(2))
@@ -80,7 +90,7 @@ def test_ring_attention_matches_full(causal):
     def f(q, k, v):
         return attn.ring_attention(q, k, v, causal=causal)
 
-    o = jax.jit(shard_map(
+    o = jax.jit(comm.shard_map(
         f, mesh,
         in_specs=(P(None, None, comm.AXIS_CTX, None),) * 3,
         out_specs=P(None, None, comm.AXIS_CTX, None)))(q, k, v)
@@ -99,7 +109,7 @@ def test_ring_attention_grads_match_full():
     def f(q, k, v):
         return jnp.sum(attn.ring_attention(q, k, v, causal=True) ** 2)
 
-    g = jax.jit(shard_map(
+    g = jax.jit(comm.shard_map(
         jax.grad(f, argnums=(0, 1, 2)), mesh,
         in_specs=(P(None, None, comm.AXIS_CTX, None),) * 3,
         out_specs=(P(None, None, comm.AXIS_CTX, None),) * 3))(q, k, v)

@@ -11,16 +11,6 @@ from apex_tpu.models import GPTModel, resnet18
 from apex_tpu.models.bert import BertModel
 
 
-def shard_map(f, mesh, in_specs, out_specs):
-    try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
-        from jax.experimental.shard_map import shard_map as sm
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-
-
 def test_resnet18_forward_and_train_step():
     model = resnet18(num_classes=10)
     x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
@@ -99,11 +89,11 @@ def test_gpt_tp_matches_tp1(sequence_parallel):
     def init_fn(key, tok):
         return model.init(key, tok)
 
-    variables = jax.jit(shard_map(
+    variables = jax.jit(comm.shard_map(
         init_fn, mesh, in_specs=(P(), P()), out_specs=specs))(
         jax.random.key(1), tokens)
 
-    loss_tp = jax.jit(shard_map(
+    loss_tp = jax.jit(comm.shard_map(
         lambda v, t, l: model.loss(v, t, l), mesh,
         in_specs=(specs, P(), P()), out_specs=P()))(
         variables, tokens, labels)
@@ -116,6 +106,48 @@ def test_gpt_tp_matches_tp1(sequence_parallel):
     loss_ref = model1.loss(variables, tokens, labels)
     np.testing.assert_allclose(float(loss_tp), float(loss_ref),
                                rtol=2e-4)
+
+
+@pytest.mark.parametrize("sequence_parallel", [False, True])
+def test_bert_tp_matches_tp1(sequence_parallel):
+    """BERT under tp=4 (+SP scatter/gather) == same weights at tp=1."""
+    V, H, NH, L, S, B = 64, 32, 4, 2, 16, 2
+    tokens = jax.random.randint(jax.random.key(10), (B, S), 0, V)
+
+    def spec_for(path, leaf):
+        name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        if "/embed/" in f"/{name}/":
+            return P(comm.AXIS_MODEL, None)
+        if "qkv" in name or "fc1" in name:
+            return (P(None, comm.AXIS_MODEL) if leaf.ndim == 2
+                    else P(comm.AXIS_MODEL))
+        if "proj/weight" in name or "fc2/weight" in name:
+            return P(comm.AXIS_MODEL, None)
+        return P()
+
+    comm.initialize(data=8)
+    probe = BertModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                      num_layers=L, max_seq_len=S)
+    shape = jax.eval_shape(probe.init, jax.random.key(11), tokens)
+    specs = jax.tree_util.tree_map_with_path(spec_for, shape)
+    comm.destroy()
+
+    mesh = comm.initialize(data=2, model=4)
+    model = BertModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                      num_layers=L, max_seq_len=S,
+                      sequence_parallel=sequence_parallel)
+    variables = jax.jit(comm.shard_map(
+        lambda k, t: model.init(k, t), mesh,
+        in_specs=(P(), P()), out_specs=specs))(jax.random.key(11), tokens)
+    out_tp = jax.jit(comm.shard_map(
+        lambda v, t: model.apply(v, t), mesh,
+        in_specs=(specs, P()), out_specs=P()))(variables, tokens)
+
+    comm.destroy()
+    comm.initialize(data=8)
+    out_ref = probe.apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(out_tp), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_bert_forward_shapes_and_mask():

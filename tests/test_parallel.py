@@ -17,16 +17,6 @@ from apex_tpu.parallel import (DistributedDataParallel, LARC,
 from apex_tpu.optimizers import FusedSGD
 
 
-def shard_map(f, mesh, in_specs, out_specs):
-    try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
-        from jax.experimental.shard_map import shard_map as sm
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-
-
 @pytest.mark.parametrize("n,c", [(32, 128), (100, 256), (7, 128)])
 def test_welford_kernel_vs_ref(n, c):
     x = jax.random.normal(jax.random.key(0), (n, c))
@@ -57,7 +47,7 @@ def test_sync_stats_match_full_batch():
         mean, var, n = sync_batch_norm_stats(xs, comm.AXIS_DATA)
         return mean, var
 
-    mean, var = jax.jit(shard_map(
+    mean, var = jax.jit(comm.shard_map(
         f, mesh, in_specs=P(comm.AXIS_DATA), out_specs=P()))(x)
     np.testing.assert_allclose(mean, jnp.mean(x, 0), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(var, jnp.var(x, 0), rtol=1e-4, atol=1e-6)
@@ -75,7 +65,7 @@ def test_syncbn_module_matches_full_batch_bn():
                               mutable=["batch_stats"])
         return y, updates
 
-    y, updates = jax.jit(shard_map(
+    y, updates = jax.jit(comm.shard_map(
         f, mesh, in_specs=(P(), P(comm.AXIS_DATA)),
         out_specs=(P(comm.AXIS_DATA), P())))(variables, x)
 
@@ -107,7 +97,7 @@ def test_ddp_reduce_matches_full_batch_grads():
         g = jax.grad(loss_fn)(w, xs, ys)
         return ddp.reduce_gradients(g)
 
-    g = jax.jit(shard_map(
+    g = jax.jit(comm.shard_map(
         step, mesh, in_specs=(P(), P(comm.AXIS_DATA), P(comm.AXIS_DATA)),
         out_specs=P()))(w, x, y)
     np.testing.assert_allclose(g, full_grad, rtol=1e-5, atol=1e-6)

@@ -17,16 +17,6 @@ MB = 4         # microbatch size
 L = 4          # stages
 
 
-def shard_map(f, mesh, in_specs, out_specs):
-    try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
-        from jax.experimental.shard_map import shard_map as sm
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-
-
 def stage_apply(params, x):
     return jnp.tanh(x @ params["w"] + params["b"])
 
@@ -149,7 +139,7 @@ def test_spmd_pipeline_matches_chain(problem):
         local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
         return pp.spmd_pipeline(stage_apply, local, xx)
 
-    y = jax.jit(shard_map(
+    y = jax.jit(comm.shard_map(
         run, mesh,
         in_specs=(pspec, P()),
         out_specs=P()))(stacked, x)
@@ -173,7 +163,7 @@ def test_spmd_pipeline_grads_match_chain(problem):
             stage_apply, lambda y, t: jnp.mean((y - t) ** 2),
             local, xx, tt)
 
-    g = jax.jit(shard_map(
+    g = jax.jit(comm.shard_map(
         jax.grad(loss), mesh,
         in_specs=(pspec, P(), P()),
         out_specs=pspec))(stacked, x, tgt)

@@ -15,16 +15,6 @@ from apex_tpu.transformer.tensor_parallel import mappings
 IN, OUT = 16, 32
 
 
-def shard_map(f, mesh, in_specs, out_specs):
-    try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
-        from jax.experimental.shard_map import shard_map as sm
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-
-
 def tp_mesh():
     return comm.initialize(data=2, model=4)
 
@@ -42,7 +32,7 @@ def row_specs():
 def init_sharded(mesh, module, x_spec, x, param_specs):
     def init_fn(key, xx):
         return module.init(key, xx)
-    return jax.jit(shard_map(init_fn, mesh, in_specs=(P(), x_spec),
+    return jax.jit(comm.shard_map(init_fn, mesh, in_specs=(P(), x_spec),
                              out_specs=param_specs))(jax.random.key(0), x)
 
 
@@ -52,7 +42,7 @@ def test_column_parallel_matches_dense():
     x = jax.random.normal(jax.random.key(1), (6, IN))
     params = init_sharded(mesh, col, P(), x, col_specs())
 
-    y = jax.jit(shard_map(lambda p, xx: col.apply(p, xx), mesh,
+    y = jax.jit(comm.shard_map(lambda p, xx: col.apply(p, xx), mesh,
                           in_specs=(col_specs(), P()),
                           out_specs=P()))(params, x)
     w = params["params"]["weight"]   # assembled (IN, OUT)
@@ -68,7 +58,7 @@ def test_row_parallel_matches_dense():
     x = jax.random.normal(jax.random.key(2), (6, IN))
     params = init_sharded(mesh, row, P(), x, row_specs())
 
-    y = jax.jit(shard_map(lambda p, xx: row.apply(p, xx), mesh,
+    y = jax.jit(comm.shard_map(lambda p, xx: row.apply(p, xx), mesh,
                           in_specs=(row_specs(), P()),
                           out_specs=P()))(params, x)
     w = params["params"]["weight"]
@@ -122,7 +112,7 @@ def test_tp_mlp_forward_and_grads_match_dense():
     model = TwoLayer()
     x = jax.random.normal(jax.random.key(3), (8, IN))
 
-    params = jax.jit(shard_map(model.init, mesh,
+    params = jax.jit(comm.shard_map(model.init, mesh,
                                in_specs=(P(), P()),
                                out_specs=model.specs()))(
         jax.random.key(0), x)
@@ -133,7 +123,7 @@ def test_tp_mlp_forward_and_grads_match_dense():
     def dense_loss(p, xx):
         return jnp.sum(dense_oracle(p, xx) ** 2)
 
-    l_tp, g_tp = jax.jit(shard_map(
+    l_tp, g_tp = jax.jit(comm.shard_map(
         jax.value_and_grad(loss), mesh,
         in_specs=(model.specs(), P()),
         out_specs=(P(), model.specs())))(params, x)
@@ -156,12 +146,12 @@ def test_sequence_parallel_mlp_matches_dense():
     S = 8  # sequence length, sharded 4-way
     x = jax.random.normal(jax.random.key(4), (S, 2, IN))
 
-    params = jax.jit(shard_map(model.init, mesh,
+    params = jax.jit(comm.shard_map(model.init, mesh,
                                in_specs=(P(), P(comm.AXIS_MODEL)),
                                out_specs=model.specs()))(
         jax.random.key(0), x)
 
-    y = jax.jit(shard_map(model.apply, mesh,
+    y = jax.jit(comm.shard_map(model.apply, mesh,
                           in_specs=(model.specs(), P(comm.AXIS_MODEL)),
                           out_specs=P(comm.AXIS_MODEL)))(params, x)
     want = dense_oracle(params, x)
@@ -175,10 +165,10 @@ def test_vocab_parallel_embedding_matches_take():
     emb = tp.VocabParallelEmbedding(V, D)
     ids = jax.random.randint(jax.random.key(5), (4, 7), 0, V)
     especs = {"params": {"weight": P(comm.AXIS_MODEL, None)}}
-    params = jax.jit(shard_map(lambda k, i: emb.init(k, i), mesh,
+    params = jax.jit(comm.shard_map(lambda k, i: emb.init(k, i), mesh,
                                in_specs=(P(), P()),
                                out_specs=especs))(jax.random.key(0), ids)
-    y = jax.jit(shard_map(lambda p, i: emb.apply(p, i), mesh,
+    y = jax.jit(comm.shard_map(lambda p, i: emb.apply(p, i), mesh,
                           in_specs=(especs, P()),
                           out_specs=P()))(params, ids)
     want = jnp.take(params["params"]["weight"], ids, axis=0)
@@ -197,7 +187,7 @@ def test_vocab_parallel_cross_entropy(smoothing):
         return tp.vocab_parallel_cross_entropy(lg, t,
                                                label_smoothing=smoothing)
 
-    loss = jax.jit(shard_map(f, mesh,
+    loss = jax.jit(comm.shard_map(f, mesh,
                              in_specs=(P(None, comm.AXIS_MODEL), P()),
                              out_specs=P()))(logits, target)
     want = tp.cross_entropy_ref(logits, target, label_smoothing=smoothing)
@@ -214,7 +204,7 @@ def test_vocab_parallel_cross_entropy_grads():
     def f(lg, t):
         return jnp.mean(tp.vocab_parallel_cross_entropy(lg, t))
 
-    g = jax.jit(shard_map(jax.grad(f), mesh,
+    g = jax.jit(comm.shard_map(jax.grad(f), mesh,
                           in_specs=(P(None, comm.AXIS_MODEL), P()),
                           out_specs=P(None, comm.AXIS_MODEL)))(
         logits, target)
@@ -232,7 +222,7 @@ def test_mappings_roundtrip():
         s = mappings.scatter_to_tensor_model_parallel_region(xx)
         return mappings.gather_from_tensor_model_parallel_region(s)
 
-    y = jax.jit(shard_map(f, mesh, in_specs=P(), out_specs=P()))(x)
+    y = jax.jit(comm.shard_map(f, mesh, in_specs=P(), out_specs=P()))(x)
     np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
 
 
