@@ -1,0 +1,3 @@
+from apex_tpu.RNN.models import GRU, LSTM, mLSTM, RNNCell  # noqa: F401
+
+__all__ = ["LSTM", "GRU", "mLSTM", "RNNCell"]

@@ -1,0 +1,194 @@
+"""apex.RNN parity — fused recurrent cells (reference: apex/RNN/*.py:
+LSTM, GRU, mLSTM factories over fused pointwise cells; deprecated
+upstream but part of the surface, SURVEY.md §2.1).
+
+TPU-native structure: the input-to-hidden projection for ALL timesteps is
+ONE batched (T*B, 4H) GEMM on the MXU before the loop (the reference
+fuses per-step GEMMs instead — on TPU hoisting is strictly better); only
+the hidden-to-hidden matmul and the pointwise gate math live inside a
+`lax.scan`, which XLA compiles to a single fused step — the same effect
+as the reference's fused pointwise CUDA cells, minus the launches.
+
+Layout: (T, B, input_size) seq-first, matching the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _dense(feats, name, bias=True):
+    return nn.Dense(feats, use_bias=bias, name=name)
+
+
+class _StackedRNNBase(nn.Module):
+    """Shared stacked-layer scaffolding."""
+
+    def h2h_params(self, layer, n_gates):
+        h = self.hidden_size
+        wh = self.param(f"l{layer}_h2h_kernel",
+                        nn.initializers.lecun_normal(), (h, n_gates * h))
+        bh = (self.param(f"l{layer}_h2h_bias", nn.initializers.zeros,
+                         (n_gates * h,)) if self.bias else None)
+        return wh, bh
+
+    def inter_layer_dropout(self, outs, layer, is_training):
+        """Reference parity: dropout between stacked layers, not after
+        the last."""
+        if self.dropout > 0.0 and layer < self.num_layers - 1:
+            outs = nn.Dropout(self.dropout)(
+                outs, deterministic=not is_training)
+        return outs
+
+
+def _lstm_gates(g, c):
+    i, f, gg, o = jnp.split(g, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+class LSTM(_StackedRNNBase):
+    """Multi-layer LSTM, reference-factory shape:
+    LSTM(input_size, hidden_size, num_layers, bias, dropout).
+    Gate order i, f, g, o."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, hx: Optional[tuple] = None,
+                 is_training: bool = False):
+        t, b, _ = x.shape
+        outs = x
+        finals = []
+        for layer in range(self.num_layers):
+            gi = _dense(4 * self.hidden_size, f"l{layer}_i2h",
+                        self.bias)(outs)                    # (T, B, 4H)
+            wh, bh = self.h2h_params(layer, 4)
+            if hx is None:
+                h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+                carry = (h0, h0)
+            else:
+                carry = (hx[0][layer], hx[1][layer])
+
+            def step(carry, g_t, wh=wh, bh=bh):
+                h, c = carry
+                g = g_t + h @ wh + (bh if bh is not None else 0.0)
+                h, c = _lstm_gates(g, c)
+                return (h, c), h
+
+            carry, outs = jax.lax.scan(step, carry, gi)
+            outs = self.inter_layer_dropout(outs, layer, is_training)
+            finals.append(carry)
+        h_n = jnp.stack([f[0] for f in finals])
+        c_n = jnp.stack([f[1] for f in finals])
+        return outs, (h_n, c_n)
+
+
+class GRU(_StackedRNNBase):
+    """Gate order r, z, n (torch/reference convention: the candidate's
+    hidden projection is gated by r BEFORE the bias-add of hn)."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, hx: Optional[jnp.ndarray] = None,
+                 is_training: bool = False):
+        t, b, _ = x.shape
+        outs = x
+        finals = []
+        for layer in range(self.num_layers):
+            gi = _dense(3 * self.hidden_size, f"l{layer}_i2h",
+                        self.bias)(outs)
+            wh, bh = self.h2h_params(layer, 3)
+            carry = (jnp.zeros((b, self.hidden_size), x.dtype)
+                     if hx is None else hx[layer])
+
+            def step(h, g_t, wh=wh, bh=bh):
+                gh = h @ wh + (bh if bh is not None else 0.0)
+                ir, iz, in_ = jnp.split(g_t, 3, axis=-1)
+                hr, hz, hn = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(in_ + r * hn)
+                h = (1.0 - z) * n + z * h
+                return h, h
+
+            carry, outs = jax.lax.scan(step, carry, gi)
+            outs = self.inter_layer_dropout(outs, layer, is_training)
+            finals.append(carry)
+        return outs, jnp.stack(finals)
+
+
+class mLSTM(_StackedRNNBase):
+    """Multiplicative LSTM (reference apex/RNN/models.py::mLSTM): the
+    hidden state is modulated by m = (W_mx x) * (W_mh h) and the
+    hidden-to-hidden gates read m instead of h."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, hx: Optional[tuple] = None,
+                 is_training: bool = False):
+        t, b, _ = x.shape
+        outs = x
+        finals = []
+        for layer in range(self.num_layers):
+            gi = _dense(4 * self.hidden_size, f"l{layer}_i2h",
+                        self.bias)(outs)
+            mx = _dense(self.hidden_size, f"l{layer}_mx", False)(outs)
+            w_mh = self.param(f"l{layer}_mh_kernel",
+                              nn.initializers.lecun_normal(),
+                              (self.hidden_size, self.hidden_size))
+            wh, bh = self.h2h_params(layer, 4)
+            if hx is None:
+                h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+                carry = (h0, h0)
+            else:
+                carry = (hx[0][layer], hx[1][layer])
+
+            def step(carry, inp, w_mh=w_mh, wh=wh, bh=bh):
+                h, c = carry
+                g_t, mx_t = inp
+                m = mx_t * (h @ w_mh)
+                g = g_t + m @ wh + (bh if bh is not None else 0.0)
+                h, c = _lstm_gates(g, c)
+                return (h, c), h
+
+            carry, outs = jax.lax.scan(step, carry, (gi, mx))
+            outs = self.inter_layer_dropout(outs, layer, is_training)
+            finals.append(carry)
+        h_n = jnp.stack([f[0] for f in finals])
+        c_n = jnp.stack([f[1] for f in finals])
+        return outs, (h_n, c_n)
+
+
+class RNNCell(nn.Module):
+    """Plain tanh/ReLU cell (reference RNNCell parity)."""
+
+    input_size: int
+    hidden_size: int
+    nonlinearity: str = "tanh"
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, h):
+        act = jnp.tanh if self.nonlinearity == "tanh" else jax.nn.relu
+        return act(_dense(self.hidden_size, "i2h", self.bias)(x)
+                   + _dense(self.hidden_size, "h2h", self.bias)(h))

@@ -1,0 +1,10 @@
+"""apex_tpu.contrib — optional-feature parity tree (reference:
+apex/contrib/, SURVEY.md §2.3).
+
+The reference gates each contrib feature on "was its CUDA extension
+built?".  Here every feature is pure Python over the apex_tpu.ops kernel
+substrate, so everything importable is available; GPU-physics-bound
+features (peer_memory, nccl_p2p raw channels, gpu_direct_storage,
+nccl_allocator) exist as documented stubs raising NotImplementedError —
+see apex_tpu/contrib/_unavailable.py and the parity matrix in PARITY.md.
+"""

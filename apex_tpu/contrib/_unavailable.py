@@ -1,0 +1,37 @@
+"""Documented stubs for GPU-physics-bound reference features with no TPU
+analog (SURVEY.md §2.3/§2.4: peer_memory = CUDA-IPC peer buffers,
+nccl_p2p = raw NCCL channels, nccl_allocator = NCCL-registered caching
+allocator, gpu_direct_storage = GPUDirect cufile IO).
+
+On TPU the equivalents are owned by the runtime: device-to-device
+transfer is XLA `ppermute`/collective traffic over ICI (see
+apex_tpu.comm), and host IO never bypasses the host.  Importing these
+modules works (so feature-probing code can run); USING them raises with
+a pointer to the TPU-native replacement, which is honest parity for a
+feature whose premise is CUDA hardware.
+"""
+
+from __future__ import annotations
+
+
+class _Unavailable:
+    def __init__(self, feature: str, replacement: str):
+        self._feature = feature
+        self._replacement = replacement
+
+    def _raise(self):
+        raise NotImplementedError(
+            f"{self._feature} is CUDA-hardware-bound and has no TPU "
+            f"analog; use {self._replacement} instead (see PARITY.md)")
+
+    def __call__(self, *a, **kw):
+        self._raise()
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        self._raise()
+
+
+def make(feature: str, replacement: str) -> _Unavailable:
+    return _Unavailable(feature, replacement)

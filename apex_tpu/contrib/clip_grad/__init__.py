@@ -1,0 +1,6 @@
+from apex_tpu.contrib.clip_grad.clip_grad import (  # noqa: F401
+    clip_grad_norm_,
+    clip_grad_norm,
+)
+
+__all__ = ["clip_grad_norm_", "clip_grad_norm"]

@@ -1,0 +1,45 @@
+"""Fused gradient clipping (reference: apex/contrib/clip_grad/clip_grad.py,
+SURVEY.md §2.3 — `clip_grad_norm_` over amp_C.multi_tensor_l2norm +
+multi_tensor_scale).
+
+The reference's win is ONE l2norm kernel over all grads and ONE scale
+kernel, instead of per-tensor launches.  TPU-native: ravel the grad
+pytree once, take the global norm with the Pallas flat_l2norm kernel,
+scale with flat_scale — two fused passes, no per-leaf work.  JAX arrays
+are immutable so the "in-place" entry point returns the clipped tree.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from apex_tpu.ops.multi_tensor import flat_l2norm, flat_scale
+
+
+def clip_grad_norm(grads, max_norm, norm_type=2.0, eps=1e-6):
+    """Clip a grad pytree to global norm max_norm.
+
+    Returns (clipped_grads, total_norm).  norm_type 2.0 uses the fused
+    Pallas l2norm; other norms (incl. inf) go through XLA.
+    """
+    flat, unravel = ravel_pytree(grads)
+    if norm_type == 2.0:
+        total_norm = flat_l2norm(flat)
+    elif norm_type == float("inf"):
+        total_norm = jnp.max(jnp.abs(flat.astype(jnp.float32)))
+    else:
+        a = jnp.abs(flat.astype(jnp.float32))
+        total_norm = jnp.sum(a ** norm_type) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total_norm + eps), 1.0)
+    clipped, _ = flat_scale(flat, scale.astype(jnp.float32))
+    return unravel(clipped.astype(flat.dtype)), total_norm
+
+
+def clip_grad_norm_(grads, max_norm, norm_type=2.0):
+    """Reference-shaped entry point (same name incl. trailing underscore).
+
+    Reference returns the pre-clip total norm; here the clipped tree comes
+    too since mutation is impossible: (clipped_grads, total_norm)."""
+    return clip_grad_norm(grads, max_norm, norm_type)

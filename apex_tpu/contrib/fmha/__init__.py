@@ -1,0 +1,3 @@
+from apex_tpu.contrib.fmha.fmha import FMHAFun, fmha_packed  # noqa: F401
+
+__all__ = ["FMHAFun", "fmha_packed"]

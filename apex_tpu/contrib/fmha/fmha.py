@@ -1,0 +1,66 @@
+"""contrib.fmha parity — fused MHA on packed variable-length batches
+(reference: apex/contrib/fmha/ over apex/contrib/csrc/fmha/, SURVEY.md
+§2.3; pre-FlashAttention kernels for seqlens <= 512).
+
+Reference contract: qkv packed as (total_tokens, 3, H, D) with
+cu_seqlens (B+1,) prefix offsets; attention runs independently inside
+each sequence.  TPU-native: keep the packed layout end-to-end and mask
+cross-sequence pairs with segment ids derived from cu_seqlens —
+everything stays static-shape (dynamic per-example seqlens live in the
+mask values, never in shapes, as XLA requires).  The O(total^2) score
+tile is in line with the reference's own <=512-seqlen envelope.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -10000.0
+
+
+def _segment_ids(cu_seqlens, total):
+    """token index -> sequence index, from (B+1,) prefix offsets."""
+    pos = jnp.arange(total)
+    return jnp.searchsorted(cu_seqlens[1:], pos, side="right")
+
+
+def fmha_packed(qkv, cu_seqlens, p_dropout=0.0, *, is_training=True,
+                dropout_rng=None, causal=False):
+    """qkv (total, 3, H, D), cu_seqlens (B+1,) int32 -> (total, H, D).
+
+    Tokens beyond cu_seqlens[-1] (padding of the packed buffer) get zero
+    output, matching the reference's packed semantics.
+    """
+    total, three, h, d = qkv.shape
+    assert three == 3
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # (total, H, D)
+    seg = _segment_ids(cu_seqlens, total)
+    valid = jnp.arange(total) < cu_seqlens[-1]
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    same = seg[:, None] == seg[None, :]
+    ok = same & valid[:, None] & valid[None, :]
+    if causal:
+        ok = ok & (jnp.arange(total)[None, :] <= jnp.arange(total)[:, None])
+    s = jnp.where(ok[None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(ok[None], p, 0.0)                    # fully-masked rows -> 0
+    if p_dropout > 0.0 and is_training:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - p_dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - p_dropout), 0.0)
+    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return (out * valid[:, None, None]).astype(qkv.dtype)
+
+
+class FMHAFun:
+    """Reference-shaped autograd.Function facade
+    (apex.contrib.fmha.FMHAFun.apply); differentiable via jax.grad."""
+
+    @staticmethod
+    def apply(qkv, cu_seqlens, p_dropout=0.0, max_s=None,
+              is_training=True, dropout_rng=None):
+        del max_s   # static shapes make the reference's max_s tiling moot
+        return fmha_packed(qkv, cu_seqlens, p_dropout,
+                           is_training=is_training, dropout_rng=dropout_rng)

@@ -1,0 +1,7 @@
+"""Stub: reference apex/contrib/gpu_direct_storage/ (GPUDirect cufile
+IO).  TPU host IO goes through the host; use numpy/orbax-style
+checkpoint IO instead.  See PARITY.md."""
+
+from apex_tpu.contrib._unavailable import make
+
+GDSFile = make("gpu_direct_storage.GDSFile", "host-side checkpoint IO")

@@ -1,0 +1,14 @@
+"""contrib.layer_norm parity (reference: apex/contrib/layer_norm/
+layer_norm.py — `FastLayerNorm` over the fast_layer_norm extension,
+hidden sizes <= ~8k, SURVEY.md §2.3).
+
+The reference maintains two separate LN kernel stacks (core
+fused_layer_norm_cuda and contrib fast_layer_norm); the TPU rebuild has
+one Pallas LN (apex_tpu.ops.layer_norm) serving both, so FastLayerNorm
+IS FusedLayerNorm under the contrib name (SURVEY.md §2.4 maps them to
+the same kernel).
+"""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm  # noqa: F401
+
+__all__ = ["FastLayerNorm"]

@@ -1,0 +1,8 @@
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import (  # noqa: F401
+    SelfMultiheadAttn,
+)
+from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+)
+
+__all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]

@@ -1,0 +1,87 @@
+"""Shared core for the fused multihead-attention family (reference:
+apex/contrib/multihead_attn/*.py over apex/contrib/csrc/multihead_attn/,
+SURVEY.md §2.3 — self/encdec attention, ±bias, ±norm-add,
+boolean-or-additive key padding masks).
+
+The reference spells every variant as a separate fused CUDA autograd
+Function (self_attn_func, self_attn_bias_func, self_attn_norm_add_func,
+encdec variants, ...).  TPU-native all variants share ONE attention core:
+the Pallas flash kernel (apex_tpu.ops.attention.flash_attention) when no
+per-key mask / prob-dropout / weight-return is requested, else the
+masked XLA path that compiles to the same fused-softmax pipeline.
+
+Layout parity: inputs are (T, B, E) seq-first, exactly the reference's
+contract; heads are split/merged here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import attention_ref, flash_attention
+
+_NEG = -10000.0
+
+
+def split_heads(x, num_heads):
+    """(T, B, E) -> (B, H, T, Dh)."""
+    t, b, e = x.shape
+    return x.reshape(t, b, num_heads, e // num_heads).transpose(1, 2, 0, 3)
+
+
+def merge_heads(x):
+    """(B, H, T, Dh) -> (T, B, E)."""
+    b, h, t, d = x.shape
+    return x.transpose(2, 0, 1, 3).reshape(t, b, h * d)
+
+
+def attention_core(q, k, v, *, causal: bool,
+                   key_padding_mask: Optional[jax.Array],
+                   mask_additive: bool,
+                   dropout_rate: float,
+                   dropout_rng,
+                   need_weights: bool):
+    """(B, H, T, Dh) attention with the reference's masking semantics.
+
+    key_padding_mask: (B, Sk) — boolean (True/nonzero = masked) or
+    additive float when mask_additive (reference's mask_additive flag).
+    Returns (out (B,H,Tq,Dh), probs or None).
+    """
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    if key_padding_mask is None and dropout_rate == 0.0 \
+            and not need_weights:
+        return flash_attention(q, k, v, causal=causal, scale=scale), None
+
+    mask = None
+    if key_padding_mask is not None:
+        if mask_additive:
+            mask = key_padding_mask.astype(jnp.float32)[:, None, None, :]
+        else:
+            mask = jnp.where(key_padding_mask[:, None, None, :] != 0,
+                             _NEG, 0.0)
+    if dropout_rate == 0.0 and not need_weights:
+        return attention_ref(q, k, v, causal=causal, scale=scale,
+                             mask=mask), None
+
+    # probs are needed (dropout and/or need_weights): inline softmax path
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = s + mask
+    if causal:
+        sq, sk = s.shape[-2:]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col > row, _NEG, s)
+    p = jax.nn.softmax(s, axis=-1)
+    p_drop = p
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    p.shape)
+        p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p_drop,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    return out, (p if need_weights else None)

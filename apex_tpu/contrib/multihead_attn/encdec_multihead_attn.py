@@ -1,0 +1,68 @@
+"""EncdecMultiheadAttn (reference: apex/contrib/multihead_attn/
+encdec_multihead_attn.py, SURVEY.md §2.3).
+
+Cross-attention: Q projected from the decoder query, K and V from the
+encoder memory via one packed (2E, E) projection — the reference
+requires key is value and packs their projection; same here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.contrib.multihead_attn._common import (
+    attention_core,
+    merge_heads,
+    split_heads,
+)
+from apex_tpu.normalization import FusedLayerNorm
+
+
+class EncdecMultiheadAttn(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    mask_additive: bool = False
+    impl: str = "fast"
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, value=None, *,
+                 key_padding_mask: Optional[jnp.ndarray] = None,
+                 need_weights: bool = False,
+                 attn_mask: Optional[str] = None,
+                 is_training: bool = True):
+        """query (Tq, B, E), key (Tk, B, E); value must be key (the
+        reference asserts key is value — the packed KV GEMM implies it)."""
+        assert value is None or value is key, \
+            "encdec attention packs K/V from the same memory"
+        assert self.embed_dim % self.num_heads == 0
+        residual = query
+        x = query
+        if self.include_norm_add:
+            x = FusedLayerNorm(normalized_shape=self.embed_dim,
+                               param_dtype=self.param_dtype)(x)
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, use_bias=self.bias, param_dtype=self.param_dtype,
+            dtype=x.dtype, name=name)
+        q = dense(self.embed_dim, "q_proj")(x)
+        kv = dense(2 * self.embed_dim, "kv_proj")(key)
+        k, v = jnp.split(kv, 2, axis=-1)
+        q, k, v = (split_heads(t, self.num_heads) for t in (q, k, v))
+        rate = self.dropout if is_training else 0.0
+        rng = self.make_rng("dropout") if rate > 0.0 else None
+        out, probs = attention_core(
+            q, k, v, causal=(attn_mask == "causal"),
+            key_padding_mask=key_padding_mask,
+            mask_additive=self.mask_additive,
+            dropout_rate=rate, dropout_rng=rng,
+            need_weights=need_weights)
+        out = dense(self.embed_dim, "out_proj")(merge_heads(out))
+        if self.include_norm_add:
+            out = out + residual
+        return out, probs

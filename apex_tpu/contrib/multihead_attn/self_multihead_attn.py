@@ -1,0 +1,74 @@
+"""SelfMultiheadAttn (reference: apex/contrib/multihead_attn/
+self_multihead_attn.py, SURVEY.md §2.3).
+
+Reference contract: (T, B, E) inputs, single packed (3E, E) in-proj (or
+separate q/k/v params), 1/sqrt(dh) scaling, optional prob dropout,
+optional fused "norm-add" (LayerNorm on the input + residual add on the
+output), boolean or additive key-padding masks, optional causal
+attn-mask.  forward(query, key, value, ...) -> (output, attn_weights?).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.contrib.multihead_attn._common import (
+    attention_core,
+    merge_heads,
+    split_heads,
+)
+from apex_tpu.normalization import FusedLayerNorm
+
+
+class SelfMultiheadAttn(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    separate_qkv_params: bool = False
+    mask_additive: bool = False
+    impl: str = "fast"          # accepted for parity; both map to the core
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key=None, value=None, *,
+                 key_padding_mask: Optional[jnp.ndarray] = None,
+                 need_weights: bool = False,
+                 attn_mask: Optional[str] = None,
+                 is_training: bool = True):
+        """query (T, B, E); key/value accepted for API parity (self-attn
+        uses query for all three).  attn_mask: None or "causal" (the
+        reference only supports the triangular mask in the fast path)."""
+        assert self.embed_dim % self.num_heads == 0
+        residual = query
+        x = query
+        if self.include_norm_add:
+            x = FusedLayerNorm(normalized_shape=self.embed_dim,
+                               param_dtype=self.param_dtype)(x)
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, use_bias=self.bias, param_dtype=self.param_dtype,
+            dtype=x.dtype, name=name)
+        if self.separate_qkv_params:
+            q = dense(self.embed_dim, "q_proj")(x)
+            k = dense(self.embed_dim, "k_proj")(x)
+            v = dense(self.embed_dim, "v_proj")(x)
+        else:
+            qkv = dense(3 * self.embed_dim, "qkv_proj")(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (split_heads(t, self.num_heads) for t in (q, k, v))
+        rate = self.dropout if is_training else 0.0
+        rng = self.make_rng("dropout") if rate > 0.0 else None
+        out, probs = attention_core(
+            q, k, v, causal=(attn_mask == "causal"),
+            key_padding_mask=key_padding_mask,
+            mask_additive=self.mask_additive,
+            dropout_rate=rate, dropout_rng=rng,
+            need_weights=need_weights)
+        out = dense(self.embed_dim, "out_proj")(merge_heads(out))
+        if self.include_norm_add:
+            out = out + residual
+        return out, probs

@@ -1,0 +1,8 @@
+"""Stub: reference apex/contrib/nccl_allocator/ (NCCL-registered caching
+allocator).  On TPU, device memory is owned by the XLA runtime; there is
+nothing to register.  See PARITY.md."""
+
+from apex_tpu.contrib._unavailable import make
+
+nccl_mem = make("nccl_allocator.nccl_mem", "XLA-managed device memory")
+init = make("nccl_allocator.init", "XLA-managed device memory")

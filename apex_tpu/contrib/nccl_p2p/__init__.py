@@ -1,0 +1,9 @@
+"""Stub: reference apex/contrib/nccl_p2p/ (raw NCCL point-to-point side
+channels).  TPU replacement: `jax.lax.ppermute` under shard_map (see
+apex_tpu.transformer.pipeline_parallel.p2p_communication).  See
+PARITY.md."""
+
+from apex_tpu.contrib._unavailable import make
+
+nccl_p2p = make(
+    "nccl_p2p", "apex_tpu.transformer.pipeline_parallel.p2p_communication")

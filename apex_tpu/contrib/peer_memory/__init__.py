@@ -1,0 +1,10 @@
+"""Stub: reference apex/contrib/peer_memory/ (CUDA-IPC peer-memory pools
+for halo exchange).  TPU replacement: `jax.lax.ppermute` over the mesh —
+see apex_tpu.contrib.bottleneck's halo exchange.  See PARITY.md."""
+
+from apex_tpu.contrib._unavailable import make
+
+PeerMemoryPool = make("peer_memory.PeerMemoryPool",
+                      "apex_tpu.comm ppermute halo exchange")
+PeerHaloExchanger1d = make("peer_memory.PeerHaloExchanger1d",
+                           "apex_tpu.contrib.bottleneck.halo_exchange")

@@ -1,0 +1,6 @@
+from apex_tpu.contrib.xentropy.softmax_xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]

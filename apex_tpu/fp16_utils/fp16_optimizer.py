@@ -1,0 +1,85 @@
+"""FP16_Optimizer (reference: apex/fp16_utils/fp16_optimizer.py — the
+legacy master-weights wrapper predating amp).
+
+Reference flow per step: scale loss -> backward -> copy model grads to
+f32 master grads -> check overflow -> (skip | master step -> copy
+masters back to model params) -> update scale.  Functionally here:
+
+    opt  = FusedSGD(half_params, lr=...)
+    fopt = FP16_Optimizer(opt, dynamic_loss_scale=True)
+    loss, grads = value_and_grad(lambda p: fopt.scale(loss_fn(p)))(params)
+    params = fopt.step(grads)          # grads of the SCALED loss
+
+The wrapped optimizer's own master handling is reused (FusedOptimizerBase
+keeps f32 masters for half params, apex O2 contract); this wrapper adds
+the legacy scaling/overflow-skip surface around it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+        self.verbose = verbose
+
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale
+
+    @property
+    def params(self):
+        return self.optimizer.params
+
+    def scale(self, loss):
+        """Multiply the loss by the current scale (use inside your loss fn;
+        replaces the reference's fp16_optimizer.backward(loss))."""
+        return loss * self.loss_scaler.loss_scale
+
+    # reference name for the same operation
+    scale_loss = scale
+
+    def step(self, scaled_grads, grad_scale_extra=1.0):
+        """Unscale, overflow-check, conditionally step; returns params."""
+        grads = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32)
+                       / (self.loss_scaler.loss_scale * grad_scale_extra)),
+            scaled_grads)
+        self.overflow = self.loss_scaler.has_overflow(grads)
+        if not self.overflow:
+            self.optimizer.step(grads)
+        elif self.verbose:
+            print(f"OVERFLOW! Skipping step, scale {self.loss_scale}")
+        self.loss_scaler.update_scale(self.overflow)
+        return self.optimizer.params
+
+    def clip_master_grads(self, grads, max_norm, norm_type=2.0):
+        """Clip (already-unscaled) f32 grads; returns (clipped, norm)."""
+        return clip_grad_norm(grads, max_norm, norm_type)
+
+    def zero_grad(self):
+        self.optimizer.zero_grad()
+
+    def state_dict(self):
+        return {
+            "optimizer": self.optimizer.state_dict(),
+            "cur_scale": self.loss_scaler.cur_scale,
+            "dynamic": isinstance(self.loss_scaler, DynamicLossScaler),
+        }
+
+    def load_state_dict(self, sd):
+        self.optimizer.load_state_dict(sd["optimizer"])
+        self.loss_scaler.cur_scale = sd["cur_scale"]

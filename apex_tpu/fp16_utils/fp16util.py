@@ -1,0 +1,81 @@
+"""Legacy manual mixed-precision helpers (reference:
+apex/fp16_utils/fp16util.py, SURVEY.md §2.1 — the pre-amp API:
+network_to_half, BN_convert_float, prep_param_lists,
+master_params_to_model_params, ...).
+
+The reference operates on nn.Module parameter lists; here the unit of
+state is the params PYTREE, so each helper is a tree transform.  "Half"
+defaults to bfloat16 — the TPU's native half — with fp16 available via
+the dtype argument.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NORM_NAME_HINTS = ("batchnorm", "bn", "layernorm", "ln", "norm",
+                    "batch_stats")
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def tree_to_half(params, dtype=jnp.bfloat16):
+    """Cast every floating leaf to half precision."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float(x) else x, params)
+
+
+def network_to_half(params, dtype=jnp.bfloat16):
+    """Reference parity: convert a model to half but keep normalization
+    layers in f32 (the reference wraps BN in tofp32 shims).  Norm leaves
+    are identified by path-name hints (flax module names)."""
+    half = tree_to_half(params, dtype)
+    return BN_convert_float(half)
+
+
+def BN_convert_float(params):
+    """Cast normalization-layer params back to f32 (reference contract:
+    BN statistics/affine math must stay f32 under fp16 training)."""
+    def fix(path, x):
+        names = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                         for k in path).lower()
+        if _is_float(x) and any(h in names for h in _NORM_NAME_HINTS):
+            return x.astype(jnp.float32)
+        return x
+    return jax.tree_util.tree_map_with_path(fix, params)
+
+
+def prep_param_lists(params, flat_master: bool = False):
+    """(model_params, master_params): f32 master copies of the model tree.
+
+    flat_master=True additionally fuses masters into ONE flat f32 buffer
+    (the reference's single-tensor master option); returned as
+    (params, (flat_buffer, unravel_fn))."""
+    masters = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32) if _is_float(x) else x, params)
+    if flat_master:
+        from jax.flatten_util import ravel_pytree
+        flat, unravel = ravel_pytree(masters)
+        return params, (flat, unravel)
+    return params, masters
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Write master values back into model dtypes (returns new tree)."""
+    return jax.tree_util.tree_map(
+        lambda p, m: m.astype(p.dtype) if _is_float(p) else m,
+        model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    """Promote model-dtype grads to f32 for the master step."""
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) if _is_float(g) else g, model_grads)
+
+
+def to_python_float(t):
+    """Reference helper: pull a scalar to host."""
+    return float(jnp.asarray(t).reshape(()))

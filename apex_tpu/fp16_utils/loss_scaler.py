@@ -1,0 +1,72 @@
+"""Legacy loss scalers (reference: apex/fp16_utils/loss_scaler.py —
+`LossScaler` (static) and `DynamicLossScaler`, the pre-amp API).
+
+Same math as apex_tpu.amp.scaler (the modern path); these classes keep
+the legacy surface: has_overflow(grads), update_scale(overflow),
+scale_gradient semantics via unscale()."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _has_overflow(grads) -> bool:
+    leaves = [g for g in jax.tree_util.tree_leaves(grads)
+              if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)]
+    if not leaves:
+        return False
+    # one device reduction + ONE host sync (not one per leaf)
+    ok = jnp.stack([jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                    for g in leaves])
+    return not bool(jnp.all(ok))
+
+
+class LossScaler:
+    """Static scale.  has_overflow always False (reference behavior)."""
+
+    def __init__(self, scale=1.0):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_loss(self, loss):
+        return loss * self.cur_scale
+
+    def unscale(self, grads):
+        inv = 1.0 / self.cur_scale
+        return jax.tree_util.tree_map(lambda g: g * inv, grads)
+
+    def has_overflow(self, grads):
+        return False
+
+    def update_scale(self, overflow):
+        pass
+
+
+class DynamicLossScaler(LossScaler):
+    """Grow x2 after scale_window clean steps, back off x0.5 on overflow
+    (reference defaults: init 2**32 clipped here to 2**16 for bf16-era
+    sanity is NOT done — parity keeps the reference's 2**32)."""
+
+    def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        super().__init__(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.last_overflow_iter = -1
+        self.cur_iter = 0
+
+    def has_overflow(self, grads):
+        return _has_overflow(grads)
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % \
+                self.scale_window == 0 and self.cur_iter > 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

@@ -1,0 +1,9 @@
+from apex_tpu.reparameterization.weight_norm import (  # noqa: F401
+    WeightNorm,
+    apply_weight_norm,
+    remove_weight_norm,
+    reparametrize,
+)
+
+__all__ = ["WeightNorm", "apply_weight_norm", "remove_weight_norm",
+           "reparametrize"]

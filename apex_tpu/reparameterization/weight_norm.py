@@ -1,0 +1,99 @@
+"""Weight normalization (reference: apex/reparameterization/ —
+`WeightNorm`/`Reparameterization` splitting w into direction v and
+magnitude g, w = g * v / ||v||, SURVEY.md §2.1).
+
+The reference hooks torch Parameters; functionally in JAX the split IS
+the parameter tree: `apply_weight_norm` rewrites matching kernel leaves
+into {v, g} subtrees, `reparametrize` reconstitutes w inside the forward
+pass (differentiable — grads flow to v and g exactly as the reference's
+autograd does), `remove_weight_norm` folds back to plain weights.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+def _norm(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+_G_RE = re.compile(r"^g(\d+)$")
+
+
+def _g_key(node):
+    for k in node:
+        m = _G_RE.match(k)
+        if m:
+            return k, int(m.group(1))
+    return None, None
+
+
+def _is_wn_node(node) -> bool:
+    if not (isinstance(node, dict) and len(node) == 2 and "v" in node
+            and isinstance(node["v"], jnp.ndarray)):
+        return False
+    return _g_key(node)[0] is not None
+
+
+def apply_weight_norm(params: Any, name: str = "kernel", dim: int = -1):
+    """Split every leaf whose key == `name` into a {v, g<dim>} subtree.
+    The norm axis is encoded in the g key (structural metadata), so the
+    tree contains only float leaves and stays jax.grad-able; size-1 axes
+    are unambiguous."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k == name and isinstance(v, jnp.ndarray):
+                d = dim % v.ndim
+                out[k] = {"v": v, f"g{d}": _norm(v, d).astype(v.dtype)}
+            else:
+                out[k] = walk(v)
+        return out
+    return walk(jax.tree_util.tree_map(lambda x: x, params))
+
+
+def reparametrize(params: Any):
+    """Reconstitute w = g * v / ||v|| for every weight-normed leaf; call
+    on the tree before module.apply."""
+    def walk(node):
+        if _is_wn_node(node):
+            gk, d = _g_key(node)
+            v, g = node["v"], node[gk]
+            w = g.astype(jnp.float32) * v.astype(jnp.float32) / _norm(v, d)
+            return w.astype(v.dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+    return walk(params)
+
+
+def remove_weight_norm(params: Any):
+    """Fold {v, g} back into plain weights (reference remove_weight_norm)."""
+    return reparametrize(params)
+
+
+class WeightNorm(nn.Module):
+    """Module wrapper parity: WeightNorm(module)(x) runs the wrapped
+    module with weight-normed kernels, learning v and g."""
+
+    module: nn.Module
+    name: str = "kernel"
+    dim: int = -1
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        def init_fn(rng):
+            vars_ = self.module.init(rng, *args, **kwargs)
+            return apply_weight_norm(vars_["params"], self.name, self.dim)
+        wn_params = self.param("wn", lambda rng: init_fn(rng))
+        return self.module.apply({"params": reparametrize(wn_params)},
+                                 *args, **kwargs)

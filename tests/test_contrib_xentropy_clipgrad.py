@@ -1,0 +1,96 @@
+"""contrib.xentropy + contrib.clip_grad vs stock-JAX oracles (reference
+test pattern: apex/contrib/test/xentropy/test_label_smoothing.py — fused
+kernel vs pure-framework oracle under per-dtype tolerances)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
+from apex_tpu.ops.xentropy import (
+    softmax_cross_entropy,
+    softmax_cross_entropy_ref,
+)
+
+
+def _data(n, c, dtype, seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    logits = jax.random.normal(k1, (n, c), jnp.float32).astype(dtype) * 2.0
+    labels = jax.random.randint(k2, (n,), 0, c)
+    return logits, labels
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 2e-2)])
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+@pytest.mark.parametrize("c", [128, 1000])   # 1000: non-lane-aligned fallback
+def test_xentropy_forward(dtype, tol, smoothing, c):
+    logits, labels = _data(64, c, dtype)
+    got = softmax_cross_entropy(logits, labels, smoothing)
+    want = softmax_cross_entropy_ref(logits, labels, smoothing)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_grad_matches_autodiff_oracle(smoothing):
+    logits, labels = _data(32, 256, jnp.float32, seed=1)
+
+    got = jax.grad(lambda x: jnp.mean(
+        softmax_cross_entropy(x, labels, smoothing)))(logits)
+    want = jax.grad(lambda x: jnp.mean(
+        softmax_cross_entropy_ref(x, labels, smoothing)))(logits)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_xentropy_padding_idx_zeroes_loss_and_grad():
+    logits, labels = _data(16, 128, jnp.float32, seed=2)
+    labels = labels.at[::4].set(0)   # padding_idx = 0
+    losses = SoftmaxCrossEntropyLoss.apply(logits, labels, 0.1, 0)
+    assert np.all(np.asarray(losses)[::4] == 0.0)
+    g = jax.grad(lambda x: jnp.sum(
+        SoftmaxCrossEntropyLoss.apply(x, labels, 0.1, 0)))(logits)
+    assert np.all(np.asarray(g)[::4] == 0.0)
+    assert np.any(np.asarray(g)[1::4] != 0.0)
+
+
+def test_xentropy_half_to_float_dtype():
+    logits, labels = _data(8, 128, jnp.bfloat16)
+    assert softmax_cross_entropy(logits, labels, 0.0, True).dtype == \
+        jnp.float32
+    assert softmax_cross_entropy(logits, labels, 0.0, False).dtype == \
+        jnp.bfloat16
+
+
+def test_clip_grad_norm_clips_and_reports():
+    grads = {"w": jnp.full((64, 64), 1.0), "b": jnp.full((64,), -2.0)}
+    flat = jnp.concatenate([g.ravel() for g in
+                            jax.tree_util.tree_leaves(grads)])
+    expect_norm = float(jnp.linalg.norm(flat))
+    clipped, total = clip_grad_norm_(grads, max_norm=1.0)
+    assert abs(float(total) - expect_norm) < 1e-3
+    cflat = jnp.concatenate([g.ravel() for g in
+                             jax.tree_util.tree_leaves(clipped)])
+    assert abs(float(jnp.linalg.norm(cflat)) - 1.0) < 1e-3
+    # direction preserved
+    np.testing.assert_allclose(np.asarray(cflat) * expect_norm,
+                               np.asarray(flat), rtol=1e-3)
+
+
+def test_clip_grad_norm_noop_below_threshold():
+    grads = [jnp.ones((8, 8)) * 1e-3]
+    clipped, total = clip_grad_norm_(grads, max_norm=10.0)
+    np.testing.assert_allclose(np.asarray(clipped[0]),
+                               np.asarray(grads[0]), rtol=1e-5)
+
+
+def test_clip_grad_norm_inf_norm():
+    grads = [jnp.asarray([1.0, -5.0, 3.0])]
+    clipped, total = clip_grad_norm_(grads, max_norm=1.0,
+                                     norm_type=float("inf"))
+    assert abs(float(total) - 5.0) < 1e-5
+    assert abs(float(jnp.max(jnp.abs(clipped[0]))) - 1.0) < 1e-3

@@ -1,0 +1,197 @@
+"""fp16_utils + RNN + reparameterization suites (reference test pattern:
+tests/L0/run_fp16util/ — half/master round-trips; RNN cells vs a naive
+per-timestep recurrence oracle; weight-norm reconstruction identities)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.RNN import GRU, LSTM, mLSTM
+from apex_tpu.fp16_utils import (
+    BN_convert_float,
+    DynamicLossScaler,
+    FP16_Optimizer,
+    master_params_to_model_params,
+    network_to_half,
+    prep_param_lists,
+    tree_to_half,
+)
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.reparameterization import (
+    apply_weight_norm,
+    remove_weight_norm,
+    reparametrize,
+)
+
+# ---------------------------------------------------------------------------
+# fp16_utils
+# ---------------------------------------------------------------------------
+
+
+def test_network_to_half_keeps_norm_layers_f32():
+    params = {"dense": {"kernel": jnp.ones((4, 4))},
+              "layernorm_0": {"scale": jnp.ones((4,))},
+              "bn": {"bias": jnp.zeros((4,))}}
+    half = network_to_half(params)
+    assert half["dense"]["kernel"].dtype == jnp.bfloat16
+    assert half["layernorm_0"]["scale"].dtype == jnp.float32
+    assert half["bn"]["bias"].dtype == jnp.float32
+    assert tree_to_half(params)["layernorm_0"]["scale"].dtype == jnp.bfloat16
+
+
+def test_prep_and_writeback_roundtrip():
+    model = {"w": jnp.ones((8,), jnp.bfloat16) * 0.5}
+    model, masters = prep_param_lists(model)
+    assert masters["w"].dtype == jnp.float32
+    masters = {"w": masters["w"] + 0.25}
+    model2 = master_params_to_model_params(model, masters)
+    assert model2["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(model2["w"], np.float32), 0.75)
+
+
+def test_prep_param_lists_flat_master():
+    model = {"a": jnp.ones((4,), jnp.bfloat16),
+             "b": jnp.zeros((2, 2), jnp.bfloat16)}
+    _, (flat, unravel) = prep_param_lists(model, flat_master=True)
+    assert flat.dtype == jnp.float32 and flat.shape == (8,)
+    back = unravel(flat)
+    assert back["b"].shape == (2, 2)
+
+
+def test_dynamic_loss_scaler_backoff_and_growth():
+    s = DynamicLossScaler(init_scale=2.0 ** 8, scale_window=2)
+    assert s.has_overflow({"g": jnp.asarray([jnp.inf])})
+    s.update_scale(True)
+    assert s.loss_scale == 2.0 ** 7
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 2.0 ** 8       # grew after window clean steps
+
+
+def test_fp16_optimizer_skips_on_overflow_and_steps_clean():
+    params = {"w": jnp.ones((16,), jnp.bfloat16)}
+    opt = FusedSGD(params, lr=0.5)
+    fopt = FP16_Optimizer(opt, dynamic_loss_scale=True,
+                          dynamic_loss_args={"init_scale": 4.0})
+    scale0 = fopt.loss_scale
+    bad = {"w": jnp.full((16,), jnp.inf, jnp.float32) * scale0}
+    p_after = fopt.step(bad)
+    assert fopt.overflow
+    assert fopt.loss_scale == scale0 / 2.0
+    np.testing.assert_allclose(np.asarray(p_after["w"], np.float32), 1.0)
+    good = {"w": jnp.full((16,), 1.0) * fopt.loss_scale}   # d(loss*s)/dw
+    p_after = fopt.step(good)
+    assert not fopt.overflow
+    np.testing.assert_allclose(np.asarray(p_after["w"], np.float32), 0.5)
+
+
+# ---------------------------------------------------------------------------
+# RNN — scan cells vs naive per-step recurrence
+# ---------------------------------------------------------------------------
+
+T, B, IN, HID = 6, 3, 8, 16
+
+
+def _np_lstm(params, x, layer=0):
+    wi = np.asarray(params[f"l{layer}_i2h"]["kernel"])
+    bi = np.asarray(params[f"l{layer}_i2h"]["bias"])
+    wh = np.asarray(params[f"l{layer}_h2h_kernel"])
+    bh = np.asarray(params[f"l{layer}_h2h_bias"])
+    h = np.zeros((x.shape[1], HID), np.float32)
+    c = np.zeros_like(h)
+    sig = lambda a: 1.0 / (1.0 + np.exp(-a))  # noqa: E731
+    outs = []
+    for t in range(x.shape[0]):
+        g = x[t] @ wi + bi + h @ wh + bh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_lstm_matches_naive_recurrence():
+    m = LSTM(input_size=IN, hidden_size=HID)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = m.init(jax.random.PRNGKey(1), x)["params"]
+    out, (h_n, c_n) = m.apply({"params": params}, x)
+    want, h, c = _np_lstm(params, np.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_n[0]), h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c_n[0]), c, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_shapes_and_determinism():
+    m = GRU(input_size=IN, hidden_size=HID, num_layers=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = m.init(jax.random.PRNGKey(1), x)["params"]
+    out, h_n = m.apply({"params": params}, x)
+    assert out.shape == (T, B, HID) and h_n.shape == (2, B, HID)
+    out2, _ = m.apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_mlstm_runs_and_multiplicative_path_matters():
+    m = mLSTM(input_size=IN, hidden_size=HID)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = m.init(jax.random.PRNGKey(1), x)["params"]
+    out, _ = m.apply({"params": params}, x)
+    assert out.shape == (T, B, HID)
+    # zeroing the multiplicative projection changes the output
+    z = dict(params)
+    z["l0_mx"] = jax.tree_util.tree_map(jnp.zeros_like, params["l0_mx"])
+    out_z, _ = m.apply({"params": z}, x)
+    assert not np.allclose(np.asarray(out), np.asarray(out_z))
+
+
+def test_lstm_grad_flows_through_scan():
+    m = LSTM(input_size=IN, hidden_size=HID)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = m.init(jax.random.PRNGKey(1), x)["params"]
+    g = jax.grad(lambda p: jnp.sum(m.apply({"params": p}, x)[0] ** 2))(
+        params)
+    assert float(jnp.linalg.norm(g["l0_i2h"]["kernel"])) > 0
+
+
+# ---------------------------------------------------------------------------
+# reparameterization
+# ---------------------------------------------------------------------------
+
+def test_weight_norm_roundtrip_identity():
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+    tree = {"dense": {"kernel": w, "bias": jnp.zeros((4,))}}
+    wn = apply_weight_norm(tree, dim=-1)
+    back = remove_weight_norm(wn)
+    np.testing.assert_allclose(np.asarray(back["dense"]["kernel"]),
+                               np.asarray(w), rtol=1e-5, atol=1e-6)
+    assert back["dense"]["bias"].shape == (4,)
+
+
+def test_weight_norm_g_scales_magnitude():
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+    wn = apply_weight_norm({"k": {"kernel": w}}, dim=-1)
+    wn["k"]["kernel"]["g1"] = wn["k"]["kernel"]["g1"] * 2.0
+    w2 = reparametrize(wn)["k"]["kernel"]
+    np.testing.assert_allclose(np.asarray(w2), 2.0 * np.asarray(w),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_weight_norm_differentiable():
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+    wn = apply_weight_norm({"k": {"kernel": w}}, dim=-1)
+
+    def loss(t):
+        return jnp.sum(reparametrize(t)["k"]["kernel"] ** 2)
+    g = jax.grad(loss)(wn)
+    assert float(jnp.linalg.norm(g["k"]["kernel"]["v"])) >= 0
+    assert float(jnp.linalg.norm(g["k"]["kernel"]["g1"])) > 0
+
+
+def test_weight_norm_size1_dim_roundtrip():
+    # regression: dim axis of size 1 must still reconstruct exactly
+    w = jnp.asarray([[1.0], [2.0], [-3.0]])          # (3, 1), dim=-1
+    wn = apply_weight_norm({"k": {"kernel": w}}, dim=-1)
+    back = reparametrize(wn)["k"]["kernel"]
+    np.testing.assert_allclose(np.asarray(back), np.asarray(w),
+                               rtol=1e-6, atol=1e-6)
