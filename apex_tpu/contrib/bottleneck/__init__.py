@@ -1,0 +1,7 @@
+from apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
+    Bottleneck,
+    SpatialBottleneck,
+    halo_exchange,
+)
+
+__all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange"]

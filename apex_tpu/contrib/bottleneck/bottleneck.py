@@ -1,0 +1,110 @@
+"""contrib.bottleneck parity — ResNet bottleneck + spatial (halo)
+parallelism (reference: apex/contrib/bottleneck/bottleneck.py over
+`fast_bottleneck` + peer_memory/nccl_p2p halo exchange, SURVEY.md
+§2.3/§2.5).
+
+The reference shards the H dimension of the activation across a GPU
+"spatial group" and exchanges 1-row halos through CUDA-IPC peer buffers
+so each rank can run its 3x3 conv.  TPU-native: the halo exchange is a
+pair of `jax.lax.ppermute` shifts over a mesh axis (ICI neighbors —
+exactly the physical transfer the peer-memory pool emulates), and the
+3x3 conv then runs with VALID padding in H since the halos supply it.
+Boundary ranks receive zeros, which reproduces the SAME-padding of the
+unsharded conv.
+
+Layout NHWC throughout (the reference's explicit-NHWC fast path is the
+TPU-native default).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def halo_exchange(x, axis_name: str, halo: int = 1, dim: int = 1):
+    """Concatenate `halo` rows from both mesh-axis neighbors along `dim`.
+
+    Must run inside shard_map with `axis_name` bound; x is the local
+    shard (N, H_local, W, C).  Edge ranks get zero halos (= SAME
+    padding).  Replaces peer_memory.PeerHaloExchanger1d.
+    """
+    n = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    h = x.shape[dim]
+    top = jax.lax.slice_in_dim(x, 0, halo, axis=dim)
+    bot = jax.lax.slice_in_dim(x, h - halo, h, axis=dim)
+    # my bottom rows become the NEXT rank's top halo, and vice versa
+    from_prev = jax.lax.ppermute(bot, axis_name,
+                                 [(j, (j + 1) % n) for j in range(n)])
+    from_next = jax.lax.ppermute(top, axis_name,
+                                 [(j, (j - 1) % n) for j in range(n)])
+    from_prev = jnp.where(i == 0, jnp.zeros_like(from_prev), from_prev)
+    from_next = jnp.where(i == n - 1, jnp.zeros_like(from_next),
+                          from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=dim)
+
+
+def _axis_bound(axis_name: Optional[str]) -> bool:
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+class Bottleneck(nn.Module):
+    """Reference-shaped ctor: Bottleneck(in_channels, bottleneck_channels,
+    out_channels, stride).  conv1x1-bn-relu / conv3x3-bn-relu /
+    conv1x1-bn + residual, relu — with every conv+bn+relu left to XLA's
+    epilogue fusion (the fast_bottleneck claim, §2.4)."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    spatial_group: Optional[str] = None    # mesh-axis name (H-sharded)
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = True):
+        def bn(name):
+            return nn.BatchNorm(use_running_average=use_running_average,
+                                momentum=0.9, epsilon=1e-5, name=name)
+
+        conv = lambda f, k, s, p, name: nn.Conv(  # noqa: E731
+            f, (k, k), strides=(s, s), padding=p, use_bias=False,
+            name=name)
+
+        y = jax.nn.relu(bn("bn1")(
+            conv(self.bottleneck_channels, 1, 1, "SAME", "conv1")(x)))
+
+        if _axis_bound(self.spatial_group):
+            if self.stride != 1:
+                raise NotImplementedError(
+                    "spatial (H-sharded) bottleneck requires stride 1 "
+                    "in the sharded dim, as the reference's halo "
+                    "exchange does")
+            y = halo_exchange(y, self.spatial_group, halo=1, dim=1)
+            y = conv(self.bottleneck_channels, 3, 1,
+                     ((0, 0), (1, 1)), "conv2")(y)     # H from halos
+        else:
+            y = conv(self.bottleneck_channels, 3, self.stride, "SAME",
+                     "conv2")(y)
+        y = jax.nn.relu(bn("bn2")(y))
+        y = bn("bn3")(conv(self.out_channels, 1, 1, "SAME", "conv3")(y))
+
+        res = x
+        if self.stride != 1 or self.in_channels != self.out_channels:
+            res = bn("bn_down")(conv(self.out_channels, 1, self.stride,
+                                     "SAME", "conv_down")(x))
+        return jax.nn.relu(y + res)
+
+
+class SpatialBottleneck(Bottleneck):
+    """Reference parity name: a Bottleneck whose input is H-sharded over
+    `spatial_group`; run it under shard_map on that axis."""

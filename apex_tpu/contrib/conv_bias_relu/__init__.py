@@ -1,0 +1,7 @@
+from apex_tpu.contrib.conv_bias_relu.conv_bias_relu import (  # noqa: F401
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+)
+
+__all__ = ["ConvBias", "ConvBiasReLU", "ConvBiasMaskReLU"]

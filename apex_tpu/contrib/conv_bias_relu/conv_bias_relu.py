@@ -1,0 +1,41 @@
+"""contrib.conv_bias_relu parity (reference: apex/contrib/conv_bias_relu/
+over fused_conv_bias_relu cudnn-frontend kernels, SURVEY.md §2.3).
+
+The reference fuses conv+bias(+mask)(+relu) through cuDNN runtime
+fusion.  Under XLA a conv_general_dilated followed by bias/mask/relu in
+one jit IS one fused convolution epilogue on TPU, so these are
+functional wrappers fixing the reference's NHWC layout and semantics.
+All are differentiable (the reference ships matching bwd kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _conv(x, w, padding, stride):
+    # x (N, H, W, Cin), w (KH, KW, Cin, Cout)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class ConvBias:
+    @staticmethod
+    def apply(x, weight, bias, padding=0, stride=1):
+        return _conv(x, weight, padding, stride) + bias
+
+
+class ConvBiasReLU:
+    @staticmethod
+    def apply(x, weight, bias, padding=0, stride=1):
+        return jax.nn.relu(_conv(x, weight, padding, stride) + bias)
+
+
+class ConvBiasMaskReLU:
+    @staticmethod
+    def apply(x, weight, bias, mask, padding=0, stride=1):
+        return jax.nn.relu((_conv(x, weight, padding, stride) + bias)
+                           * mask.astype(x.dtype))

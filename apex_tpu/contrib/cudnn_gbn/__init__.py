@@ -1,0 +1,13 @@
+"""contrib.cudnn_gbn parity (reference: apex/contrib/cudnn_gbn/ —
+GroupBatchNorm2d over cudnn_gbn_lib NHWC group batch norm).
+
+Same capability as contrib.groupbn on TPU (SURVEY.md §2.4 folds both
+into the one SyncBN/NHWC-BN path): NHWC batch norm whose statistics are
+synchronized over a device group (mesh axis).
+"""
+
+from apex_tpu.contrib.groupbn.batch_norm import (  # noqa: F401
+    BatchNorm2d_NHWC as GroupBatchNorm2d,
+)
+
+__all__ = ["GroupBatchNorm2d"]

@@ -1,0 +1,6 @@
+from apex_tpu.contrib.focal_loss.focal_loss import (  # noqa: F401
+    focal_loss,
+    FocalLoss,
+)
+
+__all__ = ["focal_loss", "FocalLoss"]

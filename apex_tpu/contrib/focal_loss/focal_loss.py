@@ -1,0 +1,60 @@
+"""contrib.focal_loss parity (reference: apex/contrib/focal_loss/ over
+focal_loss_cuda — fused sigmoid focal loss for detection heads,
+SURVEY.md §2.3).
+
+Reference target encoding (RetinaNet convention): integer class per
+anchor, >= 0 real class, -1 background (all-zero one-hot), -2 ignore
+(excluded from the loss).  Forward fuses one-hot + sigmoid + focal
+weighting + normalization by num_positives_sum; XLA fuses the whole
+expression into one elementwise pipeline over the logits, which is
+exactly what the CUDA kernel hand-rolls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def focal_loss(cls_output, cls_targets, num_positives_sum,
+               num_real_classes=None, alpha=0.25, gamma=2.0,
+               label_smoothing=0.0):
+    """cls_output (..., C) logits; cls_targets (...) int.
+
+    Returns the summed focal loss / num_positives_sum (a scalar), the
+    reference's contract.
+    """
+    c = cls_output.shape[-1]
+    if num_real_classes is None:
+        num_real_classes = c
+    t = cls_targets.astype(jnp.int32)
+    onehot = jax.nn.one_hot(jnp.clip(t, 0, c - 1), c,
+                            dtype=jnp.float32)
+    onehot = jnp.where((t >= 0)[..., None], onehot, 0.0)   # -1: background
+    if label_smoothing > 0.0:
+        onehot = (onehot * (1.0 - label_smoothing)
+                  + label_smoothing / num_real_classes)
+    x = cls_output.astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    # stable BCE-with-logits
+    bce = jnp.maximum(x, 0.0) - x * onehot + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * onehot + (1.0 - p) * (1.0 - onehot)
+    alpha_t = alpha * onehot + (1.0 - alpha) * (1.0 - onehot)
+    loss = alpha_t * ((1.0 - p_t) ** gamma) * bce
+    # mask channels beyond num_real_classes and ignored (-2) anchors
+    if num_real_classes < c:
+        loss = loss * (jnp.arange(c) < num_real_classes)
+    loss = loss * (t != -2)[..., None]
+    return jnp.sum(loss) / jnp.maximum(
+        jnp.asarray(num_positives_sum, jnp.float32), 1.0)
+
+
+class FocalLoss:
+    """autograd.Function facade (reference focal_loss.FocalLoss.apply)."""
+
+    @staticmethod
+    def apply(cls_output, cls_targets_at_level, num_positives_sum,
+              num_real_classes, alpha, gamma, label_smoothing=0.0):
+        return focal_loss(cls_output, cls_targets_at_level,
+                          num_positives_sum, num_real_classes, alpha,
+                          gamma, label_smoothing)

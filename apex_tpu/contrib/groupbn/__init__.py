@@ -1,0 +1,3 @@
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC  # noqa: F401
+
+__all__ = ["BatchNorm2d_NHWC"]

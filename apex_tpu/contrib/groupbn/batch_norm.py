@@ -1,0 +1,67 @@
+"""contrib.groupbn parity — NHWC BatchNorm with fused ReLU / residual-add
+(reference: apex/contrib/groupbn/batch_norm.py over the `bnp` extension:
+bn_fwd_nhwc / bn_add_relu_fwd_nhwc etc., SURVEY.md §2.3).
+
+NHWC is the TPU-native layout anyway (lane dim = channels), so this is
+the SyncBatchNorm dataflow specialized to channel-last with the
+add+ReLU epilogue fused by XLA into the normalize expression.  bn_group
+maps to a mesh-axis name (the reference's multi-GPU stats group).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm_stats
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """Reference-shaped: BatchNorm2d_NHWC(planes, fuse_relu, bn_group).
+
+    __call__(x, z=None): y = bn(x) (+ z residual) (relu if fuse_relu) —
+    the reference's batch_norm / batch_norm_add_relu variants selected by
+    arguments, as its Python wrapper does.
+    Input (N, H, W, C).
+    """
+
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: Optional[str] = None       # mesh-axis name or None
+    eps: float = 1e-5
+    momentum: float = 0.1
+    use_running_average: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, z: Optional[jax.Array] = None,
+                 use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        c = self.num_features
+        xc = x.reshape(-1, c)
+        ra_mean = self.variable("batch_stats", "running_mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "running_var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            mean, var, n = sync_batch_norm_stats(xc, self.bn_group)
+            if not self.is_initializing():
+                m = self.momentum
+                unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+        w = self.param("weight", nn.initializers.ones, (c,), jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        y = (xc.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.eps)
+        y = (y * w + b).reshape(x.shape)
+        if z is not None:
+            y = y + z.astype(jnp.float32)
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y.astype(x.dtype)

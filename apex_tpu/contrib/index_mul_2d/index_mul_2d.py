@@ -1,0 +1,17 @@
+"""contrib.index_mul_2d parity (reference: apex/contrib/index_mul_2d/
+over index_mul_2d_cuda — fused gather+multiply for 2D tensors,
+SURVEY.md §2.3; used by openfold-style models).
+
+out[i] = in1[idx[i]] * in2[i].  One XLA gather + one fused multiply;
+the backward (scatter-add into in1, gather-mul into in2) is the autodiff
+transpose, which XLA lowers to the same scatter the CUDA bwd hand-codes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1, in2, idx):
+    """in1 (N1, F), in2 (N2, F), idx (N2,) int -> (N2, F)."""
+    return jnp.take(in1, idx, axis=0) * in2

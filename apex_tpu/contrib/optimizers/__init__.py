@@ -1,0 +1,8 @@
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: F401
+    DistributedFusedAdam,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import (  # noqa: F401
+    DistributedFusedLAMB,
+)
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]

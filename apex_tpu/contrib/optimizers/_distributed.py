@@ -1,0 +1,139 @@
+"""ZeRO-style distributed optimizer substrate (reference:
+apex/contrib/optimizers/distributed_fused_adam.py /
+distributed_fused_lamb.py, SURVEY.md §2.3/§2.5).
+
+Reference flow per step (NCCL, process-per-GPU): reduce-scatter grads →
+each rank steps ITS shard of params/moments → all-gather updated params,
+all chunked and overlapped by hand.
+
+TPU-native redesign: the optimizer state lives as flat f32 buffers with a
+`NamedSharding` over the data-parallel mesh axis.  The step is one jitted
+elementwise program whose sharding propagation makes XLA emit exactly
+reduce-scatter(grads) → local shard update → all-gather(params) — the
+hand-rolled NCCL pipeline IS the GSPMD partitioning of this program, and
+the overlap is the XLA latency-hiding scheduler's job (SURVEY.md §2.6).
+
+Grads arrive as a full (replicated or batch-computed) tree, already
+summed over data parallelism — the facade contract of every apex_tpu
+optimizer; what is distributed here is the STATE and the update compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import comm
+
+Pytree = Any
+
+
+class DistributedOptimizerBase:
+    """Subclasses define `defaults`, `n_state_slots`, `_flat_update`."""
+
+    defaults: Dict[str, Any] = {}
+    n_state_slots = 2      # (m, v) for both Adam and LAMB
+
+    def __init__(self, params: Pytree, process_group: str = comm.AXIS_DATA,
+                 **hypers):
+        self.hypers = dict(self.defaults)
+        unknown = set(hypers) - set(self.hypers)
+        if unknown:
+            raise TypeError(f"unexpected arguments {sorted(unknown)}")
+        self.hypers.update(hypers)
+        self.axis = process_group
+        if not comm.is_initialized():
+            raise RuntimeError(
+                "DistributedFused* optimizers need the global mesh: call "
+                "apex_tpu.comm.initialize(...) first (reference parity: "
+                "torch.distributed must be initialized)")
+        self.mesh = comm.mesh()
+        self.n_shards = self.mesh.shape[self.axis]
+
+        self.params = params
+        flat, self._unravel = ravel_pytree(
+            jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params))
+        self._n = flat.shape[0]
+        pad = (-self._n) % self.n_shards
+        self._padded = self._n + pad
+        flat = jnp.pad(flat, (0, pad))
+
+        shard = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        # masters replicated (they rebuild params every step); moments
+        # SHARDED over the axis — the ZeRO memory win
+        self.master = jax.device_put(flat, repl)
+        self.state = [jax.device_put(jnp.zeros_like(flat), shard)
+                      for _ in range(self.n_state_slots)]
+        self.step_count = jnp.int32(0)
+
+        self._jit_step = jax.jit(
+            self._flat_update,
+            out_shardings=((repl,) + (shard,) * self.n_state_slots),
+            donate_argnums=(0, 1),
+        )
+
+    # subclass: (master, state_tuple, grad_flat, step, hypers) ->
+    #           (master, *state)
+    def _flat_update(self, master, state, grad, step, hypers):
+        raise NotImplementedError
+
+    def step(self, grads: Pytree, grad_scale=1.0) -> Pytree:
+        gflat, _ = ravel_pytree(
+            jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads))
+        gflat = jnp.pad(gflat, (0, self._padded - self._n))
+        self.step_count = self.step_count + 1
+        hypers = {k: jnp.asarray(v, jnp.float32)
+                  for k, v in self.hypers.items()
+                  if isinstance(v, (int, float))
+                  and not isinstance(v, bool)}
+        hypers["grad_scale"] = jnp.asarray(grad_scale, jnp.float32)
+        out = self._jit_step(self.master, tuple(self.state), gflat,
+                             self.step_count, hypers)
+        self.master, self.state = out[0], list(out[1:])
+        new_flat = self.master[:self._n]
+        new_tree = self._unravel(new_flat)
+        self.params = jax.tree_util.tree_map(
+            lambda p, q: q.astype(p.dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else q,
+            self.params, new_tree)
+        return self.params
+
+    def zero_grad(self):
+        pass
+
+    def state_dict(self):
+        import numpy as np
+        # host copies: the live buffers get donated by the next step,
+        # which would invalidate a checkpoint holding references to them
+        return {"step": int(self.step_count),
+                "hypers": dict(self.hypers),
+                "master": np.asarray(self.master),
+                "state": [np.asarray(s) for s in self.state]}
+
+    def load_state_dict(self, sd):
+        import numpy as np
+        self.step_count = jnp.int32(sd["step"])
+        self.hypers.update(sd["hypers"])
+        # fresh buffers: the live ones get DONATED by the jitted step, so
+        # aliasing a checkpointed array would die on the donor's next step
+        shard = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        self.master = jax.device_put(np.asarray(sd["master"]), repl)
+        self.state = [jax.device_put(np.asarray(s), shard)
+                      for s in sd["state"]]
+
+    @property
+    def lr(self):
+        return self.hypers["lr"]
+
+    @lr.setter
+    def lr(self, value):
+        self.hypers["lr"] = value

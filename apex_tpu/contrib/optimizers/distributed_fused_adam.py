@@ -1,0 +1,41 @@
+"""DistributedFusedAdam (reference:
+apex/contrib/optimizers/distributed_fused_adam.py — ZeRO-sharded Adam;
+see _distributed.py for the TPU mapping)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.contrib.optimizers._distributed import DistributedOptimizerBase
+
+
+class DistributedFusedAdam(DistributedOptimizerBase):
+    defaults = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+                    weight_decay=0.0, adam_w_mode=True,
+                    bias_correction=True, grad_averaging=True)
+
+    def __init__(self, params, betas=None, **kw):
+        if betas is not None:
+            kw["beta1"], kw["beta2"] = betas
+        super().__init__(params, **kw)
+
+    def _flat_update(self, master, state, grad, step, h):
+        m, v = state
+        g = grad / h["grad_scale"]
+        b1, b2 = h["beta1"], h["beta2"]
+        if not self.hypers["adam_w_mode"]:
+            g = g + h["weight_decay"] * master
+        # reference: beta3 = 1 - beta1 if grad_averaging else 1.0
+        b3 = (1 - b1) if self.hypers["grad_averaging"] else 1.0
+        m = b1 * m + b3 * g
+        v = b2 * v + (1 - b2) * g * g
+        sf = step.astype(jnp.float32)
+        if self.hypers["bias_correction"]:
+            mh = m / (1 - b1 ** sf)
+            vh = v / (1 - b2 ** sf)
+        else:
+            mh, vh = m, v
+        update = mh / (jnp.sqrt(vh) + h["eps"])
+        if self.hypers["adam_w_mode"]:
+            update = update + h["weight_decay"] * master
+        return (master - h["lr"] * update, m, v)

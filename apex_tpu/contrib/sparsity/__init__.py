@@ -1,0 +1,4 @@
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401
+
+__all__ = ["ASP", "create_mask"]

@@ -1,0 +1,118 @@
+"""ASP — automatic sparsity (reference: apex/contrib/sparsity/asp.py,
+SURVEY.md §2.3: mask search over whitelisted layers, mask application to
+weights AND optimizer state, recompute option).
+
+The reference hooks torch modules/optimizer in place.  Functionally:
+ASP owns a mask pytree; `compute_sparse_masks` searches masks for every
+eligible leaf; masked params/grads/moments are produced by tree
+multiplication.  `init_optimizer_for_pruning` wraps an apex_tpu fused
+optimizer so every step re-applies the masks (the reference patches
+optimizer.step the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+Pytree = Any
+
+
+def _default_whitelist(path, leaf) -> bool:
+    """Reference default: prune Linear/Conv weights, skip
+    biases/norms/embeddings too small to matter: here = floating leaves
+    with ndim >= 2 and last dim divisible by 4."""
+    return (jnp.issubdtype(leaf.dtype, jnp.floating)
+            and leaf.ndim >= 2 and leaf.shape[-1] % 4 == 0)
+
+
+class ASP:
+    """Class-level state mirrors the reference's module-global ASP."""
+
+    _masks: Optional[Pytree] = None
+    _pattern: str = "m4n2_1d"
+    _whitelist: Callable = staticmethod(_default_whitelist)
+
+    @classmethod
+    def init_model_for_pruning(cls, params: Pytree,
+                               mask_calculator: str = "m4n2_1d",
+                               whitelist: Optional[Callable] = None,
+                               verbosity: int = 2,
+                               allow_recompute_mask: bool = False,
+                               custom_layer_dict=None):
+        del verbosity, allow_recompute_mask, custom_layer_dict
+        cls._pattern = mask_calculator
+        if whitelist is not None:
+            cls._whitelist = staticmethod(whitelist)
+        cls._masks = None
+        return params
+
+    @classmethod
+    def compute_sparse_masks(cls, params: Pytree) -> Pytree:
+        """Search masks and return the masked params (reference mutates)."""
+        def leaf_mask(path, leaf):
+            if cls._whitelist(path, leaf):
+                return create_mask(leaf, cls._pattern)
+            return jnp.ones_like(leaf)
+        cls._masks = jax.tree_util.tree_map_with_path(leaf_mask, params)
+        return cls.apply_masks(params)
+
+    @classmethod
+    def apply_masks(cls, tree: Pytree) -> Pytree:
+        if cls._masks is None:
+            raise RuntimeError("call compute_sparse_masks first")
+        return jax.tree_util.tree_map(
+            lambda x, m: x * m.astype(x.dtype), tree, cls._masks)
+
+    @classmethod
+    def masks(cls) -> Optional[Pytree]:
+        return cls._masks
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        return cls._masks is not None
+
+    @classmethod
+    def restore_pruned_weights(cls, params: Pytree) -> Pytree:
+        """Disable sparsity (reference zero-restores are impossible —
+        pruned values are gone — it just stops masking; same here)."""
+        cls._masks = None
+        return params
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, optimizer):
+        """Patch optimizer.step to re-mask params (and keep moments
+        masked) after every update — the reference wraps step the same
+        way."""
+        orig_step = optimizer.step
+
+        def sparse_step(grads, *a, **kw):
+            if cls._masks is not None:
+                grads = cls.apply_masks(grads)
+            params = orig_step(grads, *a, **kw)
+            if cls._masks is not None:
+                params = cls.apply_masks(params)
+                optimizer.params = params
+                if getattr(optimizer, "masters", None) is not None:
+                    optimizer.masters = cls.apply_masks(optimizer.masters)
+            return params
+
+        optimizer.step = sparse_step
+        return optimizer
+
+    @classmethod
+    def prune_trained_model(cls, params: Pytree, optimizer=None):
+        """Reference one-call recipe: init + mask search + optimizer
+        hookup.  Returns masked params."""
+        cls.init_model_for_pruning(params)
+        masked = cls.compute_sparse_masks(params)
+        if optimizer is not None:
+            cls.init_optimizer_for_pruning(optimizer)
+            optimizer.params = masked
+            if getattr(optimizer, "masters", None) is not None:
+                optimizer.masters = cls.apply_masks(optimizer.masters)
+        return masked

@@ -1,0 +1,42 @@
+"""2:4 structured-sparsity mask search (reference:
+apex/contrib/sparsity/sparse_masklib.py — `create_mask` with m4n2
+patterns, SURVEY.md §2.3).
+
+A mask keeps the n largest-magnitude elements of every group of m along
+the chosen dim.  Rank-based selection (double argsort) keeps exactly n
+per group even with ties, matching the reference's behavior of picking a
+deterministic winner.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_PATTERNS = {
+    "m4n2_1d": (4, 2),
+    "m8n2_1d": (8, 2),
+    "m4n1_1d": (4, 1),
+}
+
+
+def mn_1d_mask(w, m: int, n: int):
+    """Boolean mask keeping the n largest |w| in every m-group along the
+    LAST axis (the reference's 1d patterns group along the input dim)."""
+    shape = w.shape
+    if shape[-1] % m != 0:
+        raise ValueError(f"last dim {shape[-1]} not divisible by m={m}")
+    g = w.reshape(shape[:-1] + (shape[-1] // m, m))
+    aw = jnp.abs(g.astype(jnp.float32))
+    order = jnp.argsort(-aw, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    return (ranks < n).reshape(shape)
+
+
+def create_mask(tensor, pattern: str = "m4n2_1d"):
+    """Reference-shaped entry: create_mask(weight, "m4n2_1d") -> mask of
+    tensor's dtype with exactly n/m density per group."""
+    if pattern not in _PATTERNS:
+        raise ValueError(
+            f"unknown pattern {pattern!r}; available: {sorted(_PATTERNS)}")
+    m, n = _PATTERNS[pattern]
+    return mn_1d_mask(tensor, m, n).astype(tensor.dtype)

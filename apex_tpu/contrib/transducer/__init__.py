@@ -1,0 +1,6 @@
+from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+)
+
+__all__ = ["TransducerJoint", "TransducerLoss"]

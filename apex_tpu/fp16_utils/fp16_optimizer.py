@@ -74,12 +74,24 @@ class FP16_Optimizer:
         self.optimizer.zero_grad()
 
     def state_dict(self):
-        return {
+        sd = {
             "optimizer": self.optimizer.state_dict(),
             "cur_scale": self.loss_scaler.cur_scale,
             "dynamic": isinstance(self.loss_scaler, DynamicLossScaler),
         }
+        if sd["dynamic"]:
+            # growth-window clock (the reference checkpoints these too)
+            sd["cur_iter"] = self.loss_scaler.cur_iter
+            sd["last_overflow_iter"] = self.loss_scaler.last_overflow_iter
+        return sd
 
     def load_state_dict(self, sd):
         self.optimizer.load_state_dict(sd["optimizer"])
+        if sd.get("dynamic") and not isinstance(self.loss_scaler,
+                                                DynamicLossScaler):
+            self.loss_scaler = DynamicLossScaler(sd["cur_scale"])
         self.loss_scaler.cur_scale = sd["cur_scale"]
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            self.loss_scaler.cur_iter = sd.get("cur_iter", 0)
+            self.loss_scaler.last_overflow_iter = sd.get(
+                "last_overflow_iter", -1)

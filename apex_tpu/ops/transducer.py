@@ -1,0 +1,153 @@
+"""RNN-T transducer joint + loss (reference: apex/contrib/csrc/transducer/
+— `transducer_joint_cuda`, `transducer_loss_cuda`, SURVEY.md §2.3/§2.4).
+
+Joint: h[b,t,u] = f[b,t] + g[b,u] broadcast-add (optionally ReLU), the
+reference's packed layouts replaced by masking — XLA needs static shapes,
+so padding positions are zeroed instead of physically dropped (the
+reference packs purely to save HBM on ragged batches; on TPU the masked
+form keeps the add a single fused broadcast).
+
+Loss: the forward-backward alpha recursion
+
+    alpha[t,u] = lse(alpha[t-1,u] + blank[t-1,u],
+                     alpha[t,u-1] + label[t,u-1])
+
+is computed as a `lax.scan` over ANTI-DIAGONALS d = t+u: both
+dependencies sit on diagonal d-1, so every cell of a diagonal is computed
+in one vectorized step — the standard wavefront schedule the CUDA kernel
+implements with a thread per u; here the VPU lanes are the wavefront.
+Backward comes from autodiff through the scan (the transpose of the
+wavefront IS the beta recursion the reference hand-codes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def transducer_joint(f, g, f_len=None, g_len=None, *, relu=False,
+                     dropout_rate=0.0, dropout_rng=None):
+    """f (B, T, H), g (B, U, H) -> (B, T, U, H) broadcast add.
+
+    Positions with t >= f_len[b] or u >= g_len[b] are zeroed (the masked
+    equivalent of the reference's pack_output).  Reference:
+    transducer_joint_cuda.forward.
+    """
+    h = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        h = jax.nn.relu(h)
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+    if f_len is not None:
+        b, t, u, _ = h.shape
+        tmask = jnp.arange(t)[None, :] < f_len[:, None]        # (B, T)
+        umask = jnp.arange(u)[None, :] < g_len[:, None]        # (B, U)
+        h = h * (tmask[:, :, None, None] & umask[:, None, :, None])
+    return h
+
+
+def transducer_joint_ref(f, g, f_len=None, g_len=None, *, relu=False):
+    return transducer_joint(f, g, f_len, g_len, relu=relu)
+
+
+def _gather_t(x, t_idx):
+    """x (B, T, U), t_idx (U,) -> y (B, U) with y[b,u] = x[b, t_idx[u], u]
+    (t clipped to range; caller masks invalid cells)."""
+    b, t, u = x.shape
+    idx = jnp.clip(t_idx, 0, t - 1)[None, :, None]             # (1, U, 1)
+    xt = jnp.swapaxes(x, 1, 2)                                 # (B, U, T)
+    return jnp.take_along_axis(xt, jnp.broadcast_to(idx, (b, u, 1)),
+                               axis=2)[..., 0]
+
+
+def transducer_loss(x, label, f_len, y_len, blank_idx=0):
+    """RNN-T loss.  x (B, T, U, V) joint logits with U = max_y_len + 1;
+    label (B, U-1) int; f_len (B,), y_len (B,).  Returns per-example
+    negative log-likelihood (B,) f32.  Reference:
+    transducer_loss_cuda.forward.
+    """
+    b, t, u, v = x.shape
+    acc = jnp.promote_types(x.dtype, jnp.float32)   # f32, or f64 under x64
+    logp = jax.nn.log_softmax(x.astype(acc), axis=-1)
+    blank_lp = logp[..., blank_idx]                            # (B, T, U)
+    # label_lp[b,t,u] = logp[b,t,u,label[b,u]] for u < U-1; pad last col
+    lab = jnp.concatenate(
+        [label.astype(jnp.int32),
+         jnp.zeros((b, 1), jnp.int32)], axis=1)                # (B, U)
+    label_lp = jnp.take_along_axis(
+        logp, lab[:, None, :, None], axis=3)[..., 0]           # (B, T, U)
+
+    us = jnp.arange(u)
+    alpha0 = jnp.full((b, u), _NEG, acc).at[:, 0].set(0.0)
+    # label_lp_shift[b,t,u] = label_lp[b,t,u-1] (the label emitted to
+    # REACH column u lives in column u-1)
+    label_lp_shift = jnp.roll(label_lp, 1, axis=2)
+
+    def diag_step(alpha_prev, d):
+        # cell (t, u) on diagonal d has t = d - u
+        t_here = d - us                                        # (U,)
+        # blank path: from (t-1, u) on diag d-1
+        blank_term = alpha_prev + _gather_t(blank_lp, t_here - 1)
+        blank_term = jnp.where((t_here >= 1)[None, :], blank_term, _NEG)
+        # label path: from (t, u-1) on diag d-1 (same t)
+        lab_term = (jnp.roll(alpha_prev, 1, axis=1)
+                    + _gather_t(label_lp_shift, t_here))
+        lab_term = jnp.where((us >= 1)[None, :], lab_term, _NEG)
+        new = jnp.logaddexp(blank_term, lab_term)
+        # out-of-range cells stay inert
+        on_diag = (t_here >= 0) & (t_here < t)
+        new = jnp.where(on_diag[None, :], new, _NEG)
+        return new, new
+
+    n_diag = t + u - 1
+    _, alphas = jax.lax.scan(diag_step, alpha0,
+                             jnp.arange(1, n_diag))            # (D-1, B, U)
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)   # (D, B, U)
+
+    # terminal: alpha[f_len-1, y_len] + blank[f_len-1, y_len]
+    t_last = f_len.astype(jnp.int32) - 1                       # (B,)
+    u_last = y_len.astype(jnp.int32)                           # (B,)
+    d_last = t_last + u_last
+    alpha_last = alphas[d_last, jnp.arange(b), u_last]
+    blank_last = blank_lp[jnp.arange(b), t_last, u_last]
+    return -(alpha_last + blank_last)
+
+
+def transducer_loss_ref(x, label, f_len, y_len, blank_idx=0):
+    """Naive per-example dynamic-programming oracle (host loop, numpy
+    semantics via jnp; used by tests only)."""
+    import numpy as np
+    x = np.asarray(x, np.float64)
+    label = np.asarray(label)
+    f_len = np.asarray(f_len)
+    y_len = np.asarray(y_len)
+    b, t, u, v = x.shape
+    lp = x - np.log(np.sum(np.exp(x - x.max(-1, keepdims=True)), -1,
+                           keepdims=True)) - x.max(-1, keepdims=True)
+    losses = []
+    for i in range(b):
+        ti, ui = int(f_len[i]), int(y_len[i]) + 1
+        alpha = np.full((ti, ui), -np.inf)
+        alpha[0, 0] = 0.0
+        for tt in range(ti):
+            for uu in range(ui):
+                if tt == 0 and uu == 0:
+                    continue
+                cands = []
+                if tt > 0:
+                    cands.append(alpha[tt - 1, uu]
+                                 + lp[i, tt - 1, uu, blank_idx])
+                if uu > 0:
+                    cands.append(alpha[tt, uu - 1]
+                                 + lp[i, tt, uu - 1, label[i, uu - 1]])
+                m = max(cands)
+                alpha[tt, uu] = m + np.log(
+                    sum(np.exp(c - m) for c in cands))
+        losses.append(-(alpha[ti - 1, ui - 1]
+                        + lp[i, ti - 1, ui - 1, blank_idx]))
+    return jnp.asarray(losses, jnp.float32)

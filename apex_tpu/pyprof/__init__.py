@@ -1,0 +1,27 @@
+"""apex.pyprof parity shim (reference: historical apex/pyprof — nvtx
+annotation toolkit wrapping torch functions with
+torch.cuda.nvtx.range_push/pop, SURVEY.md §5 tracing).
+
+TPU equivalent: `jax.named_scope` annotations (visible in XProf/
+TensorBoard traces) and `jax.profiler` trace capture — strictly better
+tooling for free.  The nvtx push/pop surface is preserved so reference
+code annotating hot regions ports unchanged.
+"""
+
+from apex_tpu.pyprof import nvtx  # noqa: F401
+
+_enabled = False
+
+
+def init():
+    """Reference parity: pyprof.init() enabled global annotation.  Here
+    named scopes are always legal; init just flips the marker flag."""
+    global _enabled
+    _enabled = True
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+__all__ = ["init", "enabled", "nvtx"]

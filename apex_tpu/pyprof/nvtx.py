@@ -1,0 +1,64 @@
+"""nvtx-shaped annotation API over jax.named_scope (reference:
+apex/pyprof/nvtx/nvmarker.py).
+
+range_push/range_pop manage a stack of named_scope context managers;
+`range` is the decorator/context form; `profile` wraps
+jax.profiler.trace for XProf capture.  Scopes show up in TPU traces the
+way nvtx ranges show up in nsight.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import List
+
+import jax
+
+_stack: List = []
+
+
+def range_push(msg: str) -> int:
+    cm = jax.named_scope(msg)
+    cm.__enter__()
+    _stack.append(cm)
+    return len(_stack)
+
+
+def range_pop() -> int:
+    if not _stack:
+        return 0
+    cm = _stack.pop()
+    cm.__exit__(None, None, None)
+    return len(_stack)
+
+
+@contextlib.contextmanager
+def range(msg: str):
+    with jax.named_scope(msg):
+        yield
+
+
+def annotate(msg: str = None):
+    """Decorator: wrap a function in a named scope (nvmarker's wrapped
+    torch-function behavior, opt-in per function here)."""
+    def deco(fn):
+        name = msg or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with jax.named_scope(name):
+                return fn(*a, **kw)
+        return wrapper
+    return deco
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """Capture an XProf trace of the enclosed region (TensorBoard-viewable
+    — the DLProf story, natively)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

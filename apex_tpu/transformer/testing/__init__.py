@@ -1,0 +1,1 @@
+from apex_tpu.transformer.testing import commons, global_vars  # noqa: F401

@@ -1,0 +1,55 @@
+"""Shared transformer-test fixtures (reference:
+apex/transformer/testing/commons.py — initialize_distributed, seeds,
+tiny model builders for the TP/PP suites, SURVEY.md §2.2/§4)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+from apex_tpu.transformer import parallel_state
+
+
+def initialize_distributed(tensor_model_parallel_size: int = 1,
+                           pipeline_model_parallel_size: int = 1,
+                           data_parallel_size: int = 0):
+    """Build the mesh + parallel_state for a test (the reference's
+    torch.distributed.init_process_group + initialize_model_parallel).
+
+    data_parallel_size 0 = use all remaining devices."""
+    n = len(jax.devices())
+    tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
+    dp = data_parallel_size or n // (tp * pp)
+    comm.destroy()
+    comm.initialize(data=dp, pipe=pp, ctx=1, model=tp)
+    parallel_state.initialize_model_parallel(tp, pp)
+    return comm.mesh()
+
+
+def destroy_distributed():
+    parallel_state.destroy_model_parallel()
+    comm.destroy()
+
+
+def set_random_seed(seed: int):
+    """Reference helper: one call seeding everything; JAX is functional
+    so this just returns the key (and seeds numpy for test data)."""
+    import numpy as np
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def build_tiny_gpt(vocab=128, layers=2, hidden=64, heads=4, seq=32):
+    """Tiny GPT config for schedule/parallel tests."""
+    from apex_tpu.models.gpt import GPTModel
+    return GPTModel(vocab_size=vocab, num_layers=layers,
+                    hidden_size=hidden, num_heads=heads, max_seq_len=seq)
+
+
+def rand_tokens(key, batch, seq, vocab=128):
+    return jax.random.randint(key, (batch, seq), 0, vocab)
+
+
+def print_separator(msg: str):
+    print(f"{' ' + msg + ' ':-^70}")

@@ -1,0 +1,41 @@
+"""Reference parity: apex/transformer/testing/global_vars.py — a
+module-global args namespace the megatron-style test harnesses read
+(get_args/set_global_variables)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+_GLOBAL_ARGS: Optional[argparse.Namespace] = None
+
+
+def set_global_variables(args=None, **overrides):
+    global _GLOBAL_ARGS
+    ns = args or argparse.Namespace(
+        micro_batch_size=2,
+        global_batch_size=8,
+        num_layers=2,
+        hidden_size=64,
+        num_attention_heads=4,
+        seq_length=32,
+        padded_vocab_size=128,
+        tensor_model_parallel_size=1,
+        pipeline_model_parallel_size=1,
+        seed=1234,
+    )
+    for k, v in overrides.items():
+        setattr(ns, k, v)
+    _GLOBAL_ARGS = ns
+    return ns
+
+
+def get_args() -> argparse.Namespace:
+    if _GLOBAL_ARGS is None:
+        raise RuntimeError("call set_global_variables() first")
+    return _GLOBAL_ARGS
+
+
+def destroy_global_vars():
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = None

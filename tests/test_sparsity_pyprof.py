@@ -1,0 +1,107 @@
+"""contrib.sparsity (ASP) + pyprof shim + transformer.testing harness
+(reference pattern: apex/contrib/test/sparsity/ — mask density and
+training-with-masks invariants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.pyprof as pyprof
+from apex_tpu.contrib.sparsity import ASP, create_mask
+from apex_tpu.contrib.sparsity.sparse_masklib import mn_1d_mask
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.pyprof import nvtx
+
+
+@pytest.fixture(autouse=True)
+def _reset_asp():
+    ASP._masks = None
+    yield
+    ASP._masks = None
+
+
+def test_mask_density_and_topk():
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 64))
+    m = create_mask(w, "m4n2_1d")
+    assert float(jnp.mean(m)) == 0.5
+    # each group of 4 keeps exactly its 2 largest |w|
+    wg = np.asarray(w).reshape(16, 16, 4)
+    mg = np.asarray(m).reshape(16, 16, 4)
+    for i in range(16):
+        for g in range(16):
+            kept = np.sort(np.abs(wg[i, g][mg[i, g] > 0]))
+            dropped = np.abs(wg[i, g][mg[i, g] == 0])
+            assert kept.shape == (2,) and dropped.shape == (2,)
+            assert kept.min() >= dropped.max() - 1e-7
+
+
+def test_mask_ties_keep_exact_count():
+    w = jnp.ones((2, 8))
+    m = mn_1d_mask(w, 4, 2)
+    assert int(jnp.sum(m)) == 8          # exactly 2 per group despite ties
+
+
+def test_create_mask_rejects_bad_shapes_and_patterns():
+    with pytest.raises(ValueError, match="divisible"):
+        create_mask(jnp.ones((3, 6)), "m4n2_1d")
+    with pytest.raises(ValueError, match="unknown pattern"):
+        create_mask(jnp.ones((4, 8)), "m16n3_1d")
+
+
+def test_asp_prune_and_training_preserves_sparsity():
+    params = {"dense": {"kernel": jax.random.normal(
+        jax.random.PRNGKey(0), (32, 16))},
+        "bias": jnp.ones((16,))}
+    opt = FusedSGD(params, lr=0.1)
+    masked = ASP.prune_trained_model(params, opt)
+    assert float(jnp.mean(masked["dense"]["kernel"] != 0)) <= 0.5
+    np.testing.assert_allclose(np.asarray(masked["bias"]), 1.0)  # skipped
+    # steps keep the pruned pattern
+    for i in range(3):
+        g = jax.tree_util.tree_map(
+            lambda x: jnp.ones_like(x), params)
+        p = opt.step(g)
+    zeros = np.asarray(ASP.masks()["dense"]["kernel"]) == 0
+    assert np.all(np.asarray(p["dense"]["kernel"])[zeros] == 0.0)
+    assert np.all(np.asarray(p["bias"]) != 1.0)   # unmasked leaf trained
+
+
+def test_asp_restore_disables():
+    params = {"k": jnp.ones((4, 8))}
+    ASP.init_model_for_pruning(params)
+    ASP.compute_sparse_masks(params)
+    assert ASP.is_sparsity_enabled()
+    ASP.restore_pruned_weights(params)
+    assert not ASP.is_sparsity_enabled()
+
+
+def test_nvtx_push_pop_and_annotate():
+    pyprof.init()
+    assert pyprof.enabled()
+    depth = nvtx.range_push("outer")
+    assert depth == 1
+    with nvtx.range("inner"):
+        pass
+    assert nvtx.range_pop() == 0
+    assert nvtx.range_pop() == 0        # extra pop is harmless
+
+    @nvtx.annotate("f")
+    def f(x):
+        return x * 2
+    assert float(f(jnp.float32(3))) == 6.0
+
+
+def test_testing_commons_builds_mesh():
+    from apex_tpu.transformer.testing import commons, global_vars
+    mesh = commons.initialize_distributed(tensor_model_parallel_size=2,
+                                          pipeline_model_parallel_size=2)
+    assert mesh.shape["model"] == 2 and mesh.shape["pipe"] == 2
+    from apex_tpu.transformer import parallel_state
+    assert parallel_state.get_tensor_model_parallel_world_size() == 2
+    commons.destroy_distributed()
+    args = global_vars.set_global_variables(hidden_size=128)
+    assert global_vars.get_args().hidden_size == 128
+    global_vars.destroy_global_vars()
+    with pytest.raises(RuntimeError):
+        global_vars.get_args()
