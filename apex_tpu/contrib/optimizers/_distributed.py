@@ -72,8 +72,12 @@ class DistributedOptimizerBase:
         self.state = [jax.device_put(jnp.zeros_like(flat), shard)
                       for _ in range(self.n_state_slots)]
         self.step_count = jnp.int32(0)
+        self._jit_step = self._make_jit_step()
 
-        self._jit_step = jax.jit(
+    def _make_jit_step(self):
+        shard = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        return jax.jit(
             self._flat_update,
             out_shardings=((repl,) + (shard,) * self.n_state_slots),
             donate_argnums=(0, 1),
@@ -122,6 +126,9 @@ class DistributedOptimizerBase:
         import numpy as np
         self.step_count = jnp.int32(sd["step"])
         self.hypers.update(sd["hypers"])
+        # bool hypers are baked into the trace: force a fresh jit so a
+        # loaded adam_w_mode/bias_correction/... actually takes effect
+        self._jit_step = self._make_jit_step()
         # fresh buffers: the live ones get DONATED by the jitted step, so
         # aliasing a checkpointed array would die on the donor's next step
         shard = NamedSharding(self.mesh, P(self.axis))

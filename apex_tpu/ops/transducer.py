@@ -40,6 +40,8 @@ def transducer_joint(f, g, f_len=None, g_len=None, *, relu=False,
     if relu:
         h = jax.nn.relu(h)
     if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
                                     h.shape)
         h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)

@@ -1,0 +1,143 @@
+"""ImageNet AMP training (port of the reference's
+examples/imagenet/main_amp.py — the north-star config of BASELINE.md:
+ResNet-50, amp O2, FusedSGD).
+
+No ImageNet on disk in this environment, so data is synthetic
+ImageNet-shaped batches (the training math, amp plumbing, checkpoint
+bundle, and throughput accounting are the real thing).
+
+Usage:
+    python examples/imagenet/main_amp.py --arch resnet50 --opt-level O2
+        [--batch-size 128] [--steps 100] [--ddp] [--sync-bn]
+        [--checkpoint PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+import apex_tpu
+from apex_tpu import amp, checkpoint, comm
+from apex_tpu.models import resnet18, resnet34, resnet50, resnet101
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import DistributedDataParallel
+
+ARCHS = {"resnet18": resnet18, "resnet34": resnet34,
+         "resnet50": resnet50, "resnet101": resnet101}
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--arch", default="resnet50", choices=sorted(ARCHS))
+    p.add_argument("--opt-level", default="O2",
+                   choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--batch-size", type=int, default=0,
+                   help="0 = pick by backend (128 tpu / 8 cpu)")
+    p.add_argument("--image-size", type=int, default=0)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--ddp", action="store_true",
+                   help="data-parallel over the mesh 'data' axis")
+    p.add_argument("--checkpoint", default="")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    batch = args.batch_size or (128 if on_tpu else 8)
+    size = args.image_size or (224 if on_tpu else 64)
+    print(f"apex_tpu {apex_tpu.__version__}: {args.arch} "
+          f"amp {args.opt_level} batch {batch} img {size} "
+          f"on {jax.default_backend()}")
+
+    model = ARCHS[args.arch](num_classes=1000)
+    x0 = jnp.zeros((batch, size, size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    params, amp_state = amp.initialize(params, opt_level=args.opt_level)
+    half = (jnp.bfloat16 if args.opt_level in ("O1", "O2", "O3")
+            else jnp.float32)
+    opt = FusedSGD(params, lr=args.lr, momentum=args.momentum,
+                   weight_decay=args.weight_decay)
+
+    ddp = DistributedDataParallel() if args.ddp else None
+    if args.ddp and not comm.is_initialized():
+        n = len(jax.devices())
+        comm.initialize(data=n, pipe=1, ctx=1, model=1)
+
+    def loss_fn(p, bs, x, y):
+        out, updates = model.apply(
+            {"params": p, "batch_stats": bs}, x.astype(half),
+            train=True, mutable=["batch_stats"])
+        logits = out.astype(jnp.float32)
+        ll = -jnp.take_along_axis(jax.nn.log_softmax(logits),
+                                  y[:, None], axis=1)
+        return jnp.mean(ll), updates["batch_stats"]
+
+    def train_step(p, bs, scaler, x, y):
+        (loss, new_bs), grads, found_inf = amp.scaled_value_and_grad(
+            loss_fn, scaler, p, bs, x, y, has_aux=True)
+        if ddp is not None:
+            grads = ddp.reduce_gradients(grads)
+        return loss, grads, new_bs, found_inf
+
+    if args.ddp:
+        jstep = jax.jit(
+            train_step,
+            in_shardings=(None, None, None,
+                          comm.sharding("data"), comm.sharding("data")))
+    else:
+        jstep = jax.jit(train_step)
+
+    key = jax.random.PRNGKey(1)
+    step0 = 0
+    if args.checkpoint:
+        import os
+        if os.path.exists(args.checkpoint):
+            p_, amp_sd, step0, batch_stats = \
+                checkpoint.load_training_state(
+                    args.checkpoint, opt.params, opt,
+                    extra_like=batch_stats)
+            if amp_sd:     # reference: amp.load_state_dict(ckpt['amp'])
+                amp_state = amp_state.load_state_dict(amp_sd)
+            print(f"resumed at step {step0} "
+                  f"scale {float(amp_state.scaler.loss_scale):.0f}")
+    t0 = None
+    for step in range(step0, step0 + args.steps):
+        kx, ky, key = jax.random.split(key, 3)
+        x = jax.random.normal(kx, (batch, size, size, 3))
+        y = jax.random.randint(ky, (batch,), 0, 1000)
+        loss, grads, batch_stats, found_inf = jstep(
+            opt.params, batch_stats, amp_state.scaler, x, y)
+        if int(found_inf) == 0:
+            opt.step(grads)
+        amp_state = amp.update_scaler(amp_state, found_inf)
+        if step == step0:
+            jax.block_until_ready(loss)
+            t0 = time.time()          # skip compile in throughput
+        if step % 10 == 0:
+            print(f"step {step:4d} loss {float(loss):.4f} "
+                  f"scale {float(amp_state.scaler.loss_scale):.0f}")
+    jax.block_until_ready(opt.params)
+    n_timed = args.steps - 1
+    if t0 and n_timed > 0:
+        imgs = batch * n_timed / (time.time() - t0)
+        print(f"throughput {imgs:.1f} imgs/sec")
+    if args.checkpoint:
+        checkpoint.save_training_state(
+            args.checkpoint, opt.params, opt,
+            amp_state=amp_state.state_dict(),
+            step=step0 + args.steps, extra=batch_stats)
+        print(f"checkpointed to {args.checkpoint}")
+
+
+if __name__ == "__main__":
+    main()
