@@ -1,0 +1,45 @@
+"""Weight-gradient GEMM with f32 accumulation into a persistent main_grad
+buffer (reference: csrc/megatron/fused_weight_gradient_dense.cpp —
+`fused_weight_gradient_mlp_cuda.wgrad_gemm_accum_fp32/_fp16`, SURVEY.md
+§2.4).
+
+The reference exists because Megatron accumulates many microbatches'
+weight grads into one fp32 buffer without materializing per-microbatch
+fp16 grads.  TPU-native: `dot_general` with
+preferred_element_type=f32 IS the mixed-precision wgrad GEMM (MXU
+accumulates in f32 natively); the running accumulation is an add into a
+DONATED buffer, which XLA performs in place — the same zero-copy
+accumulate the CUDA kernel hand-rolls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def wgrad_gemm_accum_fp32(input_, grad_output, main_grad):
+    """main_grad += input^T @ grad_output, accumulated in f32.
+
+    input_ (..., In) activations; grad_output (..., Out) upstream grads;
+    main_grad (In, Out) f32 accumulator.  Leading dims are flattened (the
+    reference's sequence*batch collapse).  Returns the new accumulator —
+    jit with donate_argnums on main_grad for true in-place accumulation.
+    """
+    x = input_.reshape(-1, input_.shape[-1])
+    dy = grad_output.reshape(-1, grad_output.shape[-1])
+    acc = jax.lax.dot_general(
+        x, dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return main_grad + acc
+
+
+# the _fp16 variant differs only in accumulator dtype upstream; on TPU
+# f32 accumulation is free on the MXU, so both names map to one impl
+wgrad_gemm_accum_fp16 = wgrad_gemm_accum_fp32
+
+
+def wgrad_gemm_accum_ref(input_, grad_output, main_grad):
+    x = input_.reshape(-1, input_.shape[-1]).astype(jnp.float32)
+    dy = grad_output.reshape(-1, grad_output.shape[-1]).astype(jnp.float32)
+    return main_grad + x.T @ dy
