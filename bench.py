@@ -1,9 +1,24 @@
-"""Benchmark: ResNet-50 ImageNet-shape training throughput, amp O2 +
-FusedSGD (BASELINE.md north star — the reference's
-examples/imagenet/main_amp.py config, synthetic data).
+"""Benchmark harness for the BASELINE.md tracked metrics.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N}
+Primary metric (north star): ResNet-50 ImageNet-shape training
+throughput, amp O2 + FusedSGD (the reference's
+examples/imagenet/main_amp.py config, synthetic data).
+Secondary metric: BERT-Large FusedLAMB step time (BASELINE tracked
+metric 2), reported in the same JSON line under "extra".
+
+Always prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "imgs/sec/chip",
+   "vs_baseline": N, "backend": "tpu"|"cpu-fallback", ...}
+
+Hardening (VERDICT.md round 1, Weak #1): the top-level process is a
+pure orchestrator that never imports jax.  It (a) probes the TPU
+backend in a bounded subprocess — backend init can hang indefinitely on
+a dead tunnel — retrying once on transient failure, and (b) runs the
+bench body itself in a second, watchdogged subprocess, so even a
+backend hang that appears AFTER a successful probe (tunnel died in the
+TOCTOU window) cannot prevent the JSON line.  On any failure the
+orchestrator emits a labeled fallback/error line itself.  Every phase
+inside the child is individually guarded too.
 
 vs_baseline compares against the A100 amp target named in BASELINE.json
 (~2500 imgs/sec/chip for ResNet-50 AMP on DGX A100, the number the
@@ -11,20 +26,45 @@ north star says to get within 10% of).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
+import traceback
 
 A100_IMGS_PER_SEC = 2500.0
 
+_PROBE_SRC = (
+    "import jax, sys; d = jax.devices(); "
+    "sys.exit(0 if d and d[0].platform != 'cpu' else 3)"
+)
 
-def main():
+
+def probe_tpu(timeout_s, attempts=2):
+    """True iff a non-CPU jax backend initializes in a child process."""
+    for _ in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                               timeout=timeout_s, capture_output=True)
+            if r.returncode == 0:
+                return True
+            if r.returncode == 3:   # definitive: backend is CPU-only
+                return False
+        except subprocess.TimeoutExpired:
+            # The timeout is already generous; a hung tunnel won't heal
+            # by waiting the same period again.  Only fast transient
+            # errors earn a retry.
+            return False
+        except OSError:
+            pass
+    return False
+
+
+def bench_resnet50_amp_o2(jax, jnp, on_tpu):
     from apex_tpu import amp
     from apex_tpu.models import resnet50
     from apex_tpu.optimizers import FusedSGD
 
-    on_tpu = jax.default_backend() not in ("cpu",)
     batch = 128 if on_tpu else 8
     size = 224 if on_tpu else 64
     steps = 20 if on_tpu else 3
@@ -41,9 +81,13 @@ def main():
     # masters come from amp.initialize (cast from the ORIGINAL f32
     # init), not from re-upcasting the rounded bf16 params.
     params_bf16, amp_state = amp.initialize(params, opt_level="O2")
-    opt = FusedSGD(params_bf16, lr=0.1, momentum=0.9, weight_decay=1e-4,
-                   master_weights=True)
-    opt.masters = amp_state.master_params
+    masters0 = amp_state.master_params
+    # Build the optimizer state from the amp masters directly
+    # (master_weights=False: the functional path below threads masters
+    # explicitly, and letting the ctor cast a second f32 master copy
+    # would transiently double master memory).
+    opt = FusedSGD(masters0, lr=0.1, momentum=0.9, weight_decay=1e-4,
+                   master_weights=False)
 
     def train_step(params, masters, opt_state, batch_stats, step, x, y):
         def loss_fn(p):
@@ -59,19 +103,15 @@ def main():
             loss_fn, has_aux=True)(params)
         new_masters, opt_state = opt.functional_step(
             masters, opt_state, grads, step)
-        new_params = jax.tree_util.tree_map(
-            lambda p, m: m.astype(p.dtype), params, new_masters)
+        new_params = amp.master_params_to_model_params(params, new_masters)
         return new_params, new_masters, opt_state, new_stats, loss
 
     step_jit = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
 
-    params_b = params_bf16
-    masters = opt.masters
-    opt_state = opt.opt_state
-    stats = batch_stats
+    params_b, masters = params_bf16, masters0
+    opt_state, stats = opt.opt_state, batch_stats
 
-    # warmup (compile)
-    for i in range(3):
+    for i in range(3):  # warmup (compile)
         params_b, masters, opt_state, stats, loss = step_jit(
             params_b, masters, opt_state, stats, jnp.int32(i + 1), x,
             labels)
@@ -84,14 +124,248 @@ def main():
             labels)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    return {"imgs_per_sec": batch * steps / dt,
+            "batch": batch, "image_size": size,
+            "step_ms": dt / steps * 1e3}
 
-    imgs_per_sec = batch * steps / dt
-    print(json.dumps({
+
+def bench_bert_lamb(jax, jnp, on_tpu):
+    """BERT-Large FusedLAMB step time (BASELINE tracked metric 2).
+
+    On the cpu-fallback path a tiny proxy config runs instead (a real
+    BERT-L CPU step takes minutes); the emitted dict carries the config
+    so the two are never confused.
+    """
+    from apex_tpu import amp
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.models.bert import bert_large, BertModel
+    from apex_tpu.optimizers import FusedLAMB
+
+    if on_tpu:
+        model = bert_large(dtype=jnp.bfloat16)
+        batch, seq, config = 8, 512, "bert-large b8 s512"
+        steps = 10
+    else:
+        model = BertModel(vocab_size=1024, hidden_size=128, num_heads=4,
+                          num_layers=2, max_seq_len=128,
+                          dtype=jnp.bfloat16)
+        batch, seq, config = 2, 64, "tiny-cpu-proxy"
+        steps = 2
+
+    vocab = model.vocab_size
+    tokens = jax.random.randint(jax.random.key(0), (batch, seq), 0, vocab)
+    mlm_labels = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                    vocab)
+    variables = model.init(jax.random.key(2), tokens)
+    params = variables["params"]
+
+    params_bf16, amp_state = amp.initialize(params, opt_level="O2")
+    masters0 = amp_state.master_params
+    opt = FusedLAMB(masters0, lr=1e-3, weight_decay=0.01,
+                    master_weights=False)
+
+    def train_step(params, masters, opt_state, step, tokens, labels):
+        def loss_fn(p):
+            logits = model.mlm_logits({"params": p}, tokens)  # (s,b,V) f32
+            flat = logits.transpose(1, 0, 2).reshape(-1, vocab)
+            losses = softmax_cross_entropy_loss(
+                flat, labels.reshape(-1), smoothing=0.0, padding_idx=-1)
+            return jnp.mean(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_masters, opt_state = opt.functional_step(
+            masters, opt_state, grads, step)
+        new_params = amp.master_params_to_model_params(params, new_masters)
+        return new_params, new_masters, opt_state, loss
+
+    step_jit = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    masters, opt_state = masters0, opt.opt_state
+    p = params_bf16
+    for i in range(2):  # warmup
+        p, masters, opt_state, loss = step_jit(
+            p, masters, opt_state, jnp.int32(i + 1), tokens, mlm_labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        p, masters, opt_state, loss = step_jit(
+            p, masters, opt_state, jnp.int32(i + 3), tokens, mlm_labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {"step_ms": dt / steps * 1e3, "config": config,
+            "batch": batch, "seq": seq}
+
+
+def _empty_result(backend="unknown"):
+    return {
         "metric": "resnet50_amp_o2_fused_sgd_train_throughput",
-        "value": round(imgs_per_sec, 2),
+        "value": 0.0,
         "unit": "imgs/sec/chip",
-        "vs_baseline": round(imgs_per_sec / A100_IMGS_PER_SEC, 4),
-    }))
+        "vs_baseline": 0.0,
+        "backend": backend,
+        "extra": {},
+        "errors": [],
+    }
+
+
+def _dump(out):
+    """One JSON line, with an empty errors list elided."""
+    return json.dumps({k: v for k, v in out.items()
+                       if k != "errors" or v})
+
+
+def run_child(backend):
+    """Bench body; prints one JSON line.  backend: "tpu"|"cpu"|"cpu-fallback"."""
+    out = _empty_result(backend)
+    on_tpu = backend == "tpu"
+    try:
+        import jax
+        if not on_tpu:
+            # sitecustomize force-registers the axon TPU plugin; env vars
+            # are too late once jax is imported, so flip the live config
+            # instead (must happen before the first backend use).
+            jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        actual = jax.default_backend()
+        if on_tpu and actual == "cpu":
+            # jax silently fell back to CPU — don't mislabel CPU numbers
+            # as a TPU result.
+            out["backend"] = backend = "cpu-fallback"
+            on_tpu = False
+            out["errors"].append("requested tpu but jax initialized cpu")
+    except Exception as e:
+        out["errors"].append(f"jax-init: {e!r}")
+        print(_dump(out))
+        return
+
+    try:
+        r = bench_resnet50_amp_o2(jax, jnp, on_tpu)
+        out["value"] = round(r["imgs_per_sec"], 2)
+        out["vs_baseline"] = round(r["imgs_per_sec"] / A100_IMGS_PER_SEC,
+                                   4)
+        out["extra"]["resnet50_step_ms"] = round(r["step_ms"], 2)
+        out["extra"]["resnet50_batch"] = r["batch"]
+        out["extra"]["resnet50_image_size"] = r["image_size"]
+    except Exception:
+        out["errors"].append(
+            "resnet50: " + traceback.format_exc(limit=3).replace("\n", " | "))
+
+    # Flush the primary metric NOW: if the secondary bench hangs and the
+    # watchdog kills us, the orchestrator salvages the last parseable
+    # line, so the north-star number survives.
+    print(_dump(out), flush=True)
+
+    try:
+        b = bench_bert_lamb(jax, jnp, on_tpu)
+        out["extra"]["bert_large_fused_lamb_step_ms"] = round(
+            b["step_ms"], 2)
+        out["extra"]["bert_config"] = b["config"]
+    except Exception:
+        out["errors"].append(
+            "bert_lamb: " + traceback.format_exc(limit=3).replace("\n", " | "))
+
+    print(_dump(out), flush=True)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name) or default)
+    except ValueError:
+        return default
+
+
+def _last_json_line(stdout):
+    """Last parseable JSON object line in a child's stdout, or None."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            out = json.loads(line)
+            if isinstance(out, dict) and "metric" in out:
+                return out
+        except ValueError:
+            continue
+    return None
+
+
+def _run_bench_child(backend, timeout_s):
+    """Returns (result-dict or None, error-string or None).
+
+    A salvaged-but-abnormal child (nonzero rc, or killed by the
+    watchdog after flushing the intermediate line) gets the abnormality
+    appended to the result's errors so a missing secondary metric is
+    distinguishable from a never-attempted one.
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", backend],
+            timeout=timeout_s, capture_output=True, text=True)
+        stdout, stderr = r.stdout, r.stderr
+        note = None if r.returncode == 0 else f"rc={r.returncode}"
+    except subprocess.TimeoutExpired as e:
+        def _s(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) else b
+        stdout, stderr = _s(e.stdout), _s(e.stderr)
+        note = f"timeout after {timeout_s}s"
+    except Exception as e:
+        return None, f"child: {e!r}"
+    out = _last_json_line(stdout)
+    if out is not None:
+        if note is not None:
+            out.setdefault("errors", []).append(f"child: {note}")
+        return out, None
+    tail = (stderr or "").strip()[-300:]
+    return None, (f"child: {note or 'exited'}, no JSON on stdout, "
+                  f"stderr tail: {tail!r}")
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        run_child(sys.argv[2])
+        return
+
+    force_cpu = (os.environ.get("APEX_TPU_BENCH_FORCE_CPU", "")
+                 .lower() not in ("", "0", "false"))
+    probe_timeout = _env_float("APEX_TPU_BENCH_PROBE_TIMEOUT", 240.0)
+    try:
+        on_tpu = (not force_cpu) and probe_tpu(probe_timeout)
+    except Exception:  # never let the probe kill the bench
+        on_tpu = False
+    backend = "tpu" if on_tpu else ("cpu" if force_cpu else "cpu-fallback")
+
+    # First TPU jit compiles slowly, so the TPU child gets a longer leash.
+    child_timeout = _env_float("APEX_TPU_BENCH_CHILD_TIMEOUT",
+                               1800.0 if on_tpu else 1200.0)
+    out, err = _run_bench_child(backend, child_timeout)
+    # A TPU child that errored fast (backend raised instead of hanging)
+    # still prints a value-0 line — that's a failure for salvage
+    # purposes, not a result.
+    tpu_failed = backend == "tpu" and (
+        out is None or float(out.get("value", 0)) <= 0)
+    if out is not None and not tpu_failed:
+        print(json.dumps(out))
+        return
+
+    if backend == "tpu":
+        # TPU child hung/crashed/zeroed after a clean probe — salvage a
+        # labeled CPU datapoint rather than returning nothing.
+        if out is not None:
+            err = "; ".join(["tpu child returned value 0"]
+                            + out.get("errors", []))
+        cpu_out, err2 = _run_bench_child("cpu-fallback", child_timeout)
+        if cpu_out is not None:
+            cpu_out.setdefault("errors", []).append(f"tpu attempt: {err}")
+            if out is not None:
+                # Keep any metric the TPU child DID measure (e.g. BERT
+                # succeeded while ResNet OOMed) — real-hardware numbers
+                # beat the CPU proxy.
+                for k, v in out.get("extra", {}).items():
+                    cpu_out.setdefault("extra", {})[f"tpu_{k}"] = v
+            print(json.dumps(cpu_out))
+            return
+        err = f"{err}; cpu-retry: {err2}"
+
+    out = _empty_result(backend)
+    out["errors"].append(err)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
