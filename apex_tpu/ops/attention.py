@@ -1,16 +1,22 @@
 """Fused attention kernels (reference: apex/contrib/csrc/multihead_attn/*
 ~8k LoC of per-variant CUDA, apex/contrib/csrc/fmha/ — SURVEY.md §2.4).
 
-One Pallas kernel family with flags replaces the reference's eight
-hand-specialized attention extensions: the whole
-scores->mask->softmax->context chain runs in VMEM per (batch*head,
-q-block) grid cell, so the (Sq, Sk) score matrix never touches HBM (the
-reference's kernels fuse the same chain; fmha additionally tiles — here
-Mosaic does the tiling).  bf16 inputs accumulate in f32 on the MXU.
+One Pallas flash-attention kernel family with flags replaces the
+reference's eight hand-specialized attention extensions.  The kernel is
+K-tiled with online softmax (flash-2 style: unnormalized accumulator,
+one divide at the last KV block), so sequence length is bounded by HBM,
+not VMEM — the (Sq, Sk) score matrix never exists, at any length.
+bf16 inputs hit the MXU in bf16 and accumulate in f32.
 
-Backward: custom_vjp recomputes scores blockwise with XLA math
-(flash-style recomputation — no saved probabilities, matching the
-memory-efficient behavior the reference gets from its fused bwd kernels).
+Backward is two Pallas kernels (dq over the KV grid; dk/dv over the Q
+grid) recomputing probabilities from the forward's saved logsumexp —
+no probability tensor is ever stored, matching the memory behavior the
+reference gets from its fused in-place bwd kernels.
+
+Variant flags: ``causal`` prunes the iteration space (fully-masked
+blocks are skipped and their DMAs clamped away); ``segment_ids``
+(q-ids, kv-ids) masks cross-segment pairs, which is how contrib.fmha's
+packed variable-length batches route through this one kernel.
 
 Long-context path: ``ring_attention`` shards the KV sequence over the
 "ctx" mesh axis and rotates KV blocks with lax.ppermute, merging partial
@@ -25,170 +31,494 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu import comm
 from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
 
 _NEG = -1e30
+_LANES = 128
 
 
 def _default_scale(d: int) -> float:
     return 1.0 / math.sqrt(d)
 
 
-# ---------------------------------------------------------------------------
-# Pallas forward kernel: grid (B*H, Sq/BQ); K/V resident per grid cell
-# ---------------------------------------------------------------------------
-
-def _attn_fwd_kernel(scale, causal, q_ref, k_ref, v_ref, o_ref):
-    j = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # (BQ, D)
-    k = k_ref[0].astype(jnp.float32)          # (Sk, D)
-    v = v_ref[0].astype(jnp.float32)
-    bq = q.shape[0]
-    sk = k.shape[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if causal:
-        row = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, (bq, sk), 1)
-        s = jnp.where(col > row, _NEG, s)
-    m = jnp.max(s, axis=1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=1, keepdims=True)
-    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32) / l
-    o_ref[0] = o.astype(o_ref.dtype)
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
 
 
-def _lane_pad(d: int) -> int:
-    """Head dim rounded up to the 128-lane width of the VPU/MXU."""
-    return -(-d // 128) * 128
+def _block(s: int, cap: int) -> int:
+    """Block size for a sequence dim: 128-multiple, <= cap, dividing the
+    padded length."""
+    sp = _round_up(s, _LANES)
+    return cap if sp % cap == 0 else _LANES
 
 
-def _fwd_pallas(q, k, v, scale, causal):
+def _geom(q, k):
+    """Shared fwd/bwd tiling geometry — the saved lse layout depends on
+    it, so both passes MUST derive it from this one place."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    # pad head dim to the 128-lane tile: real head dims (64, 80, 96...)
-    # would otherwise never reach the kernel; zero columns change nothing
-    # (scores gain 0-products, V gains zero output columns we slice off)
-    dp = _lane_pad(d)
-    if dp != d:
-        pad = ((0, 0), (0, 0), (0, 0), (0, dp - d))
-        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
-    bq = max(8, min(256, sq))
-    while sq % bq:
-        bq //= 2
-    bq = max(bq, 1)
-    q3 = q.reshape(b * h, sq, dp)
-    k3 = k.reshape(b * h, sk, dp)
-    v3 = v.reshape(b * h, sk, dp)
-    out = pl.pallas_call(
-        functools.partial(_attn_fwd_kernel, scale, causal),
-        grid=(b * h, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, dp), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, dp), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, dp), lambda i, j: (i, 0, 0)),
+    dp = _round_up(d, _LANES)
+    bq = _block(sq, 512)
+    bk = _block(sk, 512)
+    sqp, skp = _round_up(sq, bq), _round_up(sk, bk)
+    return b, h, sq, sk, d, dp, bq, bk, sqp, skp
+
+
+def _pad_seq(x, sp):
+    s = x.shape[2]
+    if s == sp:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, sp - s), (0, 0)))
+
+
+def _pad_head(x, dp):
+    d = x.shape[3]
+    if d == dp:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+
+
+def _seg_inputs(segment_ids, b, sqp, skp):
+    """Lane/sublane-broadcast segment ids so the kernel never transposes:
+    q ids ride the sublanes as (B, SQP, 128); kv ids ride the lanes as
+    (B, 8, SKP)."""
+    q_ids, kv_ids = segment_ids
+    q_ids = jnp.pad(q_ids.astype(jnp.int32),
+                    ((0, 0), (0, sqp - q_ids.shape[1])),
+                    constant_values=-1)
+    kv_ids = jnp.pad(kv_ids.astype(jnp.int32),
+                     ((0, 0), (0, skp - kv_ids.shape[1])),
+                     constant_values=-2)
+    qs = jnp.broadcast_to(q_ids[:, :, None], (b, sqp, _LANES))
+    ks = jnp.broadcast_to(kv_ids[:, None, :], (b, 8, skp))
+    return qs, ks
+
+
+def _mask_for_block(j, kk, bq, bk, sq, sk, sqp, skp, causal,
+                    qs_tile, ks_row, *, mask_rows):
+    """Validity mask (BQ, BK) for one score block, or None if nothing
+    masks.  qs_tile: (BQ, 128) or None; ks_row: (1, BK) or None."""
+    ok = None
+
+    def _and(a, b):
+        return b if a is None else a & b
+
+    row_g = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col_g = kk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if skp != sk:
+        ok = _and(ok, col_g < sk)
+    if mask_rows and sqp != sq:
+        ok = _and(ok, row_g < sq)
+    if causal:
+        ok = _and(ok, col_g <= row_g)
+    if qs_tile is not None:
+        reps = bk // _LANES
+        qseg = jnp.tile(qs_tile, (1, reps)) if reps > 1 else qs_tile
+        ok = _and(ok, qseg[:, :bk] == ks_row)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid (B*H, NQ, NK), KV innermost, flash-2 online softmax
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
+                *refs):
+    if seg:
+        q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        qs_ref = ks_ref = None
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: last KV block this Q block attends to (diagonal block)
+    kk_last = jnp.minimum(nk - 1, ((j + 1) * bq - 1) // bk) if causal \
+        else nk - 1
+
+    @pl.when(kk <= kk_last)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        ok = _mask_for_block(
+            j, kk, bq, bk, sq, sk, sqp, skp, causal,
+            qs_ref[0] if seg else None,
+            ks_ref[0, :1, :] if seg else None, mask_rows=False)
+        if ok is not None:
+            s = jnp.where(ok, s, _NEG)
+        m_prev = m_scr[:, :1]
+        m_curr = jnp.max(s, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_curr)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)
+        if ok is not None:
+            p = jnp.where(ok, p, 0.0)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(kk == kk_last)
+    def _finish():
+        l = l_scr[:, :1]
+        linv = jnp.where(l > 0.0, 1.0 / l, 0.0)
+        o_ref[0] = (acc_scr[...] * linv).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l_scr[...] > 0.0,
+                               m_scr[...] + jnp.log(l_scr[...]), _NEG)
+
+
+def _fwd_pallas(q, k, v, scale, causal, segment_ids):
+    b, h, sq, sk, d, dp, bq, bk, sqp, skp = _geom(q, k)
+    nq, nk = sqp // bq, skp // bk
+
+    q3 = _pad_head(_pad_seq(q, sqp), dp).reshape(b * h, sqp, dp)
+    k3 = _pad_head(_pad_seq(k, skp), dp).reshape(b * h, skp, dp)
+    v3 = _pad_head(_pad_seq(v, skp), dp).reshape(b * h, skp, dp)
+
+    if causal:
+        # clamp the KV index for blocks above the diagonal: the skipped
+        # iterations re-reference the diagonal block, so no DMA is issued
+        def _kv_idx(i, j, kk, bq=bq, bk=bk, nk=nk):
+            return (i, jnp.minimum(kk, jnp.minimum(
+                nk - 1, ((j + 1) * bq - 1) // bk)), 0)
+    else:
+        _kv_idx = lambda i, j, kk: (i, kk, 0)
+    in_specs = [
+        pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bk, dp), _kv_idx),
+        pl.BlockSpec((1, bk, dp), _kv_idx),
+    ]
+    args = [q3, k3, v3]
+    seg = segment_ids is not None
+    if seg:
+        qs, ks = _seg_inputs(segment_ids, b, sqp, skp)
+        in_specs += [
+            pl.BlockSpec((1, bq, _LANES), lambda i, j, kk: (i // h, j, 0)),
+            pl.BlockSpec((1, 8, bk),
+                         lambda i, j, kk: (i // h, 0,
+                                           _kv_idx(i, j, kk)[1])),
+        ]
+        args += [qs, ks]
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale, causal, seg, sq, sk,
+                          sqp, skp, bq, bk, nk),
+        grid=(b * h, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda i, j, kk: (i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, dp), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, dp), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sqp, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
         name="apex_flash_attention_fwd",
-    )(q3, k3, v3)
-    return out.reshape(b, h, sq, dp)[..., :d]
+    )(*args)
+    return o.reshape(b, h, sqp, dp)[:, :, :sq, :d], lse
 
 
-def _kernel_ok(q, k) -> bool:
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    dp = _lane_pad(d)
-    # K/V resident per grid cell: keep them within a few MiB of VMEM
-    return (pallas_enabled() and sk % 8 == 0
-            and sq % 8 == 0 and sk * dp * 4 * 2 <= 6 * 1024 * 1024)
+# ---------------------------------------------------------------------------
+# backward kernels: dq over the KV grid, dk/dv over the Q grid
+# ---------------------------------------------------------------------------
+
+def _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk, j, kk,
+                 q_ref, k_ref, qs_ref, ks_ref, lse_ref):
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse_ref[0, :, :1])
+    ok = _mask_for_block(
+        j, kk, bq, bk, sq, sk, sqp, skp, causal,
+        qs_ref[0] if seg else None,
+        ks_ref[0, :1, :] if seg else None, mask_rows=True)
+    if ok is not None:
+        p = jnp.where(ok, p, 0.0)
+    return p
+
+
+def _dq_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
+               *refs):
+    if seg:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qs_ref, ks_ref, \
+            dq_ref, dq_scr = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, dq_scr = refs
+        qs_ref = ks_ref = None
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    kk_last = jnp.minimum(nk - 1, ((j + 1) * bq - 1) // bk) if causal \
+        else nk - 1
+
+    @pl.when(kk <= kk_last)
+    def _body():
+        p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
+                         j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - di_ref[0, :, :1]) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kk == kk_last)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq,
+                *refs):
+    if seg:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qs_ref, ks_ref, \
+            dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, \
+            dk_ref, dv_ref, dk_scr, dv_scr = refs
+        qs_ref = ks_ref = None
+    kk = pl.program_id(1)
+    j = pl.program_id(2)
+
+    # causal: first Q block whose rows reach this KV block
+    j_first = jnp.minimum(nq - 1, (kk * bk) // bq) if causal else 0
+
+    @pl.when(j == j_first)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(j >= j_first)
+    def _body():
+        p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
+                         j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
+        do = do_ref[0].astype(jnp.float32)
+        # dv += p^T @ do   (contract the q dim)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - di_ref[0, :, :1]) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
+    b, h, sq, sk, d, dp, bq, bk, sqp, skp = _geom(q, k)
+    nq, nk = sqp // bq, skp // bk
+
+    q3 = _pad_head(_pad_seq(q, sqp), dp).reshape(b * h, sqp, dp)
+    k3 = _pad_head(_pad_seq(k, skp), dp).reshape(b * h, skp, dp)
+    v3 = _pad_head(_pad_seq(v, skp), dp).reshape(b * h, skp, dp)
+    do3 = _pad_head(_pad_seq(do, sqp), dp).reshape(b * h, sqp, dp)
+
+    # di = rowsum(do * o): plain-XLA elementwise; both di and the saved
+    # one-lane lse are broadcast to the kernel's 128-lane layout so
+    # neither bwd kernel ever transposes
+    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    di = jnp.pad(di.reshape(b * h, sq), ((0, 0), (0, sqp - sq)))
+    di = jnp.broadcast_to(di[:, :, None], (b * h, sqp, _LANES))
+    lse = jnp.broadcast_to(lse[:, :, None], (b * h, sqp, _LANES))
+
+    seg = segment_ids is not None
+    if causal:
+        def _kv_idx(i, j, kk, bq=bq, bk=bk, nk=nk):
+            return (i, jnp.minimum(kk, jnp.minimum(
+                nk - 1, ((j + 1) * bq - 1) // bk)), 0)
+
+        def _q_idx_kv(i, kk, j, bq=bq, bk=bk, nq=nq):
+            return (i, jnp.maximum(j, jnp.minimum(
+                nq - 1, (kk * bk) // bq)), 0)
+    else:
+        _kv_idx = lambda i, j, kk: (i, kk, 0)
+        _q_idx_kv = lambda i, kk, j: (i, j, 0)
+    base_specs = [
+        pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bk, dp), _kv_idx),
+        pl.BlockSpec((1, bk, dp), _kv_idx),
+        pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda i, j, kk: (i, j, 0)),
+    ]
+    args = [q3, k3, v3, do3, lse, di]
+    seg_specs = []
+    if seg:
+        qs, ks = _seg_inputs(segment_ids, b, sqp, skp)
+        seg_specs = [
+            pl.BlockSpec((1, bq, _LANES), lambda i, j, kk: (i // h, j, 0)),
+            pl.BlockSpec((1, 8, bk),
+                         lambda i, j, kk: (i // h, 0,
+                                           _kv_idx(i, j, kk)[1])),
+        ]
+        args += [qs, ks]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale, causal, seg, sq, sk,
+                          sqp, skp, bq, bk, nk),
+        grid=(b * h, nq, nk),
+        in_specs=base_specs + seg_specs,
+        out_specs=[pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+        name="apex_flash_attention_dq",
+    )(*args)[0]
+
+    # dk/dv grid: (BH, NK, NQ) — q innermost; index maps swap j/kk roles;
+    # for causal, Q-side blocks below the first contributing one are
+    # clamped so skipped iterations issue no DMA
+    kv_specs = [
+        pl.BlockSpec((1, bq, dp), _q_idx_kv),
+        pl.BlockSpec((1, bk, dp), lambda i, kk, j: (i, kk, 0)),
+        pl.BlockSpec((1, bk, dp), lambda i, kk, j: (i, kk, 0)),
+        pl.BlockSpec((1, bq, dp), _q_idx_kv),
+        pl.BlockSpec((1, bq, _LANES), _q_idx_kv),
+        pl.BlockSpec((1, bq, _LANES), _q_idx_kv),
+    ]
+    if seg:
+        kv_specs += [
+            pl.BlockSpec((1, bq, _LANES),
+                         lambda i, kk, j: (i // h,
+                                           _q_idx_kv(i, kk, j)[1], 0)),
+            pl.BlockSpec((1, 8, bk), lambda i, kk, j: (i // h, 0, kk)),
+        ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale, causal, seg, sq, sk,
+                          sqp, skp, bq, bk, nq),
+        grid=(b * h, nk, nq),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, dp), lambda i, kk, j: (i, kk, 0)),
+            pl.BlockSpec((1, bk, dp), lambda i, kk, j: (i, kk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, skp, dp), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skp, dp), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, dp), jnp.float32),
+            pltpu.VMEM((bk, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+        name="apex_flash_attention_dkv",
+    )(*args)
+
+    dq = dq.reshape(b, h, sqp, dp)[:, :, :sq, :d]
+    dk = dk.reshape(b, h, skp, dp)[:, :, :sk, :d]
+    dv = dv.reshape(b, h, skp, dp)[:, :, :sk, :d]
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, scale=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, segment_ids, causal, scale):
+    o, _ = _flash_fwd(q, k, v, segment_ids, causal, scale)
+    return o
+
+
+def _flash_fwd(q, k, v, segment_ids, causal, scale):
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    o, lse = _fwd_pallas(q, k, v, sc, causal, segment_ids)
+    # keep ONE lane of the kernel's 128-lane lse layout as the residual
+    # (they're identical); _bwd_pallas re-broadcasts
+    return o, (q, k, v, segment_ids, o, lse[:, :, 0])
+
+
+def _flash_bwd(causal, scale, res, do):
+    q, k, v, segment_ids, o, lse = res
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, do, sc, causal, segment_ids)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    segment_ids: Optional[Tuple[jax.Array,
+                                                jax.Array]] = None):
     """Fused scaled-dot-product attention, (B, H, S, D) layout.
 
     Replaces the reference's fast_multihead_attn softmax-chain kernels
-    and fmhalib (SURVEY.md §2.3): same math, one kernel, no HBM score
-    materialization.
+    and fmhalib (SURVEY.md §2.3): same math, one K-tiled online-softmax
+    kernel, no HBM score materialization at any sequence length.
+
+    segment_ids: optional (q_ids (B, Sq), kv_ids (B, Sk)) int arrays;
+    attention is masked where ids differ (packed variable-length
+    batches — the fmha contract).
     """
-    return _fa_fwd(q, k, v, causal, scale)[0]
-
-
-def _fa_fwd(q, k, v, causal, scale):
-    sc = scale if scale is not None else _default_scale(q.shape[-1])
-    if _kernel_ok(q, k):
-        o = _fwd_pallas(q, k, v, sc, causal)
-    else:
-        o = attention_ref(q, k, v, causal=causal, scale=sc)
-    return o, (q, k, v)
-
-
-def _fa_bwd(causal, scale, res, do):
-    """Memory-efficient backward: scan over q-chunks, recompute scores.
-
-    Peak live memory is O(chunk * Sk) per (B, H) — the full (Sq, Sk)
-    probability matrix is never materialized, matching the behavior the
-    reference gets from its fused in-place bwd kernels.  Standard flash
-    identities: dp = do @ V^T, D = rowsum(p * dp) (= rowsum(do * o)),
-    ds = p * (dp - D) * scale.
-    """
-    q, k, v = res
-    sc = scale if scale is not None else _default_scale(q.shape[-1])
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    ch = max(8, min(256, sq))
-    while sq % ch:
-        ch //= 2
-    ch = max(ch, 1)
-    n = sq // ch
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    # (n, b, h, ch, d) chunk-major for scan
-    qc = jnp.moveaxis(q.astype(jnp.float32).reshape(b, h, n, ch, d), 2, 0)
-    doc = jnp.moveaxis(do.astype(jnp.float32).reshape(b, h, n, ch, d), 2, 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (ch, sk), 1)
-
-    def step(carry, inp):
-        dk, dv = carry
-        qi, doi, idx = inp
-        s = jnp.einsum("bhqd,bhkd->bhqk", qi, kf) * sc
-        if causal:
-            row = (idx * ch
-                   + jax.lax.broadcasted_iota(jnp.int32, (ch, sk), 0))
-            s = jnp.where(col > row, _NEG, s)
-        m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        p = p / jnp.sum(p, axis=-1, keepdims=True)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", doi, vf)
-        dval = jnp.sum(p * dp, axis=-1, keepdims=True)
-        ds = p * (dp - dval) * sc
-        dqi = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qi)
-        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, doi)
-        return (dk, dv), dqi
-
-    (dk, dv), dq = jax.lax.scan(
-        step, (jnp.zeros_like(kf), jnp.zeros_like(vf)),
-        (qc, doc, jnp.arange(n)))
-    dq = jnp.moveaxis(dq, 0, 2).reshape(b, h, sq, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
-
-
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+    if not pallas_enabled():
+        sc = scale if scale is not None else _default_scale(q.shape[-1])
+        # jax.checkpoint: don't hold the (Sq, Sk) probability residual
+        # between fwd and bwd on the escape-hatch path
+        ref = jax.checkpoint(functools.partial(
+            attention_ref, causal=causal, scale=sc))
+        if segment_ids is not None:
+            q_ids, kv_ids = segment_ids
+            same = q_ids[:, None, :, None] == kv_ids[:, None, None, :]
+            o = ref(q, k, v, mask=jnp.where(same, 0.0, _NEG))
+            # kernel contract: fully-masked q rows give exact zeros (the
+            # oracle's softmax over an all--1e30 row gives mean-of-V);
+            # under causal, positions above the diagonal don't count as
+            # visible either
+            visible = same
+            if causal:
+                sq, sk = q.shape[2], k.shape[2]
+                col_ok = (jnp.arange(sk)[None, :]
+                          <= jnp.arange(sq)[:, None])   # (Sq, Sk)
+                visible = visible & col_ok[None, None]
+            any_kv = jnp.any(visible, axis=-1)          # (B, 1, Sq)
+            return jnp.where(any_kv[..., None], o, 0.0).astype(q.dtype)
+        return ref(q, k, v)
+    return _flash(q, k, v, segment_ids, causal, scale)
 
 
 def attention_ref(q, k, v, causal=False, scale=None,

@@ -16,13 +16,35 @@ import os
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("APEX_TPU_SMOKE") == "1":
+    # TPU smoke mode (tests/test_tpu_smoke.py): keep the real backend and
+    # persist compiled executables so re-runs skip the slow first compile.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+else:
+    jax.config.update("jax_platforms", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Smoke mode pins the real TPU backend for the whole process, so
+    only the smoke file may run — deselect everything else rather than
+    letting CPU-intended mesh suites loose on the single-client TPU."""
+    if os.environ.get("APEX_TPU_SMOKE") != "1":
+        return
+    keep = [it for it in items if "test_tpu_smoke" in str(it.fspath)]
+    drop = [it for it in items if "test_tpu_smoke" not in str(it.fspath)]
+    if drop:
+        config.hook.pytest_deselected(items=drop)
+        items[:] = keep
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,286 @@
+"""TPU smoke suite: every Pallas kernel under a REAL Mosaic compile.
+
+VERDICT.md round 1, Weak #2: all 199 CPU tests run the kernels with
+``interpret=True``; nothing proved the lane/tiling/VMEM assumptions on
+hardware.  This suite runs each kernel non-interpreted on the device
+against its jnp reference, across the bench-relevant shapes.
+
+Run with:  APEX_TPU_SMOKE=1 python -m pytest tests/test_tpu_smoke.py -v
+(skipped entirely when the backend is not a real TPU; the default
+``pytest tests/`` run forces CPU in conftest and skips these).
+
+Reference test model: tests/L0 oracle pattern (SURVEY.md §4) — fused
+kernel vs stock implementation, allclose under per-dtype tolerances.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+def _on_tpu() -> bool:
+    if os.environ.get("APEX_TPU_SMOKE") != "1":
+        return False
+    try:
+        # the tunnel serves one client at a time: init can fail with
+        # UNAVAILABLE if another process holds it — skip, don't error
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _on_tpu(),
+    reason="requires APEX_TPU_SMOKE=1 and a free, real TPU backend")
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else \
+        dict(rtol=2e-5, atol=2e-5)
+
+
+def _close(a, b, dtype=None, **kw):
+    dtype = dtype or a.dtype
+    tol = {**_tol(dtype), **kw}
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32), **tol)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 4, 512, 64), (1, 2, 2048, 128)])
+def test_flash_attention_fwd(shape, causal, dtype):
+    from apex_tpu.ops.attention import flash_attention, attention_ref
+    b, h, s, d = shape
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), dtype)
+    k = jax.random.normal(ks[1], (b, h, s, d), dtype)
+    v = jax.random.normal(ks[2], (b, h, s, d), dtype)
+    o = jax.jit(flash_attention, static_argnums=(3,))(q, k, v, causal)
+    o_ref = attention_ref(q, k, v, causal=causal)
+    _close(o, o_ref, dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_long_seq(causal):
+    """sk >= 8k must stay in the kernel (VERDICT Weak #3)."""
+    from apex_tpu.ops.attention import flash_attention, attention_ref
+    b, h, s, d = 1, 1, 8192, 128
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+    o = jax.jit(flash_attention, static_argnums=(3,))(q, k, v, causal)
+    _close(o, attention_ref(q, k, v, causal=causal), jnp.bfloat16)
+
+
+def test_flash_attention_grads():
+    from apex_tpu.ops.attention import flash_attention, attention_ref
+    b, h, s, d = 2, 2, 256, 64
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v, True) ** 2)
+
+    g = jax.jit(jax.grad(loss(flash_attention), argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            attention_ref(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, g_ref):
+        _close(a, b_, jnp.float32, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# layer norm / rms norm
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+@pytest.mark.parametrize("h", [1024, 4096])
+@pytest.mark.parametrize("rms", [False, True])
+def test_norm_fwd_bwd(h, rms, dtype):
+    from apex_tpu.ops import layer_norm as ln
+    rows = 512
+    x = jax.random.normal(jax.random.key(0), (rows, h), dtype)
+    w = jax.random.normal(jax.random.key(1), (h,), dtype) * 0.1 + 1.0
+    b = jax.random.normal(jax.random.key(2), (h,), dtype) * 0.1
+
+    if rms:
+        fused = lambda x, w: ln.fused_rms_norm(x, w)
+        ref = lambda x, w: ln.rms_norm_ref(x, w)
+        args = (x, w)
+    else:
+        fused = lambda x, w, b: ln.fused_layer_norm(x, w, b)
+        ref = lambda x, w, b: ln.layer_norm_ref(x, w, b)
+        args = (x, w, b)
+
+    y = jax.jit(fused)(*args)
+    _close(y, ref(*args), dtype)
+
+    g = jax.jit(jax.grad(lambda *a: jnp.sum(fused(*a) ** 2),
+                         argnums=tuple(range(len(args)))))(*args)
+    g_ref = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2),
+                     argnums=tuple(range(len(args))))(*args)
+    for a, b_ in zip(g, g_ref):
+        _close(a, b_, dtype, rtol=5e-2 if dtype == jnp.bfloat16 else 1e-4,
+               atol=5e-2 if dtype == jnp.bfloat16 else 1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax family
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_scaled_masked_softmax(dtype):
+    from apex_tpu.ops import softmax as sm
+    b, h, sq, sk = 2, 4, 256, 256
+    x = jax.random.normal(jax.random.key(0), (b, h, sq, sk), dtype)
+    mask = jax.random.bernoulli(jax.random.key(1), 0.2, (b, 1, sq, sk))
+    y = jax.jit(sm.scaled_masked_softmax)(x, mask, 0.83)
+    _close(y, sm.scaled_masked_softmax_ref(x, mask, 0.83), dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_scaled_upper_triang_masked_softmax(dtype):
+    from apex_tpu.ops import softmax as sm
+    a, sq = 8, 512
+    x = jax.random.normal(jax.random.key(0), (a, sq, sq), dtype)
+    y = jax.jit(sm.scaled_upper_triang_masked_softmax)(x, 0.5)
+    _close(y, sm.scaled_upper_triang_masked_softmax_ref(x, 0.5), dtype)
+    g = jax.jit(jax.grad(
+        lambda x: jnp.sum(
+            sm.scaled_upper_triang_masked_softmax(x, 0.5) ** 2)))(x)
+    g_ref = jax.grad(
+        lambda x: jnp.sum(
+            sm.scaled_upper_triang_masked_softmax_ref(x, 0.5) ** 2))(x)
+    _close(g, g_ref, dtype, rtol=5e-2 if dtype == jnp.bfloat16 else 1e-4,
+           atol=5e-2 if dtype == jnp.bfloat16 else 1e-4)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor substrate (flat buffer kernels)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1 << 16, (1 << 20) + 123])
+def test_flat_scale_axpby_l2norm(n):
+    from apex_tpu.ops import multi_tensor as mt
+    x = jax.random.normal(jax.random.key(0), (n,), jnp.float32)
+    y = jax.random.normal(jax.random.key(1), (n,), jnp.float32)
+    s = jnp.float32(0.37)
+    o, flag = jax.jit(mt.flat_scale)(x, s)
+    o_ref, flag_ref = mt.flat_scale_ref(x, s)
+    _close(o, o_ref, jnp.float32)
+    assert int(flag) == int(flag_ref) == 0
+    o, flag = jax.jit(mt.flat_axpby)(0.5, x, -0.25, y)
+    o_ref, _ = mt.flat_axpby_ref(0.5, x, -0.25, y)
+    _close(o, o_ref, jnp.float32)
+    nrm = jax.jit(mt.flat_l2norm)(x)
+    _close(nrm, mt.flat_l2norm_ref(x), jnp.float32, rtol=1e-4, atol=1e-4)
+
+
+def test_flat_scale_inf_flag():
+    from apex_tpu.ops import multi_tensor as mt
+    x = jnp.array([1.0, jnp.inf, 3.0] + [0.0] * 1021, jnp.float32)
+    _, flag = jax.jit(mt.flat_scale)(x, jnp.float32(1.0))
+    assert int(flag) == 1
+
+
+def test_flat_adam_sgd():
+    from apex_tpu.ops import multi_tensor as mt
+    n = 1 << 18
+    ks = jax.random.split(jax.random.key(0), 4)
+    p = jax.random.normal(ks[0], (n,), jnp.float32)
+    g = jax.random.normal(ks[1], (n,), jnp.float32) * 0.1
+    m = jax.random.normal(ks[2], (n,), jnp.float32) * 0.01
+    v = jnp.abs(jax.random.normal(ks[3], (n,), jnp.float32)) * 0.01
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.01, step=7, adam_w_mode=True)
+    out = jax.jit(lambda *a: mt.flat_adam(*a, **kw))(p, g, m, v)
+    ref = mt.flat_adam_ref(p, g, m, v, **kw)
+    for a, b_ in zip(out, ref):
+        _close(a, b_, jnp.float32, rtol=1e-5, atol=1e-6)
+    kw = dict(lr=0.1, momentum=0.9, dampening=0.0, weight_decay=1e-4,
+              nesterov=False, first_run=False)
+    out = jax.jit(lambda *a: mt.flat_sgd(*a, **kw))(p, g, m)
+    ref = mt.flat_sgd_ref(p, g, m, **kw)
+    for a, b_ in zip(out, ref):
+        _close(a, b_, jnp.float32, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# welford / xentropy
+# ---------------------------------------------------------------------------
+
+def test_welford():
+    from apex_tpu.ops import welford as wf
+    x = jax.random.normal(jax.random.key(0), (4096, 256), jnp.float32) * 3
+    cnt, mean, m2 = jax.jit(wf.welford_mean_var)(x)
+    cnt_r, mean_r, m2_r = wf.welford_mean_var_ref(x)
+    _close(mean, mean_r, jnp.float32, rtol=1e-4, atol=1e-4)
+    _close(m2, m2_r, jnp.float32, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy(dtype, smoothing):
+    from apex_tpu.ops import xentropy as xe
+    rows, c = 1024, 32768  # BERT-vocab scale
+    logits = jax.random.normal(jax.random.key(0), (rows, c), dtype)
+    labels = jax.random.randint(jax.random.key(1), (rows,), 0, c)
+    loss = jax.jit(lambda l, t: xe.softmax_cross_entropy(
+        l, t, smoothing=smoothing))(logits, labels)
+    loss_ref = xe.softmax_cross_entropy_ref(logits, labels,
+                                            smoothing=smoothing)
+    _close(loss, loss_ref, dtype)
+    g = jax.jit(jax.grad(lambda l: jnp.sum(
+        xe.softmax_cross_entropy(l, labels, smoothing=smoothing))))(logits)
+    g_ref = jax.grad(lambda l: jnp.sum(
+        xe.softmax_cross_entropy_ref(l, labels,
+                                     smoothing=smoothing)))(logits)
+    _close(g, g_ref, dtype, rtol=5e-2 if dtype == jnp.bfloat16 else 1e-4,
+           atol=5e-2 if dtype == jnp.bfloat16 else 1e-4)
+
+
+# ---------------------------------------------------------------------------
+# rope / transducer / wgrad (jnp+scan paths — compile-on-TPU sanity)
+# ---------------------------------------------------------------------------
+
+def test_rope():
+    from apex_tpu.ops import rope as rp
+    s, b, h, d = 256, 2, 4, 64
+    t = jax.random.normal(jax.random.key(0), (s, b, h, d), jnp.bfloat16)
+    freqs = jax.random.normal(jax.random.key(1), (s, 1, 1, d), jnp.float32)
+    y = jax.jit(rp.fused_apply_rotary_pos_emb)(t, freqs)
+    _close(y, rp.rope_ref(t, freqs), jnp.bfloat16)
+
+
+def test_transducer_loss():
+    from apex_tpu.ops import transducer as td
+    b, t, u, v = 2, 16, 8, 32
+    x = jax.nn.log_softmax(
+        jax.random.normal(jax.random.key(0), (b, t, u + 1, v)), axis=-1)
+    label = jax.random.randint(jax.random.key(1), (b, u), 1, v)
+    f_len = jnp.array([t, t - 3])
+    y_len = jnp.array([u, u - 2])
+    loss = jax.jit(td.transducer_loss)(x, label, f_len, y_len)
+    loss_ref = td.transducer_loss_ref(x, label, f_len, y_len)
+    _close(loss, loss_ref, jnp.float32, rtol=1e-4, atol=1e-4)
+
+
+def test_wgrad_accum():
+    from apex_tpu.ops import wgrad as wg
+    x = jax.random.normal(jax.random.key(0), (512, 1024), jnp.bfloat16)
+    dy = jax.random.normal(jax.random.key(1), (512, 2048), jnp.bfloat16)
+    main = jnp.zeros((2048, 1024), jnp.float32)
+    out = jax.jit(wg.wgrad_gemm_accum_fp32)(x, dy, main)
+    ref = wg.wgrad_gemm_accum_ref(x, dy, main)
+    _close(out, ref, jnp.float32, rtol=1e-3, atol=1e-3)
