@@ -29,6 +29,8 @@ from apex_tpu.amp.frontend import (
     master_params_to_model_params,
     update_scaler,
 )
+from apex_tpu.amp.wrap import auto_cast, cast_inputs
+from apex_tpu.amp import lists
 
 __all__ = [
     "Policy", "Properties", "opt_level_properties",
@@ -36,5 +38,5 @@ __all__ = [
     "check_finite", "conditional_step", "scale_loss",
     "scaled_value_and_grad", "unscale_grads", "update_state",
     "AmpState", "initialize", "master_params_to_model_params",
-    "update_scaler",
+    "update_scaler", "auto_cast", "cast_inputs", "lists",
 ]

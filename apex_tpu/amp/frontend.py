@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from apex_tpu.amp.policies import (Policy, Properties, opt_level_properties)
 from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
                                  update_state)
+from apex_tpu.amp.wrap import auto_cast, cast_inputs
 
 Pytree = Any
 
@@ -37,6 +38,25 @@ class AmpState:
     @property
     def policy(self) -> Policy:
         return self.properties.policy(self._half_dtype())
+
+    def wrap_forward(self, fn, cast_argnums=None):
+        """Apply this opt level's casting mechanism to an UNMODIFIED
+        forward function — the reference's model-patching step
+        (apex/amp/_initialize.py) as a functional wrapper.
+
+        O1 (patch_torch_functions): the trace-time op-list rewriter.
+        O2/O3 (cast_model_type set): cast floating inputs (restricted to
+        ``cast_argnums`` positions if given — the data args) to the
+        model half dtype.  O0 / disabled: identity.
+        """
+        props = self.properties
+        if not props.enabled:
+            return fn
+        if props.patch_torch_functions:
+            return auto_cast(fn, self.policy)
+        if props.cast_model_type is not None:
+            return cast_inputs(fn, props.cast_model_type, cast_argnums)
+        return fn
 
     def _half_dtype(self):
         cast = self.properties.cast_model_type

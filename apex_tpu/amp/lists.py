@@ -1,0 +1,90 @@
+"""amp op-classification lists, as data.
+
+Reference: apex/amp/lists/{functional_overrides,torch_overrides,
+tensor_overrides}.py (~400 LoC of torch function names split into
+FP16_FUNCS / FP32_FUNCS / CASTS — SURVEY.md §2.1).  The reference
+classifies *torch functions* because its engine monkey-patches them; the
+TPU engine (apex_tpu.amp.wrap) rewrites *jax primitives* at trace time,
+so the lists here classify primitive names.  The function-level names
+are kept alongside as documentation of parity with the reference's
+tables.
+
+Three classes, same semantics as the reference:
+
+- HALF (reference FP16_FUNCS): tensor-core/MXU-shaped ops — run in the
+  policy's compute dtype.  GEMMs and convolutions.
+- FP32 (reference FP32_FUNCS): numerically fragile ops — transcendental
+  / accumulation-heavy — always run in f32.
+- everything else (reference CASTS): type-promote so mixed-precision
+  operands widen to the widest floating dtype present.
+"""
+
+from __future__ import annotations
+
+# --- primitive-level tables (consumed by apex_tpu.amp.wrap) ---
+
+# MXU ops: run in compute dtype (reference FP16_FUNCS: conv*, linear,
+# matmul, mm, bmm, addmm, ...)
+HALF_PRIMS = frozenset({
+    "dot_general",
+    "conv_general_dilated",
+    "ragged_dot_general",
+})
+
+# fragile ops: pin to f32 (reference FP32_FUNCS: softmax, log_softmax,
+# exp, log, pow, norm, cumsum, losses, ...).  Pinning the primitive
+# decomposition — exp/log/rsqrt/sums — covers the reference's
+# function-level entries (softmax = max/sub/exp/sum/div; layer_norm =
+# mean/rsqrt; cross_entropy = log_softmax + gather; norm = square/sum/
+# sqrt) without needing to recognize whole functions.
+FP32_PRIMS = frozenset({
+    "exp", "exp2", "log", "log1p", "expm1",
+    "pow", "rsqrt", "sqrt", "cbrt",
+    "erf", "erfc", "erf_inv", "lgamma", "digamma",
+    "logistic", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "asinh", "acosh", "atanh",
+    "reduce_sum", "reduce_prod", "cumsum", "cumprod", "cumlogsumexp",
+})
+
+# call-like primitives the rewriter recurses into (their body is just
+# more jaxpr)
+RECURSE_PRIMS = frozenset({
+    "pjit", "jit", "closed_call", "core_call",
+    "remat", "remat2", "checkpoint",   # jax 0.9 names remat 'remat2'
+    "custom_jvp_call", "custom_jvp_call_jaxpr",
+})
+
+# --- reference-table documentation (function-level names, for parity
+# auditing against apex/amp/lists/*.py; not consumed by the engine) ---
+
+FP16_FUNCS = [
+    # functional_overrides.FP16_FUNCS / torch_overrides.FP16_FUNCS
+    "conv1d", "conv2d", "conv3d", "conv_transpose1d", "conv_transpose2d",
+    "conv_transpose3d", "conv_tbc", "linear", "addmm", "addmv", "addr",
+    "matmul", "mm", "mv", "bmm", "baddbmm", "addbmm", "prelu",
+]
+
+FP32_FUNCS = [
+    # functional_overrides.FP32_FUNCS / torch_overrides.FP32_FUNCS
+    "acos", "asin", "cosh", "erfinv", "exp", "expm1", "log", "log10",
+    "log2", "log1p", "reciprocal", "rsqrt", "sinh", "tan", "pow",
+    "softplus", "gelu", "layer_norm", "group_norm", "local_response_norm",
+    "normalize", "softmin", "softmax", "log_softmax", "cosine_similarity",
+    "poisson_nll_loss", "cosine_embedding_loss", "cross_entropy",
+    "hinge_embedding_loss", "kl_div", "l1_loss", "mse_loss",
+    "margin_ranking_loss", "multilabel_margin_loss", "soft_margin_loss",
+    "triplet_margin_loss", "multi_margin_loss", "nll_loss",
+    "binary_cross_entropy_with_logits", "smooth_l1_loss", "cumprod",
+    "cumsum", "dist", "norm", "prod", "renorm", "sum",
+]
+
+CASTS = [
+    # promote-to-widest ops (torch_overrides.CASTS)
+    "addcdiv", "addcmul", "atan2", "cross", "bilinear", "dot", "vdot",
+    "add", "div", "mul", "sub", "eq", "equal", "ge", "gt", "le", "lt",
+    "ne",
+]
+
+# banned in fp16 without scaling (reference raises/warns):
+# binary_cross_entropy — covered here by the FP32 pin on its log/exp
+SEQUENCE_CASTS = ["cat", "stack"]

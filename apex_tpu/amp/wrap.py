@@ -1,0 +1,202 @@
+"""The O1 casting engine: a trace-time precision rewriter.
+
+Reference: apex/amp/wrap.py + utils.py + opt.py (~900 LoC, SURVEY.md
+§2.1): the reference monkey-patches every listed torch function with a
+wrapper that casts inputs per the FP16/FP32 lists and caches parameter
+casts.  Monkey-patching has no JAX analog — but it doesn't need one:
+under `jit` every op is already visible at trace time.  ``auto_cast``
+traces the UNMODIFIED function to a jaxpr, then re-evaluates it with
+per-primitive dtype rules from apex_tpu.amp.lists:
+
+- HALF_PRIMS (GEMM/conv)        -> operands cast to compute_dtype
+- FP32_PRIMS (exp/log/sums/...) -> operands cast to f32
+- everything else               -> mixed float operands promote to the
+                                   widest dtype present (reference CASTS)
+
+so ``amp.initialize(..., "O1")`` changes an arbitrary model's precision
+with zero edits to the model.  The rewrite composes with jit/grad/vmap
+(it is itself a tracing transform), and the reference's "cast cache"
+falls out for free: a param cast appearing once in the jaxpr is one op
+in the compiled program, CSE'd and fused by XLA.
+
+Call-like primitives are recursed into (pjit/remat/custom_jvp); opaque
+ones with typed sub-jaxprs (scan/while/cond/custom_vjp — e.g. this
+package's own Pallas kernels, which already manage precision
+internally) run unmodified at their traced dtypes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists
+from apex_tpu.amp.policies import Policy
+
+# jax.extend.core is the supported home for jaxpr types in newer jax
+try:
+    from jax.extend.core import ClosedJaxpr, Literal
+except ImportError:  # pragma: no cover - older jax
+    from jax.core import ClosedJaxpr, Literal
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+def _cast_floats(vals, dtype):
+    return [v.astype(dtype) if _is_float(v)
+            and jnp.result_type(v) != dtype else v for v in vals]
+
+
+def _promote_floats(vals):
+    """Reference CASTS semantics: widen mixed float operands."""
+    fdts = {jnp.result_type(v) for v in vals if _is_float(v)}
+    if len(fdts) <= 1:
+        return vals
+    widest = functools.reduce(jnp.promote_types, fdts)
+    return _cast_floats(vals, widest)
+
+
+def _restore_dtypes(vals, invars):
+    """Cast drifted operands back to the dtypes the eqn was traced at
+    (used for opaque primitives whose sub-jaxprs are dtype-bound)."""
+    out = []
+    for v, var in zip(vals, invars):
+        aval = var.aval
+        if (_is_float(v) and hasattr(aval, "dtype")
+                and jnp.result_type(v) != aval.dtype):
+            v = v.astype(aval.dtype)
+        out.append(v)
+    return out
+
+
+def _half_params(params, half):
+    """For HALF prims: drop a traced f32 accumulation hint so the output
+    comes back in compute dtype (XLA still accumulates bf16 dots in f32
+    on the MXU)."""
+    if params.get("preferred_element_type") is not None:
+        p = dict(params)
+        if jnp.issubdtype(p["preferred_element_type"], jnp.floating):
+            p["preferred_element_type"] = jnp.dtype(half)
+        return p
+    return params
+
+
+def _bind(prim, vals, params):
+    """Re-issue an eqn the way core.eval_jaxpr does: get_bind_params
+    recovers callable sub-arguments (custom_vjp's fun/fwd/bwd, ...)
+    that live in eqn.params but bind positionally."""
+    subfuns, bind_params = prim.get_bind_params(params)
+    ans = prim.bind(*subfuns, *vals, **bind_params)
+    return ans if prim.multiple_results else [ans]
+
+
+def _eval_jaxpr(jaxpr, consts, args, half):
+    env = {}
+
+    def read(a):
+        return a.val if isinstance(a, Literal) else env[a]
+
+    for v, c in zip(jaxpr.constvars, consts):
+        env[v] = c
+    for v, a in zip(jaxpr.invars, args):
+        env[v] = a
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive
+        name = prim.name
+        vals = [read(x) for x in eqn.invars]
+        params = eqn.params
+
+        if name in lists.RECURSE_PRIMS:
+            sub = params.get("jaxpr") or params.get("call_jaxpr")
+            if sub is not None:
+                if isinstance(sub, ClosedJaxpr):
+                    ans = _eval_jaxpr(sub.jaxpr, sub.consts, vals, half)
+                else:
+                    ans = _eval_jaxpr(sub, (), vals, half)
+            else:  # unexpected shape: run opaque
+                ans = _bind(prim, _restore_dtypes(vals, eqn.invars),
+                            params)
+        elif name in lists.HALF_PRIMS:
+            ans = _bind(prim, _cast_floats(vals, half),
+                        _half_params(params, half))
+        elif name in lists.FP32_PRIMS:
+            ans = _bind(prim, _cast_floats(vals, jnp.float32), params)
+        elif "jaxpr" in params or "call_jaxpr" in params or \
+                "branches" in params or "cond_jaxpr" in params or \
+                "fwd_jaxpr_thunk" in params or "num_consts" in params:
+            # opaque control flow / custom_vjp: dtype-bound bodies
+            ans = _bind(prim, _restore_dtypes(vals, eqn.invars), params)
+        else:
+            ans = _bind(prim, _promote_floats(vals), params)
+
+        for v, a in zip(eqn.outvars, ans):
+            env[v] = a
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def auto_cast(fn: Callable, policy: Optional[Policy] = None,
+              compute_dtype: Any = None) -> Callable:
+    """Wrap ``fn`` so listed ops run at the policy's precision.
+
+    The O1 engine: ``fn`` is any jax-traceable callable (a flax
+    ``model.apply``, a bare function, a whole train-step body).  Returns
+    a callable computing the same function with GEMMs/convs in
+    ``compute_dtype`` and fragile ops in f32, per apex_tpu.amp.lists.
+
+    No-op (returns ``fn`` unchanged) when the compute dtype is f32.
+    """
+    half = jnp.dtype(compute_dtype if compute_dtype is not None
+                     else (policy.compute_dtype if policy is not None
+                           else jnp.bfloat16))
+    if half == jnp.dtype(jnp.float32):
+        return fn
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        flat, in_tree = jax.tree_util.tree_flatten((args, kwargs))
+
+        def flat_fn(*xs):
+            a, kw = jax.tree_util.tree_unflatten(in_tree, xs)
+            return fn(*a, **kw)
+
+        closed, out_shape = jax.make_jaxpr(
+            flat_fn, return_shape=True)(*flat)
+        out_tree = jax.tree_util.tree_structure(out_shape)
+        outs = _eval_jaxpr(closed.jaxpr, closed.consts, flat, half)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    return wrapped
+
+
+def cast_inputs(fn: Callable, dtype, argnums=None) -> Callable:
+    """O2/O3 forward patch: cast floating inputs to the model dtype.
+
+    Reference: apex/amp/_initialize.py patches ``model.forward`` to cast
+    ``*args`` to the cast_model_type; this is the functional analog.
+    ``argnums`` restricts casting to those positional args — functional
+    code passes params/state as arguments too, and only the DATA inputs
+    play the role of the reference's forward(*args).
+    """
+    dtype = jnp.dtype(dtype)
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        cast = lambda x: (x.astype(dtype)
+                          if hasattr(x, "dtype") and _is_float(x) else x)
+        if argnums is None:
+            args = jax.tree_util.tree_map(cast, args)
+            kwargs = jax.tree_util.tree_map(cast, kwargs)
+        else:
+            args = tuple(jax.tree_util.tree_map(cast, a)
+                         if i in argnums else a
+                         for i, a in enumerate(args))
+        return fn(*args, **kwargs)
+
+    return wrapped

@@ -62,12 +62,18 @@ def _block(s: int, cap: int) -> int:
 
 def _geom(q, k):
     """Shared fwd/bwd tiling geometry — the saved lse layout depends on
-    it, so both passes MUST derive it from this one place."""
+    it, so both passes MUST derive it from this one place.
+
+    The sequence-block cap shrinks as the padded head dim grows so the
+    working set (q/k/v/do blocks, double-buffered, plus the f32 score
+    tile and accumulators) stays well inside the ~16 MiB VMEM at any d.
+    """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     dp = _round_up(d, _LANES)
-    bq = _block(sq, 512)
-    bk = _block(sk, 512)
+    cap = 512 if dp <= 128 else (256 if dp <= 256 else 128)
+    bq = _block(sq, cap)
+    bk = _block(sk, cap)
     sqp, skp = _round_up(sq, bq), _round_up(sk, bk)
     return b, h, sq, sk, d, dp, bq, bk, sqp, skp
 

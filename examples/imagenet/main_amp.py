@@ -44,6 +44,10 @@ def parse_args():
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--ddp", action="store_true",
                    help="data-parallel over the mesh 'data' axis")
+    p.add_argument("--sync-bn", action="store_true",
+                   help="convert BatchNorm to SyncBatchNorm over the "
+                        "'data' mesh axis (reference: --sync_bn + "
+                        "apex.parallel.convert_syncbn_model)")
     p.add_argument("--checkpoint", default="")
     return p.parse_args()
 
@@ -57,16 +61,24 @@ def main():
           f"amp {args.opt_level} batch {batch} img {size} "
           f"on {jax.default_backend()}")
 
-    model = ARCHS[args.arch](num_classes=1000)
+    kwargs = dict(num_classes=1000)
+    if args.sync_bn:
+        # reference: apex.parallel.convert_syncbn_model(model); here the
+        # model takes the norm class directly
+        import functools
+        from apex_tpu.parallel import SyncBatchNorm
+        kwargs["norm_cls"] = functools.partial(
+            SyncBatchNorm, channel_last=True,
+            process_group=comm.AXIS_DATA)
+    model = ARCHS[args.arch](**kwargs)
     x0 = jnp.zeros((batch, size, size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), x0, train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
     params, amp_state = amp.initialize(params, opt_level=args.opt_level)
-    half = (jnp.bfloat16 if args.opt_level in ("O1", "O2", "O3")
-            else jnp.float32)
     opt = FusedSGD(params, lr=args.lr, momentum=args.momentum,
-                   weight_decay=args.weight_decay)
+                   weight_decay=args.weight_decay,
+                   master_weights=bool(amp_state.properties.master_weights))
 
     ddp = DistributedDataParallel() if args.ddp else None
     if args.ddp and not comm.is_initialized():
@@ -75,16 +87,20 @@ def main():
 
     def loss_fn(p, bs, x, y):
         out, updates = model.apply(
-            {"params": p, "batch_stats": bs}, x.astype(half),
+            {"params": p, "batch_stats": bs}, x,
             train=True, mutable=["batch_stats"])
         logits = out.astype(jnp.float32)
         ll = -jnp.take_along_axis(jax.nn.log_softmax(logits),
                                   y[:, None], axis=1)
         return jnp.mean(ll), updates["batch_stats"]
 
+    # the amp mechanism does ALL precision work: O1 rewrites the ops of
+    # the unmodified model, O2/O3 cast the data input (arg 2)
+    wrapped_loss = amp_state.wrap_forward(loss_fn, cast_argnums=(2,))
+
     def train_step(p, bs, scaler, x, y):
         (loss, new_bs), grads, found_inf = amp.scaled_value_and_grad(
-            loss_fn, scaler, p, bs, x, y, has_aux=True)
+            wrapped_loss, scaler, p, bs, x, y, has_aux=True)
         if ddp is not None:
             grads = ddp.reduce_gradients(grads)
         return loss, grads, new_bs, found_inf

@@ -1,0 +1,126 @@
+"""L0 tests for the O1 casting engine (reference test model:
+tests/L0/run_amp/test_basic_casts.py + test_promotion.py — does each
+listed op run at its listed precision, do mixed inputs promote)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+def _prim_in_dtypes(fn, name, *args):
+    jx = jax.make_jaxpr(fn)(*args)
+    out = []
+    for e in jx.jaxpr.eqns:
+        if e.primitive.name == name:
+            out += [str(v.aval.dtype) for v in e.invars
+                    if hasattr(v.aval, "dtype")]
+    return out
+
+
+def test_O0_is_identity():
+    f = lambda x: x @ x
+    assert amp.auto_cast(f, compute_dtype=jnp.float32) is f
+
+
+def test_basic_casts_matmul_half_exp_fp32():
+    """FP16_FUNCS analog: dot_general runs bf16; FP32_FUNCS analog:
+    exp/log run f32 — on an untouched f32 function."""
+    def f(x):
+        return jnp.sum(jnp.exp(x @ x * 0.01))
+
+    x = jax.random.normal(jax.random.key(0), (32, 32))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    assert set(_prim_in_dtypes(w, "dot_general", x)) == {"bfloat16"}
+    assert set(_prim_in_dtypes(w, "exp", x)) == {"float32"}
+    np.testing.assert_allclose(float(w(x)), float(f(x)), rtol=2e-2)
+
+
+def test_promotion_mixed_widens():
+    """CASTS analog: bf16 (from a whitelisted op) + f32 operand ->
+    the add runs f32, not bf16."""
+    def f(x, y):
+        h = x @ x          # becomes bf16
+        return h + y       # y stays f32 -> promote
+
+    x = jax.random.normal(jax.random.key(0), (16, 16))
+    y = jax.random.normal(jax.random.key(1), (16, 16))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    assert set(_prim_in_dtypes(w, "add", x, y)) == {"float32"}
+
+
+def test_nested_jit_and_custom_jvp_are_rewritten():
+    """ops inside jitted subfunctions and custom_jvp wrappers (e.g.
+    jax.nn.log_softmax) are reached by the rewriter."""
+    def f(x):
+        return jnp.mean(jax.nn.log_softmax(jax.jit(lambda a: a @ a)(x)))
+
+    x = jax.random.normal(jax.random.key(0), (16, 16))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    assert set(_prim_in_dtypes(w, "dot_general", x)) == {"bfloat16"}
+    assert set(_prim_in_dtypes(w, "exp", x)) == {"float32"}
+
+
+def test_opaque_custom_vjp_still_correct():
+    """The package's own Pallas ops (custom_vjp, dtype-bound) run
+    unmodified at traced precision inside a wrapped function, values
+    and grads intact."""
+    from apex_tpu.ops.layer_norm import fused_layer_norm
+
+    def f(x, g):
+        return jnp.sum(fused_layer_norm(x @ x, g) ** 2)
+
+    x = jax.random.normal(jax.random.key(0), (128, 128))
+    g = jnp.ones((128,))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(float(w(x, g)), float(f(x, g)), rtol=3e-2)
+    gw = jax.grad(w)(x, g)
+    gf = jax.grad(f)(x, g)
+    assert bool(jnp.all(jnp.isfinite(gw)))
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gf),
+                               rtol=1.0, atol=0.15)  # bf16 fwd, loose
+
+
+def test_grad_composes():
+    def f(p, x):
+        return jnp.mean((x @ p["w"] + p["b"]) ** 2)
+
+    p = {"w": jax.random.normal(jax.random.key(0), (8, 4)),
+         "b": jnp.zeros((4,))}
+    x = jax.random.normal(jax.random.key(1), (16, 8))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    g = jax.jit(jax.grad(w))(p, x)
+    g_ref = jax.grad(f)(p, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(g_ref)):
+        assert a.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-2)
+
+
+def test_cast_inputs_argnums():
+    seen = {}
+
+    def f(p, x):
+        seen["p"] = p.dtype
+        seen["x"] = x.dtype
+        return x
+
+    w = amp.cast_inputs(f, jnp.bfloat16, argnums=(1,))
+    w(jnp.zeros((2,), jnp.float32), jnp.zeros((2,), jnp.float32))
+    assert seen["p"] == jnp.float32
+    assert seen["x"] == jnp.bfloat16
+
+
+def test_pytree_outputs_roundtrip():
+    def f(x):
+        return {"a": x @ x, "aux": (jnp.sum(x), x + 1)}
+
+    x = jax.random.normal(jax.random.key(0), (8, 8))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    out = w(x)
+    assert set(out) == {"a", "aux"}
+    assert out["a"].shape == (8, 8)
+    assert len(out["aux"]) == 2
