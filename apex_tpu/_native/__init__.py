@@ -25,15 +25,38 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def _src_hash() -> str:
+    import hashlib
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
 def _build() -> Optional[str]:
-    if os.path.exists(_SO) and (os.path.getmtime(_SO)
-                                >= os.path.getmtime(_SRC)):
-        return _SO
+    # Rebuild keyed on a source-content hash, not mtimes: a checkout
+    # refreshes every mtime, which made a stale (possibly other-arch)
+    # committed .so look fresh forever (ADVICE r1).
+    stamp = _SO + ".srchash"
+    want = _src_hash()
+    if os.path.exists(_SO) and os.path.exists(stamp):
+        try:
+            with open(stamp) as f:
+                if f.read().strip() == want:
+                    return _SO
+        except OSError:
+            pass
     try:
+        # compile to a private temp path and publish atomically: a
+        # concurrent first-run process must never CDLL a torn ELF
+        tmp = f"{_SO}.{os.getpid()}.tmp"
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-             _SRC, "-o", _SO],
+             _SRC, "-o", tmp],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        tmp_stamp = f"{stamp}.{os.getpid()}.tmp"
+        with open(tmp_stamp, "w") as f:
+            f.write(want)
+        os.replace(tmp_stamp, stamp)
         return _SO
     except Exception:
         return None

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Any, Dict, Optional
 
 import jax
@@ -59,6 +60,10 @@ def save_checkpoint(path: str, tree: Pytree,
         "shapes": [list(h.shape) for h in host],
         "dtypes": [str(h.dtype) for h in host],   # 'bfloat16' prints fine
         "checksum": checksum,
+        # crc over EVERY payload byte — integer leaves are invisible to
+        # the float l2 checksum (ADVICE r1); zlib takes the buffer
+        # protocol, no copy
+        "payload_crc32": int(zlib.crc32(payload)),
         "metadata": metadata or {},
     }
     hbytes = json.dumps(header).encode()
@@ -98,6 +103,20 @@ def load_checkpoint(path: str, like: Pytree,
                 f"{tuple(leaf.shape)}/{leaf.dtype}")
     protos = [np.empty(s, _resolve_dtype(d))
               for s, d in zip(header["shapes"], header["dtypes"])]
+    # a truncated/oversized payload must fail BEFORE the native memcpy
+    # reads out of bounds (ADVICE r1)
+    expect = sum(int(np.prod(s)) * _resolve_dtype(d).itemsize
+                 for s, d in zip(header["shapes"], header["dtypes"]))
+    if payload.nbytes != expect:
+        raise ValueError(
+            f"checkpoint payload is {payload.nbytes} bytes, header "
+            f"declares {expect} (truncated or corrupt file?)")
+    if "payload_crc32" in header:
+        crc = int(zlib.crc32(payload))
+        if crc != header["payload_crc32"]:
+            raise ValueError(
+                f"checkpoint payload crc mismatch: {crc} != "
+                f"{header['payload_crc32']} (corrupt file?)")
     host = _native.host_unflatten(payload, protos)
     f32_leaves = [h.astype(np.float32).ravel() for h in host
                   if np.issubdtype(h.dtype, np.floating)]

@@ -24,6 +24,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu import comm
+
 
 def halo_exchange(x, axis_name: str, halo: int = 1, dim: int = 1):
     """Concatenate `halo` rows from both mesh-axis neighbors along `dim`.
@@ -51,11 +53,7 @@ def halo_exchange(x, axis_name: str, halo: int = 1, dim: int = 1):
 def _axis_bound(axis_name: Optional[str]) -> bool:
     if axis_name is None:
         return False
-    try:
-        jax.lax.axis_index(axis_name)
-        return True
-    except Exception:
-        return False
+    return comm.axis_is_bound(axis_name)
 
 
 class Bottleneck(nn.Module):

@@ -67,6 +67,9 @@ class DynamicLossScaler(LossScaler):
             self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
             self.last_overflow_iter = self.cur_iter
         elif (self.cur_iter - self.last_overflow_iter) % \
-                self.scale_window == 0 and self.cur_iter > 0:
+                self.scale_window == 0:
+            # reference grows whenever the window condition holds — with
+            # scale_window=1 that includes the very first clean step
+            # (ADVICE r1 parity fix)
             self.cur_scale *= self.scale_factor
         self.cur_iter += 1

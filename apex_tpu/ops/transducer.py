@@ -45,6 +45,11 @@ def transducer_joint(f, g, f_len=None, g_len=None, *, relu=False,
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
                                     h.shape)
         h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+    if (f_len is None) != (g_len is None):
+        raise ValueError(
+            "transducer_joint: f_len and g_len must be passed together "
+            f"(got f_len={'set' if f_len is not None else None}, "
+            f"g_len={'set' if g_len is not None else None})")
     if f_len is not None:
         b, t, u, _ = h.shape
         tmask = jnp.arange(t)[None, :] < f_len[:, None]        # (B, T)

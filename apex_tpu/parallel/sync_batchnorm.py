@@ -28,13 +28,7 @@ from apex_tpu.ops.welford import welford_mean_var_ref
 
 
 def _axis_bound(axis_name: str) -> bool:
-    try:
-        jax.lax.axis_index(axis_name)
-        return True
-    except NameError:
-        return False
-    except Exception:
-        return False
+    return comm.axis_is_bound(axis_name)
 
 
 def sync_batch_norm_stats(x2d: jax.Array, axis_name: Optional[str]):

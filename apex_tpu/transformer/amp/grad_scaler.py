@@ -21,10 +21,8 @@ def sync_found_inf(found_inf, axes=(comm.AXIS_MODEL, comm.AXIS_PIPE,
                                     comm.AXIS_DATA)):
     """Max-reduce the overflow flag over all bound parallel axes."""
     for ax in axes:
-        try:
+        if comm.axis_is_bound(ax):
             found_inf = jax.lax.pmax(found_inf, ax)
-        except Exception:
-            pass
     return found_inf
 
 

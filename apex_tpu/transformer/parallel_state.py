@@ -101,10 +101,9 @@ def get_context_parallel_world_size() -> int:
 def _axis_rank(axis: str):
     """Rank on an axis: traced value inside shard_map, 0 outside (the
     single-controller host view)."""
-    try:
+    if comm.axis_is_bound(axis):
         return jax.lax.axis_index(axis)
-    except Exception:
-        return 0
+    return 0
 
 
 def get_tensor_model_parallel_rank():

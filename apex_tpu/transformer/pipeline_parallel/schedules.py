@@ -49,7 +49,12 @@ def get_forward_backward_func(
     """Reference dispatch: schedule by pp size / virtual size."""
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
-            return _forward_backward_pipelining_with_interleaving
+            import functools
+            return functools.partial(
+                _forward_backward_pipelining_with_interleaving,
+                pipeline_model_parallel_size=pipeline_model_parallel_size,
+                virtual_pipeline_model_parallel_size=(
+                    virtual_pipeline_model_parallel_size))
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining
 
@@ -192,17 +197,153 @@ def forward_backward_pipelining_without_interleaving(
     return losses, grads
 
 
+class _KeyedP2P:
+    """Interleaved mailbox: values keyed by (virtual position, microbatch)
+    so out-of-order consumption across chunks can never alias."""
+
+    def __init__(self):
+        self.fwd: dict = {}
+        self.bwd: dict = {}
+
+    def has_fwd(self, v, mb):
+        return (v, mb) in self.fwd
+
+    def has_bwd(self, v, mb):
+        return (v, mb) in self.bwd
+
+
+def _interleaved_orders(P: int, V: int, m: int):
+    """The reference's per-rank processing order
+    (…schedules/fwd_bwd_pipelining_with_interleaving + get_model_chunk_id):
+    microbatches advance in groups of P; within a group every chunk runs
+    its P microbatches before the next chunk.  Backward mirrors with the
+    chunk order reversed.  Returns (fwd_seq, bwd_seq) of (chunk, mb),
+    identical for every rank."""
+    fwd, bwd = [], []
+    for k in range(m * V):
+        kp = k % (P * V)
+        mb = (k // (P * V)) * P + kp % P
+        fwd.append((kp // P, mb))
+        bwd.append((V - 1 - kp // P, mb))
+    return fwd, bwd
+
+
 def _forward_backward_pipelining_with_interleaving(
         forward_step_func: Callable,
         batch: Sequence,
         model: Sequence[Tuple[Callable, Pytree]],
-        *, forward_only: bool = False, **kwargs):
-    """Interleaved 1F1B (virtual stages).  ``model`` lists every model
-    CHUNK in dataflow order (chunk c of physical stage s at index
-    c*num_stages + s, as the reference assigns them).  On a single
-    controller the dataflow equals the flattened chain, so the
-    non-interleaved engine executes it; the smaller pipe bubble is a
-    wall-clock property of distributed execution, which the SPMD path
-    owns."""
-    return forward_backward_pipelining_without_interleaving(
-        forward_step_func, batch, model, forward_only=forward_only)
+        *, forward_only: bool = False,
+        pipeline_model_parallel_size: Optional[int] = None,
+        virtual_pipeline_model_parallel_size: Optional[int] = None,
+        schedule_trace: Optional[List] = None, **kwargs):
+    """Interleaved 1F1B — virtual pipeline stages (reference:
+    apex/transformer/pipeline_parallel/schedules/
+    fwd_bwd_pipelining_with_interleaving.py, SURVEY.md §2.2/§3.5).
+
+    ``model`` lists every model CHUNK in dataflow order: virtual
+    position v = c*P + s is chunk c living on physical stage s, the
+    reference's chunk-to-stage assignment.  Each rank executes the
+    reference's schedule — warmup of
+    (P - rank - 1)*2 + (V - 1)*P forwards (the interleaved pipe fills
+    V times deeper but drains V times more often, shrinking the bubble
+    by ~1/V), then strict one-forward-one-backward, then cooldown —
+    driven here by a round-based single-controller executor whose every
+    action is appended to ``schedule_trace`` as
+    (rank, "fwd"|"bwd", chunk, microbatch).
+    """
+    L = len(model)
+    V = virtual_pipeline_model_parallel_size
+    P = pipeline_model_parallel_size or (L if V is None else L // V)
+    V = V if V is not None else L // P
+    if P * V != L:
+        raise ValueError(
+            f"{L} model chunks != pipeline size {P} * virtual size {V}")
+    m = len(batch)
+    if m % P != 0:
+        raise ValueError(
+            "interleaved schedule requires num_microbatches "
+            f"({m}) % pipeline size ({P}) == 0 (reference constraint)")
+
+    ctx = _KeyedP2P()
+    fwd_seq, bwd_seq = _interleaved_orders(P, V, m)
+    total = m * V
+
+    # per-rank action list: warmup fwds, steady 1F1B, cooldown bwds
+    actions = []
+    for r in range(P):
+        w = min((P - r - 1) * 2 + (V - 1) * P, total)
+        acts = [("fwd",) + fwd_seq[i] for i in range(w)]
+        bi = 0
+        for i in range(w, total):
+            acts.append(("fwd",) + fwd_seq[i])
+            acts.append(("bwd",) + bwd_seq[bi])
+            bi += 1
+        acts += [("bwd",) + bwd_seq[i] for i in range(bi, total)]
+        if forward_only:
+            acts = [a for a in acts if a[0] == "fwd"]
+        actions.append(acts)
+
+    vjps: dict = {}                 # (v, mb) -> vjp
+    grads: List[Optional[Pytree]] = [None] * L
+    losses: List[jax.Array] = []
+    ptr = [0] * P
+
+    def ready(r, act):
+        kind, c, mb = act
+        v = c * P + r
+        if kind == "fwd":
+            return v == 0 or ctx.has_fwd(v, mb)
+        return v == L - 1 or ctx.has_bwd(v, mb)
+
+    def run(r, act):
+        kind, c, mb = act
+        v = c * P + r
+        apply_fn, params = model[v]
+        if kind == "fwd":
+            x = None if v == 0 else ctx.fwd.pop((v, mb))
+            if forward_only:
+                # no linearization: run the plain forward
+                out, loss_fn = forward_step_func(batch[mb], x,
+                                                 apply_fn, params)
+                if v == L - 1:
+                    losses.append(loss_fn(out))
+                else:
+                    ctx.fwd[(v + 1, mb)] = out
+            elif v == L - 1:
+                def g(p, xx):
+                    out, loss_fn = forward_step_func(
+                        batch[mb], xx, apply_fn, p)
+                    return loss_fn(out)
+                loss, vjp = jax.vjp(g, params, x)
+                losses.append(loss)
+                vjps[(v, mb)] = vjp
+            else:
+                def f(p, xx):
+                    out, _ = forward_step_func(
+                        batch[mb], xx, apply_fn, p)
+                    return out
+                out, vjp = jax.vjp(f, params, x)
+                ctx.fwd[(v + 1, mb)] = out
+                vjps[(v, mb)] = vjp
+        else:
+            vjp = vjps.pop((v, mb))
+            dy = (jnp.ones((), jnp.float32) if v == L - 1
+                  else ctx.bwd.pop((v, mb)))
+            gp, gx = vjp(dy)
+            grads[v] = _add_trees(grads[v], gp)
+            if v > 0:
+                ctx.bwd[(v - 1, mb)] = gx
+        if schedule_trace is not None:
+            schedule_trace.append((r, kind, c, mb))
+
+    while any(ptr[r] < len(actions[r]) for r in range(P)):
+        progressed = False
+        for r in range(P):
+            if ptr[r] < len(actions[r]) and ready(r, actions[r][ptr[r]]):
+                run(r, actions[r][ptr[r]])
+                ptr[r] += 1
+                progressed = True
+        if not progressed:
+            raise RuntimeError("interleaved 1F1B deadlocked (bug)")
+
+    return losses, None if forward_only else grads

@@ -24,11 +24,7 @@ AXIS = comm.AXIS_MODEL
 
 
 def _tp_bound(axis) -> bool:
-    try:
-        jax.lax.axis_index(axis)
-        return True
-    except Exception:
-        return False
+    return comm.axis_is_bound(axis)
 
 
 def vocab_parallel_cross_entropy(vocab_parallel_logits, target,

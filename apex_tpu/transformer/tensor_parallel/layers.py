@@ -39,10 +39,9 @@ def _tp_world() -> int:
 
 
 def _fold_tp_rank(key):
-    try:
+    if comm.axis_is_bound(AXIS):
         return jax.random.fold_in(key, jax.lax.axis_index(AXIS))
-    except Exception:
-        return key
+    return key
 
 
 def _sharded_init(base_init: Callable):

@@ -53,11 +53,9 @@ class RNGStatesTracker:
             raise Exception(f"rng state {name} is not added")
         key = self.states_[name]
         key = jax.random.fold_in(key, self.counters_[name])
-        try:
+        if comm.axis_is_bound(comm.AXIS_MODEL):
             key = jax.random.fold_in(
                 key, jax.lax.axis_index(comm.AXIS_MODEL))
-        except Exception:
-            pass
         self.counters_[name] += 1
         yield key
 

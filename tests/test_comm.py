@@ -51,3 +51,38 @@ def test_use_mesh_restores():
         assert comm.data_parallel_size() == 8
     comm.destroy()
     assert not comm.is_initialized()
+
+
+def test_initialize_distributed_single_host(monkeypatch):
+    """SURVEY §2.6 multi-host entry: with no coordinator anywhere the
+    handshake is skipped and the mesh covers local devices."""
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    called = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.update(kw))
+    m = comm.initialize_distributed(data=2, pipe=2, ctx=1, model=2)
+    assert called == {}, "handshake must be skipped without a coordinator"
+    assert m.devices.size == 8
+    assert comm.process_count() == 1
+    assert comm.process_index() == 0
+
+
+def test_initialize_distributed_passes_coordinates(monkeypatch):
+    called = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.update(kw))
+    comm.initialize_distributed(
+        coordinator_address="10.0.0.1:1234", num_processes=1,
+        process_id=0, data=8)
+    assert called == {"coordinator_address": "10.0.0.1:1234",
+                      "num_processes": 1, "process_id": 0}
+
+
+def test_initialize_distributed_env_var_triggers(monkeypatch):
+    called = {}
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.2:999")
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.update(dict(kw, hit=True)))
+    comm.initialize_distributed(data=8)
+    assert called.get("hit"), "env coordinator must trigger the handshake"

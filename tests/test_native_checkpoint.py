@@ -65,7 +65,7 @@ def test_checkpoint_detects_corruption(tmp_path):
     raw = bytearray(open(p, "rb").read())
     raw[-16:-12] = b"\xff\xff\xff\xff"    # clobber one float (NaN)
     open(p, "wb").write(bytes(raw))
-    with pytest.raises(ValueError, match="checksum"):
+    with pytest.raises(ValueError, match="crc|checksum"):
         checkpoint.load_checkpoint(p, tree)
 
 
@@ -101,3 +101,32 @@ def test_training_state_resume_continues_identically(tmp_path):
         got = opt2.step(g)
     np.testing.assert_array_equal(np.asarray(got["w"]),
                                   np.asarray(ref["w"]))
+
+
+def test_truncated_checkpoint_rejected(tmp_path):
+    """ADVICE r1 medium: a truncated payload must raise BEFORE the
+    native memcpy reads out of bounds."""
+    import os
+    from apex_tpu import checkpoint as ckpt
+    tree = {"w": jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            "step": jnp.int32(7)}
+    p = str(tmp_path / "c.ckpt")
+    ckpt.save_checkpoint(p, tree)
+    data = open(p, "rb").read()
+    open(p, "wb").write(data[:-16])          # chop the tail
+    with pytest.raises(ValueError, match="truncated|bytes"):
+        ckpt.load_checkpoint(p, tree)
+
+
+def test_integer_leaf_corruption_detected(tmp_path):
+    """ADVICE r1: integer leaves are covered by the whole-payload crc."""
+    from apex_tpu import checkpoint as ckpt
+    tree = {"w": jnp.ones((4,), jnp.float32),
+            "step": jnp.arange(16, dtype=jnp.int32)}
+    p = str(tmp_path / "c.ckpt")
+    ckpt.save_checkpoint(p, tree)
+    data = bytearray(open(p, "rb").read())
+    data[-2] ^= 0xFF                         # flip a byte in an int leaf
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="crc|checksum"):
+        ckpt.load_checkpoint(p, tree)
