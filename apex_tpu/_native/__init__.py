@@ -36,7 +36,10 @@ def _build() -> Optional[str]:
     # refreshes every mtime, which made a stale (possibly other-arch)
     # committed .so look fresh forever (ADVICE r1).
     stamp = _SO + ".srchash"
-    want = _src_hash()
+    try:
+        want = _src_hash()
+    except OSError:      # source not shipped/readable: NumPy fallback
+        return _SO if os.path.exists(_SO) else None
     if os.path.exists(_SO) and os.path.exists(stamp):
         try:
             with open(stamp) as f:

@@ -123,8 +123,8 @@ def initialize_distributed(
                                   or env.get("WORLD_SIZE")):
         num_processes = int(env.get("NUM_PROCESSES")
                             or env.get("WORLD_SIZE"))
-    if process_id is None and (env.get("PROCESS_ID") is not None
-                               or env.get("RANK") is not None):
+    if process_id is None and (env.get("PROCESS_ID")
+                               or env.get("RANK")):
         process_id = int(env.get("PROCESS_ID") or env.get("RANK"))
     pod_runtime = bool(env.get("TPU_WORKER_HOSTNAMES")
                        or env.get("MEGASCALE_COORDINATOR_ADDRESS"))

@@ -1,4 +1,16 @@
 from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
 from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401
+from apex_tpu.contrib.sparsity.permutation_search import (  # noqa: F401
+    accelerated_search_for_good_permutation,
+    apply_permutation,
+    invert_permutation,
+    magnitude_init_permutation,
+    search_for_good_permutation,
+    sum_after_2_to_4,
+)
 
-__all__ = ["ASP", "create_mask"]
+__all__ = ["ASP", "create_mask",
+           "accelerated_search_for_good_permutation",
+           "apply_permutation", "invert_permutation",
+           "magnitude_init_permutation",
+           "search_for_good_permutation", "sum_after_2_to_4"]

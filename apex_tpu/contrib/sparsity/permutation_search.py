@@ -1,0 +1,148 @@
+"""Channel-permutation search for 2:4 sparsity (reference:
+apex/contrib/sparsity/permutation_search_kernels/* + permutation lib —
+SURVEY.md §2.3 "permutation search", VERDICT r1 missing #6).
+
+2:4 pruning keeps the 2 largest of every 4 CONSECUTIVE input channels;
+which channels are consecutive is arbitrary, so permuting the input
+channels before pruning can retain strictly more magnitude.  The
+reference searches that permutation with CUDA kernels under a time
+budget; this is the same search as host-side numpy (it is offline
+preprocessing — the TPU never runs it), with the same two phases:
+
+1. a magnitude-aware initialization (sort channels by column norm and
+   deal them into groups snake-wise, so each group mixes strong and
+   weak channels), and
+2. bounded greedy refinement: sweep candidate channel swaps between
+   group pairs, accepting any swap that increases the post-pruning
+   retained magnitude (`sum_after_2_to_4`), until a sweep makes no
+   progress or the budget runs out.
+
+The caller applies the permutation to the weight's input dim and the
+INVERSE to the previous layer's output dim (the reference's
+`permute_model` does this graph walk for torch models; in functional
+JAX the user owns the pytree, so the utilities are exposed directly).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def sum_after_2_to_4(w: np.ndarray) -> float:
+    """Total |w| retained by m4n2 pruning along the last dim (the
+    reference's efficiency metric of the same name)."""
+    aw = np.abs(np.asarray(w, np.float32))
+    r, c = aw.shape[-2], aw.shape[-1]
+    g = aw.reshape(-1, r, c // 4, 4)
+    top2 = np.sort(g, axis=-1)[..., 2:]
+    return float(top2.sum())
+
+
+def _group_retained(aw_groups: np.ndarray) -> np.ndarray:
+    """aw_groups (G, R, 4) -> retained magnitude per group (G,)."""
+    top2 = np.sort(aw_groups, axis=-1)[..., 2:]
+    return top2.sum(axis=(1, 2))
+
+
+def magnitude_init_permutation(w: np.ndarray) -> np.ndarray:
+    """Deal channels (sorted by column norm) into groups snake-wise."""
+    aw = np.abs(np.asarray(w, np.float32))
+    c = aw.shape[-1]
+    order = np.argsort(-aw.reshape(-1, c).sum(axis=0), kind="stable")
+    groups = c // 4
+    perm = np.empty(c, np.int64)
+    for k, ch in enumerate(order):
+        rnd, pos = divmod(k, groups)
+        g = pos if rnd % 2 == 0 else groups - 1 - pos   # snake
+        perm[g * 4 + rnd] = ch
+    return perm
+
+
+def search_for_good_permutation(
+        w: np.ndarray,
+        max_sweeps: int = 10,
+        max_group_pairs_per_sweep: Optional[int] = 4096,
+        init: str = "magnitude",
+        seed: int = 0) -> np.ndarray:
+    """Find a permutation of the input channels (last dim) increasing
+    the 2:4-retained magnitude.  Reference naming:
+    accelerated_search_for_good_permutation.
+
+    Bounded-budget greedy (the reference runs under a search time limit
+    the same way): per sweep, up to ``max_group_pairs_per_sweep`` group
+    pairs are examined and every improving single-channel swap between
+    them is taken.  Returns ``perm`` with ``w[..., perm]`` the permuted
+    weight.
+    """
+    aw = np.abs(np.asarray(w, np.float32)).reshape(-1, w.shape[-1])
+    r, c = aw.shape
+    if c % 4 != 0:
+        raise ValueError(f"channel count {c} not divisible by 4")
+    groups = c // 4
+    perm = (magnitude_init_permutation(aw) if init == "magnitude"
+            else np.arange(c, dtype=np.int64))
+    if groups < 2:
+        return perm
+    rng = np.random.default_rng(seed)
+
+    def group_cols(g):
+        return perm[g * 4:(g + 1) * 4]
+
+    retained = _group_retained(
+        aw.T[perm].reshape(groups, 4, r).transpose(0, 2, 1))
+
+    for _ in range(max_sweeps):
+        pairs = [(a, b) for a in range(groups) for b in range(a + 1,
+                                                              groups)]
+        if (max_group_pairs_per_sweep is not None
+                and len(pairs) > max_group_pairs_per_sweep):
+            idx = rng.choice(len(pairs), max_group_pairs_per_sweep,
+                             replace=False)
+            pairs = [pairs[i] for i in idx]
+        improved = False
+        for a, b in pairs:
+            ca, cb = group_cols(a).copy(), group_cols(b).copy()
+            base = retained[a] + retained[b]
+            best = (0.0, None)
+            awa = aw[:, ca]                          # (R, 4)
+            awb = aw[:, cb]
+            for i in range(4):
+                for j in range(4):
+                    na = awa.copy()
+                    nb = awb.copy()
+                    na[:, i], nb[:, j] = awb[:, j], awa[:, i]
+                    gain = (_group_retained(na[None]).item()
+                            + _group_retained(nb[None]).item() - base)
+                    if gain > best[0] + 1e-7:
+                        best = (gain, (i, j))
+            if best[1] is not None:
+                i, j = best[1]
+                ca[i], cb[j] = cb[j], ca[i]
+                perm[a * 4:(a + 1) * 4] = ca
+                perm[b * 4:(b + 1) * 4] = cb
+                retained[a] = _group_retained(
+                    aw[:, ca].T.reshape(1, 4, r).transpose(0, 2, 1)).item()
+                retained[b] = _group_retained(
+                    aw[:, cb].T.reshape(1, 4, r).transpose(0, 2, 1)).item()
+                improved = True
+        if not improved:
+            break
+    return perm
+
+
+def apply_permutation(w, perm):
+    """Permute the input-channel (last) dim: w[..., perm]."""
+    return w[..., np.asarray(perm)]
+
+
+def invert_permutation(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(np.asarray(perm))
+    inv[np.asarray(perm)] = np.arange(len(perm))
+    return inv
+
+
+def accelerated_search_for_good_permutation(w, **kw) -> np.ndarray:
+    """Reference-named alias."""
+    return search_for_good_permutation(w, **kw)

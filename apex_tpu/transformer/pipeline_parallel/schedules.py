@@ -271,7 +271,12 @@ def _forward_backward_pipelining_with_interleaving(
     # per-rank action list: warmup fwds, steady 1F1B, cooldown bwds
     actions = []
     for r in range(P):
-        w = min((P - r - 1) * 2 + (V - 1) * P, total)
+        if m == P:
+            # reference special case: with exactly one microbatch group
+            # the schedule degenerates to all-forward-then-all-backward
+            w = total
+        else:
+            w = min((P - r - 1) * 2 + (V - 1) * P, total)
         acts = [("fwd",) + fwd_seq[i] for i in range(w)]
         bi = 0
         for i in range(w, total):
