@@ -34,6 +34,50 @@ import traceback
 
 A100_IMGS_PER_SEC = 2500.0
 
+# per-chip bf16 peak FLOP/s by device_kind substring; MFU is only
+# reported when the running chip is recognized
+_TPU_BF16_PEAK = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+]
+
+
+def _bf16_peak():
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in _TPU_BF16_PEAK:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _cost_flops(jitted, *args):
+    """FLOPs of one compiled step from XLA's cost analysis (also
+    triggers the compile, which later calls reuse via the jit cache).
+    None if the backend doesn't report it."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = ca.get("flops")
+        return float(f) if f and f > 0 else None
+    except Exception:
+        return None
+
+
+def _mfu(flops, step_s, on_tpu):
+    if not (flops and on_tpu):
+        return None
+    try:
+        peak = _bf16_peak()
+    except Exception:
+        return None
+    if peak is None:
+        return None
+    return round(flops / step_s / peak, 4)
+
 _PROBE_SRC = (
     "import jax, sys; d = jax.devices(); "
     "sys.exit(0 if d and d[0].platform != 'cpu' else 3)"
@@ -111,6 +155,9 @@ def bench_resnet50_amp_o2(jax, jnp, on_tpu):
     params_b, masters = params_bf16, masters0
     opt_state, stats = opt.opt_state, batch_stats
 
+    flops = _cost_flops(step_jit, params_b, masters, opt_state, stats,
+                        jnp.int32(1), x, labels)
+
     for i in range(3):  # warmup (compile)
         params_b, masters, opt_state, stats, loss = step_jit(
             params_b, masters, opt_state, stats, jnp.int32(i + 1), x,
@@ -126,7 +173,8 @@ def bench_resnet50_amp_o2(jax, jnp, on_tpu):
     dt = time.perf_counter() - t0
     return {"imgs_per_sec": batch * steps / dt,
             "batch": batch, "image_size": size,
-            "step_ms": dt / steps * 1e3}
+            "step_ms": dt / steps * 1e3,
+            "mfu": _mfu(flops, dt / steps, on_tpu)}
 
 
 def bench_bert_lamb(jax, jnp, on_tpu):
@@ -181,6 +229,8 @@ def bench_bert_lamb(jax, jnp, on_tpu):
     step_jit = jax.jit(train_step, donate_argnums=(0, 1, 2))
     masters, opt_state = masters0, opt.opt_state
     p = params_bf16
+    flops = _cost_flops(step_jit, p, masters, opt_state, jnp.int32(1),
+                        tokens, mlm_labels)
     for i in range(2):  # warmup
         p, masters, opt_state, loss = step_jit(
             p, masters, opt_state, jnp.int32(i + 1), tokens, mlm_labels)
@@ -193,7 +243,8 @@ def bench_bert_lamb(jax, jnp, on_tpu):
     float(loss)
     dt = time.perf_counter() - t0
     return {"step_ms": dt / steps * 1e3, "config": config,
-            "batch": batch, "seq": seq}
+            "batch": batch, "seq": seq,
+            "mfu": _mfu(flops, dt / steps, on_tpu)}
 
 
 def _empty_result(backend="unknown"):
@@ -255,6 +306,8 @@ def run_child(backend):
         out["extra"]["resnet50_step_ms"] = round(r["step_ms"], 2)
         out["extra"]["resnet50_batch"] = r["batch"]
         out["extra"]["resnet50_image_size"] = r["image_size"]
+        if r.get("mfu") is not None:
+            out["extra"]["resnet50_mfu"] = r["mfu"]
     except Exception:
         out["errors"].append(
             "resnet50: " + traceback.format_exc(limit=3).replace("\n", " | "))
@@ -269,6 +322,8 @@ def run_child(backend):
         out["extra"]["bert_large_fused_lamb_step_ms"] = round(
             b["step_ms"], 2)
         out["extra"]["bert_config"] = b["config"]
+        if b.get("mfu") is not None:
+            out["extra"]["bert_mfu"] = b["mfu"]
     except Exception:
         out["errors"].append(
             "bert_lamb: " + traceback.format_exc(limit=3).replace("\n", " | "))
