@@ -124,3 +124,25 @@ def test_pytree_outputs_roundtrip():
     assert set(out) == {"a", "aux"}
     assert out["a"].shape == (8, 8)
     assert len(out["aux"]) == 2
+
+
+def test_scan_based_model_runs_opaque():
+    """lax.scan bodies are dtype-bound: auto_cast must leave them
+    intact (run at traced precision) and still produce correct values
+    and grads — the RNN package is the in-repo case."""
+    from apex_tpu.RNN import LSTM
+
+    model = LSTM(input_size=16, hidden_size=32, num_layers=1)
+    x = jax.random.normal(jax.random.key(0), (12, 2, 16))
+    params = model.init(jax.random.key(1), x)
+
+    def f(p, x):
+        out, _ = model.apply(p, x)
+        return jnp.mean(out ** 2)
+
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(float(w(params, x)), float(f(params, x)),
+                               rtol=3e-2, atol=1e-3)
+    g = jax.grad(w)(params, x)
+    assert all(bool(jnp.all(jnp.isfinite(l)))
+               for l in jax.tree_util.tree_leaves(g))
