@@ -143,6 +143,13 @@ def test_scan_based_model_runs_opaque():
     w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
     np.testing.assert_allclose(float(w(params, x)), float(f(params, x)),
                                rtol=3e-2, atol=1e-3)
+    # the opacity guard itself: the scan eqn survives the rewrite with
+    # all-f32 float operands (a regression recursing into scan bodies
+    # would show bf16 here while still passing the value check)
+    scan_in = _prim_in_dtypes(w, "scan", params, x)
+    assert scan_in, "expected a scan eqn in the rewritten jaxpr"
+    assert set(d for d in scan_in if "float" in d or "bfloat" in d) \
+        == {"float32"}
     g = jax.grad(w)(params, x)
     assert all(bool(jnp.all(jnp.isfinite(l)))
                for l in jax.tree_util.tree_leaves(g))
