@@ -42,21 +42,35 @@ class FusedOptimizerBase:
     """Subclasses set ``defaults`` and implement ``_step_math``."""
 
     def __init__(self, params: Pytree, master_weights: Optional[bool] = None,
-                 **hypers):
+                 masters: Optional[Pytree] = None, **hypers):
         self.hypers: Dict[str, Any] = dict(self.defaults)
         unknown = set(hypers) - set(self.hypers)
         if unknown:
             raise TypeError(f"unexpected arguments {sorted(unknown)}")
         self.hypers.update(hypers)
+        if masters is not None:
+            # externally-sourced masters (amp.initialize's copies made
+            # from the ORIGINAL f32 init — upcasting the rounded half
+            # params here would lose the low bits, apex O2 contract)
+            if (jax.tree_util.tree_structure(masters)
+                    != jax.tree_util.tree_structure(params)):
+                raise ValueError(
+                    "masters pytree structure does not match params")
+            master_weights = True
         if master_weights is None:
             master_weights = _is_low_precision(params)
         self.master_weights = master_weights and _is_low_precision(params)
         self.params = params
-        masters = None
-        if self.master_weights:
+        if not self.master_weights:
+            masters = None
+        elif masters is None:
             masters = tree_map(
                 lambda x: x.astype(jnp.float32)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        else:
+            masters = tree_map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, masters)
         self.masters = masters
         self.opt_state = self.init_state(masters if masters is not None
                                          else params)

@@ -47,7 +47,7 @@ def main():
     seq = args.seq_len or (512 if on_tpu else 64)
     half = jnp.bfloat16 if args.opt_level != "O0" else jnp.float32
     if args.large:
-        model = bert_large(dtype=half)
+        model = bert_large(dtype=half, max_seq_len=max(seq, 512))
     else:
         model = BertModel(vocab_size=2048, hidden_size=128, num_heads=4,
                           num_layers=4, max_seq_len=max(seq, 128),
@@ -61,7 +61,8 @@ def main():
     params = model.init(jax.random.key(0), tokens0)["params"]
     params, amp_state = amp.initialize(params, opt_level=args.opt_level)
     opt = FusedLAMB(params, lr=args.lr, weight_decay=args.weight_decay,
-                    master_weights=bool(amp_state.properties.master_weights))
+                    master_weights=bool(amp_state.properties.master_weights),
+                    masters=amp_state.master_params)
 
     def loss_fn(p, tokens, labels):
         logits = model.mlm_logits({"params": p}, tokens)   # (s,b,V) f32

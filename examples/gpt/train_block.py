@@ -102,7 +102,8 @@ def main():
     params = model.init(jax.random.key(0), tokens0)["params"]
     params, amp_state = amp.initialize(params, opt_level="O2")
     opt = FusedAdam(params, lr=args.lr,
-                    master_weights=bool(amp_state.properties.master_weights))
+                    master_weights=bool(amp_state.properties.master_weights),
+                    masters=amp_state.master_params)
 
     def loss_fn(p, tokens):
         logits = model.apply({"params": p}, tokens)     # (s, b, V)

@@ -78,7 +78,8 @@ def main():
     params, amp_state = amp.initialize(params, opt_level=args.opt_level)
     opt = FusedSGD(params, lr=args.lr, momentum=args.momentum,
                    weight_decay=args.weight_decay,
-                   master_weights=bool(amp_state.properties.master_weights))
+                   master_weights=bool(amp_state.properties.master_weights),
+                   masters=amp_state.master_params)
 
     ddp = DistributedDataParallel() if args.ddp else None
     if args.ddp and not comm.is_initialized():

@@ -35,7 +35,8 @@ def _train(opt_level, loss_scale=None, seed=0, lr=0.01,
     # O2: masters + copy-back inside FusedSGD (reference master_weights
     # contract); O0/O1/O3 step the model params directly
     opt = FusedSGD(params, lr=lr, momentum=0.9,
-                   master_weights=bool(amp_state.properties.master_weights))
+                   master_weights=bool(amp_state.properties.master_weights),
+                   masters=amp_state.master_params)
 
     def loss_fn(p, bs, x, y):
         out, upd = model.apply({"params": p, "batch_stats": bs},

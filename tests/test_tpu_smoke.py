@@ -80,6 +80,43 @@ def test_flash_attention_long_seq(causal):
     _close(o, attention_ref(q, k, v, causal=causal), jnp.bfloat16)
 
 
+def test_flash_attention_long_seq_grads():
+    """Pallas backward kernels at multi-block length (dq over KV grid,
+    dk/dv over Q grid)."""
+    from apex_tpu.ops.attention import flash_attention, attention_ref
+    b, h, s, d = 1, 2, 4096, 64
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, True)
+                                .astype(jnp.float32) ** 2) / s,
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(attention_ref(q, k, v, causal=True)
+                                .astype(jnp.float32) ** 2) / s,
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, g_ref):
+        _close(a, b_, jnp.bfloat16, rtol=5e-2, atol=5e-2)
+
+
+def test_flash_attention_segment_ids_tpu():
+    """Segment masking (fmha path) under real Mosaic."""
+    from apex_tpu.ops.attention import flash_attention, attention_ref
+    b, h, s, d = 1, 2, 512, 64
+    ks = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+    seg = (jnp.arange(s)[None] // 128).astype(jnp.int32)
+    o = jax.jit(lambda *a: flash_attention(
+        *a, segment_ids=(seg, seg)))(q, k, v)
+    same = seg[:, None, :, None] == seg[:, None, None, :]
+    o_ref = attention_ref(q, k, v, mask=jnp.where(same, 0.0, -1e30))
+    _close(o, o_ref, jnp.bfloat16)
+
+
 def test_flash_attention_grads():
     from apex_tpu.ops.attention import flash_attention, attention_ref
     b, h, s, d = 2, 2, 256, 64

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Serial TPU validation: smoke suite, then bench. ONE TPU client at a
+# time; nothing here kills a TPU-attached process (a killed client
+# wedges the single-client tunnel for a long time — see
+# docs/kernels.md dispatch note and tests/test_tpu_smoke.py header).
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== TPU smoke suite =="
+APEX_TPU_SMOKE=1 python -m pytest tests/test_tpu_smoke.py -v \
+    > /tmp/smoke_tpu.log 2>&1
+smoke_rc=$?
+tail -5 /tmp/smoke_tpu.log
+echo "smoke rc=$smoke_rc"
+
+echo "== bench =="
+python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
+bench_rc=$?
+cat /tmp/bench_tpu.json
+echo "bench rc=$bench_rc"
+
+exit $(( smoke_rc != 0 || bench_rc != 0 ? 1 : 0 ))
