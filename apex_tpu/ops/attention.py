@@ -159,9 +159,10 @@ def _fwd_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
 
     @pl.when(kk <= kk_last)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # native-dtype operands on the MXU (bf16 runs at full rate),
+        # f32 accumulation via preferred_element_type
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         ok = _mask_for_block(
             j, kk, bq, bk, sq, sk, sqp, skp, causal,
@@ -257,9 +258,8 @@ def _fwd_pallas(q, k, v, scale, causal, segment_ids):
 
 def _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk, j, kk,
                  q_ref, k_ref, qs_ref, ks_ref, lse_ref):
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+    s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                            (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     p = jnp.exp(s - lse_ref[0, :, :1])
     ok = _mask_for_block(
@@ -293,9 +293,8 @@ def _dq_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
     def _body():
         p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
                          j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
-        do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - di_ref[0, :, :1]) * scale
         dq_scr[...] += jax.lax.dot_general(
@@ -331,13 +330,12 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq,
     def _body():
         p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
                          j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
-        do = do_ref[0].astype(jnp.float32)
         # dv += p^T @ do   (contract the q dim)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - di_ref[0, :, :1]) * scale
         dk_scr[...] += jax.lax.dot_general(
@@ -501,6 +499,12 @@ def flash_attention(q, k, v, causal=False, scale=None,
     attention is masked where ids differ (packed variable-length
     batches — the fmha contract).
     """
+    # the kernels dot native-dtype operands (full-rate MXU): normalize
+    # mixed q/k/v dtypes once here so kernel and fallback paths agree
+    if not (q.dtype == k.dtype == v.dtype):
+        dt = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype),
+                               v.dtype)
+        q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
     if not pallas_enabled():
         sc = scale if scale is not None else _default_scale(q.shape[-1])
         # jax.checkpoint: don't hold the (Sq, Sk) probability residual

@@ -52,6 +52,14 @@ class FusedOptimizerBase:
             # externally-sourced masters (amp.initialize's copies made
             # from the ORIGINAL f32 init — upcasting the rounded half
             # params here would lose the low bits, apex O2 contract)
+            if master_weights is False:
+                raise ValueError(
+                    "masters= provided together with "
+                    "master_weights=False — contradictory")
+            if not _is_low_precision(params):
+                raise ValueError(
+                    "masters= provided but params are not low-precision"
+                    " — masters only apply to half-precision params")
             if (jax.tree_util.tree_structure(masters)
                     != jax.tree_util.tree_structure(params)):
                 raise ValueError(
@@ -63,14 +71,11 @@ class FusedOptimizerBase:
         self.params = params
         if not self.master_weights:
             masters = None
-        elif masters is None:
-            masters = tree_map(
-                lambda x: x.astype(jnp.float32)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         else:
             masters = tree_map(
                 lambda x: x.astype(jnp.float32)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, masters)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                masters if masters is not None else params)
         self.masters = masters
         self.opt_state = self.init_state(masters if masters is not None
                                          else params)

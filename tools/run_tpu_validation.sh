@@ -2,7 +2,7 @@
 # Serial TPU validation: smoke suite, then bench. ONE TPU client at a
 # time; nothing here kills a TPU-attached process (a killed client
 # wedges the single-client tunnel for a long time — see
-# docs/kernels.md dispatch note and tests/test_tpu_smoke.py header).
+# tests/test_tpu_smoke.py header).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -11,12 +11,29 @@ APEX_TPU_SMOKE=1 python -m pytest tests/test_tpu_smoke.py -v \
     > /tmp/smoke_tpu.log 2>&1
 smoke_rc=$?
 tail -5 /tmp/smoke_tpu.log
+# pytest exits 0 on all-skipped (backend never initialized): that is a
+# FAILED validation, not a pass
+if ! grep -qE "[0-9]+ passed" /tmp/smoke_tpu.log; then
+    echo "smoke: no tests actually ran (all skipped or collection failed)"
+    smoke_rc=1
+fi
 echo "smoke rc=$smoke_rc"
 
 echo "== bench =="
 python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
-bench_rc=$?
 cat /tmp/bench_tpu.json
+# bench.py always exits 0 by design; judge the JSON instead
+bench_rc=$(python - <<'EOF'
+import json
+try:
+    out = json.load(open("/tmp/bench_tpu.json"))
+    ok = (out.get("backend") == "tpu" and float(out.get("value", 0)) > 0
+          and not out.get("errors"))
+    print(0 if ok else 1)
+except Exception:
+    print(1)
+EOF
+)
 echo "bench rc=$bench_rc"
 
 exit $(( smoke_rc != 0 || bench_rc != 0 ? 1 : 0 ))
