@@ -247,6 +247,57 @@ def bench_bert_lamb(jax, jnp, on_tpu):
             "mfu": _mfu(flops, dt / steps, on_tpu)}
 
 
+def bench_flash_attention(jax, jnp, on_tpu):
+    """Flash kernel vs unfused XLA oracle (VERDICT r1 #3 done-criterion:
+    kernel >= oracle at 2k; kernel handles 8k).  TPU only — interpret
+    mode timings are meaningless."""
+    import numpy as np
+    from apex_tpu.ops.attention import attention_ref, flash_attention
+
+    def sync(o):
+        # scalar-slice fetch: forces completion without shipping the
+        # whole array through the tunnel
+        leaf = jax.tree_util.tree_leaves(o)[0]
+        np.asarray(leaf[(0,) * (leaf.ndim - 1)][:1])
+
+    def time_fn(f, *args, iters=20):
+        o = f(*args)
+        sync(o)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = f(*args)
+        sync(o)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    out = {}
+    for s, run_oracle in ((2048, True), (8192, False)):
+        b, h, d = 4, 16, 128
+        ks = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+
+        def fwd_bwd(f):
+            # all three grads returned so neither backward kernel is
+            # dead-code-eliminated
+            def g(q, k, v):
+                return jax.grad(
+                    lambda q, k, v: jnp.sum(
+                        f(q, k, v).astype(jnp.float32)),
+                    argnums=(0, 1, 2))(q, k, v)
+            return jax.jit(g)
+
+        out[f"flash_{s}_fwdbwd_ms"] = round(time_fn(
+            fwd_bwd(lambda q, k, v: flash_attention(q, k, v, True)),
+            q, k, v), 2)
+        if run_oracle:
+            out[f"oracle_{s}_fwdbwd_ms"] = round(time_fn(
+                fwd_bwd(lambda q, k, v: attention_ref(q, k, v,
+                                                      causal=True)),
+                q, k, v), 2)
+    return out
+
+
 def _empty_result(backend="unknown"):
     return {
         "metric": "resnet50_amp_o2_fused_sgd_train_throughput",
@@ -327,6 +378,17 @@ def run_child(backend):
     except Exception:
         out["errors"].append(
             "bert_lamb: " + traceback.format_exc(limit=3).replace("\n", " | "))
+
+    # flash kernel vs oracle LAST: both tracked metrics are already
+    # flushed if this hangs and the watchdog fires
+    print(_dump(out), flush=True)
+    if on_tpu:
+        try:
+            out["extra"].update(bench_flash_attention(jax, jnp, on_tpu))
+        except Exception:
+            out["errors"].append(
+                "flash_attention: "
+                + traceback.format_exc(limit=3).replace("\n", " | "))
 
     print(_dump(out), flush=True)
 
