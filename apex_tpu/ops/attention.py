@@ -136,14 +136,16 @@ def _mask_for_block(j, kk, bq, bk, sq, sk, sqp, skp, causal,
 # forward kernel: grid (B*H, NQ, NK), KV innermost, flash-2 online softmax
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
-                *refs):
-    if seg:
-        q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, \
-            m_scr, l_scr, acc_scr = refs
+def _fwd_kernel(scale, causal, seg, need_lse, sq, sk, sqp, skp, bq, bk,
+                nk, *refs):
+    q_ref, k_ref, v_ref = refs[:3]
+    qs_ref, ks_ref = (refs[3:5] if seg else (None, None))
+    rest = refs[5:] if seg else refs[3:]
+    if need_lse:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-        qs_ref = ks_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+        lse_ref = None
     j = pl.program_id(1)
     kk = pl.program_id(2)
 
@@ -189,11 +191,13 @@ def _fwd_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
         l = l_scr[:, :1]
         linv = jnp.where(l > 0.0, 1.0 / l, 0.0)
         o_ref[0] = (acc_scr[...] * linv).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(l_scr[...] > 0.0,
-                               m_scr[...] + jnp.log(l_scr[...]), _NEG)
+        if need_lse:   # inference skips the 128-lane lse write entirely
+            lse_ref[0] = jnp.where(l_scr[...] > 0.0,
+                                   m_scr[...] + jnp.log(l_scr[...]),
+                                   _NEG)
 
 
-def _fwd_pallas(q, k, v, scale, causal, segment_ids):
+def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True):
     b, h, sq, sk, d, dp, bq, bk, sqp, skp = _geom(q, k)
     nq, nk = sqp // bq, skp // bk
 
@@ -226,19 +230,20 @@ def _fwd_pallas(q, k, v, scale, causal, segment_ids):
         ]
         args += [qs, ks]
 
-    o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale, causal, seg, sq, sk,
-                          sqp, skp, bq, bk, nk),
+    out_specs = [pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype)]
+    if need_lse:
+        out_specs.append(
+            pl.BlockSpec((1, bq, _LANES), lambda i, j, kk: (i, j, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, sqp, _LANES), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale, causal, seg, need_lse,
+                          sq, sk, sqp, skp, bq, bk, nk),
         grid=(b * h, nq, nk),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda i, j, kk: (i, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sqp, _LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -249,7 +254,8 @@ def _fwd_pallas(q, k, v, scale, causal, segment_ids):
         interpret=interpret_mode(),
         name="apex_flash_attention_fwd",
     )(*args)
-    return o.reshape(b, h, sqp, dp)[:, :, :sq, :d], lse
+    o = outs[0].reshape(b, h, sqp, dp)[:, :, :sq, :d]
+    return o, (outs[1] if need_lse else None)
 
 
 # ---------------------------------------------------------------------------
@@ -464,7 +470,9 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash(q, k, v, segment_ids, causal, scale):
-    o, _ = _flash_fwd(q, k, v, segment_ids, causal, scale)
+    # primal (non-differentiated) path: no lse output at all
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    o, _ = _fwd_pallas(q, k, v, sc, causal, segment_ids, need_lse=False)
     return o
 
 

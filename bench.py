@@ -381,8 +381,8 @@ def run_child(backend):
 
     # flash kernel vs oracle LAST: both tracked metrics are already
     # flushed if this hangs and the watchdog fires
-    print(_dump(out), flush=True)
     if on_tpu:
+        print(_dump(out), flush=True)
         try:
             out["extra"].update(bench_flash_attention(jax, jnp, on_tpu))
         except Exception:

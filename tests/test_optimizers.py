@@ -1,0 +1,46 @@
+"""Fused-optimizer facade contracts (reference model:
+tests/L0/run_optimizers — here the ctor-level masters contract;
+numeric step parity lives in test_multi_tensor.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestMastersContract:
+    """masters= ctor contract (apex O2: masters come from the ORIGINAL
+    f32 init, not from re-upcasting rounded half params)."""
+
+    def _params(self, dtype):
+        return {"w": jnp.ones((8, 8), dtype), "b": jnp.zeros((8,), dtype)}
+
+    def test_external_masters_used_verbatim(self):
+        from apex_tpu.optimizers import FusedSGD
+        p32 = self._params(jnp.float32)
+        # perturb below bf16 resolution: must survive into the masters
+        p32 = jax.tree_util.tree_map(lambda x: x + 1e-4, p32)
+        pbf = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), p32)
+        opt = FusedSGD(pbf, lr=0.1, masters=p32)
+        np.testing.assert_array_equal(np.asarray(opt.masters["w"]),
+                                      np.asarray(p32["w"]))
+
+    def test_masters_with_master_weights_false_raises(self):
+        from apex_tpu.optimizers import FusedSGD
+        pbf = self._params(jnp.bfloat16)
+        with pytest.raises(ValueError, match="contradictory"):
+            FusedSGD(pbf, lr=0.1, master_weights=False,
+                     masters=self._params(jnp.float32))
+
+    def test_masters_for_f32_params_raises(self):
+        from apex_tpu.optimizers import FusedSGD
+        with pytest.raises(ValueError, match="low-precision"):
+            FusedSGD(self._params(jnp.float32), lr=0.1,
+                     masters=self._params(jnp.float32))
+
+    def test_masters_structure_mismatch_raises(self):
+        from apex_tpu.optimizers import FusedSGD
+        pbf = self._params(jnp.bfloat16)
+        with pytest.raises(ValueError, match="structure"):
+            FusedSGD(pbf, lr=0.1, masters={"w": jnp.ones((8, 8))})
