@@ -1,0 +1,56 @@
+"""Microbatch calculator parity (reference:
+apex/transformer/microbatches.py — constant and batch-size-rampup
+calculators behind build_num_microbatches_calculator)."""
+
+import pytest
+
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+
+def test_constant_calculator():
+    c = ConstantNumMicroBatches(global_batch_size=64, micro_batch_size=4,
+                                data_parallel_size=2)
+    # 64 global / (4 micro * 2 dp) = 8 microbatches
+    assert c.get() == 8
+    assert c.get_current_global_batch_size() == 64
+    c.update(consumed_samples=1024, consistency_check=True)
+    assert c.get() == 8                       # constant stays constant
+
+
+def test_constant_requires_divisibility():
+    with pytest.raises(Exception):
+        ConstantNumMicroBatches(global_batch_size=65, micro_batch_size=4,
+                                data_parallel_size=2)
+
+
+def test_rampup_calculator_grows_with_consumed_samples():
+    c = RampupBatchsizeNumMicroBatches(
+        start_batch_size=16, batch_size_increment=16,
+        ramup_samples=1000, global_batch_size=64,
+        micro_batch_size=4, data_parallel_size=2)
+    c.update(0, False)
+    assert c.get_current_global_batch_size() == 16
+    first = c.get()
+    c.update(500, False)
+    mid = c.get_current_global_batch_size()
+    assert 16 <= mid <= 64
+    c.update(2000, False)                     # past the ramp
+    assert c.get_current_global_batch_size() == 64
+    assert c.get() == 64 // (4 * 2)
+    assert first <= c.get()
+
+
+def test_builder_dispatch():
+    c = build_num_microbatches_calculator(
+        rank=0, rampup_batch_size=None, global_batch_size=32,
+        micro_batch_size=4, data_parallel_size=1)
+    assert isinstance(c, ConstantNumMicroBatches)
+    assert c.get() == 8
+    c = build_num_microbatches_calculator(
+        rank=0, rampup_batch_size=[16, 8, 1000], global_batch_size=32,
+        micro_batch_size=4, data_parallel_size=1)
+    assert isinstance(c, RampupBatchsizeNumMicroBatches)
