@@ -6,6 +6,7 @@ from apex_tpu.transformer import tensor_parallel
 from apex_tpu.transformer import pipeline_parallel
 from apex_tpu.transformer import functional
 from apex_tpu.transformer import amp
+from apex_tpu.transformer import moe
 from apex_tpu.transformer.enums import (AttnMaskType, AttnType, LayerType,
                                         ModelType)
 from apex_tpu.transformer.log_util import (get_transformer_logger,
@@ -14,7 +15,7 @@ from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
 
 __all__ = [
     "parallel_state", "tensor_parallel", "pipeline_parallel", "functional",
-    "amp",
+    "amp", "moe",
     "AttnMaskType", "AttnType", "LayerType", "ModelType",
     "get_transformer_logger", "set_logging_level",
     "build_num_microbatches_calculator",

@@ -1,0 +1,196 @@
+"""Mixture-of-experts with expert parallelism over the mesh.
+
+NO reference equivalent: apex has no MoE and SURVEY.md §2.5 marks
+expert parallelism out of reference scope.  Like ``ring_attention``
+(context parallelism), this is a TPU-native extension that makes the
+remaining first-class parallelism axis available: experts shard over a
+mesh axis and tokens move with ONE ``lax.all_to_all`` each way riding
+ICI — the dispatch pattern every TPU MoE uses (the "how to scale your
+model" recipe: dense dispatch/combine einsums + all_to_all, static
+capacity so shapes never depend on routing).
+
+Per-rank SPMD view (use inside shard_map over ``axis``):
+
+  x (T, H) tokens local to this rank
+  -> top-k gating (router replicated)
+  -> dispatch einsum to (E, C, H)          [E = global experts]
+  -> all_to_all over ``axis``              [tokens to expert owners]
+  -> local expert FFN (E/ep experts here)
+  -> all_to_all back
+  -> combine einsum weighted by gate probs
+
+Static capacity C = ceil(2 * T * capacity_factor / E) (top-2:
+two assignments per token); overflow tokens are
+dropped by the position-in-expert cumsum mask (standard MoE semantics;
+dropped tokens pass through the residual path of the caller).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+
+Array = jax.Array
+
+
+def _capacity(tokens: int, num_experts: int,
+              capacity_factor: float, k: int = 2) -> int:
+    """GShard-style top-k capacity: ceil(k * T * cf / E) — k assignments
+    per token must fit in E * C slots at cf=1 under perfect balance."""
+    c = -(-(k * tokens * capacity_factor) // num_experts)
+    return max(int(c), 1)
+
+
+def top2_gating(logits: Array, capacity: int,
+                jitter_rng: Optional[Array] = None,
+                jitter_eps: float = 0.0
+                ) -> Tuple[Array, Array, Array]:
+    """Top-2 router (Shazeer-style), static shapes throughout.
+
+    logits (T, E) -> (dispatch (T, E, C) bool, combine (T, E, C) f32,
+    aux_loss scalar).  combine carries the renormalized gate prob at
+    the token's position in its expert's capacity buffer; tokens past
+    capacity get all-zero rows (dropped).
+    """
+    t, e = logits.shape
+    if jitter_rng is not None and jitter_eps > 0.0:
+        # multiplicative jitter: noise scales with logit magnitude
+        logits = logits * jax.random.uniform(
+            jitter_rng, logits.shape, logits.dtype,
+            1.0 - jitter_eps, 1.0 + jitter_eps)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    g1 = jnp.max(probs, axis=-1)
+    i1 = jnp.argmax(probs, axis=-1)
+    probs_wo1 = probs * (1.0 - jax.nn.one_hot(i1, e))
+    g2 = jnp.max(probs_wo1, axis=-1)
+    i2 = jnp.argmax(probs_wo1, axis=-1)
+
+    # load-balancing auxiliary loss (mean prob * mean assignment)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(i1, e, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * e
+
+    # position of each token within its chosen expert, first choice
+    # filling before second (the usual priority)
+    oh1 = jax.nn.one_hot(i1, e, dtype=jnp.int32)            # (T, E)
+    oh2 = jax.nn.one_hot(i2, e, dtype=jnp.int32)
+    pos1 = jnp.cumsum(oh1, axis=0) - oh1                    # (T, E)
+    count1 = jnp.sum(oh1, axis=0, keepdims=True)
+    pos2 = jnp.cumsum(oh2, axis=0) - oh2 + count1
+    p1 = jnp.sum(pos1 * oh1, axis=1)                        # (T,)
+    p2 = jnp.sum(pos2 * oh2, axis=1)
+    keep1 = p1 < capacity
+    keep2 = p2 < capacity
+
+    # renormalize the two gates over the kept pair
+    denom = g1 * keep1 + g2 * keep2
+    denom = jnp.where(denom > 0.0, denom, 1.0)
+    w1 = jnp.where(keep1, g1 / denom, 0.0)
+    w2 = jnp.where(keep2, g2 / denom, 0.0)
+
+    # one_hot of index==capacity (overflow sentinel) is an all-zero row
+    cap_oh1 = jax.nn.one_hot(jnp.where(keep1, p1, capacity), capacity,
+                             dtype=jnp.float32)
+    cap_oh2 = jax.nn.one_hot(jnp.where(keep2, p2, capacity), capacity,
+                             dtype=jnp.float32)
+    combine = (w1[:, None, None] * oh1[..., None] * cap_oh1[:, None, :]
+               + w2[:, None, None] * oh2[..., None] * cap_oh2[:, None, :])
+    dispatch = combine > 0.0
+    return dispatch, combine.astype(jnp.float32), aux
+
+
+class ExpertParallelMLP(nn.Module):
+    """Top-2 MoE FFN with experts sharded over a mesh axis.
+
+    hidden/ffn sizes are per-expert; ``num_experts`` is GLOBAL and must
+    divide by the axis size.  Call inside shard_map with ``axis`` bound
+    (or axis=None / unbound for single-rank execution, where all
+    experts live locally — the degenerate path used off-mesh).
+
+    Returns (out (T, H), aux_loss).
+    """
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    axis: Optional[str] = comm.AXIS_MODEL
+    activation: Callable = jax.nn.gelu
+    param_dtype: jnp.dtype = jnp.float32
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        t, h = x.shape
+        e = self.num_experts
+        ep = (jax.lax.axis_size(self.axis)
+              if self.axis is not None and comm.axis_is_bound(self.axis)
+              else 1)
+        if e % ep != 0:
+            raise ValueError(f"num_experts {e} % axis size {ep} != 0")
+        e_local = e // ep
+        dt = self.dtype or x.dtype
+
+        wg = self.param("router", nn.initializers.normal(0.02),
+                        (h, e), jnp.float32)
+        # per-rank expert shards, rank-decorrelated init
+        def einit(base):
+            def init(key, shape, dtype):
+                if ep > 1:
+                    key = jax.random.fold_in(
+                        key, jax.lax.axis_index(self.axis))
+                return base(key, shape, dtype)
+            return init
+        w1 = self.param("w1", einit(nn.initializers.lecun_normal()),
+                        (e_local, h, self.ffn_hidden_size),
+                        self.param_dtype)
+        w2 = self.param("w2", einit(nn.initializers.lecun_normal()),
+                        (e_local, self.ffn_hidden_size, h),
+                        self.param_dtype)
+
+        cap = _capacity(t, e, self.capacity_factor)
+        logits = x.astype(jnp.float32) @ wg
+        dispatch, combine, aux = top2_gating(logits, cap)
+
+        # (T, E, C) x (T, H) -> (E, C, H)
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(dt), x.astype(dt))
+        if ep > 1:
+            # tokens to their expert's owner: split E into (ep, E/ep)
+            # and all_to_all the ep dim over the mesh axis
+            xe = xe.reshape(ep, e_local, cap, h)
+            xe = jax.lax.all_to_all(xe, self.axis, split_axis=0,
+                                    concat_axis=0, tiled=False)
+            # (ep, e_local, C, H): dim 0 now enumerates source ranks
+            xe = jnp.moveaxis(xe, 0, 1).reshape(e_local, ep * cap, h)
+        else:
+            xe = xe.reshape(e_local, cap, h)
+
+        he = self.activation(
+            jnp.einsum("ech,ehf->ecf", xe, w1.astype(dt)))
+        ye = jnp.einsum("ecf,efh->ech", he, w2.astype(dt))
+
+        if ep > 1:
+            ye = jnp.moveaxis(ye.reshape(e_local, ep, cap, h), 1, 0)
+            ye = jax.lax.all_to_all(ye, self.axis, split_axis=0,
+                                    concat_axis=0, tiled=False)
+            ye = ye.reshape(e, cap, h)
+        out = jnp.einsum("tec,ech->th", combine.astype(jnp.float32),
+                         ye.astype(jnp.float32))
+        return out.astype(x.dtype), aux
+
+
+def moe_ref(x, router, w1, w2, capacity, activation=jax.nn.gelu):
+    """Dense oracle: same gating, every expert applied to every token,
+    output = gate-weighted mixture.  w1 (E, H, F), w2 (E, F, H)."""
+    logits = x.astype(jnp.float32) @ router
+    dispatch, combine, aux = top2_gating(logits, capacity)
+    h = activation(jnp.einsum("th,ehf->tef", x.astype(jnp.float32),
+                              w1.astype(jnp.float32)))
+    y = jnp.einsum("tef,efh->teh", h, w2.astype(jnp.float32))
+    weight = jnp.sum(combine, axis=-1)                 # (T, E)
+    return jnp.einsum("te,teh->th", weight, y).astype(x.dtype), aux

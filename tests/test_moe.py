@@ -1,0 +1,137 @@
+"""Expert-parallel MoE vs its dense oracle (no reference equivalent —
+a TPU-native extension, like ring attention; SURVEY.md §2.5 marks EP
+out of apex's scope)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import comm
+from apex_tpu.transformer import moe
+
+T, H, F, E = 64, 16, 32, 8
+
+
+def _inputs(seed=0):
+    ks = jax.random.split(jax.random.key(seed), 4)
+    x = jax.random.normal(ks[0], (T, H))
+    router = jax.random.normal(ks[1], (H, E)) * 0.5
+    w1 = jax.random.normal(ks[2], (E, H, F)) * 0.1
+    w2 = jax.random.normal(ks[3], (E, F, H)) * 0.1
+    return x, router, w1, w2
+
+
+def test_top2_gating_capacity_and_renorm():
+    logits = jax.random.normal(jax.random.key(1), (T, E))
+    cap = moe._capacity(T, E, 1.25)
+    dispatch, combine, aux = moe.top2_gating(logits, cap)
+    assert dispatch.shape == (T, E, cap)
+    # each capacity slot holds at most one token
+    assert int(jnp.max(jnp.sum(dispatch, axis=0))) <= 1
+    # kept tokens' gates renormalize to 1; dropped rows are all-zero
+    tok_w = jnp.sum(combine, axis=(1, 2))
+    full = jnp.isclose(tok_w, 1.0, atol=1e-6)
+    empty = jnp.isclose(tok_w, 0.0, atol=1e-6)
+    partial = ~(full | empty)
+    # a token keeping only one of its two choices has weight < 1
+    assert bool(jnp.all(tok_w <= 1.0 + 1e-6))
+    # partial rows must carry exactly one surviving choice's gate:
+    # strictly between 0 and 1
+    pw = np.asarray(tok_w)[np.asarray(partial)]
+    assert ((pw > 0.0) & (pw < 1.0)).all()
+    # at generous capacity most tokens keep both choices
+    assert int(jnp.sum(full)) > 0
+    assert float(aux) > 0.0
+
+
+def test_single_rank_matches_oracle():
+    x, router, w1, w2 = _inputs()
+    m = moe.ExpertParallelMLP(H, F, E, capacity_factor=2.0, axis=None)
+    params = {"router": router, "w1": w1, "w2": w2}
+    out, aux = m.apply({"params": params}, x)
+    cap = moe._capacity(T, E, 2.0)
+    want, want_aux = moe.moe_ref(x, router, w1, w2, cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(want_aux), rtol=1e-5)
+
+
+def test_expert_parallel_matches_oracle():
+    """Experts sharded 8 ways; ONE all_to_all each way; output equals
+    the dense oracle on every rank."""
+    x, router, w1, w2 = _inputs(seed=2)
+    mesh = comm.initialize(data=1, model=8)
+    m = moe.ExpertParallelMLP(H, F, E, capacity_factor=2.0)
+
+    def run(router, w1_local, w2_local, x):
+        params = {"router": router, "w1": w1_local, "w2": w2_local}
+        return m.apply({"params": params}, x)
+
+    out, aux = jax.jit(comm.shard_map(
+        run, mesh,
+        in_specs=(P(), P(comm.AXIS_MODEL), P(comm.AXIS_MODEL), P()),
+        out_specs=(P(), P())))(router, w1, w2, x)
+
+    cap = moe._capacity(T, E, 2.0)
+    want, want_aux = moe.moe_ref(x, router, w1, w2, cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(want_aux), rtol=1e-5)
+
+
+def test_expert_parallel_grads_finite_and_match():
+    """Tokens sharded over the expert axis (each rank routes its own
+    shard); SPMD autodiff through the two all_to_alls yields
+    d(sum of all ranks' losses)/d local experts — compared against the
+    per-shard oracle sum."""
+    x, router, w1, w2 = _inputs(seed=3)       # (T, H): 8 shards of T/8
+    mesh = comm.initialize(data=1, model=8)
+    m = moe.ExpertParallelMLP(H, F, E, capacity_factor=2.0)
+    t_r = T // 8
+    cap = moe._capacity(t_r, E, 2.0)
+
+    def loss_sharded(router, w1_local, w2_local, x_local):
+        params = {"router": router, "w1": w1_local, "w2": w2_local}
+        out, aux = m.apply({"params": params}, x_local)
+        return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+    g = jax.jit(comm.shard_map(
+        jax.grad(loss_sharded, argnums=(1, 2)), mesh,
+        in_specs=(P(), P(comm.AXIS_MODEL), P(comm.AXIS_MODEL),
+                  P(comm.AXIS_MODEL)),
+        out_specs=(P(comm.AXIS_MODEL), P(comm.AXIS_MODEL))))(
+        router, w1, w2, x)
+
+    def loss_ref(w1, w2):
+        total = 0.0
+        for r in range(8):
+            xr = x[r * t_r:(r + 1) * t_r]
+            out, aux = moe.moe_ref(xr, router, w1, w2, cap)
+            total = total + jnp.sum(out.astype(jnp.float32) ** 2) \
+                + 0.01 * aux
+        return total
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(w1, w2)
+    for a, b in zip(g, g_ref):
+        assert bool(jnp.all(jnp.isfinite(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_capacity_drops_are_deterministic():
+    """Tight capacity: some tokens drop, output rows for dropped tokens
+    are exactly zero (residual path semantics)."""
+    x, router, w1, w2 = _inputs(seed=4)
+    m = moe.ExpertParallelMLP(H, F, E, capacity_factor=0.5, axis=None)
+    params = {"router": router, "w1": w1, "w2": w2}
+    out, _ = m.apply({"params": params}, x)
+    out2, _ = m.apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    cap = moe._capacity(T, E, 0.5)
+    _, combine, _ = moe.top2_gating(
+        x.astype(jnp.float32) @ router, cap)
+    dropped = np.asarray(jnp.sum(combine, axis=(1, 2)) == 0.0)
+    assert dropped.any(), "expected some dropped tokens at cf=0.5"
+    np.testing.assert_allclose(np.asarray(out)[dropped], 0.0, atol=1e-6)
