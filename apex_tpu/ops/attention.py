@@ -597,8 +597,12 @@ def ring_attention(q, k, v, causal=False, scale=None,
     row = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
     col = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
 
-    def step(carry, r):
-        o, m, l, k_r, v_r = carry
+    # jax.checkpoint: without it the scan saves every step's (s_loc,
+    # s_loc) probability block as a backward residual — O(cp * s^2)
+    # memory, exactly what ring attention exists to avoid.  Remat
+    # recomputes each block's scores during backward instead.
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def step_math(o, m, l, k_r, v_r, r):
         # k_r currently holds the block owned by rank (rank - r) mod cp
         kv_owner = (rank - r) % cp
         if causal:
@@ -615,9 +619,14 @@ def ring_attention(q, k, v, causal=False, scale=None,
         c_new = jnp.exp(m_i - m_new)
         o = o * c_old[..., None] + o_i * c_new[..., None]
         l = l * c_old + l_i * c_new
+        return o, m_new, l
+
+    def step(carry, r):
+        o, m, l, k_r, v_r = carry
+        o, m, l = step_math(o, m, l, k_r, v_r, r)
         k_r = jax.lax.ppermute(k_r, axis, perm)
         v_r = jax.lax.ppermute(v_r, axis, perm)
-        return (o, m_new, l, k_r, v_r), None
+        return (o, m, l, k_r, v_r), None
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
     m0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)

@@ -119,6 +119,7 @@ class ExpertParallelMLP(nn.Module):
     ffn_hidden_size: int
     num_experts: int
     capacity_factor: float = 1.25
+    router_jitter_eps: float = 0.0   # multiplicative routing noise
     axis: Optional[str] = comm.AXIS_MODEL
     activation: Callable = jax.nn.gelu
     param_dtype: jnp.dtype = jnp.float32
@@ -155,7 +156,11 @@ class ExpertParallelMLP(nn.Module):
 
         cap = _capacity(t, e, self.capacity_factor)
         logits = x.astype(jnp.float32) @ wg
-        dispatch, combine, aux = top2_gating(logits, cap)
+        jrng = (self.make_rng("router")
+                if self.router_jitter_eps > 0.0 else None)
+        dispatch, combine, aux = top2_gating(
+            logits, cap, jitter_rng=jrng,
+            jitter_eps=self.router_jitter_eps)
 
         # (T, E, C) x (T, H) -> (E, C, H)
         xe = jnp.einsum("tec,th->ech", dispatch.astype(dt), x.astype(dt))

@@ -135,3 +135,20 @@ def test_capacity_drops_are_deterministic():
     dropped = np.asarray(jnp.sum(combine, axis=(1, 2)) == 0.0)
     assert dropped.any(), "expected some dropped tokens at cf=0.5"
     np.testing.assert_allclose(np.asarray(out)[dropped], 0.0, atol=1e-6)
+
+
+def test_router_jitter_perturbs_routing():
+    x, router, w1, w2 = _inputs(seed=5)
+    m = moe.ExpertParallelMLP(H, F, E, capacity_factor=2.0, axis=None,
+                              router_jitter_eps=0.3)
+    params = {"router": router, "w1": w1, "w2": w2}
+    o1, _ = m.apply({"params": params}, x,
+                    rngs={"router": jax.random.key(0)})
+    o2, _ = m.apply({"params": params}, x,
+                    rngs={"router": jax.random.key(1)})
+    # different jitter draws change routing for some tokens
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    # same draw is deterministic
+    o3, _ = m.apply({"params": params}, x,
+                    rngs={"router": jax.random.key(0)})
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o3))
