@@ -369,6 +369,8 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
     di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     di = jnp.pad(di.reshape(b * h, sq), ((0, 0), (0, sqp - sq)))
     di = jnp.broadcast_to(di[:, :, None], (b * h, sqp, _LANES))
+    if lse.shape[1] != sqp:     # callers may pass unpadded (b*h, Sq)
+        lse = jnp.pad(lse, ((0, 0), (0, sqp - lse.shape[1])))
     lse = jnp.broadcast_to(lse[:, :, None], (b * h, sqp, _LANES))
 
     seg = segment_ids is not None
@@ -578,15 +580,153 @@ def _partial_attention(q, k, v, scale, mask_val):
     return o, m, l
 
 
+def _block_modes(causal, kv_owner, rank):
+    """0 = attend fully, 1 = diagonal (causal mask), 2 = skip."""
+    if not causal:
+        return jnp.int32(0)
+    return jnp.where(kv_owner < rank, 0,
+                     jnp.where(kv_owner == rank, 1, 2)).astype(jnp.int32)
+
+
+def _ring_block_fwd(q, k_r, v_r, sc, mode):
+    """One ring step through the flash kernel: normalized block output
+    + lse, switched over the causal block mode."""
+    b, h, s_loc, d = q.shape
+
+    def _run(causal_flag):
+        def f(_):
+            o, lse = _fwd_pallas(q, k_r, v_r, sc, causal_flag, None,
+                                 need_lse=True)
+            lse = lse[:, :s_loc, 0].reshape(b, h, s_loc)
+            return o.astype(jnp.float32), lse
+        return f
+
+    def _skip(_):
+        return (jnp.zeros((b, h, s_loc, d), jnp.float32),
+                jnp.full((b, h, s_loc), _NEG, jnp.float32))
+
+    return jax.lax.switch(mode, [_run(False), _run(True), _skip], None)
+
+
+def _ring_block_bwd(q, k_r, v_r, o, lse1, do, sc, mode):
+    """One backward ring step: per-block (dq, dk, dv) from the Pallas
+    backward kernels evaluated against the GLOBAL lse (probabilities
+    come out globally normalized, so the partials sum exactly)."""
+    b, h, s_loc, d = q.shape
+
+    def _run(causal_flag):
+        def f(_):
+            return _bwd_pallas(q, k_r, v_r, o, lse1, do, sc,
+                               causal_flag, None)
+        return f
+
+    def _skip(_):
+        return (jnp.zeros_like(q), jnp.zeros_like(k_r),
+                jnp.zeros_like(v_r))
+
+    return jax.lax.switch(mode, [_run(False), _run(True), _skip], None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring(q, k, v, causal, scale, axis):
+    o, _ = _ring_fwd_impl(q, k, v, causal, scale, axis)
+    return o
+
+
+def _ring_fwd_impl(q, k, v, causal, scale, axis):
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    cp = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    b, h, s_loc, d = q.shape
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, r):
+        o, lse, k_r, v_r = carry
+        kv_owner = (rank - r) % cp
+        mode = _block_modes(causal, kv_owner, rank)
+        o_i, lse_i = _ring_block_fwd(q, k_r, v_r, sc, mode)
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - lse_new)
+        w_new = jnp.exp(lse_i - lse_new)
+        o = o * w_old[..., None] + o_i * w_new[..., None]
+        k_r = jax.lax.ppermute(k_r, axis, perm)
+        v_r = jax.lax.ppermute(v_r, axis, perm)
+        return (o, lse_new, k_r, v_r), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v),
+                                     jnp.arange(cp))
+    return o.astype(q.dtype), lse
+
+
+def _ring_vjp_fwd(q, k, v, causal, scale, axis):
+    o, lse = _ring_fwd_impl(q, k, v, causal, scale, axis)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_vjp_bwd(causal, scale, axis, res, do):
+    q, k, v, o, lse = res
+    sc = scale if scale is not None else _default_scale(q.shape[-1])
+    cp = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    b, h, s_loc, d = q.shape
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    lse1 = lse.reshape(b * h, s_loc)
+
+    # second ring: dk/dv accumulators travel WITH their kv block, so
+    # after the full cycle every block is back home carrying the sum of
+    # all ranks' contributions
+    def step(carry, r):
+        dq, k_r, v_r, dk_r, dv_r = carry
+        kv_owner = (rank - r) % cp
+        mode = _block_modes(causal, kv_owner, rank)
+        dq_i, dk_i, dv_i = _ring_block_bwd(q, k_r, v_r, o, lse1, do,
+                                           sc, mode)
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_r = dk_r + dk_i.astype(jnp.float32)
+        dv_r = dv_r + dv_i.astype(jnp.float32)
+        k_r = jax.lax.ppermute(k_r, axis, perm)
+        v_r = jax.lax.ppermute(v_r, axis, perm)
+        dk_r = jax.lax.ppermute(dk_r, axis, perm)
+        dv_r = jax.lax.ppermute(dv_r, axis, perm)
+        return (dq, k_r, v_r, dk_r, dv_r), None
+
+    zeros = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (zeros, k, v, zeros, zeros), jnp.arange(cp))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
 def ring_attention(q, k, v, causal=False, scale=None,
                    axis: str = comm.AXIS_CTX):
     """Context-parallel attention: sequences sharded over ``axis``.
 
     q/k/v: (B, H, S/cp, D) per shard.  KV blocks rotate around the ring
-    with ppermute; partial softmax stats merge online, so the full
-    (S, S) score matrix never exists anywhere.  Per-step traffic is the
-    KV block on ICI neighbors, overlapped by XLA with the block compute.
-    Differentiable (scan + ppermute transpose).
+    with ppermute; per-block flash-kernel calls merge via logsumexp, so
+    the full (S, S) score matrix never exists anywhere and each block
+    runs at kernel speed.  Backward is a second ring whose dk/dv
+    accumulators travel with their KV block (each block arrives home
+    after the full cycle carrying every rank's contribution).  Per-step
+    traffic is the KV block (+cotangets in backward) on ICI neighbors.
+
+    Reverse-mode only (custom_vjp): for jvp/forward-mode use
+    ``ring_attention_ref`` (plain scan + ppermute, fully transposable)
+    or set APEX_TPU_DISABLE_PALLAS=1.
+    """
+    if pallas_enabled():
+        return _ring(q, k, v, causal, scale, axis)
+    return ring_attention_ref(q, k, v, causal=causal, scale=scale,
+                              axis=axis)
+
+
+def ring_attention_ref(q, k, v, causal=False, scale=None,
+                       axis: str = comm.AXIS_CTX):
+    """jnp blockwise ring (oracle/escape hatch): same math, plain XLA
+    per-block attention with online stat merging.
     """
     sc = scale if scale is not None else _default_scale(q.shape[-1])
     cp = jax.lax.axis_size(axis)

@@ -113,7 +113,8 @@ class ExpertParallelMLP(nn.Module):
     (or axis=None / unbound for single-rank execution, where all
     experts live locally — the degenerate path used off-mesh).
 
-    Returns (out (T, H), aux_loss).
+    Returns (out (T, H), aux_loss).  Router jitter applies only when
+    ``deterministic=False`` (training) — eval calls need no rng.
     """
     hidden_size: int
     ffn_hidden_size: int
@@ -126,7 +127,7 @@ class ExpertParallelMLP(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool = False):
         t, h = x.shape
         e = self.num_experts
         ep = (jax.lax.axis_size(self.axis)
@@ -156,11 +157,11 @@ class ExpertParallelMLP(nn.Module):
 
         cap = _capacity(t, e, self.capacity_factor)
         logits = x.astype(jnp.float32) @ wg
-        jrng = (self.make_rng("router")
-                if self.router_jitter_eps > 0.0 else None)
+        use_jitter = self.router_jitter_eps > 0.0 and not deterministic
+        jrng = self.make_rng("router") if use_jitter else None
         dispatch, combine, aux = top2_gating(
             logits, cap, jitter_rng=jrng,
-            jitter_eps=self.router_jitter_eps)
+            jitter_eps=self.router_jitter_eps if use_jitter else 0.0)
 
         # (T, E, C) x (T, H) -> (E, C, H)
         xe = jnp.einsum("tec,th->ech", dispatch.astype(dt), x.astype(dt))

@@ -180,3 +180,32 @@ def test_flash_attention_multiblock_tiling(causal):
     for a, b_ in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_kernel_matches_ring_ref(causal):
+    """The flash-kernel ring == the jnp blockwise ring (fwd + grads),
+    on multi-128-block per-shard lengths."""
+    b, h, s, d = 1, 2, 8 * 256, 64     # 256 tokens per ctx shard
+    ks = jax.random.split(jax.random.key(9), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+    mesh = comm.initialize(data=1, ctx=8, model=1)
+    spec = P(None, None, "ctx")
+
+    def mk(f):
+        def loss(q, k, v):
+            return jnp.sum(f(q, k, v, causal=causal)
+                           .astype(jnp.float32) ** 2) / s
+        return jax.jit(comm.shard_map(
+            lambda q, k, v: (loss(q, k, v),
+                             jax.grad(loss, argnums=(0, 1, 2))(q, k, v)),
+            mesh, in_specs=(spec,) * 3, out_specs=(P(), (spec,) * 3)))
+
+    l1, g1 = mk(attn.ring_attention)(q, k, v)
+    l2, g2 = mk(attn.ring_attention_ref)(q, k, v)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)

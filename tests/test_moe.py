@@ -152,3 +152,16 @@ def test_router_jitter_perturbs_routing():
     o3, _ = m.apply({"params": params}, x,
                     rngs={"router": jax.random.key(0)})
     np.testing.assert_array_equal(np.asarray(o1), np.asarray(o3))
+
+
+def test_router_jitter_eval_deterministic_no_rng():
+    """deterministic=True disables jitter: no rng needed, same output
+    as an eps=0 module (the repo's dropout convention)."""
+    x, router, w1, w2 = _inputs(seed=6)
+    params = {"router": router, "w1": w1, "w2": w2}
+    m = moe.ExpertParallelMLP(H, F, E, capacity_factor=2.0, axis=None,
+                              router_jitter_eps=0.3)
+    out, _ = m.apply({"params": params}, x, deterministic=True)
+    m0 = moe.ExpertParallelMLP(H, F, E, capacity_factor=2.0, axis=None)
+    want, _ = m0.apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
