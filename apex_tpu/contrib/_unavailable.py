@@ -15,14 +15,17 @@ from __future__ import annotations
 
 
 class _Unavailable:
-    def __init__(self, feature: str, replacement: str):
+    def __init__(self, feature: str, replacement: str,
+                 reason: str = "is CUDA-hardware-bound and has no TPU "
+                               "analog"):
         self._feature = feature
         self._replacement = replacement
+        self._reason = reason
 
     def _raise(self):
         raise NotImplementedError(
-            f"{self._feature} is CUDA-hardware-bound and has no TPU "
-            f"analog; use {self._replacement} instead (see PARITY.md)")
+            f"{self._feature} {self._reason}; "
+            f"use {self._replacement} instead (see PARITY.md)")
 
     def __call__(self, *a, **kw):
         self._raise()
@@ -33,5 +36,5 @@ class _Unavailable:
         self._raise()
 
 
-def make(feature: str, replacement: str) -> _Unavailable:
-    return _Unavailable(feature, replacement)
+def make(feature: str, replacement: str, **kw) -> _Unavailable:
+    return _Unavailable(feature, replacement, **kw)

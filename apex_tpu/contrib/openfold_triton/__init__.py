@@ -1,0 +1,15 @@
+"""Stub: reference apex/contrib/openfold_triton/ (Triton GPU kernels for
+OpenFold: fused MHA/layernorm variants authored in Triton — SURVEY.md
+§2.3 misc [later-era]).  Triton targets CUDA; the TPU-native equivalents
+of every kernel it provides already exist in this package: the flash
+attention family (apex_tpu.ops.attention) and the Pallas LayerNorm
+(apex_tpu.ops.layer_norm).  See PARITY.md."""
+
+from apex_tpu.contrib._unavailable import make
+
+_REASON = "is authored in Triton (a CUDA kernel language)"
+AttnTri = make("openfold_triton.AttnTri",
+               "apex_tpu.ops.attention.flash_attention", reason=_REASON)
+LayerNormSmallShapeOptImpl = make(
+    "openfold_triton.LayerNormSmallShapeOptImpl",
+    "apex_tpu.ops.layer_norm.fused_layer_norm", reason=_REASON)

@@ -1,0 +1,115 @@
+"""Fused LayerNorm/RMSNorm vs jnp and torch oracles (reference model:
+tests/L0/run_fused_layer_norm/test_fused_layer_norm.py — fused kernel
+vs torch.nn.LayerNorm across a dtype x affine x shape grid)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import normalization
+from apex_tpu.ops import layer_norm as ln
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else \
+        dict(rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("h", [128, 1024, 80])   # 80: non-128-multiple path
+@pytest.mark.parametrize("rms", [False, True])
+def test_fused_norm_matches_jnp_ref(h, rms, dtype):
+    rows = 64
+    x = (jax.random.normal(jax.random.key(0), (rows, h)) * 3 + 1
+         ).astype(dtype)
+    w = (jax.random.normal(jax.random.key(1), (h,)) * 0.1 + 1.0
+         ).astype(jnp.float32)
+    b = (jax.random.normal(jax.random.key(2), (h,)) * 0.1
+         ).astype(jnp.float32)
+    if rms:
+        y = ln.fused_rms_norm(x, w)
+        want = ln.rms_norm_ref(x, w)
+    else:
+        y = ln.fused_layer_norm(x, w, b)
+        want = ln.layer_norm_ref(x, w, b)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(want, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("h", [128, 1024])
+@pytest.mark.parametrize("rms", [False, True])
+def test_fused_norm_grads_match_ref(h, rms):
+    rows = 32
+    x = jax.random.normal(jax.random.key(3), (rows, h)) * 2
+    w = jax.random.normal(jax.random.key(4), (h,)) * 0.1 + 1.0
+    b = jax.random.normal(jax.random.key(5), (h,)) * 0.1
+
+    if rms:
+        fused = lambda x, w: jnp.sum(ln.fused_rms_norm(x, w) ** 2)
+        ref = lambda x, w: jnp.sum(ln.rms_norm_ref(x, w) ** 2)
+        args = (x, w)
+    else:
+        fused = lambda x, w, b: jnp.sum(ln.fused_layer_norm(x, w, b) ** 2)
+        ref = lambda x, w, b: jnp.sum(ln.layer_norm_ref(x, w, b) ** 2)
+        args = (x, w, b)
+    g = jax.grad(fused, argnums=tuple(range(len(args))))(*args)
+    g_ref = jax.grad(ref, argnums=tuple(range(len(args))))(*args)
+    for a, b_ in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("h", [64, 256])
+def test_layer_norm_matches_torch_oracle(h):
+    """The reference's own oracle: torch.nn.LayerNorm, same weights."""
+    torch = pytest.importorskip("torch")
+    rows = 16
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, h)).astype(np.float32) * 2 + 0.5
+    w = rng.normal(size=h).astype(np.float32) * 0.2 + 1.0
+    b = rng.normal(size=h).astype(np.float32) * 0.1
+
+    m = torch.nn.LayerNorm(h, eps=1e-5)
+    with torch.no_grad():
+        m.weight.copy_(torch.from_numpy(w))
+        m.bias.copy_(torch.from_numpy(b))
+    want = m(torch.from_numpy(x)).detach().numpy()
+
+    y = ln.fused_layer_norm(jnp.asarray(x), jnp.asarray(w),
+                            jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("cls,rms", [
+    (normalization.FusedLayerNorm, False),
+    (normalization.FusedRMSNorm, True),
+    (normalization.MixedFusedLayerNorm, False),
+    (normalization.MixedFusedRMSNorm, True),
+])
+def test_module_classes(cls, rms):
+    h = 256
+    m = cls(h)
+    x = jax.random.normal(jax.random.key(6), (8, h))
+    params = m.init(jax.random.key(7), x)
+    y = m.apply(params, x)
+    want = ln.rms_norm_ref(x) if rms else ln.layer_norm_ref(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # affine params exist and are trainable
+    leaves = jax.tree_util.tree_leaves(params)
+    assert len(leaves) >= 1
+    g = jax.grad(lambda p: jnp.sum(m.apply(p, x) ** 2))(params)
+    assert all(bool(jnp.all(jnp.isfinite(l)))
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_no_affine_paths():
+    h = 128
+    x = jax.random.normal(jax.random.key(8), (8, h))
+    np.testing.assert_allclose(
+        np.asarray(ln.fused_layer_norm(x)),
+        np.asarray(ln.layer_norm_ref(x)), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ln.fused_rms_norm(x)),
+        np.asarray(ln.rms_norm_ref(x)), rtol=1e-5, atol=1e-5)
