@@ -45,7 +45,9 @@ def _welford_kernel(total_rows, x_ref, cnt_ref, mean_ref, m2_ref):
 
     @pl.when(i == 0)
     def _():
-        cnt_ref[...] = jnp.zeros_like(cnt_ref)
+        # SMEM refs take SCALAR stores under Mosaic (interpret mode is
+        # laxer — this was round 2's hardware-validation catch)
+        cnt_ref[0, 0] = jnp.float32(0.0)
         mean_ref[...] = jnp.zeros_like(mean_ref)
         m2_ref[...] = jnp.zeros_like(m2_ref)
 
@@ -59,8 +61,8 @@ def _welford_kernel(total_rows, x_ref, cnt_ref, mean_ref, m2_ref):
     mean_b = jnp.sum(xm, axis=0, keepdims=True) / safe_nb
     m2_b = jnp.sum(valid * (x - mean_b) ** 2, axis=0, keepdims=True)
     n, mean, m2 = welford_combine(
-        cnt_ref[...], mean_ref[...], m2_ref[...], n_b, mean_b, m2_b)
-    cnt_ref[...] = n
+        cnt_ref[0, 0], mean_ref[...], m2_ref[...], n_b, mean_b, m2_b)
+    cnt_ref[0, 0] = n
     mean_ref[...] = mean
     m2_ref[...] = m2
 

@@ -19,17 +19,19 @@ import jax.numpy as jnp
 
 
 def wgrad_gemm_accum_fp32(input_, grad_output, main_grad):
-    """main_grad += input^T @ grad_output, accumulated in f32.
+    """main_grad += grad_output^T @ input, accumulated in f32.
 
     input_ (..., In) activations; grad_output (..., Out) upstream grads;
-    main_grad (In, Out) f32 accumulator.  Leading dims are flattened (the
+    main_grad (Out, In) f32 accumulator — the reference's nn.Linear
+    weight layout (out_features, in_features), so the accumulator adds
+    straight onto weight.main_grad.  Leading dims are flattened (the
     reference's sequence*batch collapse).  Returns the new accumulator —
     jit with donate_argnums on main_grad for true in-place accumulation.
     """
     x = input_.reshape(-1, input_.shape[-1])
     dy = grad_output.reshape(-1, grad_output.shape[-1])
     acc = jax.lax.dot_general(
-        x, dy, (((0,), (0,)), ((), ())),
+        dy, x, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     return main_grad + acc
 
@@ -42,4 +44,4 @@ wgrad_gemm_accum_fp16 = wgrad_gemm_accum_fp32
 def wgrad_gemm_accum_ref(input_, grad_output, main_grad):
     x = input_.reshape(-1, input_.shape[-1]).astype(jnp.float32)
     dy = grad_output.reshape(-1, grad_output.shape[-1]).astype(jnp.float32)
-    return main_grad + x.T @ dy
+    return main_grad + dy.T @ x

@@ -12,7 +12,7 @@ def test_wgrad_accumulates_f32():
                           jnp.bfloat16)
     dy = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16),
                            jnp.bfloat16)
-    acc = jnp.ones((32, 16), jnp.float32)
+    acc = jnp.ones((16, 32), jnp.float32)  # (Out, In): reference layout
     got = wgrad_gemm_accum_fp32(x, dy, acc)
     want = wgrad_gemm_accum_ref(x, dy, acc)
     assert got.dtype == jnp.float32
@@ -25,8 +25,8 @@ def test_wgrad_microbatch_accumulation_matches_full_batch():
     batch wgrad, accumulated in f32."""
     x = jax.random.normal(jax.random.PRNGKey(0), (16, 32))
     dy = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
-    full = wgrad_gemm_accum_fp32(x, dy, jnp.zeros((32, 8)))
-    acc = jnp.zeros((32, 8))
+    full = wgrad_gemm_accum_fp32(x, dy, jnp.zeros((8, 32)))
+    acc = jnp.zeros((8, 32))
     step = jax.jit(wgrad_gemm_accum_fp32, donate_argnums=(2,))
     for i in range(4):
         acc = step(x[i * 4:(i + 1) * 4], dy[i * 4:(i + 1) * 4], acc)
