@@ -67,8 +67,10 @@ def attention_core(q, k, v, *, causal: bool,
                              mask=mask), None
 
     # probs are needed (dropout and/or need_weights): inline softmax path
+    from apex_tpu.ops.attention import matmul_precision
+    prec = matmul_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+                   k.astype(jnp.float32), precision=prec) * scale
     if mask is not None:
         s = s + mask
     if causal:
@@ -82,6 +84,6 @@ def attention_core(q, k, v, *, causal: bool,
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
                                     p.shape)
         p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p_drop,
-                     v.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p_drop, v.astype(jnp.float32),
+                     precision=prec).astype(q.dtype)
     return out, (p if need_weights else None)

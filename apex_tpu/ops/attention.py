@@ -49,6 +49,22 @@ def _default_scale(d: int) -> float:
     return 1.0 / math.sqrt(d)
 
 
+def matmul_precision(dtype):
+    """The precision contract (docs/kernels.md): f32 operands dot at
+    HIGHEST (true-f32 MXU passes — default would round through bf16);
+    bf16 operands keep the full-rate default.  Shared by the kernels
+    and every oracle/fallback path so comparisons are apples-to-apples."""
+    return (jax.lax.Precision.HIGHEST
+            if jnp.dtype(dtype) == jnp.float32 else None)
+
+
+def _dot(a, b, dims):
+    """Kernel dot under the precision contract, f32 accumulation."""
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=matmul_precision(a.dtype))
+
+
 def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
@@ -163,9 +179,7 @@ def _fwd_kernel(scale, causal, seg, need_lse, sq, sk, sqp, skp, bq, bk,
     def _body():
         # native-dtype operands on the MXU (bf16 runs at full rate),
         # f32 accumulation via preferred_element_type
-        s = jax.lax.dot_general(q_ref[0], k_ref[0],
-                                (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        s = _dot(q_ref[0], k_ref[0], ((1,), (1,))) * scale
         ok = _mask_for_block(
             j, kk, bq, bk, sq, sk, sqp, skp, causal,
             qs_ref[0] if seg else None,
@@ -181,9 +195,7 @@ def _fwd_kernel(scale, causal, seg, need_lse, sq, sk, sqp, skp, bq, bk,
             p = jnp.where(ok, p, 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        pv = _dot(p.astype(v_ref.dtype), v_ref[0], ((1,), (0,)))
         acc_scr[...] = acc_scr[...] * alpha + pv
 
     @pl.when(kk == kk_last)
@@ -264,9 +276,7 @@ def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True):
 
 def _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk, j, kk,
                  q_ref, k_ref, qs_ref, ks_ref, lse_ref):
-    s = jax.lax.dot_general(q_ref[0], k_ref[0],
-                            (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    s = _dot(q_ref[0], k_ref[0], ((1,), (1,))) * scale
     p = jnp.exp(s - lse_ref[0, :, :1])
     ok = _mask_for_block(
         j, kk, bq, bk, sq, sk, sqp, skp, causal,
@@ -299,13 +309,10 @@ def _dq_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
     def _body():
         p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
                          j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
-        dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dp = _dot(do_ref[0], v_ref[0], ((1,), (1,)))
         ds = p * (dp - di_ref[0, :, :1]) * scale
-        dq_scr[...] += jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dq_scr[...] += _dot(ds.astype(k_ref.dtype), k_ref[0],
+                            ((1,), (0,)))
 
     @pl.when(kk == kk_last)
     def _finish():
@@ -337,16 +344,12 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq,
         p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
                          j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
         # dv += p^T @ do   (contract the q dim)
-        dv_scr[...] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dv_scr[...] += _dot(p.astype(do_ref.dtype), do_ref[0],
+                            ((0,), (0,)))
+        dp = _dot(do_ref[0], v_ref[0], ((1,), (1,)))
         ds = p * (dp - di_ref[0, :, :1]) * scale
-        dk_scr[...] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dk_scr[...] += _dot(ds.astype(q_ref.dtype), q_ref[0],
+                            ((0,), (0,)))
 
     @pl.when(j == nq - 1)
     def _finish():
@@ -543,10 +546,15 @@ def flash_attention(q, k, v, causal=False, scale=None,
 
 def attention_ref(q, k, v, causal=False, scale=None,
                   mask: Optional[jax.Array] = None):
-    """XLA oracle/fallback; mask: additive (B,1|H,Sq,Sk) or None."""
+    """XLA oracle/fallback; mask: additive (B,1|H,Sq,Sk) or None.
+
+    f32 inputs get HIGHEST matmul precision (true f32 on the MXU, same
+    contract as the kernel's _dot); bf16 inputs keep the fast default.
+    """
     sc = scale if scale is not None else _default_scale(q.shape[-1])
+    prec = matmul_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sc
+                   k.astype(jnp.float32), precision=prec) * sc
     if mask is not None:
         s = s + mask
     if causal:
@@ -555,8 +563,8 @@ def attention_ref(q, k, v, causal=False, scale=None,
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(col > row, _NEG, s)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                      precision=prec).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -569,14 +577,16 @@ def _partial_attention(q, k, v, scale, mask_val):
     Returns (o_un (B,H,Sq,D), m (B,H,Sq), l (B,H,Sq)): o_un = exp(s-m)@v,
     l = rowsum(exp(s-m)).  mask_val: additive (Sq, Sk) or None.
     """
+    prec = matmul_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+                   k.astype(jnp.float32), precision=prec) * scale
     if mask_val is not None:
         s = s + mask_val
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   precision=prec)
     return o, m, l
 
 

@@ -1,0 +1,36 @@
+"""Platform selection that works under hosted-TPU python images.
+
+Some TPU environments register the TPU PJRT plugin via a sitecustomize
+hook in EVERY python process and pin ``JAX_PLATFORMS`` there, so the
+standard ``JAX_PLATFORMS=cpu python script.py`` idiom is silently
+overridden.  The only reliable override is flipping the live jax config
+before the first backend use — which is what ``select_platform`` does.
+
+Used by the examples' ``--cpu`` flags; honors ``APEX_TPU_PLATFORM``
+(e.g. ``APEX_TPU_PLATFORM=cpu``) so any entry point can be redirected
+without editing it.
+
+(Reference context: the reference picks devices with CUDA_VISIBLE_DEVICES
++ ``torch.cuda.set_device``; device selection there is an env concern
+too, see examples/imagenet/main_amp.py in SURVEY.md §1 L6.)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def select_platform(platform: Optional[str] = None) -> Optional[str]:
+    """Force the jax backend platform ("cpu", "tpu", ...).
+
+    Call before any jax backend use.  ``platform=None`` falls back to
+    the ``APEX_TPU_PLATFORM`` env var; returns the platform applied (or
+    None if left at the environment default).
+    """
+    import jax
+
+    p = platform or os.environ.get("APEX_TPU_PLATFORM") or None
+    if p:
+        jax.config.update("jax_platforms", p)
+    return p

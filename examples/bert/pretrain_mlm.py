@@ -37,11 +37,15 @@ def parse_args():
                    choices=["O0", "O1", "O2", "O3"])
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--weight-decay", type=float, default=0.01)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (see apex_tpu.platform)")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
+    from apex_tpu.platform import select_platform
+    select_platform("cpu" if args.cpu else None)
     on_tpu = jax.default_backend() == "tpu"
     batch = args.batch_size or (8 if on_tpu else 2)
     seq = args.seq_len or (512 if on_tpu else 64)

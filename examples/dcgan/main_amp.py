@@ -57,7 +57,11 @@ def main():
     p.add_argument("--opt-level", default="O1")
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--nz", type=int, default=32)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (see apex_tpu.platform)")
     args = p.parse_args()
+    from apex_tpu.platform import select_platform
+    select_platform("cpu" if args.cpu else None)
 
     netG, netD = Generator(), Discriminator()
     z0 = jnp.zeros((args.batch_size, args.nz))

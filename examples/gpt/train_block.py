@@ -81,11 +81,15 @@ def parse_args():
     p.add_argument("--seq-len", type=int, default=0)
     p.add_argument("--batch-size", type=int, default=0)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (see apex_tpu.platform)")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
+    from apex_tpu.platform import select_platform
+    select_platform("cpu" if args.cpu else None)
     on_tpu = jax.default_backend() == "tpu"
     layers = args.layers or (12 if on_tpu else 2)
     hidden = args.hidden or (768 if on_tpu else 128)

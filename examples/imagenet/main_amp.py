@@ -49,11 +49,16 @@ def parse_args():
                         "'data' mesh axis (reference: --sync_bn + "
                         "apex.parallel.convert_syncbn_model)")
     p.add_argument("--checkpoint", default="")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (hosted-TPU images "
+                        "override JAX_PLATFORMS; see apex_tpu.platform)")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
+    from apex_tpu.platform import select_platform
+    select_platform("cpu" if args.cpu else None)
     on_tpu = jax.default_backend() == "tpu"
     batch = args.batch_size or (128 if on_tpu else 8)
     size = args.image_size or (224 if on_tpu else 64)

@@ -34,6 +34,8 @@ def forward(params, x):
 
 
 def main():
+    from apex_tpu.platform import select_platform
+    select_platform()          # honor APEX_TPU_PLATFORM (e.g. cpu)
     print(f"apex_tpu {apex_tpu.__version__} on {jax.default_backend()}")
     key = jax.random.key(0)
     params = init_params(key)
