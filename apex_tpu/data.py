@@ -1,0 +1,140 @@
+"""Host→device input pipeline (reference: the ``data_prefetcher`` class
+in examples/imagenet/main_amp.py, which overlaps H2D copies with compute
+on a side CUDA stream; SURVEY.md §1 L6).
+
+TPU-native design: there are no user-managed streams — ``jax.device_put``
+is asynchronous and XLA overlaps transfers with running computations by
+itself.  What the prefetcher must supply is *pipelining depth*: issue the
+next batch's transfer while the current step runs.  ``DevicePrefetcher``
+keeps a ring of ``depth`` in-flight device batches fed from a background
+host thread (so host-side batch construction — augmentation, decode,
+numpy collation — also overlaps), which is the same two-deep pipeline the
+reference builds with `stream.wait_stream` + `record_stream`.
+
+Works with any iterator of pytrees (numpy or jax arrays).  When a
+``sharding`` is given, batches land already laid out for the mesh
+(`jax.device_put` with a NamedSharding performs the host-split +
+multi-device transfer in one call).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+_SENTINEL = object()
+
+
+class DevicePrefetcher:
+    """Iterate device-resident batches, ``depth`` transfers ahead.
+
+    >>> with DevicePrefetcher(loader, depth=2) as pf:
+    ...     for batch in pf:
+    ...         state = step(state, batch)   # next H2D already in flight
+
+    The reference's loop idiom ``input, target = prefetcher.next()``
+    (returning None at exhaustion — repeatedly, like the apex example's
+    data_prefetcher) is also supported for drop-in ports.  ``close()``
+    (or the context manager) releases the feeder thread and its in-flight
+    device batches on early exit.
+    """
+
+    def __init__(self, it: Iterable[Any], depth: int = 2,
+                 sharding: Optional[Any] = None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._src = iter(it)
+        self._sharding = sharding
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(target=self._feed, daemon=True)
+        self._thread.start()
+
+    def _put_device(self, batch):
+        if self._sharding is not None:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._sharding), batch)
+        return jax.tree_util.tree_map(jax.device_put, batch)
+
+    def _put_or_stop(self, item) -> bool:
+        """Bounded put that aborts when close() is signalled; returns
+        False if the prefetcher is shutting down."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _feed(self):
+        try:
+            for batch in self._src:
+                if self._stop.is_set():
+                    return
+                if not self._put_or_stop(self._put_device(batch)):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._put_or_stop(_SENTINEL)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._done = True
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        return item
+
+    def next(self):
+        """Reference-idiom alias: returns None at (and after) exhaustion
+        instead of raising (matches data_prefetcher.next() in the apex
+        example)."""
+        try:
+            return self.__next__()
+        except StopIteration:
+            return None
+
+    def close(self):
+        """Stop the feeder thread and drop queued device batches.  Safe
+        to call more than once; called automatically by the context
+        manager and on garbage collection."""
+        self._done = True
+        self._stop.set()
+        while True:             # unblock a feeder stuck in put()
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=1.0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort: don't leak the feeder thread
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prefetch_to_device(it: Iterable[Any], depth: int = 2,
+                       sharding: Optional[Any] = None):
+    """Functional spelling of DevicePrefetcher (flax-utils-style name)."""
+    return DevicePrefetcher(it, depth=depth, sharding=sharding)

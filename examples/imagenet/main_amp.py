@@ -119,7 +119,6 @@ def main():
     else:
         jstep = jax.jit(train_step)
 
-    key = jax.random.PRNGKey(1)
     step0 = 0
     if args.checkpoint:
         import os
@@ -132,11 +131,28 @@ def main():
                 amp_state = amp_state.load_state_dict(amp_sd)
             print(f"resumed at step {step0} "
                   f"scale {float(amp_state.scaler.loss_scale):.0f}")
+    # host loader + device prefetcher (reference: the data_prefetcher
+    # class in its imagenet example — H2D overlapped with compute; here
+    # apex_tpu.data.DevicePrefetcher plays that role, and batches land
+    # pre-sharded over the mesh under --ddp)
+    import numpy as np
+    from apex_tpu.data import DevicePrefetcher
+
+    nrng = np.random.default_rng(1)
+    # pre-generate a few host batches and cycle them: keeps the H2D
+    # pipeline honest without making single-threaded numpy RNG the
+    # bottleneck at TPU batch sizes
+    pool = [(nrng.standard_normal(
+                 (batch, size, size, 3), dtype=np.float32),
+             nrng.integers(0, 1000, (batch,)).astype(np.int32))
+            for _ in range(min(4, args.steps))]
+
+    prefetcher = DevicePrefetcher(
+        (pool[i % len(pool)] for i in range(args.steps)), depth=2,
+        sharding=comm.sharding("data") if args.ddp else None)
+
     t0 = None
-    for step in range(step0, step0 + args.steps):
-        kx, ky, key = jax.random.split(key, 3)
-        x = jax.random.normal(kx, (batch, size, size, 3))
-        y = jax.random.randint(ky, (batch,), 0, 1000)
+    for step, (x, y) in enumerate(prefetcher, start=step0):
         loss, grads, batch_stats, found_inf = jstep(
             opt.params, batch_stats, amp_state.scaler, x, y)
         if int(found_inf) == 0:
