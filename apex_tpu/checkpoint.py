@@ -72,7 +72,18 @@ def save_checkpoint(path: str, tree: Pytree,
         f.write(len(hbytes).to_bytes(8, "little"))
         f.write(hbytes)
         f.write(payload.tobytes())
+        f.flush()
+        os.fsync(f.fileno())   # durable before the atomic publish
     os.replace(tmp, path)
+    try:   # persist the rename itself (directory entry)
+        dfd = os.open(os.path.dirname(os.path.abspath(path)),
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass   # some filesystems refuse directory fsync; best effort
 
 
 def load_checkpoint(path: str, like: Pytree,
@@ -139,14 +150,10 @@ def load_checkpoint(path: str, like: Pytree,
         header["metadata"]
 
 
-def save_training_state(path: str, params: Pytree, optimizer=None,
-                        amp_state=None, step: int = 0,
-                        extra: Optional[Pytree] = None) -> None:
-    """The reference's {'model', 'optimizer', 'amp'} bundle in one call.
-
-    optimizer: any apex_tpu optimizer facade (state_dict'ed); amp_state:
-    amp.state_dict() or a scaler state_dict; extra: any additional array
-    pytree (e.g. BN batch_stats)."""
+def _training_state_tree(params, optimizer, amp_state, step, extra):
+    """Assemble the {'model','optimizer','amp'} bundle (tree, meta).
+    Runs on the CALLER thread so the snapshot is step-consistent even
+    when the write is deferred to AsyncCheckpointer's worker."""
     tree = {"params": params}
     if extra is not None:
         tree["extra"] = extra
@@ -160,6 +167,19 @@ def save_training_state(path: str, params: Pytree, optimizer=None,
         tree["opt"] = {k: v for k, v in sd.items() if v is not None}
     if amp_state is not None:
         meta["amp"] = amp_state
+    return tree, meta
+
+
+def save_training_state(path: str, params: Pytree, optimizer=None,
+                        amp_state=None, step: int = 0,
+                        extra: Optional[Pytree] = None) -> None:
+    """The reference's {'model', 'optimizer', 'amp'} bundle in one call.
+
+    optimizer: any apex_tpu optimizer facade (state_dict'ed); amp_state:
+    amp.state_dict() or a scaler state_dict; extra: any additional array
+    pytree (e.g. BN batch_stats)."""
+    tree, meta = _training_state_tree(params, optimizer, amp_state,
+                                      step, extra)
     save_checkpoint(path, tree, meta)
 
 
@@ -188,3 +208,80 @@ def load_training_state(path: str, params_like: Pytree, optimizer=None,
     if extra_like is not None:
         return out + (tree["extra"],)
     return out
+
+
+class AsyncCheckpointer:
+    """Non-blocking checkpoint writes on a single worker thread.
+
+    ``save()``/``save_training_state()`` snapshot on the caller thread —
+    tree containers and metadata are copied, and jax array leaves are
+    captured by reference (immutable, so consistent even while training
+    continues) — then return immediately; the device→host transfer and
+    the packed-file write happen on the worker.  (Raw numpy leaves are
+    also by-reference: don't mutate them in place mid-save.)  At most
+    one save is in flight — a new save first waits for the previous one
+    (so checkpoints never interleave), and any worker exception is
+    re-raised at the next call or at ``wait_until_finished()``.
+
+    The reference blocks training for the full torch.save; here the step
+    loop only ever waits when checkpoints are requested faster than the
+    disk can take them.
+    """
+
+    def __init__(self):
+        import concurrent.futures as cf
+        self._pool = cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="apex_ckpt")
+        self._inflight = None
+
+    def _join(self):
+        if self._inflight is not None:
+            fut, self._inflight = self._inflight, None
+            fut.result()   # re-raise worker failures
+
+    @staticmethod
+    def _snapshot(tree, metadata):
+        """Fresh containers (leaves by reference) + a deep-copied
+        metadata dict, so caller-side mutation between submit and the
+        worker's serialization can't tear the checkpoint."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        import copy
+        return (jax.tree_util.tree_unflatten(treedef, leaves),
+                copy.deepcopy(metadata) if metadata else metadata)
+
+    def save(self, path: str, tree: Pytree,
+             metadata: Optional[Dict] = None) -> None:
+        self._join()
+        tree, metadata = self._snapshot(tree, metadata)
+        self._inflight = self._pool.submit(
+            save_checkpoint, path, tree, metadata)
+
+    def save_training_state(self, path: str, params: Pytree,
+                            optimizer=None, amp_state=None,
+                            step: int = 0,
+                            extra: Optional[Pytree] = None) -> None:
+        self._join()
+        # snapshot the optimizer/amp state NOW (caller thread): the
+        # facade rebinds attributes each step, so a worker-side
+        # state_dict could mix two steps' arrays
+        tree, meta = _training_state_tree(params, optimizer, amp_state,
+                                          step, extra)
+        tree, meta = self._snapshot(tree, meta)
+        self._inflight = self._pool.submit(save_checkpoint, path, tree,
+                                           meta)
+
+    def wait_until_finished(self) -> None:
+        """Block until the in-flight save (if any) is durable on disk."""
+        self._join()
+
+    def close(self) -> None:
+        try:
+            self.wait_until_finished()
+        finally:   # never leak the worker, even when the save failed
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

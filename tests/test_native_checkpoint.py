@@ -130,3 +130,50 @@ def test_integer_leaf_corruption_detected(tmp_path):
     open(p, "wb").write(bytes(data))
     with pytest.raises(ValueError, match="crc|checksum"):
         ckpt.load_checkpoint(p, tree)
+
+
+def test_async_checkpointer_round_trip(tmp_path):
+    from apex_tpu import checkpoint as ckpt
+    tree = {"w": jnp.arange(32, dtype=jnp.float32),
+            "b": jnp.ones((4,), jnp.bfloat16)}
+    p = str(tmp_path / "a.ckpt")
+    with ckpt.AsyncCheckpointer() as ac:
+        ac.save(p, tree, metadata={"step": 3})
+        ac.wait_until_finished()
+        got, meta = ckpt.load_checkpoint(p, tree)
+    assert meta["step"] == 3
+    np.testing.assert_array_equal(np.asarray(got["w"]),
+                                  np.asarray(tree["w"]))
+
+
+def test_async_checkpointer_training_state_consistent(tmp_path):
+    """The snapshot must be of the step at save() time, even if the
+    optimizer keeps stepping while the worker writes."""
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu.optimizers import FusedSGD
+    params = {"w": jnp.ones((128,), jnp.float32)}
+    opt = FusedSGD(params, lr=0.1, momentum=0.9)
+    g = {"w": jnp.full((128,), 0.01, jnp.float32)}
+    opt.step(g)
+    p = str(tmp_path / "t.ckpt")
+    with ckpt.AsyncCheckpointer() as ac:
+        ac.save_training_state(p, opt.params, opt, step=1)
+        w_at_save = np.asarray(opt.params["w"]).copy()
+        for _ in range(5):          # keep training while it writes
+            opt.step(g)
+        ac.wait_until_finished()
+    params2 = {"w": jnp.zeros((128,), jnp.float32)}
+    opt2 = FusedSGD(params2, lr=0.1, momentum=0.9)
+    restored, _, step = ckpt.load_training_state(p, params2, opt2)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]), w_at_save)
+
+
+def test_async_checkpointer_propagates_worker_errors(tmp_path):
+    from apex_tpu import checkpoint as ckpt
+    ac = ckpt.AsyncCheckpointer()
+    ac.save(str(tmp_path / "no" / "such" / "dir" / "x.ckpt"),
+            {"w": jnp.ones((2,))})
+    with pytest.raises(FileNotFoundError):
+        ac.wait_until_finished()
+    ac.close()
