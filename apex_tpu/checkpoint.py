@@ -214,40 +214,50 @@ class AsyncCheckpointer:
     """Non-blocking checkpoint writes on a single worker thread.
 
     ``save()``/``save_training_state()`` snapshot on the caller thread —
-    tree containers and metadata are copied, and jax array leaves are
-    captured by reference (immutable, so consistent even while training
-    continues) — then return immediately; the device→host transfer and
-    the packed-file write happen on the worker.  (Raw numpy leaves are
-    also by-reference: don't mutate them in place mid-save.)  At most
-    one save is in flight — a new save first waits for the previous one
-    (so checkpoints never interleave), and any worker exception is
-    re-raised at the next call or at ``wait_until_finished()``.
+    tree containers and metadata are copied, and jax array leaves get an
+    asynchronous DEVICE-SIDE copy (dispatch returns immediately), so the
+    capture survives the caller's next step even when that step donates
+    the originals (`donate_argnums` deletes donated buffers — a
+    by-reference capture would race it).  The device→host transfer and
+    the packed-file write happen on the worker.  Pass
+    ``copy_leaves=False`` to skip the device copies (saves one transient
+    params-sized HBM allocation) IF the training step does not donate
+    the checkpointed buffers.  (Raw numpy leaves are by-reference either
+    way: don't mutate them in place mid-save.)  At most one save is in
+    flight — a new save first waits for the previous one (so checkpoints
+    never interleave), and any worker exception is re-raised at the next
+    call or at ``wait_until_finished()``.
 
     The reference blocks training for the full torch.save; here the step
     loop only ever waits when checkpoints are requested faster than the
     disk can take them.
     """
 
-    def __init__(self):
+    def __init__(self, copy_leaves: bool = True):
         import concurrent.futures as cf
         self._pool = cf.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="apex_ckpt")
         self._inflight = None
+        self._copy_leaves = copy_leaves
 
     def _join(self):
         if self._inflight is not None:
             fut, self._inflight = self._inflight, None
             fut.result()   # re-raise worker failures
 
-    @staticmethod
-    def _snapshot(tree, metadata):
-        """Fresh containers (leaves by reference) + a deep-copied
-        metadata dict, so caller-side mutation between submit and the
-        worker's serialization can't tear the checkpoint."""
+    def _snapshot(self, tree, metadata):
+        """Fresh containers + deep-copied metadata + (by default)
+        device-side leaf copies, so caller-side mutation OR buffer
+        donation between submit and the worker's serialization can't
+        tear or delete the checkpoint's inputs."""
         leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if self._copy_leaves:
+            leaves = [l.copy() if isinstance(l, jax.Array) else l
+                      for l in leaves]
         import copy
         return (jax.tree_util.tree_unflatten(treedef, leaves),
-                copy.deepcopy(metadata) if metadata else metadata)
+                copy.deepcopy(metadata) if metadata is not None
+                else None)
 
     def save(self, path: str, tree: Pytree,
              metadata: Optional[Dict] = None) -> None:

@@ -786,3 +786,51 @@ def ring_attention_ref(q, k, v, causal=False, scale=None,
     (o, m, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(cp))
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# all-to-all sequence parallelism (Ulysses-style) — the second
+# long-context strategy next to the ppermute ring
+# ---------------------------------------------------------------------------
+
+def ulysses_attention(q, k, v, causal=False, scale=None,
+                      axis: str = comm.AXIS_CTX):
+    """All-to-all sequence parallelism over ``axis`` (Ulysses style).
+
+    q/k/v: (B, H, S/cp, D) per shard, with H divisible by cp.  One
+    ``all_to_all`` reshards sequence→heads — every device ends up with
+    the FULL sequence for H/cp heads — the flash kernel runs ordinary
+    full-sequence attention locally (causal masking is exact, positions
+    are global), and a second ``all_to_all`` restores (B, H, S/cp, D).
+
+    vs ``ring_attention``: two all_to_all collectives total (each moving
+    the activations once) instead of cp ppermute rounds of KV blocks —
+    cheaper when cp is large and ICI all_to_all bandwidth is good, but
+    requires H % cp == 0 while the ring has no head constraint.  Both
+    are beyond-reference extensions: apex's only sequence-length scaling
+    is Megatron SP (SURVEY.md §2.5).
+
+    Differentiable end to end (all_to_all transposes to all_to_all; the
+    kernel brings its custom_vjp).
+    """
+    cp = jax.lax.axis_size(axis)
+    if cp == 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    h = q.shape[1]
+    if h % cp:
+        raise ValueError(
+            f"ulysses_attention: heads ({h}) must be divisible by the "
+            f"'{axis}' axis size ({cp}); use ring_attention for "
+            "head-count-agnostic context parallelism")
+
+    def seq_to_heads(x):   # (B, H, S/cp, D) -> (B, H/cp, S, D)
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(x):   # (B, H/cp, S, D) -> (B, H, S/cp, D)
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    o = flash_attention(seq_to_heads(q), seq_to_heads(k),
+                        seq_to_heads(v), causal=causal, scale=scale)
+    return heads_to_seq(o)

@@ -177,3 +177,33 @@ def test_async_checkpointer_propagates_worker_errors(tmp_path):
     with pytest.raises(FileNotFoundError):
         ac.wait_until_finished()
     ac.close()
+
+
+def test_async_checkpointer_survives_buffer_donation(tmp_path):
+    """The caller's next jitted step may donate (delete) the saved
+    buffers; the default device-side leaf copy must keep the snapshot
+    alive (code-review r2 finding)."""
+    from apex_tpu import checkpoint as ckpt
+    w = jnp.arange(1 << 16, dtype=jnp.float32)
+    p = str(tmp_path / "d.ckpt")
+    with ckpt.AsyncCheckpointer() as ac:
+        ac.save(p, {"w": w}, metadata={"step": 4})
+        w.delete()                 # simulate donation of the original
+        ac.wait_until_finished()
+    got, meta = ckpt.load_checkpoint(
+        p, {"w": jnp.zeros((1 << 16,), jnp.float32)})
+    assert meta["step"] == 4
+    assert float(got["w"][-1]) == float((1 << 16) - 1)
+
+
+def test_async_checkpointer_empty_metadata_not_torn(tmp_path):
+    """metadata={} must still be snapshotted (falsy-dict regression)."""
+    from apex_tpu import checkpoint as ckpt
+    md = {}
+    p = str(tmp_path / "m.ckpt")
+    with ckpt.AsyncCheckpointer() as ac:
+        ac.save(p, {"w": jnp.ones((1 << 20,))}, metadata=md)
+        md["late"] = True          # caller mutates after submit
+        ac.wait_until_finished()
+    _, meta = ckpt.load_checkpoint(p, {"w": jnp.ones((1 << 20,))})
+    assert meta == {}
