@@ -1,0 +1,164 @@
+"""Per-kernel micro-benchmarks: each Pallas kernel vs its XLA oracle.
+
+Run on a real TPU (falls back to a labeled CPU result like bench.py):
+
+    python tools/kernel_bench.py [--csv out.csv]
+
+Prints one JSON line per kernel:
+    {"kernel": "...", "shape": "...", "dtype": "...",
+     "kernel_ms": K, "oracle_ms": O, "speedup": O/K, "backend": "tpu"}
+
+Methodology: jit both paths, one warmup call (compile), then median of
+5 timed loops of `iters` calls each, synchronized by a scalar fetch (the
+tunnel's block_until_ready can return early; a tiny host fetch cannot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import statistics
+import time
+
+import numpy as np
+
+
+def _sync(o):
+    """Scalar-slice fetch: forces completion without a full-array ravel
+    (same idiom as bench.py's sync)."""
+    import jax
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    np.asarray(leaf[(0,) * (leaf.ndim - 1)][:1] if leaf.ndim else leaf)
+
+
+def time_fn(f, *args, iters=10, reps=5):
+    o = f(*args)
+    _sync(o)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = f(*args)
+        _sync(o)
+        times.append((time.perf_counter() - t0) / iters * 1e3)
+    return statistics.median(times)
+
+
+def bench_pair(name, shape_desc, dtype, kern, oracle, *args, grad=False):
+    import jax
+    import jax.numpy as jnp
+
+    if grad:
+        def wrap(f, n=len(args)):
+            # differentiate w.r.t. EVERY operand so no backward path is
+            # dead-code-eliminated on the oracle side (bench.py idiom)
+            return jax.jit(jax.grad(
+                lambda *a: jnp.sum(f(*a).astype(jnp.float32) ** 2),
+                argnums=tuple(range(n))))
+    else:
+        wrap = jax.jit
+    k_ms = time_fn(wrap(kern), *args)
+    o_ms = time_fn(wrap(oracle), *args)
+    return {"kernel": name + ("_grad" if grad else ""),
+            "shape": shape_desc, "dtype": dtype,
+            "kernel_ms": round(k_ms, 3), "oracle_ms": round(o_ms, 3),
+            "speedup": round(o_ms / k_ms, 2) if k_ms else None}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--csv", default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.platform import select_platform
+    select_platform()          # honor APEX_TPU_PLATFORM (e.g. cpu)
+    jax.config.update("jax_compilation_cache_dir",
+                      __file__.rsplit("/", 2)[0] + "/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    backend = jax.default_backend()
+    if backend != "tpu":
+        # interpret-mode Pallas timings are meaningless AND impractically
+        # slow (bench.py skips flash off-TPU for the same reason)
+        print(json.dumps({"backend": backend,
+                          "note": "kernel timings skipped off-TPU"}))
+        return
+
+    from apex_tpu.ops import attention as attn
+    from apex_tpu.ops import layer_norm as ln
+    from apex_tpu.ops import multi_tensor as mt
+    from apex_tpu.ops import softmax as sm
+    from apex_tpu.ops import xentropy as xe
+
+    rows = []
+    key = jax.random.key(0)
+
+    # flash attention: bench shapes (BERT-L-ish and long-context)
+    for (b, h, s, d) in [(8, 16, 512, 64), (4, 16, 2048, 128),
+                         (1, 8, 8192, 128)]:
+        ks = jax.random.split(key, 3)
+        q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+                   for kk in ks)
+        f_k = functools.partial(attn.flash_attention, causal=True)
+        f_o = functools.partial(attn.attention_ref, causal=True)
+        for grad in (False, True):
+            rows.append(bench_pair("flash_attention", f"b{b}h{h}s{s}d{d}",
+                                   "bf16", f_k, f_o, q, k, v, grad=grad))
+
+    # layer norm
+    for (r, hdim) in [(8192, 1024), (4096, 4096)]:
+        x = jax.random.normal(key, (r, hdim), jnp.bfloat16)
+        w = jnp.ones((hdim,), jnp.bfloat16)
+        b_ = jnp.zeros((hdim,), jnp.bfloat16)
+        rows.append(bench_pair("fused_layer_norm", f"{r}x{hdim}", "bf16",
+                               ln.fused_layer_norm, ln.layer_norm_ref,
+                               x, w, b_))
+        rows.append(bench_pair("fused_layer_norm", f"{r}x{hdim}", "bf16",
+                               ln.fused_layer_norm, ln.layer_norm_ref,
+                               x, w, b_, grad=True))
+
+    # fused softmax (attention-shaped)
+    x = jax.random.normal(key, (8, 16, 512, 512), jnp.bfloat16)
+    rows.append(bench_pair(
+        "scaled_upper_triang_masked_softmax", "8x16x512x512", "bf16",
+        lambda t: sm.scaled_upper_triang_masked_softmax(
+            t.reshape(-1, 512, 512), 1.0),
+        lambda t: sm.scaled_upper_triang_masked_softmax_ref(
+            t.reshape(-1, 512, 512), 1.0), x))
+
+    # xentropy at BERT vocab
+    logits = jax.random.normal(key, (4096, 32768), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.key(1), (4096,), 0, 32768)
+    rows.append(bench_pair(
+        "softmax_cross_entropy", "4096x32768", "bf16",
+        lambda l: xe.softmax_cross_entropy(l, labels),
+        lambda l: xe.softmax_cross_entropy_ref(l, labels), logits))
+
+    # multi-tensor substrate
+    n = 1 << 24
+    p = jax.random.normal(key, (n,), jnp.float32)
+    g = jax.random.normal(jax.random.key(2), (n,), jnp.float32) * 0.01
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.01, step=3, adam_w_mode=True)
+    rows.append(bench_pair(
+        "flat_adam", f"n={n}", "f32",
+        lambda *a: mt.flat_adam(*a, **kw),
+        lambda *a: mt.flat_adam_ref(*a, **kw), p, g, m, v))
+
+    for r in rows:
+        r["backend"] = backend
+        print(json.dumps(r), flush=True)
+    if args.csv:
+        import csv
+        with open(args.csv, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            w.writerows(rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,8 +6,17 @@
 set -u
 cd "$(dirname "$0")/.."
 
+echo "== probe =="
+# bounded probe first: a wedged tunnel blocks jax.devices() forever, and
+# letting pytest hit that just produces an unkillable client
+if ! timeout 180 python -c "import jax; print(jax.devices())"; then
+    echo "probe: tunnel not available (timeout/err); aborting validation"
+    exit 2
+fi
+sleep 60    # etiquette: gap between tunnel clients
+
 echo "== TPU smoke suite =="
-APEX_TPU_SMOKE=1 python -m pytest tests/test_tpu_smoke.py -v \
+APEX_TPU_SMOKE=1 timeout 2700 python -m pytest tests/test_tpu_smoke.py -v \
     > /tmp/smoke_tpu.log 2>&1
 smoke_rc=$?
 tail -5 /tmp/smoke_tpu.log
@@ -18,6 +27,8 @@ if ! grep -qE "[0-9]+ passed" /tmp/smoke_tpu.log; then
     smoke_rc=1
 fi
 echo "smoke rc=$smoke_rc"
+
+sleep 60    # gap before the next client
 
 echo "== bench =="
 python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
