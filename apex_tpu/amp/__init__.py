@@ -26,7 +26,9 @@ from apex_tpu.amp.scaler import (
 from apex_tpu.amp.frontend import (
     AmpState,
     initialize,
+    load_state_dict,
     master_params_to_model_params,
+    state_dict,
     update_scaler,
 )
 from apex_tpu.amp.wrap import auto_cast, cast_inputs
@@ -38,5 +40,6 @@ __all__ = [
     "check_finite", "conditional_step", "scale_loss",
     "scaled_value_and_grad", "unscale_grads", "update_state",
     "AmpState", "initialize", "master_params_to_model_params",
-    "update_scaler", "auto_cast", "cast_inputs", "lists",
+    "update_scaler", "state_dict", "load_state_dict",
+    "auto_cast", "cast_inputs", "lists",
 ]

@@ -149,3 +149,36 @@ def update_scaler(state: AmpState, found_inf) -> AmpState:
         state, scaler=update_state(state.scaler,
                                    jnp.asarray(found_inf, jnp.int32),
                                    state.scaler_config))
+
+
+def state_dict(*states: AmpState) -> dict:
+    """Serialize N amp states as the reference's multi-scaler layout.
+
+    apex's ``amp.initialize(..., num_losses=N)`` keeps N scalers and
+    ``amp.state_dict()`` emits ``{'loss_scaler0': ..., 'loss_scalerN':
+    ...}`` (frontend.py).  The functional analog of num_losses is one
+    AmpState per loss (see examples/dcgan); this helper merges them into
+    the same reference-shaped dict so checkpoints port unchanged.
+    """
+    out = {}
+    for i, s in enumerate(states):
+        out[f"loss_scaler{i}"] = s.state_dict()["loss_scaler0"]
+    return out
+
+
+def load_state_dict(sd: dict, *states: AmpState):
+    """Inverse of ``state_dict(*states)``: returns the restored states
+    (a single AmpState when one was passed, else a tuple in order).
+    Warns on a scaler-count mismatch (reference behavior) — missing
+    entries leave that state's scaler at its config default."""
+    import warnings
+    saved = sum(1 for k in sd if k.startswith("loss_scaler"))
+    if saved != len(states):
+        warnings.warn(
+            f"amp.load_state_dict: checkpoint has {saved} loss scaler(s) "
+            f"but {len(states)} AmpState(s) were passed; unmatched "
+            "states keep their initial scale", stacklevel=2)
+    restored = tuple(
+        s.load_state_dict({"loss_scaler0": sd.get(f"loss_scaler{i}", {})})
+        for i, s in enumerate(states))
+    return restored[0] if len(restored) == 1 else restored

@@ -114,7 +114,12 @@ def main():
         if step % 10 == 0:
             print(f"step {step:3d} lossD {float(lossD):.4f} "
                   f"lossG {float(lossG):.4f}")
-    print(f"OK: D {float(lossD):.3f} G {float(lossG):.3f}")
+    # reference checkpoint shape: one amp.state_dict() covering BOTH
+    # scalers (num_losses=2 -> loss_scaler0/loss_scaler1)
+    sd = amp.state_dict(ampD, ampG)
+    ampD, ampG = amp.load_state_dict(sd, ampD, ampG)
+    print(f"OK: D {float(lossD):.3f} G {float(lossG):.3f} "
+          f"scalers {sorted(sd)}")
 
 
 if __name__ == "__main__":

@@ -137,3 +137,44 @@ def test_conditional_step_jits():
     jitted = jax.jit(train_step)
     params, scaler, loss = jitted(params, scaler, 2.0)
     assert np.isfinite(float(loss))
+
+
+def test_multi_scaler_state_dict_reference_layout():
+    """num_losses parity: N AmpStates serialize as loss_scaler0..N-1
+    (reference amp.state_dict with num_losses=N) and round-trip."""
+    from apex_tpu import amp
+    _, s0 = amp.initialize({"w": jnp.ones((2,))}, opt_level="O2",
+                           loss_scale="dynamic")
+    _, s1 = amp.initialize({"w": jnp.ones((2,))}, opt_level="O2",
+                           loss_scale=128.0)
+    s0 = amp.update_scaler(s0, jnp.int32(1))    # overflow: scale halves
+    sd = amp.state_dict(s0, s1)
+    assert set(sd) == {"loss_scaler0", "loss_scaler1"}
+    assert sd["loss_scaler0"]["loss_scale"] == 2.0 ** 15
+    assert sd["loss_scaler1"]["loss_scale"] == 128.0
+
+    _, f0 = amp.initialize({"w": jnp.ones((2,))}, opt_level="O2",
+                           loss_scale="dynamic")
+    _, f1 = amp.initialize({"w": jnp.ones((2,))}, opt_level="O2",
+                           loss_scale=128.0)
+    r0, r1 = amp.load_state_dict(sd, f0, f1)
+    assert float(r0.scaler.loss_scale) == 2.0 ** 15
+    assert float(r1.scaler.loss_scale) == 128.0
+    # single-state form returns a bare AmpState
+    r = amp.load_state_dict(amp.state_dict(s1), f1)
+    assert float(r.scaler.loss_scale) == 128.0
+
+
+def test_multi_scaler_load_warns_on_count_mismatch():
+    import warnings
+    from apex_tpu import amp
+    _, s0 = amp.initialize({"w": jnp.ones((2,))}, opt_level="O2",
+                           loss_scale=64.0)
+    _, s1 = amp.initialize({"w": jnp.ones((2,))}, opt_level="O2",
+                           loss_scale=32.0)
+    sd = amp.state_dict(s0)                      # one saved scaler
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        r0, r1 = amp.load_state_dict(sd, s0, s1)  # two states passed
+    assert any("loss scaler" in str(x.message) for x in w)
+    assert float(r0.scaler.loss_scale) == 64.0
