@@ -30,7 +30,8 @@ import jax.numpy as jnp
 
 from apex_tpu import comm
 from apex_tpu.normalization import FusedLayerNorm
-from apex_tpu.ops.attention import flash_attention, ring_attention
+from apex_tpu.ops.attention import (flash_attention, ring_attention,
+                                    ulysses_attention)
 from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
 from apex_tpu.transformer import tensor_parallel as tp
 from apex_tpu.transformer.tensor_parallel import mappings
@@ -42,7 +43,9 @@ class GPTLayer(nn.Module):
     ffn_hidden_size: Optional[int] = None
     sequence_parallel: bool = False
     use_rope: bool = False
-    context_parallel: bool = False     # ring attention over "ctx" axis
+    context_parallel: bool = False     # attention over the "ctx" axis
+    cp_strategy: str = "ring"          # "ring" (ppermute) | "ulysses"
+                                       # (all_to_all; local_heads % cp == 0)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -103,7 +106,14 @@ class GPTLayer(nn.Module):
                 return jnp.transpose(t_sbhd, (1, 2, 0, 3))
             q, k = rope(q), rope(k)
         if self.context_parallel:
-            attn = ring_attention(q, k, v, causal=True)
+            if self.cp_strategy == "ulysses":
+                attn = ulysses_attention(q, k, v, causal=True)
+            elif self.cp_strategy == "ring":
+                attn = ring_attention(q, k, v, causal=True)
+            else:
+                raise ValueError(
+                    f"cp_strategy must be 'ring' or 'ulysses', got "
+                    f"{self.cp_strategy!r}")
         else:
             attn = flash_attention(q, k, v, causal=True)
         attn = jnp.transpose(attn, (2, 0, 1, 3)).reshape(

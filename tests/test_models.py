@@ -161,3 +161,38 @@ def test_bert_forward_shapes_and_mask():
     assert y.shape == (12, 2, 32)
     logits = model.mlm_logits(variables, tokens, attention_mask=amask)
     assert logits.shape == (12, 2, 64)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_gpt_layer_context_parallel_matches_full(strategy):
+    """A GPT layer with its sequence sharded over the ctx axis (either
+    cp strategy, RoPE with global position offsets) == the same layer
+    on the full sequence."""
+    from apex_tpu.models.gpt import GPTLayer
+    H, NH, S, B = 32, 4, 32, 2
+    x = jax.random.normal(jax.random.key(0), (S, B, H))
+
+    comm.initialize(data=8)    # ctx axis size 1: plain full-seq oracle
+    full = GPTLayer(H, NH, use_rope=True)
+    params = full.init(jax.random.key(1), x)
+    y_ref = full.apply(params, x)
+    comm.destroy()
+
+    mesh = comm.initialize(ctx=4)
+    cp_layer = GPTLayer(H, NH, use_rope=True, context_parallel=True,
+                        cp_strategy=strategy)
+    y_cp = jax.jit(comm.shard_map(
+        lambda p, xx: cp_layer.apply(p, xx), mesh,
+        in_specs=(P(), P(comm.AXIS_CTX, None, None)),
+        out_specs=P(comm.AXIS_CTX, None, None)))(params, x)
+    np.testing.assert_allclose(np.asarray(y_cp), np.asarray(y_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_layer_rejects_unknown_cp_strategy():
+    # the raise happens at trace time, before any collective — no mesh
+    # or shard_map needed
+    from apex_tpu.models.gpt import GPTLayer
+    layer = GPTLayer(32, 4, context_parallel=True, cp_strategy="nope")
+    with pytest.raises(ValueError, match="ring.*ulysses|ulysses.*ring"):
+        layer.init(jax.random.key(0), jnp.zeros((8, 2, 32)))
