@@ -22,6 +22,12 @@ def on_tpu() -> bool:
 
 def interpret_mode() -> bool:
     """True when pallas_call must run interpreted (non-TPU backends)."""
+    if os.environ.get("APEX_TPU_FORCE_MOSAIC", "") == "1":
+        # AOT TPU lowering on a CPU host: Mosaic kernel serialization and
+        # its verifier run at lowering time, no device needed
+        # (tests/test_tpu_lowering.py) — checked per call so tests can
+        # flip it with monkeypatch
+        return False
     if _FORCE_INTERPRET:
         return True
     return not on_tpu()

@@ -112,14 +112,32 @@ def _rows_call(kernel, out_dtype, x2d, br):
 # public ops with custom_vjp
 # ---------------------------------------------------------------------------
 
+def _check_static_scale(scale):
+    """scale is a compile-time constant (custom_vjp nondiff arg, like the
+    reference's Python-float attribute); jitting the raw op with scale as
+    a traced argument would die deep in custom_vjp with an opaque
+    UnexpectedTracerError — fail early with the fix instead."""
+    if isinstance(scale, jax.core.Tracer):
+        raise TypeError(
+            "scale must be a static Python number (it is non-"
+            "differentiable); when jitting this op directly, mark it "
+            "static: jax.jit(fn, static_argnums=(<scale position>,))")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scaled_masked_softmax_p(x, mask, scale):
+    return _sms_fwd(x, mask, scale)[0]
+
+
 def scaled_masked_softmax(x, mask, scale):
     """softmax(x*scale masked_fill(mask, -10000)) over the last dim.
 
     x: (b, np, sq, sk); mask: (b, 1, sq, sk) with nonzero = masked, or
-    None.  Reference: scaled_masked_softmax_cuda.forward.
+    None.  scale: static Python number.  Reference:
+    scaled_masked_softmax_cuda.forward.
     """
-    return _sms_fwd(x, mask, scale)[0]
+    _check_static_scale(scale)
+    return _scaled_masked_softmax_p(x, mask, scale)
 
 
 def _sms_fwd(x, mask, scale):
@@ -187,14 +205,20 @@ def _softmax_vjp(y, dy, scale):
     return dx[:rows].reshape(y.shape)
 
 
-scaled_masked_softmax.defvjp(_sms_fwd, _sms_bwd)
+_scaled_masked_softmax_p.defvjp(_sms_fwd, _sms_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _scaled_upper_triang_masked_softmax_p(x, scale):
+    return _suts_fwd(x, scale)[0]
+
+
 def scaled_upper_triang_masked_softmax(x, scale):
     """Causal softmax(x*scale) for (attn_batches, sq, sq) inputs.
-    Reference: scaled_upper_triang_masked_softmax_cuda.forward."""
-    return _suts_fwd(x, scale)[0]
+    scale: static Python number.  Reference:
+    scaled_upper_triang_masked_softmax_cuda.forward."""
+    _check_static_scale(scale)
+    return _scaled_upper_triang_masked_softmax_p(x, scale)
 
 
 def _suts_fwd(x, scale):
@@ -215,7 +239,7 @@ def _suts_bwd(scale, y, dy):
     return (_softmax_vjp(y, dy, scale),)
 
 
-scaled_upper_triang_masked_softmax.defvjp(_suts_fwd, _suts_bwd)
+_scaled_upper_triang_masked_softmax_p.defvjp(_suts_fwd, _suts_bwd)
 
 
 # ---------------------------------------------------------------------------

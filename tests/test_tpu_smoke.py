@@ -182,7 +182,9 @@ def test_scaled_masked_softmax(dtype):
     b, h, sq, sk = 2, 4, 256, 256
     x = jax.random.normal(jax.random.key(0), (b, h, sq, sk), dtype)
     mask = jax.random.bernoulli(jax.random.key(1), 0.2, (b, 1, sq, sk))
-    y = jax.jit(sm.scaled_masked_softmax)(x, mask, 0.83)
+    # scale is a nondiff/static arg — jitting it traced is a TypeError
+    y = jax.jit(sm.scaled_masked_softmax,
+                static_argnums=(2,))(x, mask, 0.83)
     _close(y, sm.scaled_masked_softmax_ref(x, mask, 0.83), dtype)
 
 
@@ -191,7 +193,8 @@ def test_scaled_upper_triang_masked_softmax(dtype):
     from apex_tpu.ops import softmax as sm
     a, sq = 8, 512
     x = jax.random.normal(jax.random.key(0), (a, sq, sq), dtype)
-    y = jax.jit(sm.scaled_upper_triang_masked_softmax)(x, 0.5)
+    y = jax.jit(sm.scaled_upper_triang_masked_softmax,
+                static_argnums=(1,))(x, 0.5)
     _close(y, sm.scaled_upper_triang_masked_softmax_ref(x, 0.5), dtype)
     g = jax.jit(jax.grad(
         lambda x: jnp.sum(
