@@ -24,6 +24,27 @@ Pytree = Any
 tree_map = jax.tree_util.tree_map
 
 
+def _host_sharding(x: jax.Array):
+    """The array's own sharding, re-homed to pinned host memory (the
+    TPU host-offload target; CPU also exposes the kind)."""
+    return x.sharding.with_memory_kind("pinned_host")
+
+
+def place_on_host(tree: Pytree) -> Pytree:
+    """Eagerly move every array leaf to pinned host memory, preserving
+    its device/mesh sharding."""
+    return tree_map(
+        lambda x: jax.device_put(x, _host_sharding(x))
+        if isinstance(x, jax.Array) else x, tree)
+
+
+def place_on_device(tree: Pytree) -> Pytree:
+    return tree_map(
+        lambda x: jax.device_put(
+            x, x.sharding.with_memory_kind("device"))
+        if isinstance(x, jax.Array) else x, tree)
+
+
 def unzip_tree(like: Pytree, tree_of_tuples: Pytree, n: int):
     """pytree-of-n-tuples -> n-tuple of pytrees (robust to tuples INSIDE
     the params pytree, unlike is_leaf=isinstance(tuple))."""
@@ -42,7 +63,8 @@ class FusedOptimizerBase:
     """Subclasses set ``defaults`` and implement ``_step_math``."""
 
     def __init__(self, params: Pytree, master_weights: Optional[bool] = None,
-                 masters: Optional[Pytree] = None, **hypers):
+                 masters: Optional[Pytree] = None,
+                 offload_state: bool = False, **hypers):
         self.hypers: Dict[str, Any] = dict(self.defaults)
         unknown = set(hypers) - set(self.hypers)
         if unknown:
@@ -80,7 +102,29 @@ class FusedOptimizerBase:
         self.opt_state = self.init_state(masters if masters is not None
                                          else params)
         self.step_count = jnp.int32(0)
-        self._jit_step = jax.jit(self._full_step)
+        # Host-offloaded optimizer state (beyond-reference; the HBM
+        # relief the reference gets from ZeRO sharding alone).  On TPU
+        # the step is ONE program: state transfers in from pinned host,
+        # math runs on device, out_shardings land the new state back on
+        # host (XLA overlaps the DMAs with compute).  Elsewhere (CPU CI)
+        # the in-jit placement custom call doesn't exist, so step()
+        # moves the state eagerly around a plain device step.
+        self.offload_state = offload_state
+        self._fused_offload = False
+        if offload_state:
+            from apex_tpu.ops._dispatch import on_tpu
+            self.opt_state = place_on_host(self.opt_state)
+            self._fused_offload = on_tpu()
+            if self._fused_offload:
+                self._jit_step = jax.jit(
+                    self._full_step_offload,
+                    out_shardings=(None, None,
+                                   tree_map(_host_sharding,
+                                            self.opt_state)))
+            else:
+                self._jit_step = jax.jit(self._full_step)
+        else:
+            self._jit_step = jax.jit(self._full_step)
 
     # ---- functional core -------------------------------------------------
     def init_state(self, params: Pytree) -> Pytree:
@@ -102,6 +146,16 @@ class FusedOptimizerBase:
             return new_params, new_work, opt_state
         return new_work, None, opt_state
 
+    def _full_step_offload(self, params, masters, opt_state, grads, step,
+                           grad_scale, hypers):
+        """TPU fused-offload step body: pull state from pinned host at
+        the top; out_shardings push the new state back."""
+        opt_state = tree_map(
+            lambda x: jax.device_put(x, jax.memory.Space.Device),
+            opt_state)
+        return self._full_step(params, masters, opt_state, grads, step,
+                               grad_scale, hypers)
+
     def functional_step(self, params, opt_state, grads, step, grad_scale=1.0):
         """Embed-in-your-own-jit entry point (no master handling)."""
         return self._step_math(params, grads, opt_state, step,
@@ -112,12 +166,18 @@ class FusedOptimizerBase:
     def step(self, grads: Pytree, grad_scale=1.0) -> Pytree:
         """Apply one update; returns (and stores) the new params."""
         self.step_count = self.step_count + 1
+        state = self.opt_state
+        eager_offload = self.offload_state and not self._fused_offload
+        if eager_offload:   # CPU fallback: explicit round trip
+            state = place_on_device(state)
         self.params, self.masters, self.opt_state = self._jit_step(
-            self.params, self.masters, self.opt_state, grads,
+            self.params, self.masters, state, grads,
             self.step_count, jnp.asarray(grad_scale, jnp.float32),
             {k: jnp.asarray(v, jnp.float32) if isinstance(v, float) else v
              for k, v in self.hypers.items()
              if isinstance(v, (int, float)) and not isinstance(v, bool)})
+        if eager_offload:
+            self.opt_state = place_on_host(self.opt_state)
         return self.params
 
     def zero_grad(self):
@@ -136,6 +196,12 @@ class FusedOptimizerBase:
         self.step_count = jnp.int32(sd["step"])
         self.hypers.update(sd["hypers"])
         self.opt_state = sd["state"]
+        if self.offload_state:
+            # restore must respect the host-residency invariant NOW —
+            # waiting for the next step to re-home it would leave the
+            # full f32 state in HBM at exactly the tight-memory moment
+            # offloading exists for
+            self.opt_state = place_on_host(self.opt_state)
         if sd.get("masters") is not None:
             self.masters = sd["masters"]
 
