@@ -27,6 +27,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from apex_tpu import comm
 from apex_tpu.normalization import FusedLayerNorm
@@ -118,11 +119,15 @@ class GPTLayer(nn.Module):
             attn = flash_attention(q, k, v, causal=True)
         attn = jnp.transpose(attn, (2, 0, 1, 3)).reshape(
             s_full, b, local_heads * head_dim)
+        # offload tags (no-ops outside remat): the two largest
+        # activations, usable with apex_tpu.offload.offload_checkpoint
+        attn = checkpoint_name(attn, "attn_out")
         x = x + proj(attn).astype(x.dtype)
 
         # --- mlp block ---
         y = ln2(x).astype(self.dtype)
-        y = jax.nn.gelu(fc1(y), approximate=True)
+        y = checkpoint_name(jax.nn.gelu(fc1(y), approximate=True),
+                            "ffn_hidden")
         x = x + fc2(y).astype(x.dtype)
         return x
 

@@ -1,0 +1,60 @@
+"""Host-memory offload utilities (beyond-reference TPU extension).
+
+HBM is the scarce resource on TPU; pinned host memory rides the same
+PCIe/DMA engines XLA already overlaps with compute.  Two offload tiers:
+
+- **Optimizer state**: ``FusedAdam(..., offload_state=True)`` (see
+  apex_tpu.optimizers) — helpers ``place_on_host`` / ``place_on_device``
+  re-exported here.
+- **Activations under rematerialization**: ``offload_checkpoint`` is
+  ``jax.checkpoint`` with a save-to-host policy — activations tagged
+  with ``checkpoint_name`` stream to pinned host memory in the forward
+  pass and back for backward, instead of being recomputed (FLOPs) or
+  held in HBM (memory).  The reference has no analog (its
+  ``tensor_parallel.checkpoint`` recomputes only).
+
+Example::
+
+    from apex_tpu.offload import offload_checkpoint, checkpoint_name
+
+    def block(params, x):
+        h = checkpoint_name(big_ffn_hidden(params, x), "ffn_hidden")
+        return out_proj(params, h)
+
+    y = offload_checkpoint(block, offload_names=("ffn_hidden",))(p, x)
+
+GPT layers pre-tag their two largest activations as ``"attn_out"`` and
+``"ffn_hidden"`` (apex_tpu.models.gpt), so
+``offload_checkpoint(layer.apply, offload_names=("ffn_hidden",))`` works
+out of the box.  ``checkpoint_name`` is a no-op marker outside a remat
+scope.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax.ad_checkpoint import checkpoint_name
+
+from apex_tpu.optimizers._base import place_on_device, place_on_host
+
+__all__ = ["checkpoint_name", "offload_checkpoint", "place_on_host",
+           "place_on_device"]
+
+
+def offload_checkpoint(fn: Callable,
+                       offload_names: Sequence[str],
+                       save_names: Sequence[str] = (),
+                       offload_dst: str = "pinned_host") -> Callable:
+    """Rematerialize ``fn`` with named activations offloaded to host.
+
+    offload_names: ``checkpoint_name`` tags whose values are saved to
+    ``offload_dst`` (streamed back for backward).  save_names: tags kept
+    in device memory.  Everything untagged is recomputed.
+    """
+    policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=list(save_names),
+        names_which_can_be_offloaded=list(offload_names),
+        offload_src="device", offload_dst=offload_dst)
+    return jax.checkpoint(fn, policy=policy)
