@@ -41,6 +41,10 @@ SUITES = {
                         "tests/test_wgrad.py"],
     "run_checkpoint": ["tests/test_native_checkpoint.py"],
     "run_models": ["tests/test_models.py"],
+    "run_data": ["tests/test_data.py"],
+    "run_offload": ["tests/test_offload.py"],
+    # AOT Mosaic lowering for the TPU platform — runs in CPU CI
+    "run_tpu_lowering": ["tests/test_tpu_lowering.py"],
     # TPU-only: needs APEX_TPU_SMOKE=1 and a real chip (else skips)
     "run_tpu_smoke": ["tests/test_tpu_smoke.py"],
 }
