@@ -52,19 +52,61 @@ _MESH: Optional[Mesh] = None
 _CONFIG: Optional[MeshConfig] = None
 
 
+def _device_array(devices, cfg: "MeshConfig", physical: bool):
+    """Lay devices out as (data, pipe, ctx, model).
+
+    ``physical=True`` asks mesh_utils for a topology-aware assignment:
+    on a TPU slice the minor axes land on ICI-adjacent chips (the naive
+    list reshape can put a TP group across the torus), and on
+    multi-slice topologies (distinct ``slice_index``) the DATA axis is
+    mapped over DCN with everything else inside each slice
+    (create_hybrid_device_mesh).  Falls back to the plain reshape when
+    the topology is unknown to mesh_utils (CPU host devices, odd
+    shapes) — layout is a performance choice, never a correctness one.
+    """
+    shape = (cfg.data, cfg.pipe, cfg.ctx, cfg.model)
+    if physical:
+        try:
+            from jax.experimental import mesh_utils
+            slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+            if len(slice_ids) > 1 and cfg.data % len(slice_ids) == 0:
+                return mesh_utils.create_hybrid_device_mesh(
+                    (cfg.data // len(slice_ids), cfg.pipe, cfg.ctx,
+                     cfg.model),
+                    (len(slice_ids), 1, 1, 1), devices=devices)
+            return mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True)
+        except Exception as e:
+            # mesh_utils has no assignment for this topology; the
+            # reshape below is always valid.  On real TPUs the silent
+            # difference would be a collective-latency regression, so
+            # make the degradation observable.
+            if getattr(devices[0], "platform", "") == "tpu":
+                import warnings
+                warnings.warn(
+                    "comm.initialize: topology-aware mesh layout "
+                    f"failed ({type(e).__name__}: {e}); falling back "
+                    "to naive device-list reshape — TP groups may span "
+                    "the torus/DCN", stacklevel=3)
+    return np.asarray(devices).reshape(shape)
+
+
 def initialize(
     data: int = -1,
     pipe: int = 1,
     ctx: int = 1,
     model: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    physical: bool = True,
 ) -> Mesh:
     """Build and install the global mesh.
 
     ``data=-1`` infers the data axis from the device count (reference
     behavior: data-parallel size = world_size / (tp * pp)).  The device
     array is laid out so that the "model" axis is minor: tensor-parallel
-    collectives (the chattiest) land on physically adjacent chips.
+    collectives (the chattiest) land on physically adjacent chips;
+    ``physical=True`` additionally uses the platform topology (ICI
+    torus, DCN slices) for the assignment — see ``_device_array``.
     """
     global _MESH, _CONFIG
     if devices is None:
@@ -83,7 +125,7 @@ def initialize(
             f"mesh {dataclasses.asdict(cfg)} wants {cfg.world_size} devices, "
             f"have {n}"
         )
-    dev_array = np.asarray(devices).reshape(data, pipe, ctx, model)
+    dev_array = _device_array(devices, cfg, physical)
     _MESH = Mesh(dev_array, MESH_AXES)
     _CONFIG = cfg
     return _MESH

@@ -86,3 +86,17 @@ def test_initialize_distributed_env_var_triggers(monkeypatch):
                         lambda **kw: called.update(dict(kw, hit=True)))
     comm.initialize_distributed(data=8)
     assert called.get("hit"), "env coordinator must trigger the handshake"
+
+
+def test_physical_mesh_layout_covers_all_devices():
+    """physical=True routes through mesh_utils; every device appears
+    exactly once and axis sizes match, on any backend."""
+    mesh = comm.initialize(data=2, model=4, physical=True)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2, "pipe": 1, "ctx": 1, "model": 4}
+    ids = [d.id for d in mesh.devices.ravel()]
+    assert sorted(ids) == sorted(d.id for d in jax.devices())
+    comm.destroy()
+    # the naive layout stays available
+    mesh2 = comm.initialize(data=2, model=4, physical=False)
+    assert sorted(d.id for d in mesh2.devices.ravel()) == sorted(ids)
