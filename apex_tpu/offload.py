@@ -39,8 +39,20 @@ from jax.ad_checkpoint import checkpoint_name
 
 from apex_tpu.optimizers._base import place_on_device, place_on_host
 
-__all__ = ["checkpoint_name", "offload_checkpoint", "place_on_host",
-           "place_on_device"]
+__all__ = ["checkpoint_name", "offload_checkpoint", "offload_policy",
+           "place_on_host", "place_on_device"]
+
+
+def offload_policy(offload_names: Sequence[str],
+                   save_names: Sequence[str] = (),
+                   offload_dst: str = "pinned_host"):
+    """The remat policy behind ``offload_checkpoint``, exposed for
+    wrappers that take a policy directly (e.g. ``flax.linen.remat(Block,
+    policy=offload_policy(("ffn_hidden",)))``)."""
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=list(save_names),
+        names_which_can_be_offloaded=list(offload_names),
+        offload_src="device", offload_dst=offload_dst)
 
 
 def offload_checkpoint(fn: Callable,
@@ -53,8 +65,5 @@ def offload_checkpoint(fn: Callable,
     ``offload_dst`` (streamed back for backward).  save_names: tags kept
     in device memory.  Everything untagged is recomputed.
     """
-    policy = jax.checkpoint_policies.save_and_offload_only_these_names(
-        names_which_can_be_saved=list(save_names),
-        names_which_can_be_offloaded=list(offload_names),
-        offload_src="device", offload_dst=offload_dst)
-    return jax.checkpoint(fn, policy=policy)
+    return jax.checkpoint(fn, policy=offload_policy(
+        offload_names, save_names, offload_dst))

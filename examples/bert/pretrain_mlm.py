@@ -39,6 +39,9 @@ def parse_args():
     p.add_argument("--weight-decay", type=float, default=0.01)
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (see apex_tpu.platform)")
+    p.add_argument("--offload-state", action="store_true",
+                   help="keep LAMB state in pinned host memory "
+                        "(apex_tpu.offload)")
     return p.parse_args()
 
 
@@ -66,7 +69,8 @@ def main():
     params, amp_state = amp.initialize(params, opt_level=args.opt_level)
     opt = FusedLAMB(params, lr=args.lr, weight_decay=args.weight_decay,
                     master_weights=bool(amp_state.properties.master_weights),
-                    masters=amp_state.master_params)
+                    masters=amp_state.master_params,
+                    offload_state=args.offload_state)
 
     def loss_fn(p, tokens, labels):
         logits = model.mlm_logits({"params": p}, tokens)   # (s,b,V) f32

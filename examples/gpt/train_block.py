@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 import apex_tpu
 from apex_tpu import amp
+from apex_tpu.offload import checkpoint_name
 from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.optimizers import FusedAdam
@@ -42,7 +43,9 @@ class Block(nn.Module):
         h = FusedLayerNorm(self.hidden, name="ln2")(x)
         h = nn.Dense(4 * self.hidden, dtype=self.dtype,
                      param_dtype=jnp.float32, name="fc1")(h)
-        h = jax.nn.gelu(h)
+        # offload tag: no-op unless the block runs under an offload
+        # remat policy (--offload-activations)
+        h = checkpoint_name(jax.nn.gelu(h), "ffn_hidden")
         h = nn.Dense(self.hidden, dtype=self.dtype,
                      param_dtype=jnp.float32, name="fc2")(h)
         return x + h
@@ -55,6 +58,7 @@ class GPTBlocks(nn.Module):
     layers: int
     max_seq: int
     dtype: jnp.dtype = jnp.bfloat16
+    offload_activations: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -65,9 +69,16 @@ class GPTBlocks(nn.Module):
                          (self.max_seq, self.hidden), jnp.float32)
         x = emb[tokens] + pos[:s][None]
         x = jnp.transpose(x, (1, 0, 2)).astype(self.dtype)  # (s, b, h)
+        blk_cls = Block
+        if self.offload_activations:
+            # remat each block; the tagged ffn hidden streams to pinned
+            # host memory instead of being held or recomputed
+            from apex_tpu.offload import offload_policy
+            blk_cls = nn.remat(Block,
+                               policy=offload_policy(("ffn_hidden",)))
         for i in range(self.layers):
-            x = Block(self.hidden, self.heads, self.dtype,
-                      name=f"block{i}")(x)
+            x = blk_cls(self.hidden, self.heads, self.dtype,
+                        name=f"block{i}")(x)
         x = FusedLayerNorm(self.hidden, name="lnf")(x)
         return jnp.dot(x.astype(jnp.float32), emb.T)        # (s, b, V)
 
@@ -83,6 +94,10 @@ def parse_args():
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (see apex_tpu.platform)")
+    p.add_argument("--offload-activations", action="store_true",
+                   help="remat blocks with the ffn hidden streamed to "
+                        "pinned host memory (apex_tpu.offload); "
+                        "TPU-backend feature")
     return p.parse_args()
 
 
@@ -98,7 +113,8 @@ def main():
     batch = args.batch_size or (8 if on_tpu else 2)
     vocab = 2048 if not on_tpu else 50257
 
-    model = GPTBlocks(vocab, hidden, heads, layers, max_seq=max(seq, 128))
+    model = GPTBlocks(vocab, hidden, heads, layers, max_seq=max(seq, 128),
+                      offload_activations=args.offload_activations)
     print(f"apex_tpu {apex_tpu.__version__}: gpt-block L{layers} "
           f"h{hidden} b{batch} s{seq} on {jax.default_backend()}")
 
