@@ -1,0 +1,162 @@
+"""Int8 inference quantization (beyond-reference TPU extension).
+
+The reference's perf toolbox ends at fp16 + 2:4 sparsity (ASP); TPUs
+have a different fast path: the MXU runs int8×int8→int32 at twice the
+bf16 rate (v5e: ~394 TOPS vs ~197 TFLOPS), and int8 weights halve HBM
+traffic for bandwidth-bound inference.  Two modes, composable per layer:
+
+- **weight-only** (``int8_matmul(..., dynamic=False)``): weights stored
+  int8 + per-channel f32 scales, dequantized into the matmul operand —
+  XLA fuses the dequant into the dot's operand read, so the win is
+  weight memory/bandwidth (activation precision untouched).
+- **dynamic full-int8** (``dynamic=True``): activations are quantized
+  per-row at runtime (dynamic symmetric), the dot runs int8×int8 on the
+  MXU with i32 accumulation, and the output is rescaled by
+  (row_scale × channel_scale).
+
+``quantize_model`` walks a params pytree and replaces selected float
+matrices with ``QTensor``s; ``QuantDense`` mirrors
+apex_tpu.fused_dense.FusedDense's contract for drop-in inference.
+Training stays in bf16/f32 — this is an inference tier, like the
+reference's ASP is a post-training tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QTensor:
+    """Symmetric per-channel int8 weight: ``w ≈ q * scale``.
+
+    q: int8, same shape as the original weight; scale: f32, shape 1 on
+    ``axis`` (the contraction dim keeps full length).
+    """
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):   # the "logical" dtype callers see
+        return self.scale.dtype
+
+
+def _symmetric_int8(x: jax.Array, axis: int):
+    """The one symmetric-int8 formula (weights AND activations):
+    per-slice amax → scale, round, clip."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                   keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_int8(w: jax.Array, axis: int = 0) -> QTensor:
+    """Symmetric per-channel int8 quantization.
+
+    axis: the CONTRACTION axis (reduced in the matmul) — scales are
+    per-output-channel, i.e. per element of the other axes.
+    """
+    q, scale = _symmetric_int8(w, axis)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize(t: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (t.q.astype(jnp.float32) * t.scale).astype(dtype)
+
+
+def _dynamic_quant_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8 for activations (x: (..., K))."""
+    return _symmetric_int8(x, axis=-1)
+
+
+def int8_matmul(x: jax.Array, w: QTensor, *,
+                dynamic: bool = False) -> jax.Array:
+    """``x @ dequant(w)`` with int8 weights; w quantized on axis 0
+    (shape (K, N), scale (1, N)).
+
+    dynamic=False: weight-only — dequant folds into the dot operand.
+    dynamic=True: per-row activation quant + int8×int8 MXU dot with i32
+    accumulation, rescaled to x's dtype.
+    """
+    if not dynamic:
+        return jax.lax.dot_general(
+            x, dequantize(w, x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x.dtype)
+    qx, sx = _dynamic_quant_rows(x)
+    acc = jax.lax.dot_general(
+        qx, w.q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    # squeeze the (1, N) channel scale so a 1-D x keeps rank 1 (matches
+    # the weight-only path's (..., In) -> (..., Out) contract)
+    return (acc.astype(jnp.float32) * sx
+            * jnp.squeeze(w.scale, axis=0)).astype(x.dtype)
+
+
+def quantize_model(params: Pytree,
+                   predicate: Optional[Callable[[tuple, jax.Array],
+                                                bool]] = None,
+                   axis: int = 0) -> Pytree:
+    """Replace selected float matrices in a params pytree with QTensors.
+
+    predicate(path, leaf) -> bool decides per leaf; default: every
+    floating 2D+ array (weights), leaving 1D (biases/norm params) alone.
+    The result is still a pytree — checkpoints, tree_map, and jit all
+    work on it unchanged.
+    """
+    if predicate is None:
+        def predicate(path, leaf):
+            return (hasattr(leaf, "ndim") and leaf.ndim >= 2
+                    and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+    def visit(path, leaf):
+        if predicate(path, leaf):
+            return quantize_int8(leaf, axis=axis)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(
+        visit, params, is_leaf=lambda x: isinstance(x, QTensor))
+
+
+class QuantDense:
+    """Inference drop-in for fused_dense.FusedDense over int8 weights.
+
+    >>> qd = QuantDense.from_weights(weight, bias, dynamic=True)
+    >>> y = qd(x)        # x (..., In) -> (..., Out)
+
+    weight follows the reference layout (Out, In) — quantization is
+    per-Out-channel over the In contraction.
+    """
+
+    def __init__(self, qweight: QTensor, bias: Optional[jax.Array] = None,
+                 dynamic: bool = False):
+        self.qweight = qweight    # stored (In, Out), scale (1, Out)
+        self.bias = bias
+        self.dynamic = dynamic
+
+    @classmethod
+    def from_weights(cls, weight: jax.Array,
+                     bias: Optional[jax.Array] = None,
+                     dynamic: bool = False) -> "QuantDense":
+        # (Out, In) -> transpose once at quantization time so the hot
+        # matmul is a plain (…, In) @ (In, Out)
+        return cls(quantize_int8(jnp.transpose(weight), axis=0),
+                   bias=bias, dynamic=dynamic)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = int8_matmul(x, self.qweight, dynamic=self.dynamic)
+        if self.bias is not None:
+            y = y + self.bias.astype(y.dtype)
+        return y

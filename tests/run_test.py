@@ -43,6 +43,7 @@ SUITES = {
     "run_models": ["tests/test_models.py"],
     "run_data": ["tests/test_data.py"],
     "run_offload": ["tests/test_offload.py"],
+    "run_quantization": ["tests/test_quantization.py"],
     # AOT Mosaic lowering for the TPU platform — runs in CPU CI
     "run_tpu_lowering": ["tests/test_tpu_lowering.py"],
     # TPU-only: needs APEX_TPU_SMOKE=1 and a real chip (else skips)

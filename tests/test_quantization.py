@@ -1,0 +1,85 @@
+"""apex_tpu.quantization — int8 inference tier (beyond reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.quantization import (QTensor, QuantDense, dequantize,
+                                   int8_matmul, quantize_int8,
+                                   quantize_model)
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.key(0), (128, 64)) * 0.3
+    t = quantize_int8(w, axis=0)
+    assert t.q.dtype == jnp.int8 and t.scale.shape == (1, 64)
+    err = np.abs(np.asarray(dequantize(t, jnp.float32)) - np.asarray(w))
+    # symmetric int8: per-channel max error <= scale/2
+    assert (err <= np.asarray(t.scale) / 2 + 1e-7).all()
+
+
+def test_weight_only_matmul_close_to_f32():
+    k = jax.random.key(1)
+    x = jax.random.normal(k, (8, 256), jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(2), (256, 64)) * 0.1
+    y_ref = np.asarray(x.astype(jnp.float32) @ w)
+    y = int8_matmul(x, quantize_int8(w), dynamic=False)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=0.05, atol=0.12)
+
+
+def test_dynamic_int8_matmul_close_to_f32():
+    x = jax.random.normal(jax.random.key(3), (8, 256), jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(4), (256, 64)) * 0.1
+    y_ref = np.asarray(x.astype(jnp.float32) @ w)
+    y = int8_matmul(x, quantize_int8(w), dynamic=True)
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=0.08, atol=0.15)
+
+
+def test_quantize_model_default_predicate():
+    params = {"dense": {"kernel": jnp.ones((32, 16)),
+                        "bias": jnp.zeros((16,))},
+              "ln": {"scale": jnp.ones((32,))}}
+    q = quantize_model(params)
+    assert isinstance(q["dense"]["kernel"], QTensor)
+    assert q["dense"]["bias"].shape == (16,)       # 1D untouched
+    assert q["ln"]["scale"].shape == (32,)
+    # still a pytree: jit/tree_map work
+    n = len(jax.tree_util.tree_leaves(q))
+    assert n == 4   # q + scale + bias + ln.scale
+
+
+@pytest.mark.parametrize("dynamic", [False, True])
+def test_quant_dense_matches_fused_dense(dynamic):
+    from apex_tpu.fused_dense import fused_dense_function
+    w = jax.random.normal(jax.random.key(5), (64, 256)) * 0.05  # (Out, In)
+    b = jax.random.normal(jax.random.key(6), (64,)) * 0.1
+    x = jax.random.normal(jax.random.key(7), (4, 256), jnp.bfloat16)
+    y_ref = np.asarray(fused_dense_function(x, w, b), np.float32)
+    qd = QuantDense.from_weights(w, b, dynamic=dynamic)
+    y = qd(x)
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=0.1, atol=0.15)
+
+
+@pytest.mark.parametrize("dynamic", [False, True])
+def test_int8_matmul_lowers_for_tpu(dynamic):
+    """Both modes must lower for the TPU platform (AOT, no device)."""
+    x = jnp.zeros((128, 512), jnp.bfloat16)
+    w = quantize_int8(jnp.zeros((512, 256)))
+    jax.jit(lambda x, q, s: int8_matmul(
+        x, QTensor(q=q, scale=s), dynamic=dynamic)).trace(
+        x, w.q, w.scale).lower(lowering_platforms=("tpu",))
+
+
+@pytest.mark.parametrize("dynamic", [False, True])
+def test_int8_matmul_rank1_contract(dynamic):
+    """1-D input keeps rank 1 in BOTH modes (code-review r2 finding)."""
+    x = jax.random.normal(jax.random.key(8), (256,), jnp.bfloat16)
+    w = quantize_int8(jax.random.normal(jax.random.key(9),
+                                        (256, 64)) * 0.1)
+    y = int8_matmul(x, w, dynamic=dynamic)
+    assert y.shape == (64,)
