@@ -29,6 +29,13 @@ Pytree = Any
 _MAGIC = "APEX_TPU_CKPT_V1"
 
 
+class TemplateMismatchError(ValueError):
+    """The checkpoint is intact but does not fit the caller's template
+    (different tree/shape/dtype) — a caller bug, NOT file corruption.
+    Recovery flows (resilience.restore_latest) must not treat it as a
+    corrupt file to skip."""
+
+
 def _resolve_dtype(name: str) -> np.dtype:
     """np.dtype('bfloat16') fails in stock numpy; resolve extended types
     through jnp (ml_dtypes)."""
@@ -101,14 +108,14 @@ def load_checkpoint(path: str, like: Pytree,
         raise ValueError(f"{path} is not an apex_tpu checkpoint")
     leaves, treedef = jax.tree_util.tree_flatten(like)
     if len(leaves) != len(header["shapes"]):
-        raise ValueError(
+        raise TemplateMismatchError(
             f"checkpoint has {len(header['shapes'])} leaves, template "
             f"has {len(leaves)}")
     for i, (leaf, s, d) in enumerate(zip(leaves, header["shapes"],
                                          header["dtypes"])):
         if tuple(leaf.shape) != tuple(s) or \
                 np.dtype(leaf.dtype) != _resolve_dtype(d):
-            raise ValueError(
+            raise TemplateMismatchError(
                 f"checkpoint does not match template at leaf {i}: "
                 f"saved {tuple(s)}/{d}, template "
                 f"{tuple(leaf.shape)}/{leaf.dtype}")

@@ -1,0 +1,149 @@
+"""Failure recovery: periodic checkpoints + resume-from-newest-valid.
+
+SURVEY.md §5: the reference has NO failure detection or elastic story (a
+crashed rank kills the job); the prescribed TPU recovery model is
+"multi-host restart + checkpoint-resume".  This module is that story as
+a first-class helper:
+
+- ``CheckpointManager`` keeps a rotating window of packed checkpoints
+  (``step-<N>.ckpt``), written asynchronously (AsyncCheckpointer) so the
+  step loop never blocks, fsync'd before publish (checkpoint.py), each
+  self-validating via header + crc + float-norm checksums.
+- ``restore_latest`` walks checkpoints newest-first and resumes from the
+  first VALID one — a file truncated by the crash that killed the job is
+  detected (ValueError from load) and skipped, which is exactly the
+  failure mode a mid-write crash produces.
+
+Multi-host: only process_index 0 writes by default; ``all_hosts=True``
+gives every host its own ``step-<N>.p<idx>.ckpt`` file (for per-host
+extra state).  Restore is deterministic across hosts because each host
+scans its own files and the save cadence is identical everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+
+from apex_tpu import checkpoint as _ckpt
+from apex_tpu.checkpoint import TemplateMismatchError
+
+Pytree = Any
+
+
+class CheckpointManager:
+    """Rotating async training checkpoints with crash-safe resume.
+
+    >>> mgr = CheckpointManager(dir, keep=3, every=100)
+    >>> for step in range(start, total):
+    ...     ...train...
+    ...     mgr.maybe_save(step, opt.params, opt, amp_state=amp_sd)
+    >>> mgr.close()
+    """
+
+    def __init__(self, directory: str, keep: int = 3, every: int = 100,
+                 all_hosts: bool = False):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.directory = directory
+        self.keep = keep
+        self.every = every
+        self._writer = (jax.process_index() == 0) or all_hosts
+        # per-host file names under all_hosts: hosts on a SHARED
+        # filesystem must never race on one path
+        self._suffix = (f".p{jax.process_index()}.ckpt" if all_hosts
+                        else ".ckpt")
+        self._step_re = re.compile(
+            r"^step-(\d+)" + re.escape(self._suffix) + "$")
+        self._async = _ckpt.AsyncCheckpointer()
+        if self._writer:
+            os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step-{step}{self._suffix}")
+
+    def steps_on_disk(self):
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        out = []
+        for n in names:
+            m = self._step_re.match(n)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def maybe_save(self, step: int, params: Pytree, optimizer=None,
+                   amp_state=None, extra: Optional[Pytree] = None) -> bool:
+        """Save iff ``step`` is on the cadence; returns True if a save
+        was scheduled.  Non-writer hosts no-op (all hosts return the
+        same value, so loops stay in step)."""
+        if step % self.every != 0:
+            return False
+        if self._writer:
+            # save_training_state first JOINS the previous async save
+            # (raising if it failed), so everything on disk below is
+            # known-durable; the checkpoint scheduled here is NOT, and
+            # _gc therefore keeps `keep` durable files besides it — a
+            # failed in-flight write can never leave zero checkpoints
+            self._async.save_training_state(
+                self._path(step), params, optimizer=optimizer,
+                amp_state=amp_state, step=step, extra=extra)
+            self._gc(in_flight=step)
+        return True
+
+    def _gc(self, in_flight: Optional[int] = None) -> None:
+        """Trim to the newest ``keep`` checkpoints, never counting (or
+        deleting) the not-yet-durable in-flight one — so a failed
+        in-flight write can never reduce the durable window."""
+        steps = [s for s in self.steps_on_disk() if s != in_flight]
+        for s in steps[:max(0, len(steps) - self.keep)]:
+            try:
+                os.remove(self._path(s))
+            except OSError:
+                pass
+
+    def restore_latest(self, params_like: Pytree, optimizer=None,
+                       extra_like: Optional[Pytree] = None
+                       ) -> Optional[Tuple]:
+        """Resume from the newest VALID checkpoint, or None if none.
+
+        Corrupt/truncated files (the artifact of dying mid-write) are
+        skipped with the next-newest tried — the crash-recovery
+        contract.  A TEMPLATE mismatch (intact checkpoint, wrong
+        tree/shape/dtype) is a caller bug and re-raises instead of
+        silently restarting from scratch.  Returns
+        load_training_state's tuple.
+        """
+        for step in reversed(self.steps_on_disk()):
+            try:
+                return _ckpt.load_training_state(
+                    self._path(step), params_like, optimizer=optimizer,
+                    extra_like=extra_like)
+            except TemplateMismatchError:
+                raise
+            except (ValueError, OSError):
+                continue   # corrupt or vanished: try the previous one
+        return None
+
+    def wait(self) -> None:
+        """Block until the in-flight save is durable (call before an
+        intentional shutdown); then trim the window to ``keep``."""
+        self._async.wait_until_finished()
+        if self._writer:
+            self._gc()
+
+    def close(self) -> None:
+        self._async.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -39,7 +39,8 @@ SUITES = {
                     "tests/test_sparsity_pyprof.py"],
     "run_distributed": ["tests/test_parallel.py",
                         "tests/test_wgrad.py"],
-    "run_checkpoint": ["tests/test_native_checkpoint.py"],
+    "run_checkpoint": ["tests/test_native_checkpoint.py",
+                       "tests/test_resilience.py"],
     "run_models": ["tests/test_models.py"],
     "run_data": ["tests/test_data.py"],
     "run_offload": ["tests/test_offload.py"],

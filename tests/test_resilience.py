@@ -1,0 +1,105 @@
+"""apex_tpu.resilience — crash-safe checkpoint rotation + resume
+(SURVEY.md §5: the TPU recovery story the reference lacks)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.resilience import CheckpointManager
+
+
+def _train(mgr, steps, start=0):
+    from apex_tpu.optimizers import FusedSGD
+    params = {"w": jnp.ones((64,))}
+    opt = FusedSGD(params, lr=0.1)
+    g = {"w": jnp.full((64,), 0.01)}
+    restored = mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+    s0 = 0
+    if restored is not None:
+        _, _, s0 = restored
+    for step in range(s0 + 1, steps + 1):
+        opt.step(g)
+        mgr.maybe_save(step, opt.params, opt)
+    mgr.wait()
+    return opt, s0
+
+
+def test_rotation_keeps_newest_k(tmp_path):
+    with CheckpointManager(str(tmp_path), keep=2, every=5) as mgr:
+        _train(mgr, 30)
+        assert mgr.steps_on_disk() == [25, 30]
+
+
+def test_resume_continues_from_latest(tmp_path):
+    with CheckpointManager(str(tmp_path), keep=3, every=5) as mgr:
+        opt1, s0 = _train(mgr, 20)
+        assert s0 == 0
+    with CheckpointManager(str(tmp_path), keep=3, every=5) as mgr:
+        opt2, s0 = _train(mgr, 20)   # "crash" and restart at 20
+        assert s0 == 20              # no extra steps run
+    np.testing.assert_array_equal(np.asarray(opt1.params["w"]),
+                                  np.asarray(opt2.params["w"]))
+
+
+def test_corrupt_newest_falls_back_to_previous(tmp_path):
+    with CheckpointManager(str(tmp_path), keep=3, every=5) as mgr:
+        _train(mgr, 15)
+        steps = mgr.steps_on_disk()
+        assert steps == [5, 10, 15]
+        # truncate the newest (mid-write crash artifact)
+        p = os.path.join(str(tmp_path), "step-15.ckpt")
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:len(data) // 2])
+        from apex_tpu.optimizers import FusedSGD
+        opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+        restored = mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+        assert restored is not None
+        _, _, step = restored
+        assert step == 10            # newest VALID
+
+
+def test_empty_dir_returns_none(tmp_path):
+    with CheckpointManager(str(tmp_path / "fresh"), every=5) as mgr:
+        assert mgr.restore_latest({"w": jnp.zeros((4,))}) is None
+
+
+def test_bad_config_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        CheckpointManager(str(tmp_path), keep=0)
+    with pytest.raises(ValueError):
+        CheckpointManager(str(tmp_path), every=0)
+
+
+def test_template_mismatch_raises_not_skips(tmp_path):
+    """A wrong restore template is a caller bug (code-review r2): it
+    must raise, not silently restart from step 0."""
+    from apex_tpu.checkpoint import TemplateMismatchError
+    with CheckpointManager(str(tmp_path), keep=3, every=5) as mgr:
+        _train(mgr, 10)
+        with pytest.raises(TemplateMismatchError):
+            mgr.restore_latest({"w": jnp.zeros((8,))})   # wrong shape
+
+
+def test_gc_never_drops_below_keep_durable(tmp_path):
+    """While a save is in flight, the durable window stays intact
+    (keep=1 regression: a failed in-flight write must not leave zero)."""
+    with CheckpointManager(str(tmp_path), keep=1, every=5) as mgr:
+        from apex_tpu.optimizers import FusedSGD
+        opt = FusedSGD({"w": jnp.ones((64,))}, lr=0.1)
+        g = {"w": jnp.full((64,), 0.01)}
+        for _ in range(5):
+            opt.step(g)
+        mgr.maybe_save(5, opt.params, opt)
+        mgr.wait()                            # step-5 durable
+        assert mgr.steps_on_disk() == [5]
+        for _ in range(5):
+            opt.step(g)
+        mgr.maybe_save(10, opt.params, opt)   # step-10 in flight
+        # the one durable checkpoint must still exist right after the
+        # new save was scheduled and _gc ran
+        assert 5 in mgr.steps_on_disk()
+        mgr.wait()
+        assert mgr.steps_on_disk() == [10]    # trimmed to keep
