@@ -98,3 +98,50 @@ def build_num_microbatches_calculator(
         int(rampup_batch_size[0]), int(rampup_batch_size[1]),
         int(rampup_batch_size[2]), global_batch_size, micro_batch_size,
         data_parallel_size)
+
+
+def accumulate_gradients(loss_fn, params, microbatches, *,
+                         use_remat: bool = True):
+    """Gradient accumulation over stacked microbatches, in one program.
+
+    The reference accumulates microbatch grads imperatively inside its
+    pipeline schedules (grads summed into main_grad buffers across the
+    1F1B loop); standalone accumulation is the degenerate single-stage
+    case.  TPU-native: one ``lax.scan`` over the microbatch axis with a
+    running f32 grad sum — XLA keeps ONE grad buffer alive (the fp32
+    main_grad behavior of fused_weight_gradient_mlp_cuda) instead of M.
+
+    loss_fn(params, microbatch) -> scalar loss.
+    microbatches: pytree whose leaves have a leading microbatch dim M.
+    use_remat: rematerialize each microbatch's forward (the usual
+    pairing — accumulation exists to cut activation memory).
+
+    Returns (mean_loss, mean_grads) with grads in f32.
+    """
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    # remat must wrap the PRIMAL and be differentiated through —
+    # checkpointing the already-differentiated function is a no-op
+    fn = _jax.checkpoint(loss_fn) if use_remat else loss_fn
+    vg = _jax.value_and_grad(fn)
+
+    leaves = _jax.tree_util.tree_leaves(microbatches)
+    if not leaves:
+        raise ValueError("accumulate_gradients: empty microbatch pytree")
+    m = leaves[0].shape[0]
+
+    def body(carry, mb):
+        loss_sum, gsum = carry
+        loss, g = vg(params, mb)
+        gsum = _jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(_jnp.float32), gsum, g)
+        return (loss_sum + loss.astype(_jnp.float32), gsum), None
+
+    g0 = _jax.tree_util.tree_map(
+        lambda p: _jnp.zeros(p.shape, _jnp.float32), params)
+    (loss_sum, gsum), _ = _jax.lax.scan(
+        body, (_jnp.float32(0.0), g0), microbatches)
+    inv = 1.0 / m
+    return loss_sum * inv, _jax.tree_util.tree_map(
+        lambda g: g * inv, gsum)

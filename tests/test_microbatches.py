@@ -54,3 +54,36 @@ def test_builder_dispatch():
         rank=0, rampup_batch_size=[16, 8, 1000], global_batch_size=32,
         micro_batch_size=4, data_parallel_size=1)
     assert isinstance(c, RampupBatchsizeNumMicroBatches)
+
+
+def test_accumulate_gradients_matches_full_batch():
+    """Mean of microbatch grads == grad of the full-batch mean loss."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.transformer.microbatches import accumulate_gradients
+    w = {"w": jax.random.normal(jax.random.key(0), (16, 1)) * 0.1}
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    y = jnp.sum(x[:, :3], axis=1, keepdims=True)
+
+    def loss_fn(p, mb):
+        xx, yy = mb
+        return jnp.mean((xx @ p["w"] - yy) ** 2)
+
+    full_loss, full_g = jax.value_and_grad(loss_fn)(w, (x, y))
+    mb = (x.reshape(4, 8, 16), y.reshape(4, 8, 1))
+    acc_loss, acc_g = jax.jit(
+        lambda p, mb: accumulate_gradients(loss_fn, p, mb))(w, mb)
+    np.testing.assert_allclose(float(acc_loss), float(full_loss),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(acc_g["w"]),
+                               np.asarray(full_g["w"]), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_accumulate_gradients_empty_rejected():
+    import jax.numpy as jnp
+    import pytest
+    from apex_tpu.transformer.microbatches import accumulate_gradients
+    with pytest.raises(ValueError, match="empty"):
+        accumulate_gradients(lambda p, m: 0.0, {"w": jnp.ones((2,))}, {})
