@@ -78,7 +78,7 @@ def save_checkpoint(path: str, tree: Pytree,
     with open(tmp, "wb") as f:
         f.write(len(hbytes).to_bytes(8, "little"))
         f.write(hbytes)
-        f.write(payload.tobytes())
+        payload.tofile(f)      # streams; tobytes() would copy GBs first
         f.flush()
         os.fsync(f.fileno())   # durable before the atomic publish
     os.replace(tmp, path)
@@ -103,7 +103,11 @@ def load_checkpoint(path: str, like: Pytree,
     with open(path, "rb") as f:
         hlen = int.from_bytes(f.read(8), "little")
         header = json.loads(f.read(hlen).decode())
-        payload = np.frombuffer(f.read(), np.uint8)
+        # fromfile reads straight into one array (read()+frombuffer is
+        # equivalent peak memory — frombuffer views the bytes — this
+        # just skips the intermediate bytes object); requires a real
+        # file, which every caller passes
+        payload = np.fromfile(f, np.uint8)
     if header.get("magic") != _MAGIC:
         raise ValueError(f"{path} is not an apex_tpu checkpoint")
     leaves, treedef = jax.tree_util.tree_flatten(like)
