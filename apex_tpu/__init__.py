@@ -30,6 +30,12 @@ of the reference can find everything in the same place:
   apex.RNN                  -> apex_tpu.RNN
   apex.reparameterization   -> apex_tpu.reparameterization
   csrc/ (CUDA kernels)      -> apex_tpu.ops (Pallas kernels + XLA paths)
+
+Beyond-reference TPU tiers (no apex counterpart): apex_tpu.data (device
+prefetcher), apex_tpu.offload (host-memory offload), apex_tpu.checkpoint
+(packed/async checkpoints) + apex_tpu.resilience (crash recovery),
+apex_tpu.quantization (int8 inference), apex_tpu.platform (backend
+override under hosted sitecustomize hooks).
 """
 
 from apex_tpu._version import __version__
