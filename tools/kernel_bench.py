@@ -148,6 +148,22 @@ def main():
         lambda l: xe.softmax_cross_entropy(l, labels),
         lambda l: xe.softmax_cross_entropy_ref(l, labels), logits))
 
+    # int8 inference matmuls vs the bf16 baseline (MXU int8 ~2x rate)
+    from apex_tpu.quantization import int8_matmul, quantize_int8
+    m_, k_, n_ = 4096, 4096, 4096
+    xb = jax.random.normal(key, (m_, k_), jnp.bfloat16)
+    wf = jax.random.normal(jax.random.key(3), (k_, n_)) * 0.05
+    wq = quantize_int8(wf)
+    wb = wf.astype(jnp.bfloat16)
+    for mode, fn in (
+            ("weight_only", lambda x: int8_matmul(x, wq, dynamic=False)),
+            ("dynamic_full", lambda x: int8_matmul(x, wq, dynamic=True))):
+        rows.append(bench_pair(
+            f"int8_matmul_{mode}", f"{m_}x{k_}x{n_}", "bf16/int8",
+            fn, lambda x: jnp.dot(x, wb,
+                                  preferred_element_type=jnp.float32)
+            .astype(jnp.bfloat16), xb))
+
     # multi-tensor substrate
     n = 1 << 24
     p = jax.random.normal(key, (n,), jnp.float32)
