@@ -195,3 +195,12 @@ def test_weight_norm_size1_dim_roundtrip():
     back = reparametrize(wn)["k"]["kernel"]
     np.testing.assert_allclose(np.asarray(back), np.asarray(w),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_dynamic_loss_scaler_window_one_grows_first_step():
+    """ADVICE r1: with scale_window=1 the FIRST clean step already grows
+    the scale (reference condition (iter - last_overflow) % window == 0)."""
+    from apex_tpu.fp16_utils import DynamicLossScaler
+    s = DynamicLossScaler(init_scale=2.0 ** 8, scale_window=1)
+    s.update_scale(overflow=False)
+    assert s.loss_scale == 2.0 ** 9
