@@ -42,6 +42,7 @@ SUITES = {
     "run_checkpoint": ["tests/test_native_checkpoint.py",
                        "tests/test_resilience.py"],
     "run_models": ["tests/test_models.py"],
+    "run_examples": ["tests/test_examples_smoke.py"],
     "run_data": ["tests/test_data.py"],
     "run_offload": ["tests/test_offload.py"],
     "run_quantization": ["tests/test_quantization.py"],

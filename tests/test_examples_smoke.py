@@ -1,0 +1,44 @@
+"""Examples tier under CI: run the fast examples in-process (reference
+model: examples are the reference's L6 layer; keeping them green is part
+of the public contract — SURVEY.md §1)."""
+
+import runpy
+import sys
+
+import pytest
+
+
+def _run(path, argv):
+    old = sys.argv
+    sys.argv = [path] + argv
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+def test_train_toy_runs_and_converges(capsys):
+    _run("examples/simple/train_toy.py", [])
+    assert "OK: loss" in capsys.readouterr().out
+
+
+def test_imagenet_tiny_cpu(capsys):
+    _run("examples/imagenet/main_amp.py",
+         ["--cpu", "--steps", "2", "--batch-size", "2",
+          "--image-size", "32", "--arch", "resnet18"])
+    assert "throughput" in capsys.readouterr().out
+
+
+def test_dcgan_two_scalers(capsys):
+    _run("examples/dcgan/main_amp.py",
+         ["--cpu", "--steps", "2", "--batch-size", "4"])
+    out = capsys.readouterr().out
+    assert "loss_scaler0" in out and "loss_scaler1" in out
+
+
+@pytest.mark.slow
+def test_gpt_block_tiny(capsys):
+    _run("examples/gpt/train_block.py",
+         ["--cpu", "--steps", "2", "--layers", "1", "--hidden", "64",
+          "--heads", "4", "--seq-len", "64", "--batch-size", "2"])
+    assert "step time" in capsys.readouterr().out
