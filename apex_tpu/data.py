@@ -87,12 +87,31 @@ class DevicePrefetcher:
     def __iter__(self) -> Iterator[Any]:
         return self
 
+    def _publish_sentinel(self):
+        """Best-effort sentinel publish so consumers blocked in q.get()
+        wake; combined with __next__'s post-get _done check, a dropped
+        publish (queue momentarily full) is still safe."""
+        try:
+            self._q.put_nowait(_SENTINEL)
+        except queue.Full:
+            pass
+
     def __next__(self):
         if self._done:
             raise StopIteration
         item = self._q.get()
+        if self._done and item is not _SENTINEL:
+            # close() ran while we were blocked in get(): `item` is a
+            # stale batch that slipped in after close()'s drain (the
+            # feeder may have had one put in flight).  Shut down — and
+            # re-publish so every other blocked consumer wakes too.
+            self._publish_sentinel()
+            raise StopIteration
         if item is _SENTINEL:
             self._done = True
+            # re-publish for any OTHER consumer blocked in q.get() —
+            # one sentinel must wake every waiter, not just the first
+            self._publish_sentinel()
             if self._err is not None:
                 err, self._err = self._err, None
                 raise err
@@ -119,6 +138,10 @@ class DevicePrefetcher:
                 self._q.get_nowait()
             except queue.Empty:
                 break
+        # re-publish the sentinel: a consumer already blocked in
+        # __next__'s q.get() when close() ran would otherwise hang
+        # forever (the drain above may have eaten the feeder's sentinel)
+        self._publish_sentinel()
         self._thread.join(timeout=1.0)
 
     def __enter__(self) -> "DevicePrefetcher":

@@ -16,14 +16,21 @@ a first-class helper:
 
 Multi-host: only process_index 0 writes by default; ``all_hosts=True``
 gives every host its own ``step-<N>.p<idx>.ckpt`` file (for per-host
-extra state).  Restore is deterministic across hosts because each host
-scans its own files and the save cadence is identical everywhere.
+extra state).  **Multi-host restore requires a SHARED filesystem** (all
+hosts see the same ``directory``): with ``all_hosts=False`` only host 0
+writes, so on per-host local disks the non-writer hosts would find
+nothing and diverge from host 0's resume step.  On a shared filesystem
+restore is deterministic across hosts — every host scans the same files
+and the save cadence is identical everywhere.  (With ``all_hosts=True``
+each host needs its own complete file set, so per-host disks work, but
+all hosts must have saved the same steps.)
 """
 
 from __future__ import annotations
 
 import os
 import re
+import warnings
 from typing import Any, Optional, Tuple
 
 import jax
@@ -63,6 +70,25 @@ class CheckpointManager:
         self._async = _ckpt.AsyncCheckpointer()
         if self._writer:
             os.makedirs(directory, exist_ok=True)
+            # a crash mid-write leaves step-N.ckpt.tmp behind forever
+            # (_gc only matches published names); any .tmp predating
+            # this process is by definition garbage — clear it now.
+            # Strictly scoped to THIS host's exact tmp name shape: on a
+            # shared filesystem another host's .tmp may be a live
+            # in-flight write (".ckpt.tmp" is a suffix of ".pK.ckpt.tmp",
+            # so a loose glob would cross-delete).  Contract: the
+            # previous writer with this suffix is DEAD before this one
+            # constructs (the normal restart sequence); a still-alive
+            # superseded writer racing its replacement is unsafe with or
+            # without this GC (both would publish the same step files)
+            tmp_re = re.compile(
+                r"^step-\d+" + re.escape(self._suffix) + r"\.tmp$")
+            for name in os.listdir(directory):
+                if tmp_re.match(name):
+                    try:
+                        os.remove(os.path.join(directory, name))
+                    except OSError:
+                        pass
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step-{step}{self._suffix}")
@@ -128,8 +154,15 @@ class CheckpointManager:
                     extra_like=extra_like)
             except TemplateMismatchError:
                 raise
-            except (ValueError, OSError):
-                continue   # corrupt or vanished: try the previous one
+            except (ValueError, OSError) as e:
+                # corrupt or vanished: try the previous one — but LOUDLY,
+                # so a transient I/O failure that walks past every good
+                # checkpoint (and thereby restarts training from scratch)
+                # is observable in the logs
+                warnings.warn(
+                    f"restore_latest: skipping {self._path(step)}: "
+                    f"{type(e).__name__}: {e}")
+                continue
         return None
 
     def wait(self) -> None:

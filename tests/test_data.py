@@ -80,3 +80,35 @@ def test_prefetcher_propagates_source_errors():
 def test_prefetcher_rejects_bad_depth():
     with pytest.raises(ValueError):
         DevicePrefetcher(_batches(1), depth=0)
+
+
+def test_close_wakes_blocked_consumer():
+    """A consumer blocked in __next__ when close() runs must observe
+    shutdown, not hang forever (advisor r2)."""
+    import threading, queue as _q
+
+    def slow():
+        yield {"x": np.zeros((2,), np.float32)}
+        import time
+        time.sleep(30)          # feeder never produces a second batch
+        yield {"x": np.zeros((2,), np.float32)}
+
+    pf = DevicePrefetcher(slow(), depth=1)
+    assert pf.next() is not None
+    got = _q.Queue()
+
+    def consume():
+        try:
+            pf.__next__()
+            got.put("item")
+        except StopIteration:
+            got.put("stop")
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+    time.sleep(0.2)             # let the consumer block in q.get()
+    pf.close()
+    assert got.get(timeout=5.0) == "stop"
+    t.join(timeout=5.0)
+    assert not t.is_alive()
