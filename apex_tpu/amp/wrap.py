@@ -19,10 +19,15 @@ with zero edits to the model.  The rewrite composes with jit/grad/vmap
 falls out for free: a param cast appearing once in the jaxpr is one op
 in the compiled program, CSE'd and fused by XLA.
 
-Call-like primitives are recursed into (pjit/remat/custom_jvp); opaque
-ones with typed sub-jaxprs (scan/while/cond/custom_vjp — e.g. this
-package's own Pallas kernels, which already manage precision
-internally) run unmodified at their traced dtypes.
+Call-like primitives are recursed into (pjit/remat/custom_jvp), and so
+is structured control flow: ``scan`` / ``while`` / ``cond`` bodies are
+re-traced with the same per-primitive rules, with loop state cast back
+to its traced dtype at every iteration boundary so the loop stays
+well-typed (the reference reaches ops inside RNN loops the same way,
+via rnn_compat).  Only genuinely dtype-bound opaque primitives
+(custom_vjp, pallas_call — e.g. this package's own kernels, which
+already manage precision internally) run unmodified at their traced
+dtypes.
 """
 
 from __future__ import annotations
@@ -86,6 +91,79 @@ def _half_params(params, half):
     return params
 
 
+def _cast_to_dtypes(vals, dtypes):
+    """Cast each float val back to its traced dtype (None = leave)."""
+    return [v.astype(d) if d is not None and _is_float(v)
+            and jnp.result_type(v) != d else v
+            for v, d in zip(vals, dtypes)]
+
+
+def _aval_dtypes(vars_):
+    return [v.aval.dtype for v in vars_]
+
+
+def _rewrite_scan(vals, params, half):
+    """Re-issue a scan with its body O1-rewritten.  The carry is cast
+    back to its traced dtype each iteration (dtype-coherent boundary);
+    ops INSIDE the body follow the normal HALF/FP32/promote rules."""
+    body = params["jaxpr"]                      # ClosedJaxpr
+    C, K = params["num_consts"], params["num_carry"]
+    consts, init, xs = vals[:C], vals[C:C + K], vals[C + K:]
+    carry_dts = _aval_dtypes(body.jaxpr.invars[C:C + K])
+
+    def new_body(carry, x):
+        ins = list(consts) + list(carry) + list(x)
+        outs = _eval_jaxpr(body.jaxpr, body.consts, ins, half)
+        return (tuple(_cast_to_dtypes(outs[:K], carry_dts)),
+                tuple(outs[K:]))
+
+    carry_out, ys = jax.lax.scan(
+        new_body, tuple(init), tuple(xs), length=params.get("length"),
+        reverse=params.get("reverse", False),
+        unroll=params.get("unroll", 1))
+    return list(carry_out) + list(ys)
+
+
+def _rewrite_while(vals, params, half):
+    """Re-issue a while_loop with cond/body O1-rewritten; loop state is
+    cast back to its traced dtype after every body application."""
+    cj, bj = params["cond_jaxpr"], params["body_jaxpr"]
+    cn, bn = params["cond_nconsts"], params["body_nconsts"]
+    cc, bc, init = vals[:cn], vals[cn:cn + bn], vals[cn + bn:]
+    carry_dts = _aval_dtypes(bj.jaxpr.invars[bn:])
+
+    def cond_fn(carry):
+        return _eval_jaxpr(cj.jaxpr, cj.consts,
+                           list(cc) + list(carry), half)[0]
+
+    def body_fn(carry):
+        outs = _eval_jaxpr(bj.jaxpr, bj.consts,
+                           list(bc) + list(carry), half)
+        return tuple(_cast_to_dtypes(outs, carry_dts))
+
+    return list(jax.lax.while_loop(cond_fn, body_fn, tuple(init)))
+
+
+def _rewrite_cond(vals, params, outvars, half):
+    """Re-issue a cond/switch with every branch O1-rewritten.  Branch
+    outputs are cast back to the traced output dtypes — the branches
+    must agree on out avals, and after an asymmetric rewrite (a GEMM in
+    one branch, a pass-through in the other) they wouldn't."""
+    out_dts = [getattr(v.aval, "dtype", None) for v in outvars]
+    idx, ops = jnp.asarray(vals[0]), vals[1:]
+    if idx.dtype == jnp.bool_:
+        idx = idx.astype(jnp.int32)
+
+    def mk(br):
+        def f(*ops_):
+            outs = _eval_jaxpr(br.jaxpr, br.consts, list(ops_), half)
+            return tuple(_cast_to_dtypes(outs, out_dts))
+        return f
+
+    return list(jax.lax.switch(idx, [mk(b) for b in params["branches"]],
+                               *ops))
+
+
 def _bind(prim, vals, params):
     """Re-issue an eqn the way core.eval_jaxpr does: get_bind_params
     recovers callable sub-arguments (custom_vjp's fun/fwd/bwd, ...)
@@ -127,10 +205,19 @@ def _eval_jaxpr(jaxpr, consts, args, half):
                         _half_params(params, half))
         elif name in lists.FP32_PRIMS:
             ans = _bind(prim, _cast_floats(vals, jnp.float32), params)
+        elif name == "scan" and "jaxpr" in params:
+            ans = _rewrite_scan(_restore_dtypes(vals, eqn.invars),
+                                params, half)
+        elif name == "while" and "body_jaxpr" in params:
+            ans = _rewrite_while(_restore_dtypes(vals, eqn.invars),
+                                 params, half)
+        elif name == "cond" and "branches" in params:
+            ans = _rewrite_cond(_restore_dtypes(vals, eqn.invars),
+                                params, eqn.outvars, half)
         elif "jaxpr" in params or "call_jaxpr" in params or \
                 "branches" in params or "cond_jaxpr" in params or \
                 "fwd_jaxpr_thunk" in params or "num_consts" in params:
-            # opaque control flow / custom_vjp: dtype-bound bodies
+            # opaque (custom_vjp, pallas_call, ...): dtype-bound bodies
             ans = _bind(prim, _restore_dtypes(vals, eqn.invars), params)
         else:
             ans = _bind(prim, _promote_floats(vals), params)

