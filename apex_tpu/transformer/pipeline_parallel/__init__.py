@@ -13,6 +13,8 @@ from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
 from apex_tpu.transformer.pipeline_parallel.spmd import (
     spmd_pipeline,
     spmd_pipeline_1f1b,
+    spmd_pipeline_1f1b_apply,
+    spmd_pipeline_interleaved,
     spmd_pipeline_loss,
 )
 from apex_tpu.transformer.pipeline_parallel.utils import (
@@ -29,7 +31,9 @@ __all__ = [
     "forward_backward_pipelining_without_interleaving",
     "get_forward_backward_func",
     "P2PContext",
-    "spmd_pipeline", "spmd_pipeline_1f1b", "spmd_pipeline_loss",
+    "spmd_pipeline", "spmd_pipeline_1f1b",
+    "spmd_pipeline_1f1b_apply", "spmd_pipeline_interleaved",
+    "spmd_pipeline_loss",
     "get_kth_microbatch", "get_num_microbatches", "listify_model",
     "setup_microbatch_calculator", "split_into_microbatches",
     "update_num_microbatches",

@@ -289,3 +289,133 @@ def test_spmd_1f1b_matches_chain(problem):
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
                                                 atol=1e-5),
         g, want_stacked)
+
+
+def test_spmd_1f1b_apply_differentiable_end_to_end(problem):
+    """VERDICT r2 #5: the DIFFERENTIABLE 1F1B (custom_vjp drop-in for
+    spmd_pipeline) matches chain autodiff for stage grads AND for
+    params before (pre-scale) and after (post-head) the pipeline —
+    i.e. the input-cotangent path works, which plain
+    spmd_pipeline_1f1b cannot provide."""
+    params, x, tgt = problem
+    mesh = comm.initialize(data=2, pipe=4)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params)
+    pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE), params[0])
+    D = x.shape[-1]
+    pre = jnp.eye(D) + 0.01 * jnp.arange(D * D).reshape(D, D) / (D * D)
+    post = jnp.eye(D) * 0.9
+
+    def loss_1f1b(pre_w, post_w, stacked_local, xx, tt):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        ub = xx @ pre_w                       # pre-pipeline op
+        y = pp.spmd_pipeline_1f1b_apply(stage_apply, local, ub)
+        y = y @ post_w                        # post-pipeline op
+        return jnp.mean(jax.vmap(
+            lambda yy, t: jnp.mean((yy - t) ** 2))(y, tt))
+
+    def loss_gpipe(pre_w, post_w, stacked_local, xx, tt):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        ub = xx @ pre_w
+        y = pp.spmd_pipeline(stage_apply, local, ub)
+        y = y @ post_w
+        return jnp.mean(jax.vmap(
+            lambda yy, t: jnp.mean((yy - t) ** 2))(y, tt))
+
+    def run(loss_f):
+        return jax.jit(comm.shard_map(
+            jax.value_and_grad(loss_f, argnums=(0, 1, 2)), mesh,
+            in_specs=(P(), P(), pspec, P(), P()),
+            out_specs=(P(), (P(), P(), pspec))))(
+            pre, post, stacked, x, tgt)
+
+    l1, g1 = run(loss_1f1b)
+    l2, g2 = run(loss_gpipe)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g1, g2)
+
+    # and the chain oracle (no pipeline at all)
+    def chain(pre_w, post_w, ps):
+        h = x @ pre_w
+        for p in ps:
+            h = jax.vmap(stage_apply, in_axes=(None, 0))(p, h)
+        h = h @ post_w
+        return jnp.mean(jax.vmap(
+            lambda yy, t: jnp.mean((yy - t) ** 2))(h, tgt))
+
+    want_l, want_g = jax.value_and_grad(chain, argnums=(0, 1, 2))(
+        pre, post, params)
+    want_stacked = (want_g[0], want_g[1], jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *want_g[2]))
+    np.testing.assert_allclose(float(l1), float(want_l), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g1, want_stacked)
+
+
+def test_spmd_interleaved_matches_chain(problem):
+    """SPMD interleaved virtual stages (VERDICT r2 #7): V=2 chunks per
+    stage, v=c*P+s placement — outputs AND grads match the sequential
+    chain over all P*V chunks, with more microbatches than stages so
+    the grouped circular schedule actually engages."""
+    params, x, tgt = problem
+    mesh = comm.initialize(data=2, pipe=4)
+    P_, V = 4, 2
+    # build P*V chunks: reuse the 4 stage params twice with a tweak so
+    # chunks are all distinct
+    chunks = [jax.tree_util.tree_map(lambda a, k=i: a * (1.0 + 0.05 * k),
+                                     params[i % P_])
+              for i in range(P_ * V)]
+    # stage s holds chunks [s, P+s] stacked on a leading V dim
+    per_stage = [jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), chunks[s], chunks[P_ + s])
+        for s in range(P_)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *per_stage)     # (P, V, ...)
+    pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE),
+                                   params[0])
+
+    def run(stacked_local, xx):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        return pp.spmd_pipeline_interleaved(stage_apply, local, xx)
+
+    y = jax.jit(comm.shard_map(
+        run, mesh, in_specs=(pspec, P()), out_specs=P()))(stacked, x)
+
+    h = x
+    for c in chunks:                      # global chunk order 0..PV-1
+        h = jax.vmap(stage_apply, in_axes=(None, 0))(c, h)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(h),
+                               rtol=1e-5, atol=1e-5)
+
+    # grads through the interleaved pipeline
+    def loss_i(stacked_local, xx, tt):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        yy = pp.spmd_pipeline_interleaved(stage_apply, local, xx)
+        return jnp.mean(jax.vmap(
+            lambda a, b: jnp.mean((a - b) ** 2))(yy, tt))
+
+    g = jax.jit(comm.shard_map(
+        jax.grad(loss_i), mesh,
+        in_specs=(pspec, P(), P()), out_specs=pspec))(stacked, x, tgt)
+
+    def chain_loss(cs):
+        hh = x
+        for c in cs:
+            hh = jax.vmap(stage_apply, in_axes=(None, 0))(c, hh)
+        return jnp.mean(jax.vmap(
+            lambda a, b: jnp.mean((a - b) ** 2))(hh, tgt))
+
+    want = jax.grad(chain_loss)(chunks)
+    want_per_stage = [jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), want[s], want[P_ + s])
+        for s in range(P_)]
+    want_stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *want_per_stage)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g, want_stacked)
