@@ -88,6 +88,85 @@ def test_initialize_distributed_env_var_triggers(monkeypatch):
     assert called.get("hit"), "env coordinator must trigger the handshake"
 
 
+def test_initialize_distributed_parses_world_size_rank(monkeypatch):
+    """VERDICT r2 #8: the launcher env contract (torchrun-style
+    WORLD_SIZE/RANK next to a coordinator) must parse to ints and land
+    in the initialize() kwargs — a typo here only fails on a real pod."""
+    called = {}
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.3:8476")
+    monkeypatch.setenv("WORLD_SIZE", "16")
+    monkeypatch.setenv("RANK", "3")
+    monkeypatch.delenv("NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.update(kw))
+    comm.initialize_distributed(data=8)
+    assert called == {"coordinator_address": "10.0.0.3:8476",
+                      "num_processes": 16, "process_id": 3}
+    assert isinstance(called["num_processes"], int)
+    assert isinstance(called["process_id"], int)
+
+
+def test_initialize_distributed_env_precedence(monkeypatch):
+    """JAX_COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID win over
+    their COORDINATOR_ADDRESS / WORLD_SIZE / RANK fallbacks, and
+    explicit arguments beat both."""
+    called = {}
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "jax.addr:1")
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "plain.addr:2")
+    monkeypatch.setenv("NUM_PROCESSES", "4")
+    monkeypatch.setenv("WORLD_SIZE", "999")
+    monkeypatch.setenv("PROCESS_ID", "2")
+    monkeypatch.setenv("RANK", "998")
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.update(kw))
+    comm.initialize_distributed(data=8)
+    assert called == {"coordinator_address": "jax.addr:1",
+                      "num_processes": 4, "process_id": 2}
+    called.clear()
+    comm.initialize_distributed(
+        coordinator_address="arg.addr:3", num_processes=2, process_id=1,
+        data=8)
+    assert called == {"coordinator_address": "arg.addr:3",
+                      "num_processes": 2, "process_id": 1}
+
+
+def test_initialize_distributed_pod_markers_autodetect(monkeypatch):
+    """A TPU pod runtime (TPU_WORKER_HOSTNAMES set, no explicit
+    coordinator) triggers the ARGLESS jax.distributed.initialize()
+    autodetect path."""
+    for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "NUM_PROCESSES", "WORLD_SIZE", "PROCESS_ID", "RANK"):
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    called = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.update(dict(kw, hit=True)))
+    comm.initialize_distributed(data=8)
+    assert called == {"hit": True}, \
+        "pod markers must trigger argless autodetect"
+
+
+def test_initialize_distributed_reentry_tolerated(monkeypatch):
+    """A second handshake (RuntimeError 'already initialized') is
+    swallowed; any OTHER RuntimeError propagates."""
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.4:1")
+
+    def already(**kw):
+        raise RuntimeError("jax.distributed is already initialized")
+
+    monkeypatch.setattr(jax.distributed, "initialize", already)
+    m = comm.initialize_distributed(data=8)     # must not raise
+    assert m.devices.size == 8
+
+    def broken(**kw):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(jax.distributed, "initialize", broken)
+    with pytest.raises(RuntimeError, match="unreachable"):
+        comm.initialize_distributed(data=8)
+
+
 def test_physical_mesh_layout_covers_all_devices():
     """physical=True routes through mesh_utils; every device appears
     exactly once and axis sizes match, on any backend."""
