@@ -39,7 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu import comm
-from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
 _NEG = -1e30
 _LANES = 128
@@ -518,7 +518,7 @@ def flash_attention(q, k, v, causal=False, scale=None,
         dt = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype),
                                v.dtype)
         q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
-    if not pallas_enabled():
+    if not op_enabled("attention"):
         sc = scale if scale is not None else _default_scale(q.shape[-1])
         # jax.checkpoint: don't hold the (Sq, Sk) probability residual
         # between fwd and bwd on the escape-hatch path
@@ -727,7 +727,7 @@ def ring_attention(q, k, v, causal=False, scale=None,
     ``ring_attention_ref`` (plain scan + ppermute, fully transposable)
     or set APEX_TPU_DISABLE_PALLAS=1.
     """
-    if pallas_enabled():
+    if op_enabled("attention"):
         return _ring(q, k, v, causal, scale, axis)
     return ring_attention_ref(q, k, v, causal=causal, scale=scale,
                               axis=axis)

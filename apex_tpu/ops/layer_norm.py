@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
 LANE = 128
 _VMEM_BUDGET = 1024 * 1024  # per-operand block budget (bytes, f32)
@@ -53,7 +53,7 @@ def _pad_rows(x2d: jax.Array, br: int) -> jax.Array:
 def _use_pallas(h: int) -> bool:
     # 8 is the minimum block-row count: even at the floor, one block must
     # fit the per-operand budget (the backward holds ~6 operand blocks)
-    return pallas_enabled() and h % LANE == 0 and 8 * h * 4 <= _VMEM_BUDGET
+    return op_enabled("layer_norm") and h % LANE == 0 and 8 * h * 4 <= _VMEM_BUDGET
 
 
 # ---------------------------------------------------------------------------

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
 LANE = 128
 SUBLANE = 8
@@ -100,7 +100,7 @@ def flat_scale(x: jax.Array, scale: jax.Array, out_dtype=None):
     on device.
     """
     out_dtype = out_dtype or x.dtype
-    if not pallas_enabled():
+    if not op_enabled("multi_tensor"):
         return flat_scale_ref(x, scale, out_dtype)
     x2d, n = _as_tiles(x)
     scale = jnp.asarray([scale], jnp.float32).reshape(1)
@@ -146,7 +146,7 @@ def _axpby_kernel(s_ref, x_ref, y_ref, o_ref, flag_ref):
 def flat_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
     """out = a*x + b*y over flat buffers; returns (out, found_inf)."""
     out_dtype = out_dtype or x.dtype
-    if not pallas_enabled():
+    if not op_enabled("multi_tensor"):
         return flat_axpby_ref(a, x, b, y, out_dtype)
     x2d, n = _as_tiles(x)
     y2d, _ = _as_tiles(y)
@@ -190,7 +190,7 @@ def _l2norm_kernel(x_ref, acc_ref):
 
 def flat_l2norm(x: jax.Array) -> jax.Array:
     """Global L2 norm of a flat buffer (f32 accumulation)."""
-    if not pallas_enabled():
+    if not op_enabled("multi_tensor"):
         return flat_l2norm_ref(x)
     x2d, _ = _as_tiles(x)
     acc = pl.pallas_call(
@@ -242,7 +242,7 @@ def flat_adam(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay, step,
     p may be bf16 or f32; m/v must be f32.  ``step`` is the 1-based step
     count (traced scalar ok).  Returns (p, m, v).
     """
-    if not pallas_enabled():
+    if not op_enabled("multi_tensor"):
         return flat_adam_ref(
             p, g, m, v, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
             weight_decay=weight_decay, step=step, adam_w_mode=adam_w_mode,
@@ -332,7 +332,7 @@ def flat_sgd(p, g, momentum_buf, *, lr, momentum=0.0, dampening=0.0,
              weight_decay=0.0, nesterov=False, first_run=False,
              grad_scale=1.0):
     """One fused SGD step over flat buffers; returns (p, momentum_buf)."""
-    if not pallas_enabled():
+    if not op_enabled("multi_tensor"):
         return flat_sgd_ref(
             p, g, momentum_buf, lr=lr, momentum=momentum, dampening=dampening,
             weight_decay=weight_decay, nesterov=nesterov, first_run=first_run,

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
 LANE = 128
 _MAX_SK = 4096          # sk*4B*block_rows must fit VMEM comfortably
@@ -54,7 +54,7 @@ def _divisor_block(sq: int, cap: int) -> int:
 
 
 def _use_pallas(sk: int) -> bool:
-    return pallas_enabled() and sk % LANE == 0 and sk <= _MAX_SK
+    return op_enabled("softmax") and sk % LANE == 0 and sk <= _MAX_SK
 
 
 def _finish_rows(x):

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
 LANE = 128
 _BLOCK_ROWS = 256
@@ -76,7 +76,7 @@ def welford_mean_var(x2d: jax.Array) -> Tuple[jax.Array, jax.Array,
     the Pallas path; otherwise the XLA fallback runs.
     """
     n, c = x2d.shape
-    if not (pallas_enabled() and c % LANE == 0):
+    if not (op_enabled("welford") and c % LANE == 0):
         return welford_mean_var_ref(x2d)
     rows = (n + _BLOCK_ROWS - 1) // _BLOCK_ROWS * _BLOCK_ROWS
     xp = jnp.pad(x2d, ((0, rows - n), (0, 0)))

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._dispatch import interpret_mode, pallas_enabled
+from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
 LANE = 128
 _MAX_C = 65536          # beyond this, the XLA path wins anyway
@@ -41,7 +41,7 @@ def _block_rows(c: int) -> int:
 
 
 def _use_pallas(c: int) -> bool:
-    return pallas_enabled() and c % LANE == 0 and c <= _MAX_C
+    return op_enabled("xentropy") and c % LANE == 0 and c <= _MAX_C
 
 
 def _fwd_kernel(smoothing, x_ref, t_ref, loss_ref, lse_ref):

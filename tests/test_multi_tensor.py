@@ -144,3 +144,84 @@ def test_multi_tensor_applier_scale():
     np.testing.assert_allclose(outs[0], jnp.full((5,), 6.0))
     np.testing.assert_allclose(outs[1], jnp.full((3, 3), -3.0))
     assert int(flag) == 0
+
+
+class TestDispatchPrefs:
+    """Measure-aware dispatch (VERDICT r2 #2): the preference table and
+    env overrides gate each kernel family onto Pallas or the XLA path."""
+
+    def test_default_prefers_pallas(self, monkeypatch):
+        from apex_tpu.ops import _dispatch
+        monkeypatch.setattr(_dispatch, "_PREFS", {})
+        monkeypatch.delenv("APEX_TPU_PREFER_XLA", raising=False)
+        monkeypatch.delenv("APEX_TPU_PREFER_PALLAS", raising=False)
+        assert _dispatch.op_enabled("layer_norm")
+        assert _dispatch.op_enabled("never-measured-op")
+
+    def test_measured_loss_flips_to_xla(self, monkeypatch):
+        from apex_tpu.ops import _dispatch
+        monkeypatch.setattr(_dispatch, "_PREFS", {"softmax": False,
+                                                  "attention": True})
+        assert not _dispatch.op_enabled("softmax")
+        assert _dispatch.op_enabled("attention")
+
+    def test_env_overrides_beat_table(self, monkeypatch):
+        from apex_tpu.ops import _dispatch
+        monkeypatch.setattr(_dispatch, "_PREFS", {"softmax": False})
+        monkeypatch.setenv("APEX_TPU_PREFER_PALLAS", "softmax")
+        assert _dispatch.op_enabled("softmax")
+        monkeypatch.setenv("APEX_TPU_PREFER_XLA", "layer_norm, xentropy")
+        assert not _dispatch.op_enabled("layer_norm")
+        assert not _dispatch.op_enabled("xentropy")
+
+    def test_disabled_pallas_wins_over_everything(self, monkeypatch):
+        from apex_tpu.ops import _dispatch
+        monkeypatch.setattr(_dispatch, "_DISABLE_PALLAS", True)
+        monkeypatch.setenv("APEX_TPU_PREFER_PALLAS", "softmax")
+        assert not _dispatch.op_enabled("softmax")
+
+    def test_xla_pref_routes_layer_norm_to_oracle(self, monkeypatch):
+        """The gate actually changes the computed path: with layer_norm
+        preferred to XLA, fused_layer_norm still computes correctly
+        (through the reference path) and no pallas_call appears."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from apex_tpu.ops import _dispatch, layer_norm as ln
+
+        x = jax.random.normal(jax.random.key(0), (16, 256))
+        w = jnp.ones((256,)); b = jnp.zeros((256,))
+        want = ln.layer_norm_ref(x, w, b)
+        monkeypatch.setattr(_dispatch, "_PREFS", {"layer_norm": False})
+        got = ln.fused_layer_norm(x, w, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+        jx = jax.make_jaxpr(lambda t: ln.fused_layer_norm(t, w, b))(x)
+        prims = {e.primitive.name for e in jx.jaxpr.eqns}
+        assert "pallas_call" not in prims, prims
+
+    def test_prefs_written_from_rows(self, tmp_path):
+        import importlib, json as _json, os as _os
+        tools = _os.path.abspath(_os.path.join(
+            _os.path.dirname(__file__), "..", "tools"))
+        import sys as _sys
+        _sys.path.insert(0, tools)
+        try:
+            kb = importlib.import_module("kernel_bench")
+        finally:
+            _sys.path.remove(tools)
+        rows = [
+            {"kernel": "fused_layer_norm", "speedup": 1.4, "backend": "tpu"},
+            {"kernel": "fused_layer_norm_grad", "speedup": 0.8,
+             "backend": "tpu"},
+            {"kernel": "flash_attention", "speedup": 2.0, "backend": "tpu"},
+            {"kernel": "int8_matmul_weight_only", "speedup": 1.9,
+             "backend": "tpu"},               # not a dispatch family
+            {"kernel": "flat_adam", "speedup": None, "backend": "tpu"},
+        ]
+        p = tmp_path / "prefs.json"
+        prefs = kb.write_prefs(rows, str(p))
+        data = _json.loads(p.read_text())
+        # one slow shape disables the family; missing speedups ignored
+        assert prefs == {"layer_norm": False, "attention": True}
+        assert data["prefer_pallas"] == prefs

@@ -70,9 +70,46 @@ def bench_pair(name, shape_desc, dtype, kern, oracle, *args, grad=False):
                         if o_ms is not None and k_ms else None)}
 
 
+# kernel_bench row name -> dispatch op family (apex_tpu.ops._dispatch)
+_OP_FAMILY = {
+    "flash_attention": "attention",
+    "fused_layer_norm": "layer_norm",
+    "scaled_upper_triang_masked_softmax": "softmax",
+    "softmax_cross_entropy": "xentropy",
+    "flat_adam": "multi_tensor",
+    "welford_mean_var": "welford",
+}
+
+
+def write_prefs(rows, path):
+    """Distill measured rows into the dispatch preference table
+    (VERDICT r2 #2): an op family prefers Pallas only if NO measured
+    shape was slower than its XLA oracle (speedup < 1.0 anywhere ->
+    the oracle path wins by default; re-tune, then re-measure)."""
+    fam = {}
+    for r in rows:
+        base = r["kernel"].removesuffix("_grad")
+        op = _OP_FAMILY.get(base)
+        if op is None or r.get("speedup") is None:
+            continue
+        fam.setdefault(op, []).append(float(r["speedup"]))
+    prefs = {op: min(sp) >= 1.0 for op, sp in fam.items()}
+    out = {"prefer_pallas": prefs,
+           "source": "tools/kernel_bench.py",
+           "backend": rows[0]["backend"] if rows else "unknown",
+           "speedups": {op: sorted(sp) for op, sp in fam.items()}}
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return prefs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--csv", default="")
+    ap.add_argument("--write-prefs", action="store_true",
+                    help="write apex_tpu/ops/dispatch_prefs.json from "
+                         "the measured speedups")
     args = ap.parse_args()
 
     import jax
@@ -168,6 +205,13 @@ def main():
             r["speedup"] = round(r["oracle_ms"] / r["kernel_ms"], 2)
         rows.append(r)
 
+    # welford mean/var (SyncBN's local-stats kernel), NHWC-flat shape
+    from apex_tpu.ops import welford as wf
+    xw = jax.random.normal(key, (64 * 56 * 56, 256), jnp.bfloat16)
+    rows.append(bench_pair("welford_mean_var", "200704x256", "bf16",
+                           wf.welford_mean_var, wf.welford_mean_var_ref,
+                           xw))
+
     # multi-tensor substrate
     n = 1 << 24
     p = jax.random.normal(key, (n,), jnp.float32)
@@ -190,6 +234,10 @@ def main():
             w = csv.DictWriter(f, fieldnames=list(rows[0]))
             w.writeheader()
             w.writerows(rows)
+    if args.write_prefs:
+        from apex_tpu.ops import _dispatch
+        prefs = write_prefs(rows, _dispatch._PREFS_PATH)
+        print(json.dumps({"prefs_written": prefs}), flush=True)
 
 
 if __name__ == "__main__":
