@@ -6,8 +6,14 @@ This repo's suites are plain pytest; this driver maps the reference's
 suite names onto them so the reference's invocation habit
 (`python tests/run_test.py --include run_amp`) keeps working.
 
-    python tests/run_test.py                      # everything
+    python tests/run_test.py                      # fast tier (default)
+    python tests/run_test.py --tier full          # everything (nightly)
     python tests/run_test.py --include run_amp run_optimizers
+
+Tiers (VERDICT r2 #9): the default FAST tier excludes tests marked
+``slow`` (integration-weight suites, listed centrally in
+tests/conftest.py) and round-trips in ~5 minutes on the 1-core CI box;
+the FULL tier runs everything and is the nightly/pre-merge bar.
 """
 
 from __future__ import annotations
@@ -58,6 +64,9 @@ def main():
     p.add_argument("--include", nargs="+", default=None,
                    help=f"suites: {sorted(SUITES)}")
     p.add_argument("--exclude", nargs="*", default=[])
+    p.add_argument("--tier", choices=("fast", "full"), default="fast",
+                   help="fast (default): skip @slow tests; "
+                        "full: run everything (nightly bar)")
     args, passthrough = p.parse_known_args()
 
     names = args.include if args.include else sorted(SUITES)
@@ -69,7 +78,9 @@ def main():
         if n not in args.exclude:
             files += SUITES[n]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    cmd = [sys.executable, "-m", "pytest", "-q", *files, *passthrough]
+    tier = ["-m", "not slow"] if args.tier == "fast" else []
+    cmd = [sys.executable, "-m", "pytest", "-q", *tier, *files,
+           *passthrough]
     print(" ".join(cmd))
     sys.exit(subprocess.call(cmd, cwd=root))
 
