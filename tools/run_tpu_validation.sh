@@ -1,10 +1,23 @@
 #!/bin/bash
-# Serial TPU validation: smoke suite, then bench. ONE TPU client at a
-# time; nothing here kills a TPU-attached process (a killed client
-# wedges the single-client tunnel for a long time — see
-# tests/test_tpu_smoke.py header).
+# Serial TPU validation: everything the round needs from ONE tunnel
+# window, strictly sequentially (the axon tunnel admits ONE client at
+# a time; nothing here kills a TPU-attached process — a killed client
+# wedges the tunnel for a long time, see tests/test_tpu_smoke.py).
+#
+# Phases (each its own client, 60s etiquette gap between):
+#   1. bounded probe            — abort early if the tunnel is down
+#   2. TPU smoke suite          — every Pallas kernel non-interpreted
+#                                 vs its oracle (target: 37/37)
+#   3. kernel bench             — per-kernel vs XLA oracle timings ->
+#                                 bench_kernels.csv + dispatch prefs
+#   4. bench.py                 — tracked metrics (ResNet-50 imgs/sec,
+#                                 BERT-L step, MFU) -> bench JSON
+#
+# Artifacts land in tools/artifacts/ for committing.
 set -u
 cd "$(dirname "$0")/.."
+ART=tools/artifacts
+mkdir -p "$ART"
 
 echo "== probe =="
 # bounded probe first: a wedged tunnel blocks jax.devices() forever, and
@@ -20,12 +33,12 @@ echo "== TPU smoke suite =="
 # header); the bounded probe above already guards the hang case that
 # matters (backend init), and bench.py has its own internal watchdogs
 APEX_TPU_SMOKE=1 python -m pytest tests/test_tpu_smoke.py -v \
-    > /tmp/smoke_tpu.log 2>&1
+    > "$ART/smoke_tpu.log" 2>&1
 smoke_rc=$?
-tail -5 /tmp/smoke_tpu.log
+tail -5 "$ART/smoke_tpu.log"
 # pytest exits 0 on all-skipped (backend never initialized): that is a
 # FAILED validation, not a pass
-if ! grep -qE "[0-9]+ passed" /tmp/smoke_tpu.log; then
+if ! grep -qE "[0-9]+ passed" "$ART/smoke_tpu.log"; then
     echo "smoke: no tests actually ran (all skipped or collection failed)"
     smoke_rc=1
 fi
@@ -33,14 +46,25 @@ echo "smoke rc=$smoke_rc"
 
 sleep 60    # gap before the next client
 
+echo "== kernel bench (csv + dispatch prefs) =="
+# also uncapped: it is a TPU-attached client
+python tools/kernel_bench.py --csv "$ART/bench_kernels.csv" \
+    --write-prefs > "$ART/bench_kernels.jsonl" 2>"$ART/bench_kernels.err"
+kb_rc=$?
+tail -3 "$ART/bench_kernels.jsonl"
+echo "kernel_bench rc=$kb_rc"
+
+sleep 60    # gap before the next client
+
 echo "== bench =="
-python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
-cat /tmp/bench_tpu.json
+python bench.py > "$ART/bench_tpu.json" 2>"$ART/bench_tpu.err"
+cat "$ART/bench_tpu.json"
 # bench.py always exits 0 by design; judge the JSON instead
-bench_rc=$(python - <<'EOF'
-import json
+bench_rc=$(ART="$ART" python - <<'EOF'
+import json, os
 try:
-    out = json.load(open("/tmp/bench_tpu.json"))
+    out = json.load(open(os.path.join(os.environ["ART"],
+                                      "bench_tpu.json")))
     ok = (out.get("backend") == "tpu" and float(out.get("value", 0)) > 0
           and not out.get("errors"))
     print(0 if ok else 1)
@@ -50,4 +74,9 @@ EOF
 )
 echo "bench rc=$bench_rc"
 
-exit $(( smoke_rc != 0 || bench_rc != 0 ? 1 : 0 ))
+echo "== summary =="
+echo "smoke=$smoke_rc kernel_bench=$kb_rc bench=$bench_rc  (0 = pass)"
+echo "artifacts in $ART/: smoke_tpu.log bench_kernels.{csv,jsonl} bench_tpu.json"
+echo "next: review dispatch_prefs.json + commit artifacts"
+
+exit $(( smoke_rc != 0 || kb_rc != 0 || bench_rc != 0 ? 1 : 0 ))
