@@ -76,6 +76,25 @@ def _block(s: int, cap: int) -> int:
     return cap if sp % cap == 0 else _LANES
 
 
+def _block_cap(dp: int) -> int:
+    """Sequence-block cap: tunable via APEX_TPU_ATTN_BLOCK_CAP (a
+    128-multiple; tools/kernel_bench.py --sweep-attn sweeps it on
+    hardware), else a VMEM-safe default by padded head dim."""
+    import os
+    env = os.environ.get("APEX_TPU_ATTN_BLOCK_CAP")
+    if env:
+        try:
+            cap = int(env)
+        except ValueError:
+            cap = -1
+        if cap <= 0 or cap % _LANES:
+            raise ValueError(
+                f"APEX_TPU_ATTN_BLOCK_CAP must be a positive multiple "
+                f"of {_LANES}, got {env!r}")
+        return cap
+    return 512 if dp <= 128 else (256 if dp <= 256 else 128)
+
+
 def _geom(q, k):
     """Shared fwd/bwd tiling geometry — the saved lse layout depends on
     it, so both passes MUST derive it from this one place.
@@ -87,7 +106,7 @@ def _geom(q, k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     dp = _round_up(d, _LANES)
-    cap = 512 if dp <= 128 else (256 if dp <= 256 else 128)
+    cap = _block_cap(dp)
     bq = _block(sq, cap)
     bk = _block(sk, cap)
     sqp, skp = _round_up(sq, bq), _round_up(sk, bk)

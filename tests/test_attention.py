@@ -270,3 +270,30 @@ def test_ulysses_attention_rejects_indivisible_heads():
         jax.jit(comm.shard_map(
             f, mesh, in_specs=(P(None, None, comm.AXIS_CTX, None),),
             out_specs=P(None, None, comm.AXIS_CTX, None)))(q)
+
+
+def test_attn_block_cap_env_knob(monkeypatch):
+    """APEX_TPU_ATTN_BLOCK_CAP (swept by kernel_bench --sweep-attn on
+    hardware) overrides the default geometry; bad values fail loudly;
+    the kernel stays correct at a non-default cap."""
+    from apex_tpu.ops import attention as A
+
+    q = jnp.zeros((1, 1, 512, 64), jnp.float32)
+    k = jnp.zeros((1, 1, 512, 64), jnp.float32)
+    assert A._geom(q, k)[6] == 512            # default cap at dp=128
+    monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "256")
+    assert A._geom(q, k)[6] == 256
+    monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "100")
+    with pytest.raises(ValueError, match="multiple of 128"):
+        A._geom(q, k)
+    # correctness at a GENUINELY overridden geometry: s=512 with
+    # cap=128 tiles 4x4 blocks where the default cap (512) would run a
+    # single block — a silently ignored env var would not change tiling
+    monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "128")
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 512, 64)) for kk in ks)
+    assert A._geom(q, k)[6] == 128            # bq actually overridden
+    got = A.flash_attention(q, k, v, causal=True)
+    want = A.attention_ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -110,6 +110,9 @@ def main():
     ap.add_argument("--write-prefs", action="store_true",
                     help="write apex_tpu/ops/dispatch_prefs.json from "
                          "the measured speedups")
+    ap.add_argument("--sweep-attn", action="store_true",
+                    help="sweep APEX_TPU_ATTN_BLOCK_CAP geometries for "
+                         "the flash kernel and report the best")
     args = ap.parse_args()
 
     import jax
@@ -204,6 +207,44 @@ def main():
             r["oracle_ms"] = rows[-1]["oracle_ms"]
             r["speedup"] = round(r["oracle_ms"] / r["kernel_ms"], 2)
         rows.append(r)
+
+    # flash geometry sweep: find the best sequence-block cap per shape
+    # (re-jit per cap — the env knob is read at trace time)
+    if args.sweep_attn:
+        import os as _os
+        for (b, h, s, d) in [(8, 16, 512, 64), (4, 16, 2048, 128)]:
+            ks = jax.random.split(jax.random.key(7), 3)
+            q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+                       for kk in ks)
+            best = None
+            for cap in (128, 256, 512, 1024):
+                if cap > ((s + 127) // 128) * 128:
+                    continue
+                _os.environ["APEX_TPU_ATTN_BLOCK_CAP"] = str(cap)
+                try:
+                    fn = jax.jit(jax.grad(
+                        lambda q, k, v: jnp.sum(attn.flash_attention(
+                            q, k, v, causal=True).astype(jnp.float32) ** 2),
+                        argnums=(0, 1, 2)))
+                    ms = time_fn(fn, q, k, v)
+                except Exception as e:
+                    print(json.dumps({"sweep": "attention", "cap": cap,
+                                      "shape": f"b{b}h{h}s{s}d{d}",
+                                      "error": repr(e)[:200]}), flush=True)
+                    continue
+                finally:
+                    _os.environ.pop("APEX_TPU_ATTN_BLOCK_CAP", None)
+                print(json.dumps({"sweep": "attention", "cap": cap,
+                                  "shape": f"b{b}h{h}s{s}d{d}",
+                                  "fwdbwd_ms": round(ms, 3)}), flush=True)
+                if best is None or ms < best[1]:
+                    best = (cap, ms)
+            if best:
+                print(json.dumps({"sweep": "attention",
+                                  "shape": f"b{b}h{h}s{s}d{d}",
+                                  "best_cap": best[0],
+                                  "best_ms": round(best[1], 3)}),
+                      flush=True)
 
     # welford mean/var (SyncBN's local-stats kernel), NHWC-flat shape
     from apex_tpu.ops import welford as wf

@@ -52,6 +52,12 @@ python tools/kernel_bench.py --csv "$ART/bench_kernels.csv" \
     --write-prefs > "$ART/bench_kernels.jsonl" 2>"$ART/bench_kernels.err"
 kb_rc=$?
 tail -3 "$ART/bench_kernels.jsonl"
+# kernel_bench exits 0 when it skips off-TPU (tunnel dropped between
+# phases): no TPU-labeled rows means the phase did NOT validate
+if ! grep -q '"backend": "tpu"' "$ART/bench_kernels.jsonl"; then
+    echo "kernel_bench: no TPU rows (backend fell back?); phase failed"
+    kb_rc=1
+fi
 echo "kernel_bench rc=$kb_rc"
 
 sleep 60    # gap before the next client
