@@ -69,17 +69,33 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _block(s: int, cap: int) -> int:
+def _block(s: int, cap: int, explicit: bool = False) -> int:
     """Block size for a sequence dim: 128-multiple, <= cap, dividing the
-    padded length."""
+    padded length.  The cap is clamped to the padded length (a short
+    sequence runs as one block rather than falling to 128); a
+    non-dividing cap falls back to 128 — loudly when it was an explicit
+    APEX_TPU_ATTN_BLOCK_CAP, since silently tiling at 128 would be a
+    perf regression the operator asked against."""
     sp = _round_up(s, _LANES)
-    return cap if sp % cap == 0 else _LANES
+    if sp:                 # sp==0 (degenerate dim): keep old behavior
+        cap = min(cap, sp)
+    if sp % cap == 0:
+        return cap
+    if explicit:
+        import warnings
+        warnings.warn(
+            f"APEX_TPU_ATTN_BLOCK_CAP={cap} does not divide the padded "
+            f"sequence length {sp}; falling back to 128-blocks for "
+            f"this shape")
+    return _LANES
 
 
-def _block_cap(dp: int) -> int:
-    """Sequence-block cap: tunable via APEX_TPU_ATTN_BLOCK_CAP (a
+def _block_cap(dp: int):
+    """(cap, explicit): tunable via APEX_TPU_ATTN_BLOCK_CAP (a
     128-multiple; tools/kernel_bench.py --sweep-attn sweeps it on
-    hardware), else a VMEM-safe default by padded head dim."""
+    hardware), else a VMEM-safe default by padded head dim.  The env
+    var is read and interpreted HERE only; ``explicit`` tells _block
+    to complain loudly when the requested cap can't be honored."""
     import os
     env = os.environ.get("APEX_TPU_ATTN_BLOCK_CAP")
     if env:
@@ -91,8 +107,8 @@ def _block_cap(dp: int) -> int:
             raise ValueError(
                 f"APEX_TPU_ATTN_BLOCK_CAP must be a positive multiple "
                 f"of {_LANES}, got {env!r}")
-        return cap
-    return 512 if dp <= 128 else (256 if dp <= 256 else 128)
+        return cap, True
+    return (512 if dp <= 128 else (256 if dp <= 256 else 128)), False
 
 
 def _geom(q, k):
@@ -106,9 +122,9 @@ def _geom(q, k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     dp = _round_up(d, _LANES)
-    cap = _block_cap(dp)
-    bq = _block(sq, cap)
-    bk = _block(sk, cap)
+    cap, explicit = _block_cap(dp)
+    bq = _block(sq, cap, explicit)
+    bk = _block(sk, cap, explicit)
     sqp, skp = _round_up(sq, bq), _round_up(sk, bk)
     return b, h, sq, sk, d, dp, bq, bk, sqp, skp
 

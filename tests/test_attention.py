@@ -278,9 +278,14 @@ def test_attn_block_cap_env_knob(monkeypatch):
     the kernel stays correct at a non-default cap."""
     from apex_tpu.ops import attention as A
 
+    monkeypatch.delenv("APEX_TPU_ATTN_BLOCK_CAP", raising=False)
     q = jnp.zeros((1, 1, 512, 64), jnp.float32)
     k = jnp.zeros((1, 1, 512, 64), jnp.float32)
     assert A._geom(q, k)[6] == 512            # default cap at dp=128
+    # a cap above the padded length clamps to one block, not 128
+    monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "1024")
+    assert A._geom(q, k)[6] == 512
+    monkeypatch.delenv("APEX_TPU_ATTN_BLOCK_CAP")
     monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "256")
     assert A._geom(q, k)[6] == 256
     monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "100")

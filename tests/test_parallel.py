@@ -153,3 +153,67 @@ def test_syncbn_large_mean_stability():
     np.testing.assert_allclose(np.asarray(var),
                                np.asarray(jnp.var(x, 0)), rtol=1e-2)
     assert float(var.min()) > 1e-4
+
+
+def test_ddp_syncbn_resnet_config5_matches_full_batch():
+    """BASELINE config 5 at CI scale: DDP + SyncBatchNorm on a
+    Bottleneck ResNet (resnet101's block family, tiny depth) over a
+    dp=8 mesh.  The whole point of SyncBN under DDP: per-shard grads
+    after the DDP reduction equal the single-device FULL-batch grads,
+    because the BN stats are synced over the data axis."""
+    import functools
+    from apex_tpu.models import ResNet
+    from apex_tpu.models.resnet import Bottleneck as RBottleneck
+
+    model = ResNet(
+        block_cls=RBottleneck, stage_sizes=[1, 1], num_classes=4,
+        width=8,
+        norm_cls=functools.partial(SyncBatchNorm, channel_last=True,
+                                   process_group=comm.AXIS_DATA))
+    x = jax.random.normal(jax.random.key(0), (16, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(1), (16,), 0, 4)
+    variables = model.init(jax.random.key(2), x, train=False)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p, st, xs, ys):
+        logits, upd = model.apply({"params": p, "batch_stats": st},
+                                  xs, train=True,
+                                  mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(ys, 4)
+        loss = -jnp.mean(jnp.sum(
+            jax.nn.log_softmax(logits) * onehot, axis=-1))
+        return loss, upd["batch_stats"]
+
+    # oracle: single device, full batch (no axis bound -> local stats
+    # ARE full-batch stats)
+    comm.destroy()
+    (want_loss, want_stats), want_g = jax.value_and_grad(
+        loss_fn, has_aux=True)(params, stats, x, y)
+
+    # dp=8: batch sharded, SyncBN syncs stats, DDP reduces grads
+    mesh = comm.initialize(data=8)
+    ddp = DistributedDataParallel(None)
+
+    def step(p, st, xs, ys):
+        (loss, new_st), g = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, st, xs, ys)
+        return (jax.lax.pmean(loss, comm.AXIS_DATA),
+                jax.tree_util.tree_map(
+                    lambda s: jax.lax.pmean(s, comm.AXIS_DATA), new_st),
+                ddp.reduce_gradients(g))
+
+    loss, new_stats, g = jax.jit(comm.shard_map(
+        step, mesh,
+        in_specs=(P(), P(), P(comm.AXIS_DATA), P(comm.AXIS_DATA)),
+        out_specs=(P(), P(), P())))(params, stats, x, y)
+
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        g, want_g)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        new_stats, want_stats)

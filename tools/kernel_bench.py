@@ -218,7 +218,7 @@ def main():
                        for kk in ks)
             best = None
             for cap in (128, 256, 512, 1024):
-                if cap > ((s + 127) // 128) * 128:
+                if cap > attn._round_up(s, attn._LANES):
                     continue
                 _os.environ["APEX_TPU_ATTN_BLOCK_CAP"] = str(cap)
                 try:
