@@ -90,6 +90,18 @@ def _block(s: int, cap: int, explicit: bool = False) -> int:
     return _LANES
 
 
+def _attn_family(dtype) -> str:
+    """Dispatch family for the flash kernel, split by precision class:
+    f32 operands dot at Precision.HIGHEST (multi-pass MXU), a very
+    different cost model from native-rate bf16 — so a hardware
+    measurement that flips one class to the XLA path must not take the
+    other down with it (kernel_bench rows map f32 shapes to
+    'attention_f32')."""
+    return ("attention_f32"
+            if jnp.dtype(dtype) == jnp.dtype(jnp.float32) else
+            "attention")
+
+
 def _block_cap(dp: int):
     """(cap, explicit): tunable via APEX_TPU_ATTN_BLOCK_CAP (a
     128-multiple; tools/kernel_bench.py --sweep-attn sweeps it on
@@ -553,7 +565,7 @@ def flash_attention(q, k, v, causal=False, scale=None,
         dt = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype),
                                v.dtype)
         q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
-    if not op_enabled("attention"):
+    if not op_enabled(_attn_family(q.dtype)):
         sc = scale if scale is not None else _default_scale(q.shape[-1])
         # jax.checkpoint: don't hold the (Sq, Sk) probability residual
         # between fwd and bwd on the escape-hatch path
@@ -762,7 +774,7 @@ def ring_attention(q, k, v, causal=False, scale=None,
     ``ring_attention_ref`` (plain scan + ppermute, fully transposable)
     or set APEX_TPU_DISABLE_PALLAS=1.
     """
-    if op_enabled("attention"):
+    if op_enabled(_attn_family(q.dtype)):
         return _ring(q, k, v, causal, scale, axis)
     return ring_attention_ref(q, k, v, causal=causal, scale=scale,
                               axis=axis)

@@ -302,3 +302,43 @@ def test_attn_block_cap_env_knob(monkeypatch):
     want = A.attention_ref(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_f32_attention_is_its_own_dispatch_family(monkeypatch):
+    """A hardware measurement that routes f32 flash to the XLA path
+    (Precision.HIGHEST multi-pass dots may lose there) must NOT take
+    the bf16 kernel down with it — and vice versa."""
+    from apex_tpu.ops import _dispatch, attention as A
+
+    monkeypatch.setattr(_dispatch, "_PREFS", {"attention_f32": False})
+    ks = jax.random.split(jax.random.key(0), 3)
+    qf, kf, vf = (jax.random.normal(kk, (1, 2, 256, 64)) for kk in ks)
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (qf, kf, vf))
+
+    def prims(jx):
+        out = set()
+        def walk(j):
+            for e in j.eqns:
+                out.add(e.primitive.name)
+                for p in e.params.values():
+                    if hasattr(p, "jaxpr"):
+                        walk(p.jaxpr)
+        walk(jx.jaxpr)
+        return out
+
+    # recursive walk is load-bearing: a pallas_call only ever appears
+    # nested inside the kernel's custom_vjp_call, never at top level
+    jx32 = jax.make_jaxpr(
+        lambda q, k, v: A.flash_attention(q, k, v, causal=True))(
+        qf, kf, vf)
+    assert "pallas_call" not in prims(jx32)
+
+    jx16 = jax.make_jaxpr(
+        lambda q, k, v: A.flash_attention(q, k, v, causal=True))(
+        qb, kb, vb)
+    assert "pallas_call" in prims(jx16)
+    # f32 output stays correct through the rerouted path
+    got = A.flash_attention(qf, kf, vf, causal=True)
+    want = A.attention_ref(qf, kf, vf, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)

@@ -73,6 +73,7 @@ def bench_pair(name, shape_desc, dtype, kern, oracle, *args, grad=False):
 # kernel_bench row name -> dispatch op family (apex_tpu.ops._dispatch)
 _OP_FAMILY = {
     "flash_attention": "attention",
+    "flash_attention_f32": "attention_f32",
     "fused_layer_norm": "layer_norm",
     "scaled_upper_triang_masked_softmax": "softmax",
     "softmax_cross_entropy": "xentropy",
@@ -141,6 +142,15 @@ def main():
     from apex_tpu.ops import softmax as sm
     from apex_tpu.ops import xentropy as xe
 
+    # Pin every family to its Pallas path WHILE TIMING: the bench's
+    # whole purpose is kernel-vs-oracle, but the public entry points
+    # route through op_enabled — with a previously written
+    # dispatch_prefs.json disabling a family, its "kernel" timing
+    # would silently measure the oracle and the preference would
+    # oscillate between bench runs (env override beats the table).
+    os.environ["APEX_TPU_PREFER_PALLAS"] = ",".join(
+        sorted(set(_OP_FAMILY.values())))
+
     rows = []
     key = jax.random.key(0)
 
@@ -158,6 +168,19 @@ def main():
         for grad in (False, True):
             rows.append(bench_pair("flash_attention", f"b{b}h{h}s{s}d{d}",
                                    "bf16", f_k, f_o, q, k, v, grad=grad))
+
+    # f32 precision class: HIGHEST-precision multi-pass dots — its own
+    # dispatch family (attention_f32) so a loss here cannot disable the
+    # bf16 kernel
+    b, h, s, d = 8, 16, 512, 64
+    ks = jax.random.split(jax.random.key(5), 3)
+    qf, kf, vf = (jax.random.normal(kk, (b, h, s, d), jnp.float32)
+                  for kk in ks)
+    rows.append(bench_pair(
+        "flash_attention_f32", f"b{b}h{h}s{s}d{d}", "f32",
+        functools.partial(attn.flash_attention, causal=True),
+        functools.partial(attn.attention_ref, causal=True),
+        qf, kf, vf, grad=True))
 
     # layer norm
     for (r, hdim) in [(8192, 1024), (4096, 4096)]:
