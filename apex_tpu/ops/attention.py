@@ -774,6 +774,13 @@ def ring_attention(q, k, v, causal=False, scale=None,
     ``ring_attention_ref`` (plain scan + ppermute, fully transposable)
     or set APEX_TPU_DISABLE_PALLAS=1.
     """
+    # normalize mixed dtypes BEFORE picking the dispatch family, so
+    # this entry point and flash_attention consult the same precision
+    # class for identical inputs
+    if not (q.dtype == k.dtype == v.dtype):
+        dt = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype),
+                               v.dtype)
+        q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
     if op_enabled(_attn_family(q.dtype)):
         return _ring(q, k, v, causal, scale, axis)
     return ring_attention_ref(q, k, v, causal=causal, scale=scale,

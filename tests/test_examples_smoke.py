@@ -42,3 +42,8 @@ def test_gpt_block_tiny(capsys):
          ["--cpu", "--steps", "2", "--layers", "1", "--hidden", "64",
           "--heads", "4", "--seq-len", "64", "--batch-size", "2"])
     assert "step time" in capsys.readouterr().out
+
+
+def test_train_pp_1f1b_converges(capsys):
+    _run("examples/simple/train_pp.py", [])
+    assert "OK: loss" in capsys.readouterr().out
