@@ -34,3 +34,19 @@ def select_platform(platform: Optional[str] = None) -> Optional[str]:
     if p:
         jax.config.update("jax_platforms", p)
     return p
+
+
+def enable_compilation_cache(min_compile_secs: float = 1.0) -> None:
+    """Point jax at the repo's persistent executable cache (best
+    effort) so repeat tool runs skip the slow first compile.  Shared by
+    bench.py / tools/kernel_bench.py / tools/profile_step.py."""
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+    except Exception:
+        pass

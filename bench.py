@@ -324,13 +324,8 @@ def run_child(backend):
         import jax
         # Persistent executable cache: repeat bench runs skip the
         # multi-minute first compile of the train steps.
-        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass
+        from apex_tpu.platform import enable_compilation_cache
+        enable_compilation_cache()
         if not on_tpu:
             # sitecustomize force-registers the axon TPU plugin; env vars
             # are too late once jax is imported, so flip the live config

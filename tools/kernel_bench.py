@@ -118,16 +118,11 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    from apex_tpu.platform import select_platform
+    from apex_tpu.platform import enable_compilation_cache, \
+        select_platform
     select_platform()          # honor APEX_TPU_PLATFORM (e.g. cpu)
     import os
-    cache = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), ".jax_cache")
-    try:   # same guarded idiom as bench.py
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    enable_compilation_cache()
     backend = jax.default_backend()
     if backend != "tpu":
         # interpret-mode Pallas timings are meaningless AND impractically

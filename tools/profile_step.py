@@ -1,0 +1,68 @@
+"""Capture a jax.profiler trace of the north-star training step
+(ResNet-50 amp O2 + FusedSGD — BASELINE.md) for the step-time
+breakdown in docs/perf.md.
+
+    python tools/profile_step.py [--outdir /tmp/apex_tpu_trace]
+
+Writes a TensorBoard/XProf trace directory and prints one JSON line
+with the measured step time (and MFU when the chip is recognized).
+Run it on the TPU (falls back to a labeled CPU trace off-TPU with
+tiny shapes — still useful for host-side pipeline inspection).
+ONE tunnel client at a time: do not run concurrently with bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="/tmp/apex_tpu_trace")
+    args = ap.parse_args()
+
+    # reuse bench.py's bounded tunnel probe BEFORE any in-process
+    # backend init: a dead tunnel hangs jax.default_backend() forever
+    # and the stuck client can't be safely killed (tunnel etiquette)
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    from apex_tpu.platform import enable_compilation_cache, \
+        select_platform
+    forced = select_platform()
+    if forced is None and not bench.probe_tpu(180.0):
+        print("# tunnel probe failed; falling back to cpu",
+              file=sys.stderr)
+        select_platform("cpu")
+
+    import jax
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+
+    # bench.py's exact north-star step so the trace matches the
+    # reported number
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    with jax.profiler.trace(args.outdir):
+        r = bench.bench_resnet50_amp_o2(jax, jnp, on_tpu)
+    wall = time.perf_counter() - t0
+    out = {"trace_dir": args.outdir, "backend": backend,
+           "wall_s": round(wall, 1),
+           "resnet50_step_ms": round(r["step_ms"], 2),
+           "imgs_per_sec": round(r["imgs_per_sec"], 1)}
+    if r.get("mfu") is not None:
+        out["mfu"] = r["mfu"]
+    print(json.dumps(out))
+    print(f"# view: tensorboard --logdir {args.outdir}  (Profile tab)",
+          file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
