@@ -10,6 +10,9 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
     P2PContext,
 )
+from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b import (
+    spmd_pipeline_interleaved_1f1b,
+)
 from apex_tpu.transformer.pipeline_parallel.spmd import (
     spmd_pipeline,
     spmd_pipeline_1f1b,
@@ -33,6 +36,7 @@ __all__ = [
     "P2PContext",
     "spmd_pipeline", "spmd_pipeline_1f1b",
     "spmd_pipeline_1f1b_apply", "spmd_pipeline_interleaved",
+    "spmd_pipeline_interleaved_1f1b",
     "spmd_pipeline_loss",
     "get_kth_microbatch", "get_num_microbatches", "listify_model",
     "setup_microbatch_calculator", "split_into_microbatches",

@@ -1,0 +1,384 @@
+"""SPMD interleaved 1F1B: the reference's production schedule
+(_forward_backward_pipelining_with_interleaving — virtual model chunks
+AND one-forward-one-backward steady state) as ONE compiled scan over
+the "pipe" mesh axis.
+
+Design: schedule-as-data.  All of the schedule's notorious index
+arithmetic runs in plain Python at trace time
+(:func:`build_schedule`): a greedy list-scheduler assigns every
+forward/backward work item ``(virtual stage v, microbatch j)`` to a
+synchronous tick under the pipeline's dataflow dependencies, with
+backwards preferred over forwards (the 1F1B invariant that bounds
+in-flight activations).  The result is a set of static integer tables
+``[T, P]`` — per tick, per physical stage: which chunk/microbatch to
+forward, which to backward, and which statically-colored buffer slots
+to write arrivals into and read operands from.  The jax scan body then
+does no scheduling at all: it gathers its tick's table row, computes,
+scatters, and rotates payloads one hop along the ring
+(``ppermute`` down for activations, up for cotangents).
+
+Placement matches the host schedule and ``spmd_pipeline_interleaved``:
+global chunk ``v = c*P + s`` lives on physical stage ``s = v mod P``
+at local slot ``c = v div P``, so both activation hops and cotangent
+hops are always exactly one ring neighbor.
+
+Memory: saved forward inputs (for the recompute-style backward),
+arrived activations, and arrived cotangents each live in per-stage
+ring buffers whose slots are assigned by interval coloring of the
+static lifetimes — the live window tracks the schedule's actual
+concurrency (O(P·V)), independent of the microbatch count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import comm
+
+Pytree = Any
+
+
+# ---------------------------------------------------------------------
+# Static scheduling (plain Python/numpy; unit-tested directly)
+# ---------------------------------------------------------------------
+
+def _greedy_ticks(P: int, V: int, M: int):
+    """Assign every F/B work item a tick.
+
+    Returns (f_tick, b_tick): dicts (v, j) -> tick.  Dependencies:
+
+    - F(v, j) needs F(v-1, j)'s output, which arrives one tick after
+      its producer ran (ppermute hop); F(0, j) reads the local
+      microbatch stream and is always ready.
+    - B(v, j) needs B(v+1, j)'s input-cotangent (one-tick hop); the
+      LAST virtual stage seeds its cotangent from the loss in the same
+      tick as its own forward (in-tick turnaround, as in the
+      non-interleaved 1F1B scan).
+    - Each physical stage runs at most one F and one B per tick, and
+      same-type items execute in (v-major, then j) dependency order
+      per stage automatically via readiness.
+
+    Greedy rule per tick per stage: schedule the oldest READY backward
+    if any (1F1B: drain before fill), and independently the oldest
+    READY forward — but only while the stage's in-flight count
+    (forwarded-not-yet-backwarded items, i.e. saved activations) is
+    below ``2·P·V − 1``.  That cap is what makes this 1F1B rather
+    than GPipe: the activation window stays O(P·V), independent of the
+    microbatch count (for V=1 it reduces to the non-interleaved scan's
+    2L−1 circular buffer).
+    """
+    PV = P * V
+    cap = 2 * PV - 1
+    f_tick: Dict[Tuple[int, int], int] = {}
+    b_tick: Dict[Tuple[int, int], int] = {}
+    # Within one chunk, readiness is monotone in j (microbatch j's
+    # producer runs after j-1's), so each (stage, chunk) work queue is
+    # a FIFO and only its HEAD can be ready: O(V) candidates per stage
+    # per tick, O(T·P·V) total.
+    f_head = {s: {v: 0 for v in range(s, PV, P)} for s in range(P)}
+    b_head = {s: {v: 0 for v in range(s, PV, P)} for s in range(P)}
+    remaining = 2 * PV * M
+    inflight = {s: 0 for s in range(P)}
+    t = 0
+    limit = 4 * (M * V + 2 * P * V) + 16
+    while remaining:
+        if t > limit:
+            raise RuntimeError(
+                f"interleaved-1f1b scheduler did not converge "
+                f"(P={P}, V={V}, M={M}, tick {t})")
+        for s in range(P):
+            # backward first (does not consume the fwd slot); lowest
+            # ready (v, j) — per-chunk heads, ascending v
+            for v in sorted(b_head[s]):
+                j = b_head[s][v]
+                if j >= M:
+                    continue
+                if v == PV - 1:
+                    tf = f_tick.get((v, j))
+                    ready = tf is not None and tf <= t
+                else:
+                    tb = b_tick.get((v + 1, j))
+                    ready = tb is not None and tb + 1 <= t
+                # recompute needs the saved input: fwd ran at <= t
+                if ready:
+                    tf_own = f_tick.get((v, j))
+                    ready = tf_own is not None and tf_own <= t
+                if ready:
+                    b_tick[(v, j)] = t
+                    b_head[s][v] = j + 1
+                    inflight[s] -= 1
+                    remaining -= 1
+                    break
+            # one forward, gated by the in-flight (activation) cap.
+            # Among ready forwards pick the DEEPEST chunk (highest v):
+            # pushing microbatches toward the loss is what unlocks
+            # backwards — shallow-first hoarding fills the cap with
+            # chunk-0 activations and deadlocks the ring.
+            if inflight[s] < cap:
+                for v in sorted(f_head[s], reverse=True):
+                    j = f_head[s][v]
+                    if j >= M:
+                        continue
+                    if v == 0:
+                        ready = True
+                    else:
+                        tp = f_tick.get((v - 1, j))
+                        ready = tp is not None and tp + 1 <= t
+                    if ready:
+                        f_tick[(v, j)] = t
+                        f_head[s][v] = j + 1
+                        inflight[s] += 1
+                        remaining -= 1
+                        break
+        t += 1
+    return f_tick, b_tick
+
+
+def _color_intervals(intervals: List[Tuple[int, int]]) -> Tuple[List[int], int]:
+    """Greedy interval-graph coloring: [(start, end)] inclusive ->
+    (slot per interval, n_slots).  Two intervals may share a slot iff
+    they don't overlap."""
+    order = sorted(range(len(intervals)), key=lambda i: intervals[i][0])
+    free: List[int] = []
+    slots = [0] * len(intervals)
+    n = 0
+    import heapq
+    heap: List[Tuple[int, int]] = []
+    for i in order:
+        s0, e0 = intervals[i]
+        while heap and heap[0][0] < s0:
+            _, sl = heapq.heappop(heap)
+            free.append(sl)
+        if free:
+            sl = free.pop()
+        else:
+            sl = n
+            n += 1
+        slots[i] = sl
+        heapq.heappush(heap, (e0, sl))
+    return slots, max(n, 1)
+
+
+def build_schedule(P: int, V: int, M: int):
+    """All static tables for the interleaved-1F1B scan.
+
+    Returns a dict of numpy int32 arrays, each ``[T, P]`` unless noted:
+
+      f_ok/f_chunk/f_mb       — this tick's forward work
+      b_ok/b_chunk/b_mb       — this tick's backward work
+      f_src_slot              — abuf slot holding the fwd input
+                                 (-1: read the local microbatch stream)
+      a_wr_slot               — abuf slot to store the arriving
+                                 activation into (-1: discard)
+      x_wr_slot / x_rd_slot   — xbuf slot for the fwd input save /
+                                 the bwd recompute read
+      c_rd_slot               — cbuf slot holding the bwd cotangent
+                                 (-1: seed from the loss in-tick)
+      c_wr_slot               — cbuf slot for the arriving cotangent
+                                 (-1: discard)
+      sizes                   — dict: abuf/xbuf/cbuf slot counts, T
+    """
+    PV = P * V
+    f_tick, b_tick = _greedy_ticks(P, V, M)
+    T = 1 + max(max(f_tick.values()), max(b_tick.values()))
+
+    def table(fill=0):
+        return np.full((T, P), fill, np.int32)
+
+    f_ok, f_chunk, f_mb = table(), table(), table()
+    b_ok, b_chunk, b_mb = table(), table(), table()
+    f_src, a_wr = table(-1), table(-1)
+    x_wr, x_rd = table(-1), table(-1)
+    c_rd, c_wr = table(-1), table(-1)
+
+    # ---- lifetimes -> slots, per physical stage ----
+    ab_n = xb_n = cb_n = 1
+    for s in range(P):
+        # xbuf: fwd input saved at f_tick, read at b_tick (recompute)
+        items = [(v, j) for v in range(PV) if v % P == s
+                 for j in range(M)]
+        x_iv = [(f_tick[it], b_tick[it]) for it in items]
+        x_slots, xn = _color_intervals(x_iv)
+        xb_n = max(xb_n, xn)
+        # abuf: activation for F(v, j), v>0: arrives f_tick[v-1]+1,
+        # consumed at f_tick[v]
+        a_items = [it for it in items if it[0] > 0]
+        a_iv = [(f_tick[(v - 1, j)] + 1, f_tick[(v, j)])
+                for (v, j) in a_items]
+        a_slots, an = _color_intervals(a_iv) if a_iv else ([], 1)
+        ab_n = max(ab_n, an)
+        # cbuf: cotangent for B(v, j), v < PV-1: arrives
+        # b_tick[v+1]+1, consumed at b_tick[v]
+        c_items = [it for it in items if it[0] < PV - 1]
+        c_iv = [(b_tick[(v + 1, j)] + 1, b_tick[(v, j)])
+                for (v, j) in c_items]
+        c_slots, cn = _color_intervals(c_iv) if c_iv else ([], 1)
+        cb_n = max(cb_n, cn)
+
+        for idx, (v, j) in enumerate(items):
+            tf, tb = f_tick[(v, j)], b_tick[(v, j)]
+            f_ok[tf, s], f_chunk[tf, s], f_mb[tf, s] = 1, v // P, j
+            b_ok[tb, s], b_chunk[tb, s], b_mb[tb, s] = 1, v // P, j
+            x_wr[tf, s] = x_slots[idx]
+            x_rd[tb, s] = x_slots[idx]
+        for idx, (v, j) in enumerate(a_items):
+            arr_t = f_tick[(v - 1, j)] + 1
+            a_wr[arr_t, s] = a_slots[idx]
+            f_src[f_tick[(v, j)], s] = a_slots[idx]
+        for idx, (v, j) in enumerate(c_items):
+            arr_t = b_tick[(v + 1, j)] + 1
+            c_wr[arr_t, s] = c_slots[idx]
+            c_rd[b_tick[(v, j)], s] = c_slots[idx]
+
+    return {
+        "f_ok": f_ok, "f_chunk": f_chunk, "f_mb": f_mb,
+        "b_ok": b_ok, "b_chunk": b_chunk, "b_mb": b_mb,
+        "f_src_slot": f_src, "a_wr_slot": a_wr,
+        "x_wr_slot": x_wr, "x_rd_slot": x_rd,
+        "c_rd_slot": c_rd, "c_wr_slot": c_wr,
+        "sizes": {"abuf": ab_n, "xbuf": xb_n, "cbuf": cb_n, "T": T},
+        "_f_tick": f_tick, "_b_tick": b_tick,     # for tests
+    }
+
+
+# ---------------------------------------------------------------------
+# The scan (SPMD; use inside shard_map over the pipe axis)
+# ---------------------------------------------------------------------
+
+def spmd_pipeline_interleaved_1f1b(stage_fn: Callable,
+                                   loss_fn: Callable,
+                                   params_chunks: Pytree,
+                                   microbatches: jax.Array,
+                                   targets: jax.Array,
+                                   *, axis: str = comm.AXIS_PIPE):
+    """Interleaved 1F1B over the pipe axis: returns
+    ``(mean_loss, grads)`` with grads shaped like ``params_chunks``
+    (leading dim V = local chunks, global chunk ``c*P + s``).
+
+    ``stage_fn(params_chunk, x) -> y`` (one chunk's forward, same
+    shapes in and out); ``loss_fn(y, target_mb) -> scalar`` seeds the
+    last virtual stage's cotangent in the same tick as its forward.
+    Not itself differentiable (it IS the backward), like
+    ``spmd_pipeline_1f1b``.
+    """
+    L = jax.lax.axis_size(axis)
+    stage = jax.lax.axis_index(axis)
+    leaves = jax.tree_util.tree_leaves(params_chunks)
+    V = leaves[0].shape[0]
+    M = microbatches.shape[0]
+    sched = build_schedule(L, V, M)
+    sizes = sched["sizes"]
+    T = sizes["T"]
+    mb_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+
+    # tables as device arrays [T, P]; each rank slices its own column
+    tbl = {k: jnp.asarray(v) for k, v in sched.items()
+           if not k.startswith("_") and k != "sizes"}
+
+    perm_down = [(i, (i + 1) % L) for i in range(L)]
+    perm_up = [(i, (i - 1) % L) for i in range(L)]
+    i32 = jnp.int32
+
+    abuf0 = jnp.zeros((sizes["abuf"],) + mb_shape, dtype)
+    xbuf0 = jnp.zeros((sizes["xbuf"],) + mb_shape, dtype)
+    cbuf0 = jnp.zeros((sizes["cbuf"],) + mb_shape, dtype)
+    g0 = jax.tree_util.tree_map(jnp.zeros_like, params_chunks)
+    y0 = jnp.zeros(mb_shape, dtype)
+
+    def col(name, t):
+        row = jax.lax.dynamic_index_in_dim(tbl[name], t, axis=0,
+                                           keepdims=False)
+        return jax.lax.dynamic_index_in_dim(row, stage, axis=0,
+                                            keepdims=False)
+
+    def buf_write(buf, slot, val):
+        """Store val at slot (slot<0: keep old)."""
+        sl = jnp.clip(slot, 0, buf.shape[0] - 1)
+        old = jax.lax.dynamic_index_in_dim(buf, sl, axis=0,
+                                           keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(
+            buf, jnp.where(slot >= 0, val, old), sl, axis=0)
+
+    def buf_read(buf, slot):
+        return jax.lax.dynamic_index_in_dim(
+            buf, jnp.clip(slot, 0, buf.shape[0] - 1), axis=0,
+            keepdims=False)
+
+    def chunk_params(c):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(
+                p, jnp.clip(c, 0, V - 1), axis=0, keepdims=False),
+            params_chunks)
+
+    def tick(carry, t):
+        y_in, gx_in, abuf, xbuf, cbuf, gacc, loss_acc = carry
+
+        # ---- arrivals land in their statically-colored slots ----
+        abuf = buf_write(abuf, col("a_wr_slot", t), y_in)
+        cbuf = buf_write(cbuf, col("c_wr_slot", t), gx_in)
+
+        # ---- forward half ----
+        f_ok = col("f_ok", t) == 1
+        fc = col("f_chunk", t)
+        fj = col("f_mb", t)
+        src = col("f_src_slot", t)
+        mb_t = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(fj, 0, M - 1), axis=0,
+            keepdims=False)
+        x = jnp.where(src >= 0, buf_read(abuf, src), mb_t)
+        pf = chunk_params(fc)
+        y = stage_fn(pf, x)
+        xbuf = buf_write(xbuf, jnp.where(f_ok, col("x_wr_slot", t),
+                                         -1), x)
+
+        # ---- backward half ----
+        b_ok = col("b_ok", t) == 1
+        bc = col("b_chunk", t)
+        bj = col("b_mb", t)
+        xb = buf_read(xbuf, col("x_rd_slot", t))
+        pb = chunk_params(bc)
+        yb, vjp_fn = jax.vjp(lambda p, xx: stage_fn(p, xx), pb, xb)
+        tgt_b = jax.lax.dynamic_index_in_dim(
+            targets, jnp.clip(bj, 0, M - 1), axis=0, keepdims=False)
+        loss_b, gy_loss = jax.value_and_grad(
+            lambda yy: loss_fn(yy, tgt_b))(yb)
+        crd = col("c_rd_slot", t)
+        cot_y = jnp.where(crd >= 0, buf_read(cbuf, crd),
+                          gy_loss.astype(dtype))
+        gp, gx = vjp_fn(cot_y)
+        # scatter-add this chunk's grads at local slot bc
+        def acc_one(acc, g):
+            sl = jnp.clip(bc, 0, V - 1)
+            cur = jax.lax.dynamic_index_in_dim(acc, sl, axis=0,
+                                               keepdims=False)
+            upd = cur + jnp.where(b_ok, g, 0.0).astype(cur.dtype)
+            return jax.lax.dynamic_update_index_in_dim(acc, upd, sl,
+                                                       axis=0)
+        gacc = jax.tree_util.tree_map(acc_one, gacc, gp)
+        # the loss is counted where it is seeded (crd < 0 == last
+        # virtual stage's in-tick turnaround)
+        loss_acc = loss_acc + jnp.where(b_ok & (crd < 0), loss_b, 0.0)
+
+        # ---- rotate payloads ----
+        y_next = jax.lax.ppermute(
+            jnp.where(f_ok, y, jnp.zeros_like(y)), axis, perm_down)
+        gx_next = jax.lax.ppermute(
+            jnp.where(b_ok, gx, jnp.zeros_like(gx)), axis, perm_up)
+        return (y_next, gx_next, abuf, xbuf, cbuf, gacc, loss_acc), None
+
+    carry0 = (y0, jnp.zeros(mb_shape, dtype), abuf0, xbuf0, cbuf0, g0,
+              jnp.float32(0.0))
+    (_, _, _, _, _, gacc, loss_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T, dtype=i32))
+
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        reduce_from_tensor_model_parallel_region as _reduce)
+    loss = _reduce(loss_acc, axis) / M
+    grads = jax.tree_util.tree_map(lambda g: g / M, gacc)
+    return loss, grads

@@ -393,6 +393,13 @@ _pipeline_1f1b_apply.defvjp(_pipeline_1f1b_apply_fwd,
                             _pipeline_1f1b_apply_bwd)
 
 
+# re-export: the interleaved 1F1B lives in its own module (the static
+# scheduler is sizeable) but belongs to this family's namespace
+from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b import (  # noqa: E402,E501
+    spmd_pipeline_interleaved_1f1b,
+)
+
+
 def spmd_pipeline_1f1b_apply(stage_fn: Callable,
                              params_local: Pytree,
                              microbatches: jax.Array,

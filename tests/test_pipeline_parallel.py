@@ -419,3 +419,129 @@ def test_spmd_interleaved_matches_chain(problem):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
         g, want_stacked)
+
+
+class TestInterleaved1F1B:
+    """The production schedule: virtual chunks AND the 1F1B window,
+    as one SPMD scan driven by static schedule tables."""
+
+    def test_schedule_invariants(self):
+        from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b \
+            import _greedy_ticks, build_schedule
+        for (P_, V, M_) in [(2, 1, 3), (2, 2, 5), (4, 2, 6), (4, 3, 4)]:
+            PV = P_ * V
+            f, b = _greedy_ticks(P_, V, M_)
+            assert len(f) == PV * M_ and len(b) == PV * M_
+            for (v, j), t in f.items():
+                if v > 0:
+                    assert f[(v - 1, j)] + 1 <= t
+            for (v, j), t in b.items():
+                assert f[(v, j)] <= t
+                if v < PV - 1:
+                    assert b[(v + 1, j)] + 1 <= t
+            from collections import Counter
+            assert max(Counter(
+                (v % P_, t) for (v, j), t in f.items()).values()) == 1
+            assert max(Counter(
+                (v % P_, t) for (v, j), t in b.items()).values()) == 1
+            s = build_schedule(P_, V, M_)
+            for nm, cap in (("a_wr_slot", "abuf"), ("f_src_slot", "abuf"),
+                            ("x_wr_slot", "xbuf"), ("x_rd_slot", "xbuf"),
+                            ("c_wr_slot", "cbuf"), ("c_rd_slot", "cbuf")):
+                assert s[nm].max() < s["sizes"][cap]
+
+    def test_activation_window_independent_of_microbatches(self):
+        """The 1F1B point: saved-activation slots must NOT grow with
+        M (GPipe memory would)."""
+        from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b \
+            import build_schedule
+        a = build_schedule(2, 2, 8)["sizes"]["xbuf"]
+        b = build_schedule(2, 2, 64)["sizes"]["xbuf"]
+        assert a == b <= 2 * 2 * 2 - 1 + 1
+
+    def test_matches_chain(self, problem):
+        """(loss, grads) == chain autodiff over all P*V chunks, with
+        M > P so the steady state engages."""
+        params, x, tgt = problem
+        mesh = comm.initialize(data=2, pipe=4)
+        P_, V = 4, 2
+        chunks = [jax.tree_util.tree_map(
+            lambda a, k=i: a * (1.0 + 0.05 * k), params[i % P_])
+            for i in range(P_ * V)]
+        per_stage = [jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), chunks[s], chunks[P_ + s])
+            for s in range(P_)]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_stage)      # (P, V, ...)
+        pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE),
+                                       params[0])
+
+        def run(stacked_local, xx, tt):
+            local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+            loss, g = pp.spmd_pipeline_interleaved_1f1b(
+                stage_apply, lambda y, t: jnp.mean((y - t) ** 2),
+                local, xx, tt)
+            return loss, jax.tree_util.tree_map(lambda a: a[None], g)
+
+        loss, g = jax.jit(comm.shard_map(
+            run, mesh,
+            in_specs=(pspec, P(), P()),
+            out_specs=(P(), pspec)))(stacked, x, tgt)
+
+        def chain_loss(cs):
+            h = x
+            for c in cs:
+                h = jax.vmap(stage_apply, in_axes=(None, 0))(c, h)
+            return jnp.mean(jax.vmap(
+                lambda yy, t: jnp.mean((yy - t) ** 2))(h, tgt))
+
+        want_loss = chain_loss(chunks)
+        want = jax.grad(chain_loss)(chunks)
+        want_per_stage = [jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), want[s], want[P_ + s])
+            for s in range(P_)]
+        want_stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *want_per_stage)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g, want_stacked)
+
+    def test_v1_matches_noninterleaved_1f1b(self, problem):
+        """V=1 degenerates to the non-interleaved schedule's results."""
+        params, x, tgt = problem
+        mesh = comm.initialize(data=2, pipe=4)
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                         *params)
+        pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE),
+                                       params[0])
+
+        def run_i(stacked_local, xx, tt):
+            local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+            chunked = jax.tree_util.tree_map(lambda a: a[None], local)
+            loss, g = pp.spmd_pipeline_interleaved_1f1b(
+                stage_apply, lambda y, t: jnp.mean((y - t) ** 2),
+                chunked, xx, tt)
+            return loss, jax.tree_util.tree_map(lambda a: a[0][None], g)
+
+        def run_n(stacked_local, xx, tt):
+            local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+            loss, g = pp.spmd_pipeline_1f1b(
+                stage_apply, lambda y, t: jnp.mean((y - t) ** 2),
+                local, xx, tt)
+            return loss, jax.tree_util.tree_map(lambda a: a[None], g)
+
+        out_i = jax.jit(comm.shard_map(
+            run_i, mesh, in_specs=(pspec, P(), P()),
+            out_specs=(P(), pspec)))(stacked, x, tgt)
+        out_n = jax.jit(comm.shard_map(
+            run_n, mesh, in_specs=(pspec, P(), P()),
+            out_specs=(P(), pspec)))(stacked, x, tgt)
+        np.testing.assert_allclose(float(out_i[0]), float(out_n[0]),
+                                   rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            out_i[1], out_n[1])
