@@ -12,6 +12,7 @@ from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
 )
 from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b import (
     spmd_pipeline_interleaved_1f1b,
+    spmd_pipeline_interleaved_1f1b_apply,
 )
 from apex_tpu.transformer.pipeline_parallel.spmd import (
     spmd_pipeline,
@@ -37,6 +38,7 @@ __all__ = [
     "spmd_pipeline", "spmd_pipeline_1f1b",
     "spmd_pipeline_1f1b_apply", "spmd_pipeline_interleaved",
     "spmd_pipeline_interleaved_1f1b",
+    "spmd_pipeline_interleaved_1f1b_apply",
     "spmd_pipeline_loss",
     "get_kth_microbatch", "get_num_microbatches", "listify_model",
     "setup_microbatch_calculator", "split_into_microbatches",

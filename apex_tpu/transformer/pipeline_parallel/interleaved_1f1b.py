@@ -250,26 +250,26 @@ def build_schedule(P: int, V: int, M: int):
 # The scan (SPMD; use inside shard_map over the pipe axis)
 # ---------------------------------------------------------------------
 
-def spmd_pipeline_interleaved_1f1b(stage_fn: Callable,
-                                   loss_fn: Callable,
-                                   params_chunks: Pytree,
-                                   microbatches: jax.Array,
-                                   targets: jax.Array,
-                                   *, axis: str = comm.AXIS_PIPE):
-    """Interleaved 1F1B over the pipe axis: returns
-    ``(mean_loss, grads)`` with grads shaped like ``params_chunks``
-    (leading dim V = local chunks, global chunk ``c*P + s``).
-
-    ``stage_fn(params_chunk, x) -> y`` (one chunk's forward, same
-    shapes in and out); ``loss_fn(y, target_mb) -> scalar`` seeds the
-    last virtual stage's cotangent in the same tick as its forward.
-    Not itself differentiable (it IS the backward), like
-    ``spmd_pipeline_1f1b``.
-    """
+def _interleaved_scan(stage_fn: Callable, seed_fn: Callable,
+                      params_chunks: Pytree,
+                      microbatches: jax.Array,
+                      axis: str, collect_gub: bool):
+    """Shared interleaved-1F1B scan.  ``seed_fn(yb, bj) ->
+    (cotangent, loss_contrib)`` provides the last virtual stage's
+    cotangent (from a loss, or from a downstream output-cotangent
+    slice).  Returns (gacc, loss_acc, gub) — gub is the
+    d/d microbatches buffer (zeros unless collect_gub)."""
     L = jax.lax.axis_size(axis)
     stage = jax.lax.axis_index(axis)
     leaves = jax.tree_util.tree_leaves(params_chunks)
+    if not leaves:
+        raise ValueError("params_chunks must have at least one leaf")
     V = leaves[0].shape[0]
+    for lf in leaves:
+        if lf.shape[0] != V:
+            raise ValueError(
+                "every params_chunks leaf needs the same leading "
+                f"chunk dim; got {lf.shape[0]} vs {V}")
     M = microbatches.shape[0]
     sched = build_schedule(L, V, M)
     sizes = sched["sizes"]
@@ -317,7 +317,14 @@ def spmd_pipeline_interleaved_1f1b(stage_fn: Callable,
             params_chunks)
 
     def tick(carry, t):
-        y_in, gx_in, abuf, xbuf, cbuf, gacc, loss_acc = carry
+        # gub (O(M)) rides the carry ONLY when the caller wants the
+        # d/d microbatches path — the loss variant keeps the stated
+        # O(P*V) memory contract without leaning on XLA DCE
+        if collect_gub:
+            y_in, gx_in, abuf, xbuf, cbuf, gacc, loss_acc, gub = carry
+        else:
+            y_in, gx_in, abuf, xbuf, cbuf, gacc, loss_acc = carry
+            gub = None
 
         # ---- arrivals land in their statically-colored slots ----
         abuf = buf_write(abuf, col("a_wr_slot", t), y_in)
@@ -344,13 +351,10 @@ def spmd_pipeline_interleaved_1f1b(stage_fn: Callable,
         xb = buf_read(xbuf, col("x_rd_slot", t))
         pb = chunk_params(bc)
         yb, vjp_fn = jax.vjp(lambda p, xx: stage_fn(p, xx), pb, xb)
-        tgt_b = jax.lax.dynamic_index_in_dim(
-            targets, jnp.clip(bj, 0, M - 1), axis=0, keepdims=False)
-        loss_b, gy_loss = jax.value_and_grad(
-            lambda yy: loss_fn(yy, tgt_b))(yb)
+        seed_cot, loss_b = seed_fn(yb, bj)
         crd = col("c_rd_slot", t)
         cot_y = jnp.where(crd >= 0, buf_read(cbuf, crd),
-                          gy_loss.astype(dtype))
+                          seed_cot.astype(dtype))
         gp, gx = vjp_fn(cot_y)
         # scatter-add this chunk's grads at local slot bc
         def acc_one(acc, g):
@@ -364,21 +368,114 @@ def spmd_pipeline_interleaved_1f1b(stage_fn: Callable,
         # the loss is counted where it is seeded (crd < 0 == last
         # virtual stage's in-tick turnaround)
         loss_acc = loss_acc + jnp.where(b_ok & (crd < 0), loss_b, 0.0)
+        # virtual stage 0 (stage 0, chunk 0): gx is d/d microbatches
+        if collect_gub:
+            bi = jnp.clip(bj, 0, M - 1)
+            take = b_ok & (stage == 0) & (bc == 0)
+            old_g = jax.lax.dynamic_index_in_dim(gub, bi, axis=0,
+                                                 keepdims=False)
+            gub = jax.lax.dynamic_update_index_in_dim(
+                gub, jnp.where(take, gx.astype(dtype), old_g), bi,
+                axis=0)
 
         # ---- rotate payloads ----
         y_next = jax.lax.ppermute(
             jnp.where(f_ok, y, jnp.zeros_like(y)), axis, perm_down)
         gx_next = jax.lax.ppermute(
             jnp.where(b_ok, gx, jnp.zeros_like(gx)), axis, perm_up)
-        return (y_next, gx_next, abuf, xbuf, cbuf, gacc, loss_acc), None
+        out = (y_next, gx_next, abuf, xbuf, cbuf, gacc, loss_acc)
+        return (out + (gub,) if collect_gub else out), None
 
     carry0 = (y0, jnp.zeros(mb_shape, dtype), abuf0, xbuf0, cbuf0, g0,
               jnp.float32(0.0))
-    (_, _, _, _, _, gacc, loss_acc), _ = jax.lax.scan(
-        tick, carry0, jnp.arange(T, dtype=i32))
+    if collect_gub:
+        carry0 = carry0 + (jnp.zeros((M,) + mb_shape, dtype),)
+    final, _ = jax.lax.scan(tick, carry0, jnp.arange(T, dtype=i32))
+    gacc, loss_acc = final[5], final[6]
+    gub = final[7] if collect_gub else None
+    return gacc, loss_acc, gub
 
+
+def spmd_pipeline_interleaved_1f1b(stage_fn: Callable,
+                                   loss_fn: Callable,
+                                   params_chunks: Pytree,
+                                   microbatches: jax.Array,
+                                   targets: jax.Array,
+                                   *, axis: str = comm.AXIS_PIPE):
+    """Interleaved 1F1B over the pipe axis: returns
+    ``(mean_loss, grads)`` with grads shaped like ``params_chunks``
+    (leading dim V = local chunks, global chunk ``c*P + s``).
+
+    ``stage_fn(params_chunk, x) -> y`` (one chunk's forward, same
+    shapes in and out); ``loss_fn(y, target_mb) -> scalar`` seeds the
+    last virtual stage's cotangent.  Not itself differentiable (it IS
+    the backward), like ``spmd_pipeline_1f1b``; for a composable
+    drop-in see ``spmd_pipeline_interleaved_1f1b_apply``.
+    """
+    M = microbatches.shape[0]
+
+    def seed(yb, bj):
+        tgt_b = jax.lax.dynamic_index_in_dim(
+            targets, jnp.clip(bj, 0, M - 1), axis=0, keepdims=False)
+        return tuple(reversed(jax.value_and_grad(
+            lambda yy: loss_fn(yy, tgt_b))(yb)))
+
+    gacc, loss_acc, _ = _interleaved_scan(
+        stage_fn, seed, params_chunks, microbatches, axis, False)
     from apex_tpu.transformer.tensor_parallel.mappings import (
         reduce_from_tensor_model_parallel_region as _reduce)
     loss = _reduce(loss_acc, axis) / M
     grads = jax.tree_util.tree_map(lambda g: g / M, gacc)
     return loss, grads
+
+
+# ---------------------------------------------------------------------
+# Composable variant: interleaved forward, interleaved-1F1B backward
+# ---------------------------------------------------------------------
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _interleaved_apply(stage_fn, axis, params_chunks, microbatches):
+    from apex_tpu.transformer.pipeline_parallel.spmd import (
+        spmd_pipeline_interleaved)
+    return spmd_pipeline_interleaved(stage_fn, params_chunks,
+                                     microbatches, axis=axis)
+
+
+def _interleaved_apply_fwd(stage_fn, axis, params_chunks, microbatches):
+    out = _interleaved_apply(stage_fn, axis, params_chunks,
+                             microbatches)
+    return out, (params_chunks, microbatches)
+
+
+def _interleaved_apply_bwd(stage_fn, axis, res, ct):
+    params_chunks, microbatches = res
+    M = microbatches.shape[0]
+
+    def seed(yb, bj):
+        ct_b = jax.lax.dynamic_index_in_dim(
+            ct, jnp.clip(bj, 0, M - 1), axis=0, keepdims=False)
+        return ct_b, jnp.float32(0.0)
+
+    gacc, _, gub = _interleaved_scan(
+        stage_fn, seed, params_chunks, microbatches, axis, True)
+    return gacc, gub
+
+
+_interleaved_apply.defvjp(_interleaved_apply_fwd,
+                          _interleaved_apply_bwd)
+
+
+def spmd_pipeline_interleaved_1f1b_apply(
+        stage_fn: Callable, params_chunks: Pytree,
+        microbatches: jax.Array, *, axis: str = comm.AXIS_PIPE):
+    """``spmd_pipeline_interleaved`` drop-in whose BACKWARD is the
+    interleaved-1F1B table scan (O(P·V) activation window, recompute
+    from saved stage inputs).  Composable: layers before the pipeline
+    (embedding) and after it (head/loss) differentiate through,
+    including the d/d microbatches path — the virtual-chunk analog of
+    ``spmd_pipeline_1f1b_apply``."""
+    return _interleaved_apply(stage_fn, axis, params_chunks,
+                              microbatches)

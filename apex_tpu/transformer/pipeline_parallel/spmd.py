@@ -397,6 +397,7 @@ _pipeline_1f1b_apply.defvjp(_pipeline_1f1b_apply_fwd,
 # scheduler is sizeable) but belongs to this family's namespace
 from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b import (  # noqa: E402,E501
     spmd_pipeline_interleaved_1f1b,
+    spmd_pipeline_interleaved_1f1b_apply,
 )
 
 

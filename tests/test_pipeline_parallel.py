@@ -545,3 +545,60 @@ class TestInterleaved1F1B:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
             out_i[1], out_n[1])
+
+
+def test_interleaved_1f1b_apply_composable(problem):
+    """The composable interleaved variant: pre/post-pipeline params AND
+    chunked stage params all match chain autodiff (the virtual-chunk
+    analog of spmd_pipeline_1f1b_apply)."""
+    params, x, tgt = problem
+    mesh = comm.initialize(data=2, pipe=4)
+    P_, V = 4, 2
+    chunks = [jax.tree_util.tree_map(
+        lambda a, k=i: a * (1.0 + 0.05 * k), params[i % P_])
+        for i in range(P_ * V)]
+    per_stage = [jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), chunks[s], chunks[P_ + s])
+        for s in range(P_)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *per_stage)
+    pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE),
+                                   params[0])
+    D = x.shape[-1]
+    pre = jnp.eye(D) + 0.01 * jnp.arange(D * D).reshape(D, D) / (D * D)
+    post = jnp.eye(D) * 0.9
+
+    def loss_f(pre_w, post_w, stacked_local, xx, tt):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        ub = xx @ pre_w
+        y = pp.spmd_pipeline_interleaved_1f1b_apply(stage_apply, local,
+                                                    ub)
+        y = y @ post_w
+        return jnp.mean(jax.vmap(
+            lambda yy, t: jnp.mean((yy - t) ** 2))(y, tt))
+
+    l1, g1 = jax.jit(comm.shard_map(
+        jax.value_and_grad(loss_f, argnums=(0, 1, 2)), mesh,
+        in_specs=(P(), P(), pspec, P(), P()),
+        out_specs=(P(), (P(), P(), pspec))))(pre, post, stacked, x, tgt)
+
+    def chain(pre_w, post_w, cs):
+        h = x @ pre_w
+        for c in cs:
+            h = jax.vmap(stage_apply, in_axes=(None, 0))(c, h)
+        h = h @ post_w
+        return jnp.mean(jax.vmap(
+            lambda yy, t: jnp.mean((yy - t) ** 2))(h, tgt))
+
+    want_l, want_g = jax.value_and_grad(chain, argnums=(0, 1, 2))(
+        pre, post, chunks)
+    want_per_stage = [jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), want_g[2][s], want_g[2][P_ + s])
+        for s in range(P_)]
+    want_stacked = (want_g[0], want_g[1], jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *want_per_stage))
+    np.testing.assert_allclose(float(l1), float(want_l), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g1, want_stacked)
