@@ -43,6 +43,21 @@ from apex_tpu import comm
 Pytree = Any
 
 
+def chunk_count(params_chunks: Pytree) -> int:
+    """Validated leading chunk dim V shared by every leaf (used by both
+    interleaved pipelines)."""
+    leaves = jax.tree_util.tree_leaves(params_chunks)
+    if not leaves:
+        raise ValueError("params_chunks must have at least one leaf")
+    V = leaves[0].shape[0]
+    for lf in leaves:
+        if lf.shape[0] != V:
+            raise ValueError(
+                "every params_chunks leaf needs the same leading "
+                f"chunk dim; got {lf.shape[0]} vs {V}")
+    return V
+
+
 # ---------------------------------------------------------------------
 # Static scheduling (plain Python/numpy; unit-tested directly)
 # ---------------------------------------------------------------------
@@ -261,15 +276,7 @@ def _interleaved_scan(stage_fn: Callable, seed_fn: Callable,
     d/d microbatches buffer (zeros unless collect_gub)."""
     L = jax.lax.axis_size(axis)
     stage = jax.lax.axis_index(axis)
-    leaves = jax.tree_util.tree_leaves(params_chunks)
-    if not leaves:
-        raise ValueError("params_chunks must have at least one leaf")
-    V = leaves[0].shape[0]
-    for lf in leaves:
-        if lf.shape[0] != V:
-            raise ValueError(
-                "every params_chunks leaf needs the same leading "
-                f"chunk dim; got {lf.shape[0]} vs {V}")
+    V = chunk_count(params_chunks)
     M = microbatches.shape[0]
     sched = build_schedule(L, V, M)
     sizes = sched["sizes"]
@@ -445,8 +452,10 @@ def _interleaved_apply(stage_fn, axis, params_chunks, microbatches):
 
 
 def _interleaved_apply_fwd(stage_fn, axis, params_chunks, microbatches):
-    out = _interleaved_apply(stage_fn, axis, params_chunks,
-                             microbatches)
+    from apex_tpu.transformer.pipeline_parallel.spmd import (
+        spmd_pipeline_interleaved)
+    out = spmd_pipeline_interleaved(stage_fn, params_chunks,
+                                    microbatches, axis=axis)
     return out, (params_chunks, microbatches)
 
 

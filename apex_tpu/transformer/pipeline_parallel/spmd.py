@@ -118,15 +118,9 @@ def spmd_pipeline_interleaved(stage_fn: Callable,
     """
     L = jax.lax.axis_size(axis)
     stage = jax.lax.axis_index(axis)
-    leaves = jax.tree_util.tree_leaves(params_chunks)
-    if not leaves:
-        raise ValueError("params_chunks must have at least one leaf")
-    V = leaves[0].shape[0]
-    for lf in leaves:
-        if lf.shape[0] != V:
-            raise ValueError(
-                "every params_chunks leaf needs the same leading "
-                f"chunk dim; got {lf.shape[0]} vs {V}")
+    from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b import (
+        chunk_count)
+    V = chunk_count(params_chunks)
     PV = L * V
     M = microbatches.shape[0]
     G = -(-M // L)                        # microbatch groups of size P
