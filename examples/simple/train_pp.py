@@ -9,7 +9,13 @@ the microbatch count).  Layers before the pipeline (an input
 projection) and after it (the head + loss) differentiate straight
 through.  Data parallelism rides an outer "data" axis.  Runs on a
 virtual 8-device CPU mesh or a real pod unchanged.
+
+``--virtual V`` switches to ``spmd_pipeline_interleaved_1f1b_apply``
+with V model chunks per stage (global chunk c*P+s on stage s) — the
+reference's interleaved schedule, O(P*V) activation window.
 """
+
+import argparse
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +31,10 @@ MB = 8          # rows per microbatch
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual", type=int, default=0,
+                    help="virtual chunks per stage (0: non-interleaved)")
+    args = ap.parse_args()
     import os
     from apex_tpu.platform import select_platform
     if os.environ.get("APEX_TPU_PLATFORM") == "cpu":
@@ -43,7 +53,10 @@ def main():
     k = jax.random.key(0)
     ks = jax.random.split(k, pp + 2)
     # one (D,D) MLP stage per pipe rank, stacked on a leading pipe dim
-    stages = 0.3 * jax.random.normal(ks[0], (pp, D, D))
+    # (with --virtual V: V chunks per rank, (pp, V, D, D))
+    Vc = args.virtual
+    shape = (pp, Vc, D, D) if Vc else (pp, D, D)
+    stages = 0.3 * jax.random.normal(ks[0], shape)
     w_in = jnp.eye(D) + 0.05 * jax.random.normal(ks[1], (D, D))
     w_out = 0.3 * jax.random.normal(ks[2], (D, D))
     params = {"in": w_in, "stages": stages, "out": w_out}
@@ -56,8 +69,12 @@ def main():
 
     def loss_fn(p, x, y):
         ub = x @ p["in"]                    # before the pipeline
-        h = spmd.spmd_pipeline_1f1b_apply(
-            stage_fn, p["stages"][0], ub)   # [0]: this rank's stage
+        if Vc:
+            h = spmd.spmd_pipeline_interleaved_1f1b_apply(
+                stage_fn, p["stages"][0], ub)
+        else:
+            h = spmd.spmd_pipeline_1f1b_apply(
+                stage_fn, p["stages"][0], ub)
         out = h @ p["out"]                  # after the pipeline
         return jnp.mean((out - y) ** 2)
 
@@ -94,8 +111,9 @@ def main():
             print(f"step {step:3d} loss {float(loss):.4f}")
     final = float(loss)
     assert final < 0.5 * loss0, (loss0, final)
+    sched = (f"interleaved-1F1B V={Vc}" if Vc else "1F1B")
     print(f"OK: loss {loss0:.4f} -> {final:.4f} "
-          f"(pp={pp}, 1F1B backward, dp={dp})")
+          f"(pp={pp}, {sched} backward, dp={dp})")
 
 
 if __name__ == "__main__":

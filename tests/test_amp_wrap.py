@@ -351,3 +351,25 @@ def test_unmodified_flax_cnn_per_op_dtypes_across_levels():
     np.testing.assert_allclose(np.asarray(w2(params2, x)),
                                np.asarray(f(params, x)),
                                rtol=5e-2, atol=5e-2)
+
+
+def test_scan_with_prng_key_and_int_carry():
+    """Non-float scan state (PRNG keys, int counters) must pass
+    through the O1 boundary casts untouched."""
+    def f(p, x, key):
+        def body(carry, w):
+            h, k, n = carry
+            k, sub = jax.random.split(k)
+            h = jnp.tanh(h @ w + jax.random.normal(sub, h.shape) * 0.01)
+            return (h, k, n + 1), n
+        (h, k, n), ns = jax.lax.scan(body, (x, key, jnp.int32(0)), p)
+        return jnp.mean(h ** 2) + 0.0 * jnp.sum(ns)
+
+    p = jax.random.normal(jax.random.key(0), (3, 8, 8)) * 0.3
+    x = jax.random.normal(jax.random.key(1), (4, 8))
+    key = jax.random.key(2)
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(float(w(p, x, key)), float(f(p, x, key)),
+                               rtol=3e-2, atol=1e-3)
+    g = jax.grad(w)(p, x, key)
+    assert bool(jnp.all(jnp.isfinite(g)))

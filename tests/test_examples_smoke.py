@@ -47,3 +47,9 @@ def test_gpt_block_tiny(capsys):
 def test_train_pp_1f1b_converges(capsys):
     _run("examples/simple/train_pp.py", [])
     assert "OK: loss" in capsys.readouterr().out
+
+
+def test_train_pp_interleaved_converges(capsys):
+    _run("examples/simple/train_pp.py", ["--virtual", "2"])
+    out = capsys.readouterr().out
+    assert "OK: loss" in out and "interleaved-1F1B V=2" in out
