@@ -47,7 +47,8 @@ class BertLayer(nn.Module):
             h, h, input_is_parallel=True,
             sequence_parallel_enabled=self.sequence_parallel,
             compute_dtype=self.dtype, name="attn_proj")
-        ln1 = FusedLayerNorm(normalized_shape=h, name="attn_layernorm")
+        ln1 = FusedLayerNorm(normalized_shape=h, name="attn_layernorm",
+                             sequence_parallel=self.sequence_parallel)
         fc1 = tp.ColumnParallelLinear(
             h, ffn, gather_output=False,
             sequence_parallel_enabled=self.sequence_parallel,
@@ -56,7 +57,8 @@ class BertLayer(nn.Module):
             ffn, h, input_is_parallel=True,
             sequence_parallel_enabled=self.sequence_parallel,
             compute_dtype=self.dtype, name="mlp_fc2")
-        ln2 = FusedLayerNorm(normalized_shape=h, name="mlp_layernorm")
+        ln2 = FusedLayerNorm(normalized_shape=h, name="mlp_layernorm",
+                             sequence_parallel=self.sequence_parallel)
 
         y = qkv(x.astype(self.dtype))
         s_full, b = y.shape[0], y.shape[1]
@@ -121,6 +123,12 @@ class BertModel(nn.Module):
 
     def mlm_logits(self, variables, tokens, **kw):
         x = self.apply(variables, tokens, **kw)        # (s, b, h)
+        # see GPTModel's head: exactly ONE f-mapping syncs d/dx of
+        # the vocab-sharded head — under SP the encoder's exit gather
+        # already is it (bwd reduce-scatter); without SP, copy_to
+        if (comm.model_parallel_size() > 1
+                and not self.sequence_parallel):
+            x = tp.copy_to_tensor_model_parallel_region(x)
         w = variables["params"]["embed"]["weight"]
         return jnp.dot(x.astype(self.dtype),
                        jnp.transpose(w).astype(self.dtype),

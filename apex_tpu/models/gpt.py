@@ -58,7 +58,8 @@ class GPTLayer(nn.Module):
         local_heads = self.num_heads // max(tp_size, 1)
         head_dim = h // self.num_heads
 
-        ln1 = FusedLayerNorm(normalized_shape=h, name="input_layernorm")
+        ln1 = FusedLayerNorm(normalized_shape=h, name="input_layernorm",
+                             sequence_parallel=self.sequence_parallel)
         qkv = tp.ColumnParallelLinear(
             h, 3 * h, gather_output=False,
             sequence_parallel_enabled=self.sequence_parallel,
@@ -67,7 +68,9 @@ class GPTLayer(nn.Module):
             h, h, input_is_parallel=True,
             sequence_parallel_enabled=self.sequence_parallel,
             compute_dtype=self.dtype, name="attn_proj")
-        ln2 = FusedLayerNorm(normalized_shape=h, name="post_attn_layernorm")
+        ln2 = FusedLayerNorm(normalized_shape=h,
+                             name="post_attn_layernorm",
+                             sequence_parallel=self.sequence_parallel)
         fc1 = tp.ColumnParallelLinear(
             h, ffn, gather_output=False,
             sequence_parallel_enabled=self.sequence_parallel,
@@ -193,11 +196,23 @@ class GPTModel(nn.Module):
                          sequence_parallel=self.sequence_parallel,
                          use_rope=self.use_rope, dtype=self.dtype,
                          name=f"layer_{i}")(x)
+        # The head's d/dx from the LOCAL vocab shard is a partial sum
+        # over tp ranks; exactly ONE f-mapping must sync it (Megatron's
+        # parallel_lm_logits layout).  Under SP that role is played by
+        # the sequence-region exit gather (bwd = reduce-scatter), with
+        # the final LN INSIDE the region (its param grads synced by its
+        # sequence_parallel flag); without SP it is an explicit copy_to
+        # (fwd identity / bwd psum).
         if self.sequence_parallel:
+            x = FusedLayerNorm(normalized_shape=self.hidden_size,
+                               name="final_layernorm",
+                               sequence_parallel=True)(x)
             x = mappings.gather_from_sequence_parallel_region(x)
-        x = FusedLayerNorm(normalized_shape=self.hidden_size,
-                           name="final_layernorm")(x)
-        # tied LM head: logits_local = x @ embed_local^T  (V/tp columns)
+        else:
+            x = FusedLayerNorm(normalized_shape=self.hidden_size,
+                               name="final_layernorm")(x)
+            if comm.model_parallel_size() > 1:
+                x = mappings.copy_to_tensor_model_parallel_region(x)
         w = self.get_variable("params", "embed")["weight"]
         logits = jnp.dot(x.astype(self.dtype),
                          jnp.transpose(w).astype(self.dtype),

@@ -32,12 +32,32 @@ def _normalize_shape(s: Shape) -> Tuple[int, ...]:
     return tuple(s)
 
 
+def _sp_param_sync(w, b):
+    """Replicated norm params consumed by SEQUENCE-SHARDED activations:
+    each tp rank's weight grad is a sum over its local sequence shard
+    only, so the true grad needs a psum over the model axis.  Megatron
+    marks these params `sequence_parallel` and allreduces their grads
+    before the step; the by-construction equivalent is the f/g copy
+    mapping (fwd identity, bwd psum) applied to the params at use."""
+    from apex_tpu import comm
+    from apex_tpu.transformer.tensor_parallel import mappings
+    if not (comm.axis_is_bound(mappings.AXIS)):
+        return w, b
+    cp = mappings.copy_to_tensor_model_parallel_region
+    return (cp(w) if w is not None else None,
+            cp(b) if b is not None else None)
+
+
 class FusedLayerNorm(nn.Module):
     normalized_shape: Shape = None
     eps: float = 1e-5
     elementwise_affine: bool = True
     memory_efficient: bool = False
     param_dtype: jnp.dtype = jnp.float32
+    # True when the input is sequence-sharded over the model axis
+    # (Megatron LayerNorm's `sequence_parallel` attribute): syncs the
+    # affine-param grads across tp ranks
+    sequence_parallel: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -52,6 +72,8 @@ class FusedLayerNorm(nn.Module):
                            self.param_dtype)
         else:
             w = b = None
+        if self.sequence_parallel:
+            w, b = _sp_param_sync(w, b)
         y = fused_layer_norm(x2, w, b, self.eps, self.memory_efficient)
         return y.reshape(x.shape)
 
@@ -62,6 +84,7 @@ class FusedRMSNorm(nn.Module):
     elementwise_affine: bool = True
     memory_efficient: bool = False
     param_dtype: jnp.dtype = jnp.float32
+    sequence_parallel: bool = False      # see FusedLayerNorm
 
     @nn.compact
     def __call__(self, x):
@@ -72,6 +95,8 @@ class FusedRMSNorm(nn.Module):
         w = (self.param("weight", nn.initializers.ones, (h,),
                         self.param_dtype)
              if self.elementwise_affine else None)
+        if self.sequence_parallel:
+            w, _ = _sp_param_sync(w, None)
         y = fused_rms_norm(x2, w, self.eps, self.memory_efficient)
         return y.reshape(x.shape)
 

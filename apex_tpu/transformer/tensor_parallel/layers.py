@@ -143,6 +143,12 @@ class RowParallelLinear(nn.Module):
         if self.skip_bias_add:
             return y, b
         if b is not None:
+            if self.sequence_parallel_enabled and tp > 1:
+                # bias adds onto a SEQUENCE-SHARDED y: its grad is a
+                # local-shard sum, so sync like the SP layernorm params
+                # (fwd identity / bwd psum)
+                b = mappings.copy_to_tensor_model_parallel_region(b,
+                                                                  AXIS)
             y = y + b.astype(dt)
         return y
 

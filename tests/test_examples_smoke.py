@@ -53,3 +53,9 @@ def test_train_pp_interleaved_converges(capsys):
     _run("examples/simple/train_pp.py", ["--virtual", "2"])
     out = capsys.readouterr().out
     assert "OK: loss" in out and "interleaved-1F1B V=2" in out
+
+
+def test_train_4d_gpt_converges(capsys):
+    _run("examples/gpt/train_4d.py", ["--steps", "8"])
+    out = capsys.readouterr().out
+    assert "OK: loss" in out and "pp=2x2chunks" in out

@@ -196,3 +196,61 @@ def test_gpt_layer_rejects_unknown_cp_strategy():
     layer = GPTLayer(32, 4, context_parallel=True, cp_strategy="nope")
     with pytest.raises(ValueError, match="ring.*ulysses|ulysses.*ring"):
         layer.init(jax.random.key(0), jnp.zeros((8, 2, 32)))
+
+
+@pytest.mark.parametrize("sequence_parallel", [False, True])
+def test_gpt_tp_GRADS_match_tp1(sequence_parallel):
+    """Every parameter's GRADIENT under tp=4 (+SP) equals the tp=1
+    oracle — not just the loss.  Pins the Megatron grad-sync layout:
+    SP layernorm/bias param grads psum'd over the model axis (via the
+    f/g copy mapping at use), and exactly ONE f-mapping syncing the
+    vocab-sharded head's d/dx (the SP exit gather, or copy_to without
+    SP).  A loss-only check passes even with all of that missing."""
+    V, H, NH, L, S, B = 64, 32, 4, 2, 16, 2
+    tokens = jax.random.randint(jax.random.key(0), (B, S), 0, V)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def spec_for(path, leaf):
+        name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        if "/embed/" in f"/{name}/":
+            return P(comm.AXIS_MODEL, None)
+        if "qkv" in name or "fc1" in name:
+            return (P(None, comm.AXIS_MODEL) if leaf.ndim == 2
+                    else P(comm.AXIS_MODEL))
+        if "proj/weight" in name or "fc2/weight" in name:
+            return P(comm.AXIS_MODEL, None)
+        return P()
+
+    comm.initialize(data=8)
+    probe = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                     num_layers=L, max_seq_len=S)
+    shape = jax.eval_shape(probe.init, jax.random.key(1), tokens)
+    specs = jax.tree_util.tree_map_with_path(spec_for, shape)
+    comm.destroy()
+
+    mesh = comm.initialize(data=2, model=4)
+    model = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                     num_layers=L, max_seq_len=S,
+                     sequence_parallel=sequence_parallel)
+    variables = jax.jit(comm.shard_map(
+        lambda k, t: model.init(k, t), mesh, in_specs=(P(), P()),
+        out_specs=specs))(jax.random.key(1), tokens)
+    g_tp = jax.jit(comm.shard_map(
+        jax.grad(lambda v, t, l: model.loss(v, t, l)), mesh,
+        in_specs=(specs, P(), P()), out_specs=specs))(
+        variables, tokens, labels)
+
+    comm.destroy()
+    comm.initialize(data=8)
+    model1 = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                      num_layers=L, max_seq_len=S)
+    g_ref = jax.grad(lambda v, t, l: model1.loss(v, t, l))(
+        variables, tokens, labels)
+
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_tp)[0],
+            jax.tree_util.tree_flatten_with_path(g_ref)[0]):
+        name = "/".join(str(p.key) for p in pa if hasattr(p, "key"))
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5,
+            err_msg=f"grad mismatch at {name} (sp={sequence_parallel})")
