@@ -105,4 +105,12 @@ class Bottleneck(nn.Module):
 
 class SpatialBottleneck(Bottleneck):
     """Reference parity name: a Bottleneck whose input is H-sharded over
-    `spatial_group`; run it under shard_map on that axis."""
+    `spatial_group`; run it under shard_map on that axis.
+
+    Gradient convention (matches the reference): the conv/BN params are
+    replicated while the input is spatially sharded, so each rank's
+    param grads cover only its H-shard — the reference relies on DDP's
+    WORLD all-reduce (which includes the spatial group) to complete
+    them.  Do the same here: include ``spatial_group`` in your gradient
+    reduction, e.g. ``jax.lax.psum(g, spatial_group)`` on top of the
+    data-axis pmean (see tests/test_contrib_misc.py)."""

@@ -203,16 +203,13 @@ class GPTModel(nn.Module):
         # the final LN INSIDE the region (its param grads synced by its
         # sequence_parallel flag); without SP it is an explicit copy_to
         # (fwd identity / bwd psum).
+        x = FusedLayerNorm(normalized_shape=self.hidden_size,
+                           name="final_layernorm",
+                           sequence_parallel=self.sequence_parallel)(x)
         if self.sequence_parallel:
-            x = FusedLayerNorm(normalized_shape=self.hidden_size,
-                               name="final_layernorm",
-                               sequence_parallel=True)(x)
             x = mappings.gather_from_sequence_parallel_region(x)
-        else:
-            x = FusedLayerNorm(normalized_shape=self.hidden_size,
-                               name="final_layernorm")(x)
-            if comm.model_parallel_size() > 1:
-                x = mappings.copy_to_tensor_model_parallel_region(x)
+        elif comm.model_parallel_size() > 1:
+            x = mappings.copy_to_tensor_model_parallel_region(x)
         w = self.get_variable("params", "embed")["weight"]
         logits = jnp.dot(x.astype(self.dtype),
                          jnp.transpose(w).astype(self.dtype),

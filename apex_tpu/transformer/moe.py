@@ -140,6 +140,15 @@ class ExpertParallelMLP(nn.Module):
 
         wg = self.param("router", nn.initializers.normal(0.02),
                         (h, e), jnp.float32)
+        if ep > 1:
+            # replicated router consumed by TOKEN-SHARDED inputs: each
+            # rank's router grad sums only its token shard, so the true
+            # grad needs a psum over the expert axis — same f/g copy
+            # mapping (fwd identity / bwd psum) as the sequence-parallel
+            # layernorm params
+            from apex_tpu.transformer.tensor_parallel import mappings
+            wg = mappings.copy_to_tensor_model_parallel_region(
+                wg, self.axis)
         # per-rank expert shards, rank-decorrelated init
         def einit(base):
             def init(key, shape, dtype):

@@ -140,15 +140,15 @@ class RowParallelLinear(nn.Module):
             else:
                 y = mappings.reduce_from_tensor_model_parallel_region(
                     y, AXIS)
+        if b is not None and self.sequence_parallel_enabled and tp > 1:
+            # the bias (added here or by a skip_bias_add caller) lands
+            # on a SEQUENCE-SHARDED y: its grad is a local-shard sum,
+            # so sync like the SP layernorm params (fwd identity / bwd
+            # psum) — on BOTH return paths
+            b = mappings.copy_to_tensor_model_parallel_region(b, AXIS)
         if self.skip_bias_add:
             return y, b
         if b is not None:
-            if self.sequence_parallel_enabled and tp > 1:
-                # bias adds onto a SEQUENCE-SHARDED y: its grad is a
-                # local-shard sum, so sync like the SP layernorm params
-                # (fwd identity / bwd psum)
-                b = mappings.copy_to_tensor_model_parallel_region(b,
-                                                                  AXIS)
             y = y + b.astype(dt)
         return y
 

@@ -57,6 +57,7 @@ SLOW_TESTS = {
     "test_offload.py::test_gpt_layer_tags_compose_with_offload",
     "test_parallel.py::test_ddp_syncbn_resnet_config5_matches_full_batch",
     "test_contrib_misc.py::test_spatial_bottleneck_matches_unsharded",
+    "test_contrib_misc.py::test_spatial_bottleneck_grads_with_group_psum",
     "test_contrib_misc.py::test_bottleneck_shapes_and_residual",
     "test_attention.py::test_ring_attention_grads_match_full",
     "test_attention.py::test_ring_kernel_matches_ring_ref",
