@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu import comm
+from apex_tpu.transformer.tensor_parallel import mappings
 
 Array = jax.Array
 
@@ -146,7 +147,6 @@ class ExpertParallelMLP(nn.Module):
             # grad needs a psum over the expert axis — same f/g copy
             # mapping (fwd identity / bwd psum) as the sequence-parallel
             # layernorm params
-            from apex_tpu.transformer.tensor_parallel import mappings
             wg = mappings.copy_to_tensor_model_parallel_region(
                 wg, self.axis)
         # per-rank expert shards, rank-decorrelated init

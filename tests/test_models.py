@@ -11,6 +11,31 @@ from apex_tpu.models import GPTModel, resnet18
 from apex_tpu.models.bert import BertModel
 
 
+def _megatron_spec_for(path, leaf):
+    """Sharding specs by Megatron param-name convention (shared by the
+    GPT/BERT tp-parity tests)."""
+    name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+    if "/embed/" in f"/{name}/":
+        return P(comm.AXIS_MODEL, None)
+    if "qkv" in name or "fc1" in name:
+        return (P(None, comm.AXIS_MODEL) if leaf.ndim == 2
+                else P(comm.AXIS_MODEL))
+    if "proj/weight" in name or "fc2/weight" in name:
+        return P(comm.AXIS_MODEL, None)
+    return P()
+
+
+def _assert_grads_match(g_tp, g_ref, tag):
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_tp)[0],
+            jax.tree_util.tree_flatten_with_path(g_ref)[0]):
+        name = "/".join(str(p.key) for p in pa if hasattr(p, "key"))
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5,
+            err_msg=f"grad mismatch at {name} ({tag})")
+
+
+
 def test_resnet18_forward_and_train_step():
     model = resnet18(num_classes=10)
     x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
@@ -210,22 +235,11 @@ def test_gpt_tp_GRADS_match_tp1(sequence_parallel):
     tokens = jax.random.randint(jax.random.key(0), (B, S), 0, V)
     labels = jnp.roll(tokens, -1, axis=1)
 
-    def spec_for(path, leaf):
-        name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
-        if "/embed/" in f"/{name}/":
-            return P(comm.AXIS_MODEL, None)
-        if "qkv" in name or "fc1" in name:
-            return (P(None, comm.AXIS_MODEL) if leaf.ndim == 2
-                    else P(comm.AXIS_MODEL))
-        if "proj/weight" in name or "fc2/weight" in name:
-            return P(comm.AXIS_MODEL, None)
-        return P()
-
     comm.initialize(data=8)
     probe = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
                      num_layers=L, max_seq_len=S)
     shape = jax.eval_shape(probe.init, jax.random.key(1), tokens)
-    specs = jax.tree_util.tree_map_with_path(spec_for, shape)
+    specs = jax.tree_util.tree_map_with_path(_megatron_spec_for, shape)
     comm.destroy()
 
     mesh = comm.initialize(data=2, model=4)
@@ -247,10 +261,48 @@ def test_gpt_tp_GRADS_match_tp1(sequence_parallel):
     g_ref = jax.grad(lambda v, t, l: model1.loss(v, t, l))(
         variables, tokens, labels)
 
-    for (pa, a), (_, b) in zip(
-            jax.tree_util.tree_flatten_with_path(g_tp)[0],
-            jax.tree_util.tree_flatten_with_path(g_ref)[0]):
-        name = "/".join(str(p.key) for p in pa if hasattr(p, "key"))
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5,
-            err_msg=f"grad mismatch at {name} (sp={sequence_parallel})")
+    _assert_grads_match(g_tp, g_ref, f"gpt sp={sequence_parallel}")
+
+
+@pytest.mark.parametrize("sequence_parallel", [False, True])
+def test_bert_tp_GRADS_match_tp1(sequence_parallel):
+    """BERT analog of the GPT grad-parity test: every param grad under
+    tp=4 (+SP) equals the tp=1 oracle through the MLM head + vocab-
+    parallel CE."""
+    from apex_tpu.transformer import tensor_parallel as tp_
+
+    V, H, NH, L, S, B = 64, 32, 4, 2, 16, 2
+    tokens = jax.random.randint(jax.random.key(10), (B, S), 0, V)
+    labels = jax.random.randint(jax.random.key(12), (B, S), 0, V)
+
+    comm.initialize(data=8)
+    probe = BertModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                      num_layers=L, max_seq_len=S)
+    shape = jax.eval_shape(probe.init, jax.random.key(11), tokens)
+    specs = jax.tree_util.tree_map_with_path(_megatron_spec_for, shape)
+    comm.destroy()
+
+    def mlm_loss(m, v, t, l):
+        logits = m.mlm_logits(v, t)                 # (s, b, V/tp)
+        return jnp.mean(tp_.vocab_parallel_cross_entropy(
+            logits, jnp.transpose(l, (1, 0))))
+
+    mesh = comm.initialize(data=2, model=4)
+    model = BertModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                      num_layers=L, max_seq_len=S,
+                      sequence_parallel=sequence_parallel)
+    variables = jax.jit(comm.shard_map(
+        lambda k, t: model.init(k, t), mesh,
+        in_specs=(P(), P()), out_specs=specs))(jax.random.key(11),
+                                               tokens)
+    g_tp = jax.jit(comm.shard_map(
+        jax.grad(lambda v, t, l: mlm_loss(model, v, t, l)), mesh,
+        in_specs=(specs, P(), P()), out_specs=specs))(
+        variables, tokens, labels)
+
+    comm.destroy()
+    comm.initialize(data=8)
+    g_ref = jax.grad(lambda v, t, l: mlm_loss(probe, v, t, l))(
+        variables, tokens, labels)
+
+    _assert_grads_match(g_tp, g_ref, f"bert sp={sequence_parallel}")

@@ -236,3 +236,46 @@ def test_rng_tracker_forks_differ():
     assert not np.allclose(a, b)
     with pytest.raises(Exception):
         tr.add("model-parallel-rng", 5)
+
+
+def test_row_parallel_skip_bias_add_sp_bias_grad_synced():
+    """skip_bias_add + sequence_parallel (the fused bias-dropout-add
+    idiom): the RETURNED bias must carry the f/g grad sync, so a caller
+    adding it to the sequence-sharded output gets the full bias grad,
+    not 1/tp of it."""
+    mesh = comm.initialize(data=2, model=4)
+    IN = OUT = 16
+    S, B = 8, 2
+    row = tp.RowParallelLinear(IN, OUT, input_is_parallel=True,
+                               sequence_parallel_enabled=True,
+                               skip_bias_add=True)
+    x = jax.random.normal(jax.random.key(0), (S, B, IN))
+    w_full = jax.random.normal(jax.random.key(1), (IN, OUT)) * 0.2
+    bias = jax.random.normal(jax.random.key(2), (OUT,)) * 0.1
+
+    def loss_sharded(w_local, bias, x_in):
+        y, b = row.apply(
+            {"params": {"weight": w_local, "bias": bias}}, x_in)
+        return jnp.sum((y + b) ** 2)     # caller-side bias add
+
+    # with SP, each rank's loss term covers only its sequence shard;
+    # the f/g sync inside the layer must make each rank's bias grad
+    # ALREADY the total — so the oracle comparison uses NO outer psum
+    def step(w_full, bias, x_full):
+        rank = jax.lax.axis_index(comm.AXIS_MODEL)
+        w_local = jax.lax.dynamic_slice_in_dim(
+            w_full, rank * (IN // 4), IN // 4, axis=0)
+        x_local = jax.lax.dynamic_slice_in_dim(
+            x_full, rank * (IN // 4), IN // 4, axis=2)
+        return jax.grad(loss_sharded, argnums=1)(w_local, bias, x_local)
+
+    g = jax.jit(comm.shard_map(
+        step, mesh, in_specs=(P(), P(), P()), out_specs=P()))(
+        w_full, bias, x)
+
+    # oracle: dense layer, full sequence
+    y_ref = jnp.einsum("sbi,io->sbo", x, w_full)
+    g_ref = jax.grad(
+        lambda b_: jnp.sum((y_ref + b_) ** 2))(bias)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
