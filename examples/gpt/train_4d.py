@@ -159,8 +159,10 @@ def main():
             per_tok = tp.vocab_parallel_cross_entropy(
                 logits, jnp.transpose(lab, (1, 0)))
             loss = jnp.mean(per_tok)
-            # count the loss once across the pipe axis
-            return jax.lax.psum(
+            # count the loss once across the pipe axis with the f/g
+            # mapping (fwd psum, bwd identity) — a raw psum would
+            # scale every gradient by pp in backward
+            return tp.reduce_from_tensor_model_parallel_region(
                 jnp.where(pipe_rank == pp_size - 1, loss, 0.0), A_P)
 
         loss, grads, found_inf = amp.scaled_value_and_grad(

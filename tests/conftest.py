@@ -50,6 +50,7 @@ SLOW_TESTS = {
     "test_models.py::test_gpt_tp_matches_tp1",
     "test_models.py::test_gpt_tp_GRADS_match_tp1",
     "test_models.py::test_bert_tp_GRADS_match_tp1",
+    "test_models.py::test_4d_assembly_grads_match_single_device",
     "test_models.py::test_bert_tp_matches_tp1",
     "test_models.py::test_gpt_layer_context_parallel_matches_full",
     "test_models.py::test_bert_forward_shapes_and_mask",

@@ -306,3 +306,138 @@ def test_bert_tp_GRADS_match_tp1(sequence_parallel):
         variables, tokens, labels)
 
     _assert_grads_match(g_tp, g_ref, f"bert sp={sequence_parallel}")
+
+
+def test_4d_assembly_grads_match_single_device():
+    """THE integration guard: the full 4D assembly — vocab-parallel
+    embed -> SP scatter -> interleaved-1F1B pipeline (pp=2, V=2 chunks)
+    with TP+SP inside the stages -> SP final LN -> exit gather -> tied
+    vocab-sharded head -> vocab-parallel CE, grads reduced per the
+    documented conventions (psum over pipe for pipe-replicated params,
+    pmean over data, f/g mapping on the loss) — produces EXACTLY the
+    single-device model's loss and every parameter gradient.  Catches
+    the whole partial/scaled-gradient class at once (it found the
+    raw-psum loss reduction scaling all grads by pp)."""
+    from apex_tpu.models import GPTStage
+    from apex_tpu.normalization import fused_layer_norm
+    from apex_tpu.transformer import tensor_parallel as tp_
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        reduce_from_tensor_model_parallel_region as fg_reduce)
+    from apex_tpu.transformer.pipeline_parallel import spmd
+
+    dp, pp, tpsz, VCH = 2, 2, 2, 2
+    V, H, NH, S = 64, 32, 4, 16
+    MB, M = 2, 2
+    B_local = MB * M
+    B = dp * B_local
+    s_loc = S // tpsz
+    A_D, A_P, A_M = comm.AXIS_DATA, comm.AXIS_PIPE, comm.AXIS_MODEL
+
+    embed = tp_.VocabParallelEmbedding(V, H, name="embed")
+    stage = GPTStage(H, NH, num_layers=1, sequence_parallel=True)
+    tokens = jnp.mod(jnp.arange(B * S, dtype=jnp.int32) * 5,
+                     V).reshape(B, S)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def stage_spec(path, leaf):
+        name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        if "qkv" in name or "fc1" in name:
+            inner = (P(None, A_M) if leaf.ndim == 2 else P(A_M))
+        elif "proj/weight" in name or "fc2/weight" in name:
+            inner = P(A_M, None)
+        else:
+            inner = P()
+        return P(A_P, None, *inner)
+
+    embed_spec = {"params": {"weight": P(A_M, None)}}
+    lnf_spec = {"w": P(), "b": P()}
+    comm.initialize(data=8)
+    probe = jax.eval_shape(
+        GPTStage(H, NH, num_layers=1).init, jax.random.key(0),
+        jnp.zeros((S, MB, H), jnp.float32))
+    stage_specs = jax.tree_util.tree_map_with_path(stage_spec, probe)
+    comm.destroy()
+    mesh = comm.initialize(data=dp, pipe=pp, model=tpsz)
+    pspecs = (embed_spec, stage_specs, lnf_spec)
+
+    def init_fn(key, tok):
+        ev = embed.init(key, tok)
+        k2 = jax.random.fold_in(jax.random.fold_in(key, 7),
+                                jax.lax.axis_index(A_P))
+        svs = [stage.init(jax.random.fold_in(k2, c),
+                          jnp.zeros((s_loc, MB, H), jnp.float32))
+               for c in range(VCH)]
+        sv = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *svs)
+        sv = jax.tree_util.tree_map(lambda x: x[None], sv)
+        return ev, sv, {"w": jnp.ones((H,), jnp.float32),
+                        "b": jnp.zeros((H,), jnp.float32)}
+
+    params = jax.jit(comm.shard_map(
+        init_fn, mesh, in_specs=(P(), P()), out_specs=pspecs))(
+        jax.random.key(0), tokens[:B_local])
+
+    def loss_fn(params, tok, lab):
+        ev, sv, lnf = params
+        pipe_rank = jax.lax.axis_index(A_P)
+        pp_size = jax.lax.axis_size(A_P)
+        x = embed.apply(ev, tok)
+        x = jnp.transpose(x, (1, 0, 2))
+        x = tp_.scatter_to_sequence_parallel_region(x)
+        ub = jnp.transpose(x.reshape(x.shape[0], M, MB, H),
+                           (1, 0, 2, 3))
+        y = spmd.spmd_pipeline_interleaved_1f1b_apply(
+            lambda pv, xx: stage.apply(pv, xx),
+            jax.tree_util.tree_map(lambda a: a[0], sv), ub)
+        y = jnp.transpose(y, (1, 0, 2, 3)).reshape(
+            x.shape[0], B_local, H)
+        wln = tp_.copy_to_tensor_model_parallel_region(lnf["w"])
+        bln = tp_.copy_to_tensor_model_parallel_region(lnf["b"])
+        y = fused_layer_norm(y, wln, bln)
+        y = tp_.gather_from_sequence_parallel_region(y)
+        logits = jnp.dot(y, ev["params"]["weight"].T,
+                         preferred_element_type=jnp.float32)
+        per_tok = tp_.vocab_parallel_cross_entropy(
+            logits, jnp.transpose(lab, (1, 0)))
+        return fg_reduce(jnp.where(pipe_rank == pp_size - 1,
+                                   jnp.mean(per_tok), 0.0), A_P)
+
+    def grad_step(params, tok, lab):
+        loss, g = jax.value_and_grad(loss_fn)(params, tok, lab)
+        gev, gsv, glnf = g
+        gev = jax.tree_util.tree_map(
+            lambda t: jax.lax.psum(t, A_P), gev)
+        glnf = jax.tree_util.tree_map(
+            lambda t: jax.lax.psum(t, A_P), glnf)
+        g = jax.tree_util.tree_map(
+            lambda t: jax.lax.pmean(t, A_D), (gev, gsv, glnf))
+        return jax.lax.pmean(loss, A_D), g
+
+    loss4d, g4d = jax.jit(comm.shard_map(
+        grad_step, mesh, in_specs=(pspecs, P(A_D), P(A_D)),
+        out_specs=(P(), pspecs)))(params, tokens, labels)
+
+    comm.destroy()
+    comm.initialize(data=8)
+    stage1 = GPTStage(H, NH, num_layers=1)
+    embed1 = tp_.VocabParallelEmbedding(V, H, name="embed")
+
+    def oracle_loss(params, tok, lab):
+        ev, sv, lnf = params
+        x = embed1.apply(ev, tok)
+        x = jnp.transpose(x, (1, 0, 2))
+        for c in range(VCH):                  # global chunk c*pp + s
+            for s_ in range(pp):
+                chunk = jax.tree_util.tree_map(lambda a: a[s_, c], sv)
+                x = stage1.apply(chunk, x)
+        y = fused_layer_norm(x, lnf["w"], lnf["b"])
+        logits = jnp.dot(y, ev["params"]["weight"].T,
+                         preferred_element_type=jnp.float32)
+        per_tok = tp_.vocab_parallel_cross_entropy(
+            logits, jnp.transpose(lab, (1, 0)))
+        return jnp.mean(per_tok)
+
+    loss_ref, g_ref = jax.value_and_grad(oracle_loss)(
+        params, tokens, labels)
+    np.testing.assert_allclose(float(loss4d), float(loss_ref),
+                               rtol=1e-6)
+    _assert_grads_match(g4d, g_ref, "4d-assembly")
