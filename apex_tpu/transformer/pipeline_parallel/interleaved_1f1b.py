@@ -86,6 +86,14 @@ def _greedy_ticks(P: int, V: int, M: int):
     than GPipe: the activation window stays O(P·V), independent of the
     microbatch count (for V=1 it reduces to the non-interleaved scan's
     2L−1 circular buffer).
+
+    Because the scan body computes the tick's forward half before its
+    backward half (and writes the saved input before the recompute
+    read), a forward of the LAST virtual stage assigned this tick can
+    seed its loss cotangent and run its backward in the SAME tick — so
+    after the forward assignment the backward check is retried once if
+    the stage's backward slot is still free (advisor r3: without the
+    retry every schedule was one tick longer than the scan supports).
     """
     PV = P * V
     cap = 2 * PV - 1
@@ -99,6 +107,55 @@ def _greedy_ticks(P: int, V: int, M: int):
     b_head = {s: {v: 0 for v in range(s, PV, P)} for s in range(P)}
     remaining = 2 * PV * M
     inflight = {s: 0 for s in range(P)}
+
+    def try_backward(s, t):
+        # lowest ready (v, j) — per-chunk heads, ascending v
+        nonlocal remaining
+        for v in sorted(b_head[s]):
+            j = b_head[s][v]
+            if j >= M:
+                continue
+            if v == PV - 1:
+                tf = f_tick.get((v, j))
+                ready = tf is not None and tf <= t
+            else:
+                tb = b_tick.get((v + 1, j))
+                ready = tb is not None and tb + 1 <= t
+            # recompute needs the saved input: fwd ran at <= t
+            if ready:
+                tf_own = f_tick.get((v, j))
+                ready = tf_own is not None and tf_own <= t
+            if ready:
+                b_tick[(v, j)] = t
+                b_head[s][v] = j + 1
+                inflight[s] -= 1
+                remaining -= 1
+                return True
+        return False
+
+    def try_forward(s, t):
+        # Among ready forwards pick the DEEPEST chunk (highest v):
+        # pushing microbatches toward the loss is what unlocks
+        # backwards — shallow-first hoarding fills the cap with
+        # chunk-0 activations and deadlocks the ring.
+        nonlocal remaining
+        for v in sorted(f_head[s], reverse=True):
+            j = f_head[s][v]
+            if j >= M:
+                continue
+            if v == 0:
+                ready = True
+            else:
+                tp = f_tick.get((v - 1, j))
+                ready = tp is not None and tp + 1 <= t
+            if ready:
+                f_tick[(v, j)] = t
+                f_head[s][v] = j + 1
+                inflight[s] += 1
+                remaining -= 1
+                return v
+        return None
+
     t = 0
     limit = 4 * (M * V + 2 * P * V) + 16
     while remaining:
@@ -107,49 +164,16 @@ def _greedy_ticks(P: int, V: int, M: int):
                 f"interleaved-1f1b scheduler did not converge "
                 f"(P={P}, V={V}, M={M}, tick {t})")
         for s in range(P):
-            # backward first (does not consume the fwd slot); lowest
-            # ready (v, j) — per-chunk heads, ascending v
-            for v in sorted(b_head[s]):
-                j = b_head[s][v]
-                if j >= M:
-                    continue
-                if v == PV - 1:
-                    tf = f_tick.get((v, j))
-                    ready = tf is not None and tf <= t
-                else:
-                    tb = b_tick.get((v + 1, j))
-                    ready = tb is not None and tb + 1 <= t
-                # recompute needs the saved input: fwd ran at <= t
-                if ready:
-                    tf_own = f_tick.get((v, j))
-                    ready = tf_own is not None and tf_own <= t
-                if ready:
-                    b_tick[(v, j)] = t
-                    b_head[s][v] = j + 1
-                    inflight[s] -= 1
-                    remaining -= 1
-                    break
-            # one forward, gated by the in-flight (activation) cap.
-            # Among ready forwards pick the DEEPEST chunk (highest v):
-            # pushing microbatches toward the loss is what unlocks
-            # backwards — shallow-first hoarding fills the cap with
-            # chunk-0 activations and deadlocks the ring.
-            if inflight[s] < cap:
-                for v in sorted(f_head[s], reverse=True):
-                    j = f_head[s][v]
-                    if j >= M:
-                        continue
-                    if v == 0:
-                        ready = True
-                    else:
-                        tp = f_tick.get((v - 1, j))
-                        ready = tp is not None and tp + 1 <= t
-                    if ready:
-                        f_tick[(v, j)] = t
-                        f_head[s][v] = j + 1
-                        inflight[s] += 1
-                        remaining -= 1
-                        break
+            # backward first (does not consume the fwd slot)
+            did_b = try_backward(s, t)
+            # one forward, gated by the in-flight (activation) cap
+            fv = try_forward(s, t) if inflight[s] < cap else None
+            # same-tick turnaround: the forward just assigned is the
+            # last virtual stage, whose backward seeds from the loss —
+            # the scan body runs fwd-half before bwd-half, so it can
+            # drain in this very tick if the bwd slot is still free
+            if not did_b and fv == PV - 1:
+                try_backward(s, t)
         t += 1
     return f_tick, b_tick
 

@@ -80,10 +80,15 @@ def main():
 
     def train_step(p, opt_state, step, x, y):
         loss, g = jax.value_and_grad(loss_fn)(p, x, y)
-        # pipe-replicated params got partial contributions: sum them
+        # Grad conventions across the pipe axis (docs/parallel.md):
+        # the pipeline OUTPUT is replicated, so the unmasked loss gives
+        # every rank the FULL d loss/d w_out already — summing it again
+        # would scale the head gradient by pp.  Only the PRE-pipeline
+        # path is partial (the input cotangent emerges on rank 0), so
+        # w_in alone needs the psum.
         g = {"in": jax.lax.psum(g["in"], comm.AXIS_PIPE),
              "stages": g["stages"],
-             "out": jax.lax.psum(g["out"], comm.AXIS_PIPE)}
+             "out": g["out"]}
         # data-parallel mean
         g = jax.tree_util.tree_map(
             lambda t: jax.lax.pmean(t, comm.AXIS_DATA), g)

@@ -356,6 +356,70 @@ def test_spmd_1f1b_apply_differentiable_end_to_end(problem):
         g1, want_stacked)
 
 
+def test_train_pp_grad_reduction_convention(problem):
+    """Pin the grad-reduction recipe examples/simple/train_pp.py uses
+    (advisor r3 medium): the pipeline OUTPUT is replicated across the
+    pipe axis, so post-pipeline (head) grads are already FULL on every
+    rank — psum'ing them over pipe scales by pp (a lr*pp error under
+    SGD).  Only the PRE-pipeline path is a rank-0 partial and needs the
+    psum.  This test runs the example's exact reduction and demands the
+    resulting grads equal chain autodiff — with the head psum'ed it
+    would see a pp* mismatch and fail."""
+    params, x, tgt = problem
+    mesh = comm.initialize(data=2, pipe=4)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params)
+    pspec = jax.tree_util.tree_map(lambda _: P(comm.AXIS_PIPE), params[0])
+    pre = jnp.eye(D) + 0.02 * jnp.arange(D * D).reshape(D, D) / (D * D)
+    post = 0.7 * jnp.eye(D) + 0.01
+
+    def loss_fn(pre_w, post_w, stacked_local, xx, tt):
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        y = pp.spmd_pipeline_1f1b_apply(stage_apply, local, xx @ pre_w)
+        y = y @ post_w
+        return jnp.mean(jax.vmap(
+            lambda yy, t: jnp.mean((yy - t) ** 2))(y, tt))
+
+    def grad_step(pre_w, post_w, stacked_local, xx, tt):
+        g_pre, g_post, g_st = jax.grad(
+            loss_fn, argnums=(0, 1, 2))(pre_w, post_w, stacked_local,
+                                        xx, tt)
+        # the example's reduction: psum ONLY the pre-pipeline partial
+        g_pre = jax.lax.psum(g_pre, comm.AXIS_PIPE)
+        g = (g_pre, g_post, g_st)
+        return jax.tree_util.tree_map(
+            lambda t: jax.lax.pmean(t, comm.AXIS_DATA), g)
+
+    got = jax.jit(comm.shard_map(
+        grad_step, mesh,
+        in_specs=(P(), P(), pspec, P(comm.AXIS_DATA), P(comm.AXIS_DATA)),
+        out_specs=(P(), P(), pspec)))(pre, post, stacked, x, tgt)
+
+    dp = 2
+
+    def chain(pre_w, post_w, ps):
+        # mean over the dp data shards of the per-shard mean-MSE loss —
+        # exactly what the psum(pre)+pmean(data) recipe should produce
+        def shard_loss(xx, tt):
+            h = xx @ pre_w
+            for p in ps:
+                h = jax.vmap(stage_apply, in_axes=(None, 0))(p, h)
+            h = h @ post_w
+            return jnp.mean(jax.vmap(
+                lambda yy, t: jnp.mean((yy - t) ** 2))(h, tt))
+        xs = x.reshape(dp, M // dp, *x.shape[1:])
+        ts = tgt.reshape(dp, -1, *tgt.shape[1:])
+        return jnp.mean(jnp.stack(
+            [shard_loss(xs[i], ts[i]) for i in range(dp)]))
+
+    want = jax.grad(chain, argnums=(0, 1, 2))(pre, post, params)
+    want = (want[0], want[1],
+            jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *want[2]))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        got, want)
+
+
 def test_spmd_interleaved_matches_chain(problem):
     """SPMD interleaved virtual stages (VERDICT r2 #7): V=2 chunks per
     stage, v=c*P+s placement — outputs AND grads match the sequential
@@ -444,6 +508,10 @@ class TestInterleaved1F1B:
                 (v % P_, t) for (v, j), t in f.items()).values()) == 1
             assert max(Counter(
                 (v % P_, t) for (v, j), t in b.items()).values()) == 1
+            # advisor r3: the last virtual stage's first backward seeds
+            # from the loss IN the tick of its own forward (the scan
+            # body supports it; the scheduler must actually emit it)
+            assert b[(PV - 1, 0)] == f[(PV - 1, 0)]
             s = build_schedule(P_, V, M_)
             for nm, cap in (("a_wr_slot", "abuf"), ("f_src_slot", "abuf"),
                             ("x_wr_slot", "xbuf"), ("x_rd_slot", "xbuf"),
