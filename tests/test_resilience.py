@@ -129,3 +129,164 @@ def test_corrupt_skip_emits_warning(tmp_path):
         with pytest.warns(UserWarning, match="skipping .*step-%d" % newest):
             out = mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
         assert out is not None                # fell back to older step
+
+
+# ---------------------------------------------------------------------
+# Multi-host resume agreement (VERDICT r3 #5): simulate a 2-host
+# cluster by faking the manager's collective hooks — each test drives
+# one host's restore with a pre-recorded view of its peer's allgather
+# contributions.
+# ---------------------------------------------------------------------
+
+def _fake_peer(mgr, peer_steps, peer_ok=1, rank=1):
+    """Make mgr see a 2-process cluster whose other host holds
+    ``peer_steps`` on disk and reports ``peer_ok`` for every load."""
+    cap = max(mgr._SYNC_CAP, mgr.keep + 2)
+
+    def allgather(arr):
+        arr = np.asarray(arr)
+        if arr.shape == (1,):                      # per-step ok flag
+            peer = np.asarray([peer_ok], np.int64)
+        else:                                      # step-set vector
+            peer = np.full((cap,), -1, np.int64)
+            tail = sorted(peer_steps)[-cap:]
+            peer[:len(tail)] = tail
+        pair = (peer, arr) if rank == 1 else (arr, peer)
+        return np.stack(pair)
+
+    mgr._allgather = allgather
+    mgr._process_count = lambda: 2
+
+
+def test_multihost_nonwriter_resumes_from_host0_step(tmp_path):
+    """Shared filesystem, all_hosts=False: the NON-writer host's
+    restore lands on host 0's newest step via the agreement protocol
+    (previously it just read the same files by luck; now it is a
+    contract)."""
+    with CheckpointManager(str(tmp_path), keep=3, every=5) as mgr0:
+        _train(mgr0, 15)
+        host0_steps = mgr0.steps_on_disk()
+        assert host0_steps == [5, 10, 15]
+
+    # host 1: same (shared) directory, not the writer
+    from apex_tpu.optimizers import FusedSGD
+    mgr1 = CheckpointManager(str(tmp_path), keep=3, every=5)
+    _fake_peer(mgr1, host0_steps, rank=1)
+    opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+    out = mgr1.restore_latest({"w": jnp.zeros((64,))}, opt)
+    assert out is not None
+    assert out[2] == 15                    # host 0's newest step
+    mgr1.close()
+
+
+def test_multihost_partial_publish_agrees_on_common_step(tmp_path):
+    """Per-host disks, all_hosts=True: the peer missed the newest
+    publish (crash between hosts' writes) — both sides must fall back
+    to the newest COMMON step, not their own newest."""
+    d0 = tmp_path / "h0"
+    with CheckpointManager(str(d0), keep=3, every=5,
+                           all_hosts=True) as mgr0:
+        _train(mgr0, 15)
+        assert mgr0.steps_on_disk() == [5, 10, 15]
+
+    from apex_tpu.optimizers import FusedSGD
+    mgr = CheckpointManager(str(d0), keep=3, every=5, all_hosts=True)
+    _fake_peer(mgr, [5, 10], rank=0)       # peer never published 15
+    opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+    out = mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+    assert out is not None
+    assert out[2] == 10                    # newest step EVERY host has
+    mgr.close()
+
+
+def test_multihost_no_common_steps_starts_fresh_with_warning(tmp_path):
+    """Per-host disks, all_hosts=False: host 0 has checkpoints, the
+    peer has none — the cluster must start fresh TOGETHER (host 0
+    warns), never host-0-resumes-while-peers-restart."""
+    with CheckpointManager(str(tmp_path), keep=3, every=5) as mgr0:
+        _train(mgr0, 10)
+
+    from apex_tpu.optimizers import FusedSGD
+    mgr = CheckpointManager(str(tmp_path), keep=3, every=5)
+    _fake_peer(mgr, [], rank=0)            # peer disk is empty
+    opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+    with pytest.warns(UserWarning, match="cluster shares none"):
+        out = mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+    assert out is None
+    mgr.close()
+
+
+def test_multihost_peer_reject_rolls_back_optimizer(tmp_path):
+    """A step that loads locally but fails on a peer is discarded; if
+    the whole walk ends fresh, the optimizer must be back to its
+    pre-restore state (the discarded load had mutated it)."""
+    with CheckpointManager(str(tmp_path), keep=2, every=5) as mgr0:
+        _train(mgr0, 10)
+
+    from apex_tpu.optimizers import FusedSGD
+    mgr = CheckpointManager(str(tmp_path), keep=2, every=5)
+    # peer holds the same steps but every load fails over there
+    _fake_peer(mgr, mgr.steps_on_disk(), peer_ok=0, rank=0)
+    opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+    before = np.asarray(opt.params["w"]).copy()
+    with pytest.warns(UserWarning, match="failed on another host"):
+        out = mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+    assert out is None
+    np.testing.assert_array_equal(np.asarray(opt.params["w"]), before)
+    mgr.close()
+
+
+def test_multihost_template_mismatch_aborts_cluster_in_lockstep(tmp_path):
+    """A template mismatch on ANY host must abort the restore on EVERY
+    host (code-review r4): a lone raiser would strand its peers inside
+    the next collective."""
+    from apex_tpu.checkpoint import TemplateMismatchError
+    from apex_tpu.optimizers import FusedSGD
+
+    with CheckpointManager(str(tmp_path), keep=2, every=5) as mgr0:
+        _train(mgr0, 10)
+
+    # this host loads fine; the PEER reports a template mismatch (2)
+    mgr = CheckpointManager(str(tmp_path), keep=2, every=5)
+    _fake_peer(mgr, mgr.steps_on_disk(), peer_ok=2, rank=0)
+    opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+    with pytest.raises(TemplateMismatchError, match="another host"):
+        mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+    mgr.close()
+
+
+def test_multihost_stranded_checkpoints_warn_from_any_host(tmp_path):
+    """The fresh-start warning must fire even when host 0's own disk is
+    empty and only a PEER holds checkpoints (code-review r4)."""
+    from apex_tpu.optimizers import FusedSGD
+
+    empty = tmp_path / "empty"
+    mgr = CheckpointManager(str(empty), keep=2, every=5)
+    assert mgr.steps_on_disk() == []
+    _fake_peer(mgr, [5, 10], rank=0)       # peer has files, we don't
+    opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+    with pytest.warns(UserWarning, match="cluster shares none"):
+        out = mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+    assert out is None
+    mgr.close()
+
+
+def test_multihost_fatal_abort_rolls_back_local_optimizer(tmp_path):
+    """When a peer's template mismatch aborts the restore, a host whose
+    OWN load succeeded must hand back a pristine optimizer with the
+    raise (code-review r4): callers catching the abort to fall back to
+    fresh training must not inherit a half-restored optimizer."""
+    from apex_tpu.checkpoint import TemplateMismatchError
+    from apex_tpu.optimizers import FusedSGD
+
+    with CheckpointManager(str(tmp_path), keep=2, every=5) as mgr0:
+        _train(mgr0, 10)
+
+    mgr = CheckpointManager(str(tmp_path), keep=2, every=5)
+    _fake_peer(mgr, mgr.steps_on_disk(), peer_ok=2, rank=0)
+    opt = FusedSGD({"w": jnp.zeros((64,))}, lr=0.1)
+    before = np.asarray(opt.params["w"]).copy()
+    with pytest.raises(TemplateMismatchError):
+        mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
+    np.testing.assert_array_equal(np.asarray(opt.params["w"]), before)
+    mgr.close()
