@@ -9,6 +9,9 @@ metric 2), reported in the same JSON line under "extra".
 Always prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": "imgs/sec/chip",
    "vs_baseline": N, "backend": "tpu"|"cpu-fallback", ...}
+The tracked metric name appears only on real TPU runs; off-TPU lines
+are labeled "harness_check_cpu_fallback" (tiny proxy shapes prove the
+harness, not performance).
 
 Hardening (VERDICT.md round 1, Weak #1): the top-level process is a
 pure orchestrator that never imports jax.  It (a) probes the TPU
@@ -298,9 +301,22 @@ def bench_flash_attention(jax, jnp, on_tpu):
     return out
 
 
+NORTH_STAR_METRIC = "resnet50_amp_o2_fused_sgd_train_throughput"
+
+
+def _metric_name(backend):
+    """VERDICT r3 #6: the tracked metric name is reserved for REAL TPU
+    measurements.  Off-TPU the tiny proxy shape only proves the harness
+    runs end-to-end, and three rounds of 4-ish imgs/sec under the
+    north-star name read like a measurement — label it as the liveness
+    check it is."""
+    return (NORTH_STAR_METRIC if backend == "tpu"
+            else "harness_check_cpu_fallback")
+
+
 def _empty_result(backend="unknown"):
     return {
-        "metric": "resnet50_amp_o2_fused_sgd_train_throughput",
+        "metric": _metric_name(backend),
         "value": 0.0,
         "unit": "imgs/sec/chip",
         "vs_baseline": 0.0,
@@ -337,6 +353,7 @@ def run_child(backend):
             # jax silently fell back to CPU — don't mislabel CPU numbers
             # as a TPU result.
             out["backend"] = backend = "cpu-fallback"
+            out["metric"] = _metric_name(backend)
             on_tpu = False
             out["errors"].append("requested tpu but jax initialized cpu")
     except Exception as e:
