@@ -491,3 +491,174 @@ def test_scan_with_prng_key_and_int_carry():
                                rtol=3e-2, atol=1e-3)
     g = jax.grad(w)(p, x, key)
     assert bool(jnp.all(jnp.isfinite(g)))
+
+
+# ------------------------------------------------------------------
+# Randomized-program property grid (VERDICT r3 #7).  Seeded random
+# programs mix listed (GEMM / transcendental / reduction) and unlisted
+# primitives with scan/while/cond control flow; for every program the
+# rewriter must (a) agree numerically with the unrewritten f32 program
+# within compounded-bf16 tolerance and (b) satisfy the dtype invariants
+# — every HALF_PRIMS eqn sees bf16 floats, every FP32_PRIMS eqn sees
+# f32 — checked by walking the rewritten jaxpr including all control-
+# flow sub-jaxprs.  Seeds are fixed, so each case is deterministic.
+
+from apex_tpu.amp import lists as amp_lists  # noqa: E402
+from apex_tpu.amp import wrap as amp_wrap    # noqa: E402
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in amp_wrap._iter_sub_jaxprs(eqn.params):
+            yield from _walk_eqns(sub)
+
+
+def _check_dtype_invariants(jaxpr):
+    """Assert the O1 precision routing on every eqn reachable from the
+    rewritten jaxpr; returns (#HALF eqns, #FP32 eqns) seen."""
+    half_n = fp32_n = 0
+    for eqn in _walk_eqns(jaxpr):
+        nm = eqn.primitive.name
+        fdts = {str(v.aval.dtype) for v in eqn.invars
+                if hasattr(v.aval, "dtype")
+                and jnp.issubdtype(v.aval.dtype, jnp.floating)}
+        if nm in amp_lists.HALF_PRIMS:
+            assert fdts <= {"bfloat16"}, (nm, fdts)
+            half_n += 1
+        elif nm in amp_lists.FP32_PRIMS:
+            assert fdts <= {"float32"}, (nm, fdts)
+            fp32_n += 1
+    return half_n, fp32_n
+
+
+def _random_program(rng, dim, depth=0):
+    """Seeded random f: (B, dim) f32 -> (B, dim) f32.  Every op in the
+    pool preserves shape and keeps magnitudes O(1) so bf16 round-off
+    stays bounded under composition.  Control-flow ops nest recursively
+    (depth-capped) with independently generated bodies.  The returned
+    fn carries ``has_while`` (reverse-mode AD cannot cross
+    lax.while_loop, in the rewritten and unrewritten program alike)."""
+    kinds = ["matmul", "exp", "log", "rsqrt", "center", "cumsum",
+             "relu", "affine", "tanh", "scan", "while", "cond"]
+    probs = [0.20, 0.09, 0.07, 0.08, 0.08, 0.05,
+             0.07, 0.10, 0.06, 0.07, 0.06, 0.07]
+    has_while = False
+
+    def make_op(kind):
+        nonlocal has_while
+        if kind == "matmul":
+            w = jnp.asarray(rng.normal(size=(dim, dim)) / np.sqrt(dim),
+                            jnp.float32)
+            return lambda x, w=w: x @ w
+        if kind == "exp":
+            return lambda x: jnp.exp(0.2 * x) - 1.0
+        if kind == "log":
+            return lambda x: jnp.log1p(jnp.abs(x))
+        if kind == "rsqrt":
+            return lambda x: x * jax.lax.rsqrt(
+                jnp.mean(x * x, axis=-1, keepdims=True) + 1.0)
+        if kind == "center":
+            return lambda x: x - jnp.mean(x, axis=-1, keepdims=True)
+        if kind == "cumsum":
+            return lambda x: jnp.cumsum(x, axis=-1) * (1.0 / dim)
+        if kind == "relu":
+            return lambda x: jnp.maximum(x, 0.0) - 0.3
+        if kind == "affine":
+            b = jnp.asarray(rng.normal(size=(dim,)) * 0.1, jnp.float32)
+            return lambda x, b=b: 0.9 * x + b
+        if kind == "tanh":
+            return jnp.tanh
+        if kind == "scan" and depth < 2:
+            body = _random_program(rng, dim, depth + 1)
+            has_while = has_while or body.has_while
+
+            def op(x, body=body):
+                c, _ = jax.lax.scan(lambda c, _: (body(c), None),
+                                    x, None, length=2)
+                return c
+            return op
+        if kind == "while" and depth < 2:
+            body = _random_program(rng, dim, depth + 1)
+            has_while = True
+
+            def op(x, body=body):
+                def w_body(state):
+                    i, v = state
+                    return i + 1, body(v)
+                return jax.lax.while_loop(
+                    lambda s: s[0] < 2, w_body, (jnp.int32(0), x))[1]
+            return op
+        if kind == "cond" and depth < 2:
+            tb = _random_program(rng, dim, depth + 1)
+            fb = _random_program(rng, dim, depth + 1)
+            has_while = has_while or tb.has_while or fb.has_while
+            # static per-seed predicate: a data-dependent pred near its
+            # threshold could take DIFFERENT branches in the rewritten
+            # vs reference program under bf16 drift, failing the
+            # comparison for reasons unrelated to the rewriter.  Both
+            # branches are still traced and rewritten (the dtype
+            # invariants see them); traced-pred coherence is pinned by
+            # test_cond_branches_rewritten_coherently.
+            pred = jnp.asarray(bool(rng.random() < 0.5))
+
+            def op(x, tb=tb, fb=fb, pred=pred):
+                return jax.lax.cond(pred, tb, fb, x)
+            return op
+        return lambda x: x * 0.9  # depth-capped control flow
+
+    ops = [make_op(str(k))
+           for k in rng.choice(kinds, size=int(rng.integers(2, 6)),
+                               p=probs)]
+    if depth == 0:
+        # guarantee every top-level program exercises both lists
+        ops.insert(int(rng.integers(0, len(ops) + 1)), make_op("matmul"))
+        ops.insert(int(rng.integers(0, len(ops) + 2)), make_op("center"))
+
+    def f(x):
+        for op in ops:
+            x = op(x)
+        return x
+    f.has_while = has_while
+    return f
+
+
+def _run_fuzz_case(seed):
+    rng = np.random.default_rng(seed)
+    B, D = 4, 16
+    f = _random_program(rng, D)
+    x = jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+    w = amp.auto_cast(f, compute_dtype=jnp.bfloat16)
+
+    # (a) numerical agreement with the unrewritten f32 program
+    ref = np.asarray(f(x).astype(jnp.float32))
+    out = np.asarray(w(x).astype(jnp.float32))
+    assert np.isfinite(ref).all() and np.isfinite(out).all()
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.05)
+
+    # (b) dtype invariants over the whole rewritten jaxpr
+    jx = jax.make_jaxpr(w)(x)
+    half_n, fp32_n = _check_dtype_invariants(jx.jaxpr)
+    assert half_n >= 1 and fp32_n >= 1, (half_n, fp32_n)
+
+    # (c) the rewrite composes with grad and stays close to the f32
+    # gradient (while_loop is not reverse-differentiable in any mode)
+    if not f.has_while:
+        g32 = np.asarray(jax.grad(lambda t: jnp.sum(f(t)))(x))
+        gmx = np.asarray(jax.grad(
+            lambda t: jnp.sum(w(t).astype(jnp.float32)))(x))
+        assert np.isfinite(gmx).all()
+        rel = (np.linalg.norm(gmx - g32)
+               / (np.linalg.norm(g32) + 1e-6))
+        assert rel < 0.15, rel
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_rewriter_random_programs(seed):
+    _run_fuzz_case(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(8, 48))
+def test_fuzz_rewriter_random_programs_full(seed):
+    _run_fuzz_case(seed)
