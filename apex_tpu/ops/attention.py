@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu import comm
+from apex_tpu.ops import _dispatch
 from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
 _NEG = -1e30
@@ -105,9 +106,12 @@ def _attn_family(dtype) -> str:
 def _block_cap(dp: int):
     """(cap, explicit): tunable via APEX_TPU_ATTN_BLOCK_CAP (a
     128-multiple; tools/kernel_bench.py --sweep-attn sweeps it on
-    hardware), else a VMEM-safe default by padded head dim.  The env
-    var is read and interpreted HERE only; ``explicit`` tells _block
-    to complain loudly when the requested cap can't be honored."""
+    hardware), else the measured-best cap the sweep recorded in
+    dispatch_prefs.json for this padded head dim, else a VMEM-safe
+    static default.  The env var is read and interpreted HERE only;
+    ``explicit`` tells _block to complain loudly when the requested cap
+    can't be honored (the measured table is advisory — a non-dividing
+    measured cap quietly falls back to 128-blocks for that shape)."""
     import os
     env = os.environ.get("APEX_TPU_ATTN_BLOCK_CAP")
     if env:
@@ -120,7 +124,22 @@ def _block_cap(dp: int):
                 f"APEX_TPU_ATTN_BLOCK_CAP must be a positive multiple "
                 f"of {_LANES}, got {env!r}")
         return cap, True
+    measured = _dispatch.attn_block_cap(dp)
+    if measured is not None:
+        # VMEM-feasibility ceiling: the measured table is advisory and
+        # sweep-written (tools/kernel_bench.py only records caps that
+        # compiled and won), but a hand-edited value must not push the
+        # double-buffered blocks + f32 score tile past ~16 MiB VMEM —
+        # clamp to the largest cap the sweep grid explores for this dp.
+        return min(measured, _sweep_cap_ceiling(dp)), False
     return (512 if dp <= 128 else (256 if dp <= 256 else 128)), False
+
+
+def _sweep_cap_ceiling(dp: int) -> int:
+    """Largest sequence-block cap the hardware sweep explores (and thus
+    the largest a measured table entry can honestly contain) for a
+    padded head dim — the VMEM working set grows with cap*dp."""
+    return 1024 if dp <= 128 else (512 if dp <= 256 else 256)
 
 
 def _geom(q, k):

@@ -304,6 +304,53 @@ def test_attn_block_cap_env_knob(monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_attn_block_cap_measured_table(monkeypatch):
+    """The sweep-written attn_block_cap table in dispatch_prefs.json
+    sets the default geometry per padded head dim; the env knob still
+    wins over it, and unmeasured head dims keep the static default."""
+    from apex_tpu.ops import _dispatch
+    from apex_tpu.ops import attention as A
+
+    monkeypatch.delenv("APEX_TPU_ATTN_BLOCK_CAP", raising=False)
+    monkeypatch.setattr(_dispatch, "_ATTN_CAPS", {"128": 256})
+    q = jnp.zeros((1, 1, 1024, 64), jnp.float32)   # dp=128
+    k = jnp.zeros((1, 1, 1024, 64), jnp.float32)
+    assert A._geom(q, k)[6] == 256                 # measured wins
+    monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "128")
+    assert A._geom(q, k)[6] == 128                 # env beats measured
+    monkeypatch.delenv("APEX_TPU_ATTN_BLOCK_CAP")
+    q = jnp.zeros((1, 1, 1024, 256), jnp.float32)  # dp=256: unmeasured
+    k = jnp.zeros((1, 1, 1024, 256), jnp.float32)
+    assert A._geom(q, k)[6] == 256                 # static default
+    # a hand-edited cap above the sweep grid's ceiling for this head
+    # dim is clamped to VMEM-feasible geometry, not compiled blindly
+    monkeypatch.setattr(_dispatch, "_ATTN_CAPS", {"256": 1024})
+    assert A._geom(q, k)[6] == 512                 # ceiling at dp=256
+
+
+def test_dispatch_prefs_attn_caps_parse(tmp_path, monkeypatch):
+    """_load_prefs returns the measured cap table and never propagates
+    a malformed file (the documented import-safety contract)."""
+    import json as _json
+
+    from apex_tpu.ops import _dispatch
+
+    p = tmp_path / "prefs.json"
+    p.write_text(_json.dumps({
+        "prefer_pallas": {"attention": True},
+        "attn_block_cap": {"128": 256, "256": "512", "64": "auto",
+                           "bad": 100, "worse": -128}}))
+    monkeypatch.setattr(_dispatch, "_PREFS_PATH", str(p))
+    prefs, caps = _dispatch._load_prefs()
+    assert prefs == {"attention": True}
+    # 100 is not a 128-multiple, -128 is negative, "auto" is not an
+    # int: each dropped per-entry WITHOUT discarding prefer_pallas
+    assert caps == {"128": 256, "256": 512}
+
+    p.write_text("{truncated")
+    assert _dispatch._load_prefs() == ({}, {})
+
+
 def test_f32_attention_is_its_own_dispatch_family(monkeypatch):
     """A hardware measurement that routes f32 flash to the XLA path
     (Precision.HIGHEST multi-pass dots may lose there) must NOT take
