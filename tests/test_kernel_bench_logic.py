@@ -1,0 +1,83 @@
+"""Pure-logic tests for the hardware kernel-bench distillers: the
+pieces that turn measured timings into committed dispatch defaults
+(dispatch_prefs.json) must be right BEFORE a scarce tunnel window runs
+them (the sweep executes unattended inside tools/run_tpu_validation.sh)."""
+
+import importlib.util
+import json
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "kernel_bench",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools", "kernel_bench.py"))
+kb = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(kb)
+
+
+class TestSelectAttnCaps:
+    def test_lowest_mean_relative_time_wins(self):
+        caps = kb.select_attn_caps({
+            (128, 128): [1.5, 1.2],
+            (128, 256): [1.0, 1.1],
+            (128, 512): [1.3, 1.0],
+        })
+        assert caps == {"128": 256}
+
+    def test_partial_sample_cannot_win(self):
+        # cap 1024 was only feasible on the long-sequence shape and won
+        # there, but must not become the tier default on one sample
+        caps = kb.select_attn_caps({
+            (128, 256): [1.0, 1.0],
+            (128, 512): [1.1, 1.2],
+            (128, 1024): [0.8],
+        })
+        assert caps == {"128": 256}
+
+    def test_per_dp_winners_are_independent(self):
+        caps = kb.select_attn_caps({
+            (128, 256): [1.0],
+            (128, 512): [1.4],
+            (256, 128): [1.0],
+            (256, 512): [1.6],
+        })
+        assert caps == {"128": 256, "256": 128}
+
+    def test_empty(self):
+        assert kb.select_attn_caps({}) == {}
+
+
+class TestWritePrefs:
+    def test_merge_preserves_attn_caps(self, tmp_path):
+        p = tmp_path / "prefs.json"
+        p.write_text(json.dumps({"attn_block_cap": {"128": 256}}))
+        rows = [
+            {"kernel": "fused_layer_norm", "speedup": 1.3, "backend": "tpu"},
+            {"kernel": "fused_layer_norm_grad", "speedup": 1.1,
+             "backend": "tpu"},
+            {"kernel": "flash_attention", "speedup": 0.9, "backend": "tpu"},
+        ]
+        prefs = kb.write_prefs(rows, str(p))
+        doc = json.loads(p.read_text())
+        assert doc["attn_block_cap"] == {"128": 256}
+        assert doc["prefer_pallas"] == prefs == {
+            "layer_norm": True, "attention": False}
+        assert doc["backend"] == "tpu"
+
+    def test_any_slower_shape_flips_family_to_xla(self, tmp_path):
+        p = tmp_path / "prefs.json"
+        rows = [
+            {"kernel": "flash_attention", "speedup": 1.5, "backend": "tpu"},
+            {"kernel": "flash_attention_grad", "speedup": 0.95,
+             "backend": "tpu"},
+        ]
+        assert kb.write_prefs(rows, str(p)) == {"attention": False}
+
+    def test_corrupt_existing_file_does_not_abort(self, tmp_path):
+        p = tmp_path / "prefs.json"
+        p.write_text("{truncated")
+        rows = [{"kernel": "welford_mean_var", "speedup": 2.0,
+                 "backend": "tpu"}]
+        assert kb.write_prefs(rows, str(p)) == {"welford": True}
+        assert json.loads(p.read_text())["prefer_pallas"] == {
+            "welford": True}

@@ -70,6 +70,28 @@ def bench_pair(name, shape_desc, dtype, kern, oracle, *args, grad=False):
                         if o_ms is not None and k_ms else None)}
 
 
+def select_attn_caps(sweep_times):
+    """Per-head-dim winner from sweep measurements.
+
+    ``sweep_times``: {(dp, cap): [relative time per swept shape]},
+    where each entry is ms / best-ms-for-that-shape.  The winner for a
+    dp is the cap with the lowest mean relative time among caps that
+    were measured on EVERY swept shape of that dp — a cap only feasible
+    (or only surviving compilation) on a subset of shapes must not win
+    the tier on a partial sample.  Returns {str(dp): cap}."""
+    by_dp = {}
+    for (dp, cap), rels in sweep_times.items():
+        by_dp.setdefault(dp, {})[cap] = rels
+    caps_out = {}
+    for dp, capmap in by_dp.items():
+        full = max(len(r) for r in capmap.values())
+        cands = {c: sum(r) / len(r) for c, r in capmap.items()
+                 if len(r) == full}
+        if cands:
+            caps_out[str(dp)] = min(cands, key=cands.get)
+    return caps_out
+
+
 # kernel_bench row name -> dispatch op family (apex_tpu.ops._dispatch)
 _OP_FAMILY = {
     "flash_attention": "attention",
@@ -287,19 +309,7 @@ def main():
                 for cap, ms in shape_ms.items():
                     sweep_times.setdefault((dp, cap), []).append(
                         ms / best[1])
-        # per-dp winner = lowest mean relative time among caps measured
-        # on EVERY swept shape of that dp (a cap only feasible at long
-        # sequences must not win on a one-shape sample)
-        by_dp = {}
-        for (dp, cap), rels in sweep_times.items():
-            by_dp.setdefault(dp, {})[cap] = rels
-        caps_out = {}
-        for dp, capmap in by_dp.items():
-            full = max(len(r) for r in capmap.values())
-            cands = {c: sum(r) / len(r) for c, r in capmap.items()
-                     if len(r) == full}
-            if cands:
-                caps_out[str(dp)] = min(cands, key=cands.get)
+        caps_out = select_attn_caps(sweep_times)
         if caps_out:
             from apex_tpu.ops import _dispatch
             try:
