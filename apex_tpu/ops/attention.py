@@ -275,22 +275,29 @@ def _fwd_kernel(scale, causal, seg, need_lse, sq, sk, sqp, skp, bq, bk,
                                    _NEG)
 
 
+def _kv_row(i, h, hk):
+    """Flat KV row for flat q row ``i`` under grouped-query attention:
+    q head y attends kv head y // (h // hk).  Identity when hk == h."""
+    return (i // h) * hk + (i % h) // (h // hk)
+
+
 def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True):
     b, h, sq, sk, d, dp, bq, bk, sqp, skp = _geom(q, k)
     nq, nk = sqp // bq, skp // bk
+    hk = k.shape[1]
 
     q3 = _pad_head(_pad_seq(q, sqp), dp).reshape(b * h, sqp, dp)
-    k3 = _pad_head(_pad_seq(k, skp), dp).reshape(b * h, skp, dp)
-    v3 = _pad_head(_pad_seq(v, skp), dp).reshape(b * h, skp, dp)
+    k3 = _pad_head(_pad_seq(k, skp), dp).reshape(b * hk, skp, dp)
+    v3 = _pad_head(_pad_seq(v, skp), dp).reshape(b * hk, skp, dp)
 
     if causal:
         # clamp the KV index for blocks above the diagonal: the skipped
         # iterations re-reference the diagonal block, so no DMA is issued
         def _kv_idx(i, j, kk, bq=bq, bk=bk, nk=nk):
-            return (i, jnp.minimum(kk, jnp.minimum(
+            return (_kv_row(i, h, hk), jnp.minimum(kk, jnp.minimum(
                 nk - 1, ((j + 1) * bq - 1) // bk)), 0)
     else:
-        _kv_idx = lambda i, j, kk: (i, kk, 0)
+        _kv_idx = lambda i, j, kk: (_kv_row(i, h, hk), kk, 0)
     in_specs = [
         pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0)),
         pl.BlockSpec((1, bk, dp), _kv_idx),
@@ -385,8 +392,13 @@ def _dq_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq,
+def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq, g,
                 *refs):
+    """dk/dv accumulation.  The sequential axis ``t`` covers the whole
+    q-head GROUP sharing this kv head times the q blocks (t = qh*NQ+j,
+    grouped-query attention): every q head's contribution lands in the
+    same scratch accumulator, race-free because the axis is
+    'arbitrary' (sequential).  g == 1 recovers plain MHA exactly."""
     if seg:
         q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qs_ref, ks_ref, \
             dk_ref, dv_ref, dk_scr, dv_scr = refs
@@ -395,12 +407,15 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq,
             dk_ref, dv_ref, dk_scr, dv_scr = refs
         qs_ref = ks_ref = None
     kk = pl.program_id(1)
-    j = pl.program_id(2)
+    t = pl.program_id(2)
+    j = t % nq if g > 1 else t
 
-    # causal: first Q block whose rows reach this KV block
+    # causal: first Q block whose rows reach this KV block (same for
+    # every q head in the group, so init fires on the group's first
+    # executed tick: qh == 0, j == j_first)
     j_first = jnp.minimum(nq - 1, (kk * bk) // bq) if causal else 0
 
-    @pl.when(j == j_first)
+    @pl.when(t == j_first)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -417,7 +432,7 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq,
         dk_scr[...] += _dot(ds.astype(q_ref.dtype), q_ref[0],
                             ((0,), (0,)))
 
-    @pl.when(j == nq - 1)
+    @pl.when(t == g * nq - 1)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -426,10 +441,12 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq,
 def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
     b, h, sq, sk, d, dp, bq, bk, sqp, skp = _geom(q, k)
     nq, nk = sqp // bq, skp // bk
+    hk = k.shape[1]
+    g = h // hk
 
     q3 = _pad_head(_pad_seq(q, sqp), dp).reshape(b * h, sqp, dp)
-    k3 = _pad_head(_pad_seq(k, skp), dp).reshape(b * h, skp, dp)
-    v3 = _pad_head(_pad_seq(v, skp), dp).reshape(b * h, skp, dp)
+    k3 = _pad_head(_pad_seq(k, skp), dp).reshape(b * hk, skp, dp)
+    v3 = _pad_head(_pad_seq(v, skp), dp).reshape(b * hk, skp, dp)
     do3 = _pad_head(_pad_seq(do, sqp), dp).reshape(b * h, sqp, dp)
 
     # di = rowsum(do * o): plain-XLA elementwise; both di and the saved
@@ -443,17 +460,24 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
     lse = jnp.broadcast_to(lse[:, :, None], (b * h, sqp, _LANES))
 
     seg = segment_ids is not None
+
+    # dkv grid rows run over KV heads (b*hk); its sequential axis t
+    # covers the q-head group x q blocks.  These maps recover the flat
+    # q row and the (causal-clamped) q block from (i, kk, t).
+    def _q_row_kv(i, t):
+        return (i // hk) * h + (i % hk) * g + t // nq
+
     if causal:
         def _kv_idx(i, j, kk, bq=bq, bk=bk, nk=nk):
-            return (i, jnp.minimum(kk, jnp.minimum(
+            return (_kv_row(i, h, hk), jnp.minimum(kk, jnp.minimum(
                 nk - 1, ((j + 1) * bq - 1) // bk)), 0)
 
-        def _q_idx_kv(i, kk, j, bq=bq, bk=bk, nq=nq):
-            return (i, jnp.maximum(j, jnp.minimum(
+        def _q_idx_kv(i, kk, t, bq=bq, bk=bk, nq=nq):
+            return (_q_row_kv(i, t), jnp.maximum(t % nq, jnp.minimum(
                 nq - 1, (kk * bk) // bq)), 0)
     else:
-        _kv_idx = lambda i, j, kk: (i, kk, 0)
-        _q_idx_kv = lambda i, kk, j: (i, j, 0)
+        _kv_idx = lambda i, j, kk: (_kv_row(i, h, hk), kk, 0)
+        _q_idx_kv = lambda i, kk, t: (_q_row_kv(i, t), t % nq, 0)
     base_specs = [
         pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0)),
         pl.BlockSpec((1, bk, dp), _kv_idx),
@@ -502,22 +526,22 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
     if seg:
         kv_specs += [
             pl.BlockSpec((1, bq, _LANES),
-                         lambda i, kk, j: (i // h,
-                                           _q_idx_kv(i, kk, j)[1], 0)),
-            pl.BlockSpec((1, 8, bk), lambda i, kk, j: (i // h, 0, kk)),
+                         lambda i, kk, t: (i // hk,
+                                           _q_idx_kv(i, kk, t)[1], 0)),
+            pl.BlockSpec((1, 8, bk), lambda i, kk, t: (i // hk, 0, kk)),
         ]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale, causal, seg, sq, sk,
-                          sqp, skp, bq, bk, nq),
-        grid=(b * h, nk, nq),
+                          sqp, skp, bq, bk, nq, g),
+        grid=(b * hk, nk, g * nq),
         in_specs=kv_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, dp), lambda i, kk, j: (i, kk, 0)),
-            pl.BlockSpec((1, bk, dp), lambda i, kk, j: (i, kk, 0)),
+            pl.BlockSpec((1, bk, dp), lambda i, kk, t: (i, kk, 0)),
+            pl.BlockSpec((1, bk, dp), lambda i, kk, t: (i, kk, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, skp, dp), k.dtype),
-            jax.ShapeDtypeStruct((b * h, skp, dp), v.dtype),
+            jax.ShapeDtypeStruct((b * hk, skp, dp), k.dtype),
+            jax.ShapeDtypeStruct((b * hk, skp, dp), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, dp), jnp.float32),
@@ -530,8 +554,8 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
     )(*args)
 
     dq = dq.reshape(b, h, sqp, dp)[:, :, :sq, :d]
-    dk = dk.reshape(b, h, skp, dp)[:, :, :sk, :d]
-    dv = dv.reshape(b, h, skp, dp)[:, :, :sk, :d]
+    dk = dk.reshape(b, hk, skp, dp)[:, :, :sk, :d]
+    dv = dv.reshape(b, hk, skp, dp)[:, :, :sk, :d]
     return dq, dk, dv
 
 
@@ -577,7 +601,18 @@ def flash_attention(q, k, v, causal=False, scale=None,
     segment_ids: optional (q_ids (B, Sq), kv_ids (B, Sk)) int arrays;
     attention is masked where ids differ (packed variable-length
     batches — the fmha contract).
+
+    Grouped-query / multi-query attention (beyond-reference TPU
+    extension): k/v may carry FEWER heads than q — (B, HK, Sk, D) with
+    H % HK == 0; q head y attends kv head y // (H // HK).  The kernels
+    read the small K/V straight from HBM (the bandwidth point of GQA)
+    instead of materializing repeated heads.
     """
+    h, hk = q.shape[1], k.shape[1]
+    if h % hk or v.shape[1] != hk:
+        raise ValueError(
+            f"flash_attention: q heads ({h}) must be a multiple of kv "
+            f"heads ({hk}, v: {v.shape[1]})")
     # the kernels dot native-dtype operands (full-rate MXU): normalize
     # mixed q/k/v dtypes once here so kernel and fallback paths agree
     if not (q.dtype == k.dtype == v.dtype):
@@ -616,7 +651,13 @@ def attention_ref(q, k, v, causal=False, scale=None,
 
     f32 inputs get HIGHEST matmul precision (true f32 on the MXU, same
     contract as the kernel's _dot); bf16 inputs keep the fast default.
+    Grouped-query shapes (kv heads < q heads) are handled by repeating
+    kv — the oracle states the semantics; the kernel avoids the copy.
     """
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     sc = scale if scale is not None else _default_scale(q.shape[-1])
     prec = matmul_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -793,6 +834,16 @@ def ring_attention(q, k, v, causal=False, scale=None,
     ``ring_attention_ref`` (plain scan + ppermute, fully transposable)
     or set APEX_TPU_DISABLE_PALLAS=1.
     """
+    if k.shape[1] != q.shape[1]:
+        # the ring's blockwise math and its traveling dk/dv accumulators
+        # are head-aligned with q; GQA shapes would half-work (forward
+        # only) — refuse clearly instead.  GQA composes with
+        # ulysses_attention (hk % cp == 0) or plain flash_attention.
+        raise ValueError(
+            f"ring_attention requires equal q/kv head counts, got "
+            f"q={q.shape[1]} kv={k.shape[1]}; repeat kv heads first or "
+            "use ulysses_attention / flash_attention for grouped-query "
+            "shapes")
     # normalize mixed dtypes BEFORE picking the dispatch family, so
     # this entry point and flash_attention consult the same precision
     # class for identical inputs
@@ -889,12 +940,17 @@ def ulysses_attention(q, k, v, causal=False, scale=None,
     cp = jax.lax.axis_size(axis)
     if cp == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale)
-    h = q.shape[1]
-    if h % cp:
+    h, hk = q.shape[1], k.shape[1]
+    if h % cp or hk % cp:
+        # GQA composes with Ulysses when BOTH head counts split over the
+        # axis (each device then holds H/cp q heads + HK/cp kv heads of
+        # the full sequence); checking only q would let hk % cp != 0
+        # die inside all_to_all with an opaque shape error
         raise ValueError(
-            f"ulysses_attention: heads ({h}) must be divisible by the "
-            f"'{axis}' axis size ({cp}); use ring_attention for "
-            "head-count-agnostic context parallelism")
+            f"ulysses_attention: q heads ({h}) and kv heads ({hk}) "
+            f"must be divisible by the '{axis}' axis size ({cp}); use "
+            "ring_attention for head-count-agnostic context "
+            "parallelism")
 
     def seq_to_heads(x):   # (B, H, S/cp, D) -> (B, H/cp, S, D)
         return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,

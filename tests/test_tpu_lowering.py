@@ -62,6 +62,18 @@ def test_lower_flash_attention_segments_and_longseq():
 # norm / softmax / xentropy / welford / wgrad
 # --------------------------------------------------------------------------
 
+def test_lower_flash_attention_gqa():
+    """GQA/MQA geometry (kv rows indexed through _kv_row, dkv grid
+    folding the q group into its sequential axis) must pass the Mosaic
+    verifier, fwd and bwd."""
+    from apex_tpu.ops.attention import flash_attention
+    q = jnp.zeros((1, 8, 1024, 64), jnp.bfloat16)
+    kv = jnp.zeros((1, 2, 1024, 64), jnp.bfloat16)
+    lower_tpu(lambda q, k, v: flash_attention(q, k, v, True), q, kv, kv)
+    lower_tpu(grad_of(
+        lambda q, k, v: flash_attention(q, k, v, True), 3), q, kv, kv)
+
+
 @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
 @pytest.mark.parametrize("rms", [False, True])
 def test_lower_norms(rms, dtype):
