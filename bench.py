@@ -13,15 +13,17 @@ The tracked metric name appears only on real TPU runs; off-TPU lines
 are labeled "harness_check_cpu_fallback" (tiny proxy shapes prove the
 harness, not performance).
 
-Hardening (VERDICT.md round 1, Weak #1): the top-level process is a
-pure orchestrator that never imports jax.  It (a) probes the TPU
-backend in a bounded subprocess — backend init can hang indefinitely on
-a dead tunnel — retrying once on transient failure, and (b) runs the
-bench body itself in a second, watchdogged subprocess, so even a
-backend hang that appears AFTER a successful probe (tunnel died in the
-TOCTOU window) cannot prevent the JSON line.  On any failure the
-orchestrator emits a labeled fallback/error line itself.  Every phase
-inside the child is individually guarded too.
+Hardening (VERDICT.md round 1 Weak #1; restructured round 4): the
+top-level process is a pure orchestrator that never imports jax.  It
+runs the bench body in ONE watchdogged subprocess that is also the
+FIRST AND ONLY tunnel client — no pre-probe, because the axon relay
+admits only the first client after a relay restart (round-4 field
+data in tools/artifacts/), so a throwaway probe burns the session the
+bench needs.  The child detects a CPU-initialized backend itself and
+relabels the run cpu-fallback; the orchestrator salvages flushed
+intermediate lines if the child is killed, and emits a labeled
+fallback/error line on any failure.  Every phase inside the child is
+individually guarded too.
 
 vs_baseline compares against the A100 amp target named in BASELINE.json
 (~2500 imgs/sec/chip for ResNet-50 AMP on DGX A100, the number the
@@ -81,30 +83,12 @@ def _mfu(flops, step_s, on_tpu):
         return None
     return round(flops / step_s / peak, 4)
 
-_PROBE_SRC = (
-    "import jax, sys; d = jax.devices(); "
-    "sys.exit(0 if d and d[0].platform != 'cpu' else 3)"
-)
-
-
-def probe_tpu(timeout_s, attempts=2):
-    """True iff a non-CPU jax backend initializes in a child process."""
-    for _ in range(attempts):
-        try:
-            r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
-                               timeout=timeout_s, capture_output=True)
-            if r.returncode == 0:
-                return True
-            if r.returncode == 3:   # definitive: backend is CPU-only
-                return False
-        except subprocess.TimeoutExpired:
-            # The timeout is already generous; a hung tunnel won't heal
-            # by waiting the same period again.  Only fast transient
-            # errors earn a retry.
-            return False
-        except OSError:
-            pass
-    return False
+# NOTE: there is deliberately NO tunnel-probe helper here.  A
+# timeout-killed jax.devices() subprocess is the documented tunnel
+# wedge-maker, and the relay admits only the FIRST client after a
+# restart (round-4 field data) — any probe burns the session the real
+# workload needs.  Attempt the workload directly; the child relabels
+# itself cpu-fallback when the TPU isn't granted.
 
 
 def bench_resnet50_amp_o2(jax, jnp, on_tpu):
@@ -462,16 +446,23 @@ def main():
 
     force_cpu = (os.environ.get("APEX_TPU_BENCH_FORCE_CPU", "")
                  .lower() not in ("", "0", "false"))
-    probe_timeout = _env_float("APEX_TPU_BENCH_PROBE_TIMEOUT", 240.0)
-    try:
-        on_tpu = (not force_cpu) and probe_tpu(probe_timeout)
-    except Exception:  # never let the probe kill the bench
-        on_tpu = False
-    backend = "tpu" if on_tpu else ("cpu" if force_cpu else "cpu-fallback")
+    # NO pre-probe (round-4 field data, tools/artifacts/): the axon
+    # relay admits only the FIRST client after a relay restart, so a
+    # throwaway jax.devices() probe BURNS the session the bench child
+    # then needs, and a timeout-killed probe is the documented tunnel
+    # wedge-maker.  The child is the first and only client: it checks
+    # jax.default_backend() itself and relabels the run cpu-fallback
+    # (tiny proxy shapes) when the TPU isn't granted — a stalled init
+    # resolves inside the child (~25 min observed plugin give-up)
+    # without anything being killed.
+    on_tpu = not force_cpu
+    backend = "tpu" if on_tpu else "cpu"
 
-    # First TPU jit compiles slowly, so the TPU child gets a longer leash.
+    # Leash covers a worst-case init stall (~25 min) plus the bench
+    # itself; the child flushes the primary metric as soon as ResNet
+    # finishes, so even a later hang+kill salvages the north star.
     child_timeout = _env_float("APEX_TPU_BENCH_CHILD_TIMEOUT",
-                               1800.0 if on_tpu else 1200.0)
+                               2700.0 if on_tpu else 1200.0)
     out, err = _run_bench_child(backend, child_timeout)
     # A TPU child that errored fast (backend raised instead of hanging)
     # still prints a value-0 line — that's a failure for salvage

@@ -1,0 +1,238 @@
+"""ONE tunnel client does EVERYTHING — no probes, no subprocesses.
+
+Why (round-4 field observation, tools/artifacts/validation_run.log):
+the axon relay admits the FIRST client after a relay restart
+immediately (the 01:03:48 probe attached in 4s); every subsequent
+client hangs in backend init for ~25 minutes until the PJRT plugin
+gives up internally and jax falls back to CPU.  A probe-first runbook
+therefore BURNS the window's one session on printing jax.devices(),
+and timeout-killing a hung probe is the documented wedge-maker
+(PARITY.md round-2 tunnel caveat).  The fix is structural: the first
+client must be the only client, and it must do all the work.
+
+This process is that client.  It initializes the backend once, then
+runs every validation phase in-process, flushing artifacts and the
+runbook-compatible .phase_<name>.ok stamps as each phase passes:
+
+  smoke        pytest.main over tests/test_tpu_smoke.py (same process)
+  kernel_bench tools/kernel_bench.py --csv --write-prefs (imported)
+  sweep_attn   tools/kernel_bench.py --sweep-attn (imported)
+  bench        bench.run_child("tpu") (imported; writes bench_tpu.json)
+  trace        jax.profiler.trace around the north-star step
+
+If backend init resolves to CPU (tunnel absent or session already
+burned), it writes a labeled marker and exits 3 WITHOUT having spawned
+or killed anything — safe to retry after a quiet period.
+
+Run it via tools/tunnel_watch.sh (which fires on a fresh relay), or by
+hand:  python tools/one_session_validation.py
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import re
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(ROOT, "tools", "artifacts")
+PHASES = ("smoke", "kernel_bench", "sweep_attn", "bench", "trace")
+
+
+def ts() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def log(msg: str) -> None:
+    print(f"{ts()} {msg}", flush=True)
+
+
+def stamp(phase: str) -> None:
+    with open(os.path.join(ART, f".phase_{phase}.ok"), "w") as f:
+        f.write(ts() + "\n")
+
+
+def stamped(phase: str) -> bool:
+    return os.path.exists(os.path.join(ART, f".phase_{phase}.ok"))
+
+
+class Tee(io.TextIOBase):
+    """Write-through to a file AND the live stdout (progress stays
+    visible in the controller's log while the artifact accumulates)."""
+
+    def __init__(self, path, live):
+        self.f = open(path, "w")
+        self.live = live
+
+    def write(self, s):
+        self.f.write(s)
+        self.f.flush()
+        self.live.write(s)
+        self.live.flush()
+        return len(s)
+
+    def flush(self):
+        self.f.flush()
+        self.live.flush()
+
+    def close(self):
+        self.f.close()
+
+
+def main() -> int:
+    os.makedirs(ART, exist_ok=True)
+    os.chdir(ROOT)
+    sys.path.insert(0, ROOT)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+    remaining = [p for p in PHASES if not stamped(p)]
+    if not remaining:
+        log("all phases already stamped — nothing to do")
+        return 0
+    log(f"one-session validation: phases to run: {remaining}")
+
+    # Smoke mode BEFORE jax import: the conftest (and the smoke tests'
+    # skip guard) key off it, and it keeps the persistent compile cache
+    # configured for every phase.
+    os.environ["APEX_TPU_SMOKE"] = "1"
+
+    log("backend init (the one session; a burned session resolves to "
+        "cpu in ~25 min without any kill)")
+    t0 = time.time()
+    from apex_tpu.platform import enable_compilation_cache, \
+        select_platform
+    select_platform()          # honor APEX_TPU_PLATFORM (e.g. cpu)
+    import jax
+
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    log(f"backend: {backend} ({time.time() - t0:.1f}s)"
+        f" devices: {jax.devices() if backend == 'tpu' else '-'}")
+    if backend != "tpu":
+        with open(os.path.join(ART, "one_session_skip.json"), "w") as f:
+            json.dump({"ts": ts(), "backend": backend,
+                       "note": "no TPU session available"}, f)
+        return 3
+
+    ok = True
+
+    # ---- smoke -----------------------------------------------------
+    if not stamped("smoke"):
+        log("== smoke (in-process pytest) ==")
+        import pytest
+        tee = Tee(os.path.join(ART, "smoke_tpu.log"), sys.stdout)
+        with contextlib.redirect_stdout(tee):
+            rc = pytest.main(["tests/test_tpu_smoke.py", "-v", "-p",
+                              "no:cacheprovider"])
+        tee.close()
+        txt = open(os.path.join(ART, "smoke_tpu.log")).read()
+        m = re.search(r"(\d+) passed", txt)
+        npass = int(m.group(1)) if m else 0
+        log(f"smoke rc={rc} passed={npass}")
+        if rc == 0 and npass > 0:
+            stamp("smoke")
+        else:
+            ok = False
+
+    # ---- kernel bench + sweep (same module, imported) --------------
+    import kernel_bench as kb
+
+    def run_kb(argv, out_name, phase):
+        nonlocal ok
+        if stamped(phase):
+            return
+        log(f"== {phase} ==")
+        tee = Tee(os.path.join(ART, out_name), sys.stdout)
+        old_argv = sys.argv
+        sys.argv = ["kernel_bench.py"] + argv
+        try:
+            with contextlib.redirect_stdout(tee):
+                kb.main()
+        except Exception as e:  # a failed phase must not end the session
+            log(f"{phase} raised: {e!r}")
+            ok = False
+            return
+        finally:
+            sys.argv = old_argv
+            tee.close()
+            # kb.main force-pins every family to Pallas while timing;
+            # in-process that env var would outlive the phase and rig
+            # the bench/trace metrics below — scrub it
+            os.environ.pop("APEX_TPU_PREFER_PALLAS", None)
+        txt = open(os.path.join(ART, out_name)).read()
+        if '"backend": "tpu"' in txt:
+            stamp(phase)
+        else:
+            log(f"{phase}: no TPU rows")
+            ok = False
+
+    run_kb(["--csv", os.path.join(ART, "bench_kernels.csv"),
+            "--write-prefs"], "bench_kernels.jsonl", "kernel_bench")
+    run_kb(["--sweep-attn", "--csv", os.path.join(ART, "sweep_attn.csv")],
+           "sweep_attn.jsonl", "sweep_attn")
+
+    # the dispatch tables are cached at import; reload so the bench and
+    # trace below run under the prefs/attn-caps the measurements above
+    # JUST wrote — the tracked metrics must reflect the dispatch
+    # configuration users will actually get
+    from apex_tpu.ops import _dispatch
+    _dispatch._PREFS, _dispatch._ATTN_CAPS = _dispatch._load_prefs()
+    log(f"dispatch reloaded: prefer_pallas={_dispatch._PREFS} "
+        f"attn_caps={_dispatch._ATTN_CAPS}")
+
+    # ---- tracked metrics (bench.py's child body, in-process) -------
+    if not stamped("bench"):
+        log("== bench ==")
+        import bench as bench_mod
+        tee = Tee(os.path.join(ART, "bench_raw.jsonl"), sys.stdout)
+        try:
+            with contextlib.redirect_stdout(tee):
+                bench_mod.run_child("tpu")
+        except Exception as e:
+            log(f"bench raised: {e!r}")
+            ok = False
+        finally:
+            tee.close()
+        out = bench_mod._last_json_line(
+            open(os.path.join(ART, "bench_raw.jsonl")).read())
+        if out is not None:
+            with open(os.path.join(ART, "bench_tpu.json"), "w") as f:
+                json.dump(out, f)
+                f.write("\n")
+        if (out is not None and out.get("backend") == "tpu"
+                and float(out.get("value", 0)) > 0
+                and not out.get("errors")):
+            stamp("bench")
+        else:
+            log(f"bench: not a clean TPU result: "
+                f"{None if out is None else out.get('errors')}")
+            ok = False
+
+    # ---- profiler trace of the north-star step ---------------------
+    if not stamped("trace"):
+        log("== trace ==")
+        from profile_step import capture_trace
+        try:
+            summary = capture_trace(os.path.join(ART, "trace"), jax,
+                                    on_tpu=True)
+            with open(os.path.join(ART, "trace_summary.txt"), "w") as f:
+                json.dump(summary, f)
+                f.write("\n")
+            log(f"trace: {summary}")
+            stamp("trace")
+        except Exception as e:
+            log(f"trace raised: {e!r}")
+            ok = False
+
+    log("== summary ==")
+    for p in PHASES:
+        log(f"  {p}: {'PASS' if stamped(p) else 'INCOMPLETE'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

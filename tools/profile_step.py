@@ -8,7 +8,9 @@ Writes a TensorBoard/XProf trace directory and prints one JSON line
 with the measured step time (and MFU when the chip is recognized).
 Run it on the TPU (falls back to a labeled CPU trace off-TPU with
 tiny shapes — still useful for host-side pipeline inspection).
-ONE tunnel client at a time: do not run concurrently with bench.py.
+ONE tunnel client at a time: do not run concurrently with bench.py;
+inside a validation window use tools/one_session_validation.py, which
+calls capture_trace() from the already-attached session.
 """
 
 from __future__ import annotations
@@ -16,7 +18,32 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
+    """Trace ONE run of bench.py's exact north-star step (so the trace
+    matches the reported number) and return the summary dict.  Shared
+    by the standalone CLI below and the one-session validator."""
+    import jax.numpy as jnp
+
+    import bench
+
+    t0 = time.perf_counter()
+    with jax.profiler.trace(outdir):
+        r = bench.bench_resnet50_amp_o2(jax, jnp, on_tpu)
+    out = {"trace_dir": outdir,
+           "backend": "tpu" if on_tpu else jax.default_backend(),
+           "wall_s": round(time.perf_counter() - t0, 1),
+           "resnet50_step_ms": round(r["step_ms"], 2),
+           "imgs_per_sec": round(r["imgs_per_sec"], 1)}
+    if r.get("mfu") is not None:
+        out["mfu"] = r["mfu"]
+    return out
 
 
 def main():
@@ -24,41 +51,20 @@ def main():
     ap.add_argument("--outdir", default="/tmp/apex_tpu_trace")
     args = ap.parse_args()
 
-    # reuse bench.py's bounded tunnel probe BEFORE any in-process
-    # backend init: a dead tunnel hangs jax.default_backend() forever
-    # and the stuck client can't be safely killed (tunnel etiquette)
-    import sys
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
-
     from apex_tpu.platform import enable_compilation_cache, \
         select_platform
-    forced = select_platform()
-    if forced is None and not bench.probe_tpu(180.0):
-        print("# tunnel probe failed; falling back to cpu",
-              file=sys.stderr)
-        select_platform("cpu")
+    # No pre-probe (round-4 field data): the relay admits only the
+    # FIRST client after a restart, so a probe burns the session this
+    # trace needs.  Init directly; a stalled init self-resolves to CPU
+    # inside the plugin (~25 min worst case) without any kill, and the
+    # CPU trace below is labeled as such.
+    select_platform()
 
     import jax
     enable_compilation_cache()
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
+    on_tpu = jax.default_backend() == "tpu"
 
-    # bench.py's exact north-star step so the trace matches the
-    # reported number
-    import jax.numpy as jnp
-
-    t0 = time.perf_counter()
-    with jax.profiler.trace(args.outdir):
-        r = bench.bench_resnet50_amp_o2(jax, jnp, on_tpu)
-    wall = time.perf_counter() - t0
-    out = {"trace_dir": args.outdir, "backend": backend,
-           "wall_s": round(wall, 1),
-           "resnet50_step_ms": round(r["step_ms"], 2),
-           "imgs_per_sec": round(r["imgs_per_sec"], 1)}
-    if r.get("mfu") is not None:
-        out["mfu"] = r["mfu"]
+    out = capture_trace(args.outdir, jax, on_tpu)
     print(json.dumps(out))
     print(f"# view: tensorboard --logdir {args.outdir}  (Profile tab)",
           file=sys.stderr, flush=True)

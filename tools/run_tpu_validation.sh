@@ -1,27 +1,19 @@
 #!/bin/bash
-# Serial TPU validation: everything the round needs from ONE tunnel
-# window, strictly sequentially (the axon tunnel admits ONE client at
-# a time; nothing here kills a TPU-attached process — a killed client
-# wedges the tunnel for a long time, see tests/test_tpu_smoke.py).
+# TPU validation entry point — thin wrapper over the ONE-SESSION
+# validator (tools/one_session_validation.py).
 #
-# Phases (each its own client, 60s etiquette gap between):
-#   1. bounded probe            — abort early if the tunnel is down
-#   2. TPU smoke suite          — every Pallas kernel non-interpreted
-#                                 vs its oracle (target: 37/37)
-#   3. kernel bench             — per-kernel vs XLA oracle timings ->
-#                                 bench_kernels.csv + dispatch prefs
-#   4. attention geometry sweep — kernel_bench --sweep-attn -> best
-#                                 APEX_TPU_ATTN_BLOCK_CAP per shape
-#   5. bench.py                 — tracked metrics (ResNet-50 imgs/sec,
-#                                 BERT-L step, MFU) -> bench JSON
-#   6. profiler trace           — profile_step.py on the north-star
-#                                 step -> trace dir + summary
+# HISTORY: this script used to run probe -> smoke -> kernel bench ->
+# sweep -> bench -> trace as SEPARATE tunnel clients with etiquette
+# gaps.  Round-4 field data (tools/artifacts/validation_run.log,
+# 2026-07-31) showed the axon relay admits only the FIRST client after
+# a relay restart: the probe attached in 4s, then the smoke suite hung
+# in backend init for 25 minutes and every later phase fell back to
+# CPU.  Probe-first DESIGN BURNS THE WINDOW.  All phases now run
+# inside one python process — one client, one session, every artifact.
 #
-# CHECKPOINTED: each phase that passes writes $ART/.phase_<name>.ok.
-# Re-running the script skips phases whose stamp exists, so a tunnel
-# that drops mid-run resumes where it left off instead of repeating
-# TPU work (windows are the scarcest resource in the project).
-# Delete the stamps (or the artifacts dir) to force a full re-run.
+# Phase stamps ($ART/.phase_<name>.ok) are unchanged: re-running skips
+# phases that already passed on hardware, so a second window resumes
+# where the first ended.
 set -u
 cd "$(dirname "$0")/.."
 ART=tools/artifacts
@@ -29,138 +21,24 @@ mkdir -p "$ART"
 
 ts() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
 
-phase_done() { [ -f "$ART/.phase_$1.ok" ]; }
-mark_done()  { ts > "$ART/.phase_$1.ok"; }
-
-# Etiquette gap between tunnel clients — only needed after a phase that
-# actually attached a client, not after a skipped phase.
-GAP=60
-need_gap=0
-gap() { if [ "$need_gap" = 1 ]; then sleep "$GAP"; fi; need_gap=1; }
-
-all_done=1
-for p in smoke kernel_bench sweep_attn bench trace; do
-    phase_done "$p" || all_done=0
-done
-if [ "$all_done" = 1 ]; then
-    echo "$(ts) all phases already stamped in $ART — nothing to do"
-    exit 0
-fi
-
-echo "$(ts) == probe =="
-# bounded probe first: a wedged tunnel blocks jax.devices() forever, and
-# letting pytest hit that just produces an unkillable client
-if ! timeout 180 python -c "import jax; print(jax.devices())"; then
-    echo "$(ts) probe: tunnel not available (timeout/err); aborting validation"
-    exit 2
-fi
-need_gap=1
-
-if phase_done smoke; then
-    echo "$(ts) == TPU smoke suite == (stamped, skipping)"
-else
-    gap
-    echo "$(ts) == TPU smoke suite =="
-    # NO timeout here: killing a TPU-attached pytest wedges the tunnel
-    # (see header); the bounded probe above already guards the hang case
-    # that matters (backend init), and bench.py has internal watchdogs
-    APEX_TPU_SMOKE=1 python -m pytest tests/test_tpu_smoke.py -v \
-        > "$ART/smoke_tpu.log" 2>&1
-    smoke_rc=$?
-    tail -5 "$ART/smoke_tpu.log"
-    # pytest exits 0 on all-skipped (backend never initialized): that is
-    # a FAILED validation, not a pass
-    if ! grep -qE "[0-9]+ passed" "$ART/smoke_tpu.log"; then
-        echo "$(ts) smoke: no tests actually ran (all skipped or collection failed)"
-        smoke_rc=1
-    fi
-    echo "$(ts) smoke rc=$smoke_rc"
-    [ "$smoke_rc" = 0 ] && mark_done smoke
-fi
-
-if phase_done kernel_bench; then
-    echo "$(ts) == kernel bench == (stamped, skipping)"
-else
-    gap
-    echo "$(ts) == kernel bench (csv + dispatch prefs) =="
-    # also uncapped: it is a TPU-attached client
-    python tools/kernel_bench.py --csv "$ART/bench_kernels.csv" \
-        --write-prefs > "$ART/bench_kernels.jsonl" 2>"$ART/bench_kernels.err"
-    kb_rc=$?
-    tail -3 "$ART/bench_kernels.jsonl"
-    # kernel_bench exits 0 when it skips off-TPU (tunnel dropped between
-    # phases): no TPU-labeled rows means the phase did NOT validate
-    if ! grep -q '"backend": "tpu"' "$ART/bench_kernels.jsonl"; then
-        echo "$(ts) kernel_bench: no TPU rows (backend fell back?); phase failed"
-        kb_rc=1
-    fi
-    echo "$(ts) kernel_bench rc=$kb_rc"
-    [ "$kb_rc" = 0 ] && mark_done kernel_bench
-fi
-
-if phase_done sweep_attn; then
-    echo "$(ts) == attention geometry sweep == (stamped, skipping)"
-else
-    gap
-    echo "$(ts) == attention geometry sweep =="
-    python tools/kernel_bench.py --sweep-attn --csv "$ART/sweep_attn.csv" \
-        > "$ART/sweep_attn.jsonl" 2>"$ART/sweep_attn.err"
-    sw_rc=$?
-    tail -3 "$ART/sweep_attn.jsonl"
-    if ! grep -q '"backend": "tpu"' "$ART/sweep_attn.jsonl"; then
-        echo "$(ts) sweep: no TPU rows; phase failed"
-        sw_rc=1
-    fi
-    echo "$(ts) sweep rc=$sw_rc"
-    [ "$sw_rc" = 0 ] && mark_done sweep_attn
-fi
-
-if phase_done bench; then
-    echo "$(ts) == bench == (stamped, skipping)"
-else
-    gap
-    echo "$(ts) == bench =="
-    python bench.py > "$ART/bench_tpu.json" 2>"$ART/bench_tpu.err"
-    cat "$ART/bench_tpu.json"
-    # bench.py always exits 0 by design; judge the JSON instead
-    bench_rc=$(ART="$ART" python - <<'EOF'
-import json, os
-try:
-    out = json.load(open(os.path.join(os.environ["ART"],
-                                      "bench_tpu.json")))
-    ok = (out.get("backend") == "tpu" and float(out.get("value", 0)) > 0
-          and not out.get("errors"))
-    print(0 if ok else 1)
-except Exception:
-    print(1)
-EOF
-)
-    echo "$(ts) bench rc=$bench_rc"
-    [ "$bench_rc" = 0 ] && mark_done bench
-fi
-
-if phase_done trace; then
-    echo "$(ts) == profiler trace == (stamped, skipping)"
-else
-    gap
-    echo "$(ts) == profiler trace =="
-    python tools/profile_step.py --outdir "$ART/trace" \
-        > "$ART/trace_summary.txt" 2>"$ART/trace.err"
-    tr_rc=$?
-    tail -5 "$ART/trace_summary.txt"
-    echo "$(ts) trace rc=$tr_rc"
-    [ "$tr_rc" = 0 ] && mark_done trace
-fi
+echo "$(ts) == one-session validation =="
+# No timeout: killing a TPU-attached client wedges the tunnel (round-2
+# caveat, PARITY.md).  A burned/absent session resolves itself: the
+# PJRT plugin gives up internally (~25 min observed) and the validator
+# exits 3 without touching hardware.
+python tools/one_session_validation.py
+rc=$?
+echo "$(ts) validator rc=$rc"
 
 echo "$(ts) == summary =="
+all_ok=0
 for p in smoke kernel_bench sweep_attn bench trace; do
-    if phase_done "$p"; then echo "  $p: PASS ($(cat "$ART/.phase_$p.ok"))";
-    else echo "  $p: INCOMPLETE"; fi
+    if [ -f "$ART/.phase_$p.ok" ]; then
+        echo "  $p: PASS ($(cat "$ART/.phase_$p.ok"))"
+    else
+        echo "  $p: INCOMPLETE"
+        all_ok=1
+    fi
 done
 echo "artifacts in $ART/: smoke_tpu.log bench_kernels.{csv,jsonl} sweep_attn.{csv,jsonl} bench_tpu.json trace/"
-echo "next: review dispatch_prefs.json + commit artifacts"
-
-for p in smoke kernel_bench sweep_attn bench trace; do
-    phase_done "$p" || exit 1
-done
-exit 0
+exit $all_ok

@@ -1,21 +1,23 @@
 #!/bin/bash
-# Passive TPU-tunnel watcher (VERDICT r3 item 1).
+# Passive TPU-tunnel watcher (VERDICT r3 item 1), v2.
 #
-# The axon relay is a local listener; when the tunnel is DOWN nothing
-# listens except the agent's own ports (127.0.0.1:48271 stdio,
-# 0.0.0.0:2024). Spawning jax probe clients while the infra is down is
-# actively harmful (each killed probe is an abandoned claim that can
-# wedge the tunnel — see memory: tpu-tunnel-etiquette). So:
+# Round-4 field data: the axon relay admits only the FIRST client
+# after a relay (re)start — later clients hang ~25 min in backend init
+# and fall back to CPU.  So the watcher's job is to catch a FRESH
+# relay and immediately hand the one admitted session to the
+# one-session validator (via run_tpu_validation.sh).  Details:
 #
-#   1. Poll `ss -tln` every POLL seconds. ZERO tunnel clients created.
-#   2. When a listener outside the baseline set appears, require it to
-#      persist across SETTLE consecutive polls (fresh infra settling,
-#      and filters one-shot ephemeral listeners).
-#   3. Fire tools/run_tpu_validation.sh exactly once per window. The
-#      runbook is checkpointed: if the tunnel drops mid-run, the next
-#      window resumes from the first unstamped phase.
-#   4. After an attempt (success or failure) cool down COOLDOWN seconds
-#      before re-arming, and only re-fire if unstamped phases remain.
+#   1. Poll `ss -tln` every POLL seconds.  ZERO tunnel clients are
+#      created by the watcher itself.
+#   2. Fingerprint the relay process (pid + kernel start time of the
+#      owner of the first listener port).  When the fingerprint
+#      CHANGES (relay restarted -> fresh session) and the listeners
+#      persist SETTLE consecutive polls, fire the validator at once.
+#   3. If the fingerprint is UNCHANGED (this relay's session may
+#      already be burned), fire at most once every RETRY_QUIET seconds
+#      — the validator is probe-free and resolves to a clean exit 3
+#      without killing anything if no session is granted.
+#   4. Retire when every phase stamp exists.
 #
 # Log: tools/artifacts/tunnel_watch.log (timestamped, committed).
 set -u
@@ -24,12 +26,10 @@ ART=tools/artifacts
 mkdir -p "$ART"
 LOG="$ART/tunnel_watch.log"
 
-POLL=20          # seconds between passive ss polls
-SETTLE=6         # consecutive polls the listener must persist (~2 min quiet)
-COOLDOWN=900     # 15 min after any validation attempt (etiquette recovery)
+POLL=20           # seconds between passive ss polls
+SETTLE=6          # consecutive polls listeners must persist (~2 min)
+RETRY_QUIET=7200  # same-relay retry period (one patient attempt/2h)
 
-# Agent-owned ports, never the relay. Anything else that LISTENs is a
-# candidate; the validation runbook's bounded probe is the arbiter.
 BASELINE_RE=':(48271|2024)$'
 
 ts() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
@@ -39,6 +39,20 @@ listeners() {
     ss -tln 2>/dev/null | awk 'NR>1 {print $4}' | grep -vE "$BASELINE_RE" | sort -u
 }
 
+relay_fp() {
+    # pid + starttime of the owner of the first non-baseline listener
+    local port pid
+    port="$(listeners | head -1 | sed 's/.*://')"
+    [ -n "$port" ] || { echo "none"; return; }
+    pid="$(ss -tlnp 2>/dev/null | grep ":$port " | grep -oE 'pid=[0-9]+' \
+           | head -1 | cut -d= -f2)"
+    if [ -n "$pid" ] && [ -r "/proc/$pid/stat" ]; then
+        echo "$pid:$(awk '{print $22}' "/proc/$pid/stat")"
+    else
+        echo "port:$port"
+    fi
+}
+
 phases_remaining() {
     for p in smoke kernel_bench sweep_attn bench trace; do
         [ -f "$ART/.phase_$p.ok" ] || return 0
@@ -46,8 +60,19 @@ phases_remaining() {
     return 1
 }
 
-log "watcher armed (pid $$): poll=${POLL}s settle=${SETTLE} cooldown=${COOLDOWN}s baseline=$BASELINE_RE"
+fire() {
+    log "firing run_tpu_validation.sh (reason: $1, relay=$2)"
+    bash tools/run_tpu_validation.sh >> "$ART/validation_run.log" 2>&1
+    log "validation attempt finished rc=$? (see validation_run.log)"
+}
 
+log "watcher v2 armed (pid $$): poll=${POLL}s settle=${SETTLE}" \
+    "retry_quiet=${RETRY_QUIET}s baseline=$BASELINE_RE"
+
+last_fired_fp=""
+last_fired_at=0
+prev_fp=""
+was_down=0
 seen=0
 while :; do
     if ! phases_remaining; then
@@ -56,28 +81,38 @@ while :; do
     fi
     cur="$(listeners)"
     if [ -n "$cur" ]; then
-        seen=$((seen + 1))
-        if [ "$seen" = 1 ]; then
-            log "candidate listener(s) appeared: $(echo "$cur" | tr '\n' ' ')"
-        fi
-        if [ "$seen" -ge "$SETTLE" ]; then
-            log "listener persisted ${seen} polls — firing run_tpu_validation.sh"
-            bash tools/run_tpu_validation.sh >> "$ART/validation_run.log" 2>&1
-            rc=$?
-            log "validation attempt finished rc=$rc (see validation_run.log)"
+        fp="$(relay_fp)"
+        if [ "$fp" != "$prev_fp" ] && [ -n "$prev_fp" ]; then
+            # relay swapped between polls: restart the settle window —
+            # the new relay must prove itself stable before it gets
+            # the one admitted session
+            log "relay fingerprint changed ($prev_fp -> $fp) — settling"
             seen=0
-            if ! phases_remaining; then
-                log "all phases stamped after attempt — watcher retiring"
-                exit 0
+        fi
+        prev_fp="$fp"
+        seen=$((seen + 1))
+        if [ "$seen" -ge "$SETTLE" ]; then
+            now=$(date +%s)
+            # was_down covers the pid-invisible fallback fingerprint
+            # (port:NNN is stable across restarts): a listener outage
+            # since the last firing also marks the relay as fresh
+            if [ "$fp" != "$last_fired_fp" ] || [ "$was_down" = 1 ]; then
+                last_fired_fp="$fp"; last_fired_at=$now; was_down=0
+                fire "fresh relay" "$fp"
+                seen=0
+            elif [ $((now - last_fired_at)) -ge "$RETRY_QUIET" ]; then
+                last_fired_at=$now
+                fire "quiet-period retry" "$fp"
+                seen=0
             fi
-            log "cooling down ${COOLDOWN}s before re-arming"
-            sleep "$COOLDOWN"
         fi
     else
         if [ "$seen" -gt 0 ]; then
-            log "candidate listener vanished after ${seen} poll(s) — re-arming"
+            log "listeners vanished after ${seen} poll(s) — re-arming"
         fi
         seen=0
+        prev_fp=""
+        was_down=1
     fi
     sleep "$POLL"
 done
