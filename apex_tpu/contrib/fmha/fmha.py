@@ -7,12 +7,12 @@ cu_seqlens (B+1,) prefix offsets; attention runs independently inside
 each sequence.  TPU-native: keep the packed layout end-to-end and mask
 cross-sequence pairs with segment ids derived from cu_seqlens —
 everything stays static-shape (dynamic per-example seqlens live in the
-mask values, never in shapes, as XLA requires).  The no-dropout path
-routes through the one Pallas flash kernel
-(apex_tpu.ops.attention.flash_attention) with segment-id masking; only
-attention dropout (which the reference fuses into its kernel) falls
-back to the dense jnp path, whose O(total^2) tile is in line with the
-reference's own <=512-seqlen envelope.
+mask values, never in shapes, as XLA requires).  ALL paths — including
+attention dropout, which the reference fuses into its kernel — route
+through the one Pallas flash kernel
+(apex_tpu.ops.attention.flash_attention): segment ids mask
+cross-sequence pairs and the kernel's counter-based hash-mask dropout
+(round 4) handles p_dropout without materializing probabilities.
 """
 
 from __future__ import annotations
@@ -20,7 +20,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import (dropout_seed_from_key,
+                                    flash_attention)
 
 _NEG = -10000.0
 
@@ -43,34 +44,22 @@ def fmha_packed(qkv, cu_seqlens, p_dropout=0.0, *, is_training=True,
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # (total, H, D)
     seg = _segment_ids(cu_seqlens, total)
     valid = jnp.arange(total) < cu_seqlens[-1]
-    if p_dropout == 0.0 or not is_training:
-        # flash kernel path: packed batch = one (1, H, total, D) call
-        # with per-token segment ids; invalid tail tokens get disjoint
-        # ids on the q vs kv side so their rows are fully masked (the
-        # kernel outputs zero for empty rows).
-        q_ids = jnp.where(valid, seg, -1)[None]        # (1, total)
-        kv_ids = jnp.where(valid, seg, -2)[None]
-        qh = jnp.transpose(q, (1, 0, 2))[None]         # (1, H, total, D)
-        kh = jnp.transpose(k, (1, 0, 2))[None]
-        vh = jnp.transpose(v, (1, 0, 2))[None]
-        out = flash_attention(qh, kh, vh, causal=causal,
-                              segment_ids=(q_ids, kv_ids))
-        return jnp.transpose(out[0], (1, 0, 2)).astype(qkv.dtype)
-    scale = 1.0 / (d ** 0.5)
-    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    same = seg[:, None] == seg[None, :]
-    ok = same & valid[:, None] & valid[None, :]
-    if causal:
-        ok = ok & (jnp.arange(total)[None, :] <= jnp.arange(total)[:, None])
-    s = jnp.where(ok[None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    p = jnp.where(ok[None], p, 0.0)                    # fully-masked rows -> 0
-    if p_dropout > 0.0 and is_training:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - p_dropout, p.shape)
-        p = jnp.where(keep, p / (1.0 - p_dropout), 0.0)
-    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
-    return (out * valid[:, None, None]).astype(qkv.dtype)
+    # flash kernel path for every configuration: packed batch = one
+    # (1, H, total, D) call with per-token segment ids; invalid tail
+    # tokens get disjoint ids on the q vs kv side so their rows are
+    # fully masked (the kernel outputs zero for empty rows).  Dropout
+    # (training only) fuses into the kernel as the hash mask.
+    rate = float(p_dropout) if is_training else 0.0
+    seed = dropout_seed_from_key(dropout_rng) if rate > 0.0 else None
+    q_ids = jnp.where(valid, seg, -1)[None]            # (1, total)
+    kv_ids = jnp.where(valid, seg, -2)[None]
+    qh = jnp.transpose(q, (1, 0, 2))[None]             # (1, H, total, D)
+    kh = jnp.transpose(k, (1, 0, 2))[None]
+    vh = jnp.transpose(v, (1, 0, 2))[None]
+    out = flash_attention(qh, kh, vh, causal=causal,
+                          segment_ids=(q_ids, kv_ids),
+                          dropout_rate=rate, dropout_seed=seed)
+    return jnp.transpose(out[0], (1, 0, 2)).astype(qkv.dtype)
 
 
 class FMHAFun:

@@ -21,7 +21,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import attention_ref, flash_attention
+from apex_tpu.ops.attention import (attention_ref, dropout_keep_ref,
+                                    dropout_seed_from_key,
+                                    flash_attention)
 
 _NEG = -10000.0
 
@@ -51,9 +53,14 @@ def attention_core(q, k, v, *, causal: bool,
     Returns (out (B,H,Tq,Dh), probs or None).
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    if key_padding_mask is None and dropout_rate == 0.0 \
-            and not need_weights:
-        return flash_attention(q, k, v, causal=causal, scale=scale), None
+    seed = (dropout_seed_from_key(dropout_rng) if dropout_rate > 0.0 else None)
+    if key_padding_mask is None and not need_weights:
+        # fused path — dropout INCLUDED (round-4: the kernel fuses the
+        # hash-mask dropout, matching the reference's fused kernels;
+        # previously any dropout forced the dense path)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=seed), None
 
     mask = None
     if key_padding_mask is not None:
@@ -62,11 +69,14 @@ def attention_core(q, k, v, *, causal: bool,
         else:
             mask = jnp.where(key_padding_mask[:, None, None, :] != 0,
                              _NEG, 0.0)
-    if dropout_rate == 0.0 and not need_weights:
+    if not need_weights:
         return attention_ref(q, k, v, causal=causal, scale=scale,
-                             mask=mask), None
+                             mask=mask, dropout_rate=dropout_rate,
+                             dropout_seed=seed), None
 
-    # probs are needed (dropout and/or need_weights): inline softmax path
+    # probs are needed (need_weights): inline softmax path; dropout
+    # uses the SAME hash mask as the fused kernel so switching
+    # need_weights on/off never changes which elements drop
     from apex_tpu.ops.attention import matmul_precision
     prec = matmul_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -81,8 +91,8 @@ def attention_core(q, k, v, *, causal: bool,
     p = jax.nn.softmax(s, axis=-1)
     p_drop = p
     if dropout_rate > 0.0:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
-                                    p.shape)
+        bb, hh, sq, sk = p.shape
+        keep = dropout_keep_ref(seed, bb, hh, sq, sk, dropout_rate)
         p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", p_drop, v.astype(jnp.float32),
                      precision=prec).astype(q.dtype)

@@ -218,16 +218,20 @@ def _mask_for_block(j, kk, bq, bk, sq, sk, sqp, skp, causal,
 # forward kernel: grid (B*H, NQ, NK), KV innermost, flash-2 online softmax
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(scale, causal, seg, need_lse, sq, sk, sqp, skp, bq, bk,
-                nk, *refs):
+def _fwd_kernel(scale, causal, seg, need_lse, rate, sq, sk, sqp, skp,
+                bq, bk, nk, *refs):
     q_ref, k_ref, v_ref = refs[:3]
-    qs_ref, ks_ref = (refs[3:5] if seg else (None, None))
-    rest = refs[5:] if seg else refs[3:]
+    refs = refs[3:]
+    if rate > 0.0:
+        seed_ref, refs = refs[0], refs[1:]
+    qs_ref, ks_ref = (refs[:2] if seg else (None, None))
+    rest = refs[2:] if seg else refs
     if need_lse:
         o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
         o_ref, m_scr, l_scr, acc_scr = rest
         lse_ref = None
+    i = pl.program_id(0)
     j = pl.program_id(1)
     kk = pl.program_id(2)
 
@@ -261,6 +265,13 @@ def _fwd_kernel(scale, causal, seg, need_lse, sq, sk, sqp, skp, bq, bk,
             p = jnp.where(ok, p, 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+        if rate > 0.0:
+            # dropout on softmax PROBS: the denominator l uses the
+            # undropped p (softmax normalizes first); only the V
+            # accumulation sees the mask, scaled by 1/keep
+            keep = _dropout_keep_block(seed_ref[0], i, j, kk, bq, bk,
+                                       rate)
+            p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         pv = _dot(p.astype(v_ref.dtype), v_ref[0], ((1,), (0,)))
         acc_scr[...] = acc_scr[...] * alpha + pv
 
@@ -281,7 +292,62 @@ def _kv_row(i, h, hk):
     return (i // h) * hk + (i % h) // (h // hk)
 
 
-def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True):
+# ---------------------------------------------------------------------------
+# fused attention dropout: counter-based hash mask
+# ---------------------------------------------------------------------------
+#
+# The reference fuses probability dropout into its attention kernels
+# (apex/contrib/csrc/multihead_attn, fmha).  The TPU-native analog is a
+# COUNTER-BASED mask: murmur3's fmix32 avalanche on the global
+# (batch*head, row, col) coordinates, pure int32 vector ops.  The same
+# jnp code runs inside the Pallas kernels (interpret AND Mosaic), in
+# the XLA fallback path, and in the test oracle, so every path drops
+# the exact same elements — and the three backward/forward kernels
+# reconstruct the mask from coordinates instead of storing an
+# (Sq, Sk) mask tensor anywhere.
+
+def _fmix32(h):
+    """murmur3 finalizer on int32 (wraparound semantics everywhere)."""
+    h = jnp.asarray(h, jnp.int32)
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    h = h * jnp.int32(-2048144789)          # 0x85EBCA6B
+    h = h ^ jax.lax.shift_right_logical(h, 13)
+    h = h * jnp.int32(-1028477387)          # 0xC2B2AE35
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    return h
+
+
+def _keep_mask(seed, i_flat, rows, cols, rate):
+    """Boolean keep-mask for attention-prob dropout.
+
+    seed: traced int32 scalar; i_flat: flat batch*head row (scalar);
+    rows/cols: int32 arrays of GLOBAL q/k positions (any shape);
+    rate: static python float in [0, 1).  keep prob = 1 - rate,
+    decided by an unsigned compare of the hashed coordinates."""
+    h0 = _fmix32(jnp.asarray(seed, jnp.int32)
+                 + jnp.asarray(i_flat, jnp.int32) * jnp.int32(-1640531527))
+    h = _fmix32(h0
+                + rows.astype(jnp.int32) * jnp.int32(-1654467297)
+                + cols.astype(jnp.int32) * jnp.int32(2024237689))
+    # unsigned compare in int32: flip the sign bit of both sides
+    thresh = min(int((1.0 - rate) * 4294967296.0), 4294967295)
+    tu = thresh ^ 0x80000000
+    t = jnp.int32(tu - (1 << 32) if tu >= (1 << 31) else tu)
+    return (h ^ jnp.int32(-2147483648)) < t
+
+
+def _dropout_keep_block(seed, i_flat, j, kk, bq, bk, rate):
+    """Keep-mask for one (BQ, BK) score block at q-block ``j`` /
+    kv-block ``kk`` of flat row ``i_flat`` — the same global
+    coordinates in every kernel (fwd, dq, dkv), so all three
+    reconstruct the identical mask from position alone."""
+    row_g = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col_g = kk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return _keep_mask(seed, i_flat, row_g, col_g, rate)
+
+
+def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True,
+                rate=0.0, seed=None):
     b, h, sq, sk, d, dp, bq, bk, sqp, skp = _geom(q, k)
     nq, nk = sqp // bq, skp // bk
     hk = k.shape[1]
@@ -304,6 +370,9 @@ def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True):
         pl.BlockSpec((1, bk, dp), _kv_idx),
     ]
     args = [q3, k3, v3]
+    if rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(seed, jnp.int32).reshape(1))
     seg = segment_ids is not None
     if seg:
         qs, ks = _seg_inputs(segment_ids, b, sqp, skp)
@@ -324,7 +393,7 @@ def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True):
             jax.ShapeDtypeStruct((b * h, sqp, _LANES), jnp.float32))
     outs = pl.pallas_call(
         functools.partial(_fwd_kernel, scale, causal, seg, need_lse,
-                          sq, sk, sqp, skp, bq, bk, nk),
+                          rate, sq, sk, sqp, skp, bq, bk, nk),
         grid=(b * h, nq, nk),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -360,14 +429,17 @@ def _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk, j, kk,
     return p
 
 
-def _dq_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
+def _dq_kernel(scale, causal, seg, rate, sq, sk, sqp, skp, bq, bk, nk,
                *refs):
+    if rate > 0.0:
+        seed_ref, refs = refs[0], refs[1:]
     if seg:
         q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qs_ref, ks_ref, \
             dq_ref, dq_scr = refs
     else:
         q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, dq_scr = refs
         qs_ref = ks_ref = None
+    i = pl.program_id(0)
     j = pl.program_id(1)
     kk = pl.program_id(2)
 
@@ -383,6 +455,12 @@ def _dq_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
         p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
                          j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
         dp = _dot(do_ref[0], v_ref[0], ((1,), (1,)))
+        if rate > 0.0:
+            # dP = mask . (dO V^T)/keep; the rowsum correction stays di
+            # (see _flash docstring: rowsum(dP.P) == rowsum(dO.O))
+            keep = _dropout_keep_block(seed_ref[0], i, j, kk, bq, bk,
+                                       rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - di_ref[0, :, :1]) * scale
         dq_scr[...] += _dot(ds.astype(k_ref.dtype), k_ref[0],
                             ((1,), (0,)))
@@ -392,13 +470,15 @@ def _dq_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nk,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq, g,
-                *refs):
+def _dkv_kernel(scale, causal, seg, rate, h, hk, sq, sk, sqp, skp, bq,
+                bk, nq, g, *refs):
     """dk/dv accumulation.  The sequential axis ``t`` covers the whole
     q-head GROUP sharing this kv head times the q blocks (t = qh*NQ+j,
     grouped-query attention): every q head's contribution lands in the
     same scratch accumulator, race-free because the axis is
     'arbitrary' (sequential).  g == 1 recovers plain MHA exactly."""
+    if rate > 0.0:
+        seed_ref, refs = refs[0], refs[1:]
     if seg:
         q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qs_ref, ks_ref, \
             dk_ref, dv_ref, dk_scr, dv_scr = refs
@@ -406,6 +486,7 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq, g,
         q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, \
             dk_ref, dv_ref, dk_scr, dv_scr = refs
         qs_ref = ks_ref = None
+    i = pl.program_id(0)
     kk = pl.program_id(1)
     t = pl.program_id(2)
     j = t % nq if g > 1 else t
@@ -424,10 +505,22 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq, g,
     def _body():
         p = _recompute_p(scale, causal, seg, sq, sk, sqp, skp, bq, bk,
                          j, kk, q_ref, k_ref, qs_ref, ks_ref, lse_ref)
-        # dv += p^T @ do   (contract the q dim)
-        dv_scr[...] += _dot(p.astype(do_ref.dtype), do_ref[0],
+        if rate > 0.0:
+            # the mask was drawn per FLAT Q row in fwd/dq; this grid
+            # runs over kv heads, so recover that row from (i, t)
+            i_flatq = (i // hk) * h + (i % hk) * g + t // nq
+            keep = _dropout_keep_block(seed_ref[0], i_flatq, j, kk,
+                                       bq, bk, rate)
+            inv = 1.0 / (1.0 - rate)
+            p_d = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_d = p
+        # dv += (dropped p)^T @ do   (contract the q dim)
+        dv_scr[...] += _dot(p_d.astype(do_ref.dtype), do_ref[0],
                             ((0,), (0,)))
         dp = _dot(do_ref[0], v_ref[0], ((1,), (1,)))
+        if rate > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - di_ref[0, :, :1]) * scale
         dk_scr[...] += _dot(ds.astype(q_ref.dtype), q_ref[0],
                             ((0,), (0,)))
@@ -438,11 +531,16 @@ def _dkv_kernel(scale, causal, seg, sq, sk, sqp, skp, bq, bk, nq, g,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
+def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids,
+                rate=0.0, seed=None):
     b, h, sq, sk, d, dp, bq, bk, sqp, skp = _geom(q, k)
     nq, nk = sqp // bq, skp // bk
     hk = k.shape[1]
     g = h // hk
+    seed_specs, seed_args = [], []
+    if rate > 0.0:
+        seed_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        seed_args = [jnp.asarray(seed, jnp.int32).reshape(1)]
 
     q3 = _pad_head(_pad_seq(q, sqp), dp).reshape(b * h, sqp, dp)
     k3 = _pad_head(_pad_seq(k, skp), dp).reshape(b * hk, skp, dp)
@@ -499,10 +597,10 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
         args += [qs, ks]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale, causal, seg, sq, sk,
+        functools.partial(_dq_kernel, scale, causal, seg, rate, sq, sk,
                           sqp, skp, bq, bk, nk),
         grid=(b * h, nq, nk),
-        in_specs=base_specs + seg_specs,
+        in_specs=seed_specs + base_specs + seg_specs,
         out_specs=[pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
@@ -510,7 +608,7 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
         name="apex_flash_attention_dq",
-    )(*args)[0]
+    )(*(seed_args + args))[0]
 
     # dk/dv grid: (BH, NK, NQ) — q innermost; index maps swap j/kk roles;
     # for causal, Q-side blocks below the first contributing one are
@@ -531,10 +629,10 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
             pl.BlockSpec((1, 8, bk), lambda i, kk, t: (i // hk, 0, kk)),
         ]
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale, causal, seg, sq, sk,
-                          sqp, skp, bq, bk, nq, g),
+        functools.partial(_dkv_kernel, scale, causal, seg, rate, h, hk,
+                          sq, sk, sqp, skp, bq, bk, nq, g),
         grid=(b * hk, nk, g * nq),
-        in_specs=kv_specs,
+        in_specs=seed_specs + kv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, dp), lambda i, kk, t: (i, kk, 0)),
             pl.BlockSpec((1, bk, dp), lambda i, kk, t: (i, kk, 0)),
@@ -551,7 +649,7 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
         name="apex_flash_attention_dkv",
-    )(*args)
+    )(*(seed_args + args))
 
     dq = dq.reshape(b, h, sqp, dp)[:, :, :sq, :d]
     dk = dk.reshape(b, hk, skp, dp)[:, :, :sk, :d]
@@ -563,35 +661,63 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, segment_ids, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, segment_ids, seed, causal, scale, rate):
     # primal (non-differentiated) path: no lse output at all
     sc = scale if scale is not None else _default_scale(q.shape[-1])
-    o, _ = _fwd_pallas(q, k, v, sc, causal, segment_ids, need_lse=False)
+    o, _ = _fwd_pallas(q, k, v, sc, causal, segment_ids,
+                       need_lse=False, rate=rate, seed=seed)
     return o
 
 
-def _flash_fwd(q, k, v, segment_ids, causal, scale):
+def _flash_fwd(q, k, v, segment_ids, seed, causal, scale, rate):
     sc = scale if scale is not None else _default_scale(q.shape[-1])
-    o, lse = _fwd_pallas(q, k, v, sc, causal, segment_ids)
+    o, lse = _fwd_pallas(q, k, v, sc, causal, segment_ids,
+                         rate=rate, seed=seed)
     # keep ONE lane of the kernel's 128-lane lse layout as the residual
-    # (they're identical); _bwd_pallas re-broadcasts
-    return o, (q, k, v, segment_ids, o, lse[:, :, 0])
+    # (they're identical); _bwd_pallas re-broadcasts.  The dropout mask
+    # is NOT a residual: every backward kernel reconstructs it from the
+    # (seed, coordinates) hash.
+    return o, (q, k, v, segment_ids, seed, o, lse[:, :, 0])
 
 
-def _flash_bwd(causal, scale, res, do):
-    q, k, v, segment_ids, o, lse = res
+def _flash_bwd(causal, scale, rate, res, do):
+    q, k, v, segment_ids, seed, o, lse = res
     sc = scale if scale is not None else _default_scale(q.shape[-1])
-    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, do, sc, causal, segment_ids)
-    return dq, dk, dv, None
+    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, do, sc, causal,
+                             segment_ids, rate=rate, seed=seed)
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def dropout_seed_from_key(key):
+    """Fold a jax PRNG key down to the int32 seed the fused hash-mask
+    dropout consumes (deterministic per key, traced).  THE one
+    canonical fold: every frontend (contrib.multihead_attn,
+    contrib.fmha, user code) must derive seeds this way so the same
+    key always drops the same elements."""
+    return jax.random.randint(key, (), 0, 2147483647, dtype=jnp.int32)
+
+
+def dropout_keep_ref(seed, b, h, sq, sk, rate):
+    """(B, H, Sq, Sk) keep-mask EXACTLY matching the kernels' hash
+    (same _keep_mask on global coordinates).  Used by the XLA fallback
+    path and the test oracle, so dropout semantics are dispatch-stable:
+    the kernel and the escape hatch drop the same elements."""
+    i = jnp.arange(b * h, dtype=jnp.int32)[:, None, None]
+    rows = jnp.arange(sq, dtype=jnp.int32)[None, :, None]
+    cols = jnp.arange(sk, dtype=jnp.int32)[None, None, :]
+    keep = _keep_mask(jnp.asarray(seed, jnp.int32).reshape(()),
+                      i, rows, cols, rate)
+    return keep.reshape(b, h, sq, sk)
+
+
 def flash_attention(q, k, v, causal=False, scale=None,
                     segment_ids: Optional[Tuple[jax.Array,
-                                                jax.Array]] = None):
+                                                jax.Array]] = None,
+                    dropout_rate: float = 0.0, dropout_seed=None):
     """Fused scaled-dot-product attention, (B, H, S, D) layout.
 
     Replaces the reference's fast_multihead_attn softmax-chain kernels
@@ -607,12 +733,31 @@ def flash_attention(q, k, v, causal=False, scale=None,
     H % HK == 0; q head y attends kv head y // (H // HK).  The kernels
     read the small K/V straight from HBM (the bandwidth point of GQA)
     instead of materializing repeated heads.
+
+    dropout_rate/dropout_seed: fused probability dropout (the
+    reference fuses it in multihead_attn/fmha kernels).  rate is a
+    STATIC float in [0, 1); seed is a traced int32 scalar (vary it per
+    step).  The mask is a counter-based hash of (seed, head, row, col)
+    recomputed inside every kernel — no mask tensor is ever stored —
+    and the backward drops the same elements.  Callers own the
+    train/eval switch: pass rate 0 (or no seed) when not training.
     """
     h, hk = q.shape[1], k.shape[1]
     if h % hk or v.shape[1] != hk:
         raise ValueError(
             f"flash_attention: q heads ({h}) must be a multiple of kv "
             f"heads ({hk}, v: {v.shape[1]})")
+    rate = float(dropout_rate)
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(
+            f"flash_attention: dropout_rate must be in [0, 1), got "
+            f"{dropout_rate!r}")
+    if rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "flash_attention: dropout_rate > 0 requires dropout_seed "
+            "(a traced int32 scalar; vary it per training step)")
+    seed = (None if rate == 0.0
+            else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
     # the kernels dot native-dtype operands (full-rate MXU): normalize
     # mixed q/k/v dtypes once here so kernel and fallback paths agree
     if not (q.dtype == k.dtype == v.dtype):
@@ -624,7 +769,8 @@ def flash_attention(q, k, v, causal=False, scale=None,
         # jax.checkpoint: don't hold the (Sq, Sk) probability residual
         # between fwd and bwd on the escape-hatch path
         ref = jax.checkpoint(functools.partial(
-            attention_ref, causal=causal, scale=sc))
+            attention_ref, causal=causal, scale=sc,
+            dropout_rate=rate, dropout_seed=seed))
         if segment_ids is not None:
             q_ids, kv_ids = segment_ids
             same = q_ids[:, None, :, None] == kv_ids[:, None, None, :]
@@ -642,17 +788,20 @@ def flash_attention(q, k, v, causal=False, scale=None,
             any_kv = jnp.any(visible, axis=-1)          # (B, 1, Sq)
             return jnp.where(any_kv[..., None], o, 0.0).astype(q.dtype)
         return ref(q, k, v)
-    return _flash(q, k, v, segment_ids, causal, scale)
+    return _flash(q, k, v, segment_ids, seed, causal, scale, rate)
 
 
 def attention_ref(q, k, v, causal=False, scale=None,
-                  mask: Optional[jax.Array] = None):
+                  mask: Optional[jax.Array] = None,
+                  dropout_rate: float = 0.0, dropout_seed=None):
     """XLA oracle/fallback; mask: additive (B,1|H,Sq,Sk) or None.
 
     f32 inputs get HIGHEST matmul precision (true f32 on the MXU, same
     contract as the kernel's _dot); bf16 inputs keep the fast default.
     Grouped-query shapes (kv heads < q heads) are handled by repeating
     kv — the oracle states the semantics; the kernel avoids the copy.
+    Dropout uses the SAME counter-based hash as the kernels
+    (dropout_keep_ref), so kernel and oracle drop identical elements.
     """
     if k.shape[1] != q.shape[1]:
         rep = q.shape[1] // k.shape[1]
@@ -670,6 +819,15 @@ def attention_ref(q, k, v, causal=False, scale=None,
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(col > row, _NEG, s)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError(
+                "attention_ref: dropout_rate > 0 requires dropout_seed "
+                "(a traced int32 scalar; vary it per training step)")
+        b, h, sq, sk = p.shape
+        keep = dropout_keep_ref(dropout_seed, b, h, sq, sk,
+                                dropout_rate)
+        p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
                       precision=prec).astype(q.dtype)
 

@@ -389,6 +389,103 @@ def test_gqa_rejects_indivisible_heads():
         A.ring_attention(q, kv2, kv2)
 
 
+@pytest.mark.parametrize("causal,hk", [(False, 4), (True, 4), (True, 2)])
+def test_fused_dropout_matches_oracle(causal, hk):
+    """Fused hash-mask dropout: the kernel and the jnp oracle share
+    _keep_mask, so outputs and ALL grads must agree elementwise (the
+    backward kernels reconstruct the identical mask from coordinates;
+    GQA composes — the dkv kernel re-derives the flat q row)."""
+    from apex_tpu.ops import attention as A
+
+    b, h, s, d = 2, 4, 256, 64
+    ks = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, hk, s, d))
+    v = jax.random.normal(ks[2], (b, hk, s, d))
+    seed = jnp.int32(77)
+
+    kw = dict(causal=causal, dropout_rate=0.25, dropout_seed=seed)
+    got = A.flash_attention(q, k, v, **kw)
+    want = A.attention_ref(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(f):
+        return lambda *a: jnp.sum(f(*a, **kw).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss(A.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    o = jax.grad(loss(A.attention_ref), argnums=(0, 1, 2))(q, k, v)
+    assert g[1].shape == (b, hk, s, d)
+    for a_, b_ in zip(g, o):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_fused_dropout_mask_properties():
+    """Keep rate ~= 1-rate; same seed -> identical mask; different
+    seed -> different mask; rate 0 -> identity with no seed needed."""
+    from apex_tpu.ops import attention as A
+
+    keep = A.dropout_keep_ref(jnp.int32(5), 2, 4, 128, 128, 0.3)
+    frac = float(jnp.mean(keep.astype(jnp.float32)))
+    assert abs(frac - 0.7) < 0.01, frac
+    keep2 = A.dropout_keep_ref(jnp.int32(5), 2, 4, 128, 128, 0.3)
+    assert bool(jnp.all(keep == keep2))
+    keep3 = A.dropout_keep_ref(jnp.int32(6), 2, 4, 128, 128, 0.3)
+    assert float(jnp.mean((keep != keep3).astype(jnp.float32))) > 0.1
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 128, 64)) for kk in ks)
+    o0 = A.flash_attention(q, k, v, dropout_rate=0.0)
+    o_plain = A.flash_attention(q, k, v)
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(o_plain))
+
+    with pytest.raises(ValueError, match="requires dropout_seed"):
+        A.flash_attention(q, k, v, dropout_rate=0.1)
+    with pytest.raises(ValueError, match="must be in"):
+        A.flash_attention(q, k, v, dropout_rate=1.0,
+                          dropout_seed=jnp.int32(0))
+
+
+def test_fused_dropout_with_segment_ids():
+    """Dropout composes with packed-batch masking: cross-segment pairs
+    stay zero regardless of the dropout mask."""
+    from apex_tpu.ops import attention as A
+
+    b, h, s, d = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.key(13), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    ids = (jnp.arange(s)[None] // 64).astype(jnp.int32)
+    seed = jnp.int32(3)
+
+    got = A.flash_attention(q, k, v, segment_ids=(ids, ids),
+                            dropout_rate=0.2, dropout_seed=seed)
+    same = ids[:, None, :, None] == ids[:, None, None, :]
+    want = A.attention_ref(q, k, v, mask=jnp.where(same, 0.0, A._NEG),
+                           dropout_rate=0.2, dropout_seed=seed)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_dropout_dispatch_stable(monkeypatch):
+    """The escape-hatch XLA path drops the SAME elements as the kernel
+    (both hash the same coordinates), so flipping the dispatch gate
+    never changes training behavior."""
+    from apex_tpu.ops import _dispatch
+    from apex_tpu.ops import attention as A
+
+    ks = jax.random.split(jax.random.key(17), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 128, 64)) for kk in ks)
+    kw = dict(causal=True, dropout_rate=0.3,
+              dropout_seed=jnp.int32(123))
+    o_kernel = A.flash_attention(q, k, v, **kw)
+    monkeypatch.setattr(_dispatch, "_PREFS",
+                        {"attention_f32": False, "attention": False})
+    o_xla = A.flash_attention(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(o_kernel), np.asarray(o_xla),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_attn_block_cap_measured_table(monkeypatch):
     """The sweep-written attn_block_cap table in dispatch_prefs.json
     sets the default geometry per padded head dim; the env knob still

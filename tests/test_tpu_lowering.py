@@ -62,6 +62,20 @@ def test_lower_flash_attention_segments_and_longseq():
 # norm / softmax / xentropy / welford / wgrad
 # --------------------------------------------------------------------------
 
+def test_lower_flash_attention_dropout():
+    """Fused hash-mask dropout (SMEM seed scalar + int vector hash in
+    every kernel) must pass the Mosaic verifier, fwd and bwd."""
+    from apex_tpu.ops.attention import flash_attention
+    q = jnp.zeros((1, 2, 1024, 64), jnp.bfloat16)
+    s = jnp.int32(7)
+
+    def f(q, s):
+        return flash_attention(q, q, q, True, dropout_rate=0.1,
+                               dropout_seed=s)
+    lower_tpu(f, q, s)
+    lower_tpu(grad_of(lambda q, s: f(q, s), 1), q, s)
+
+
 def test_lower_flash_attention_gqa():
     """GQA/MQA geometry (kv rows indexed through _kv_row, dkv grid
     folding the q group into its sequential axis) must pass the Mosaic

@@ -161,6 +161,31 @@ def test_flash_attention_gqa_grads():
         _close(a, b_, jnp.float32, rtol=1e-3, atol=1e-3)
 
 
+def test_flash_attention_dropout_grads():
+    """Fused hash-mask dropout under real Mosaic: kernel vs the jnp
+    oracle sharing the same mask — fwd and all grads elementwise."""
+    from apex_tpu.ops.attention import flash_attention, attention_ref
+    b, h, s, d = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+    seed = jnp.int32(99)
+    kw = dict(causal=True, dropout_rate=0.2, dropout_seed=seed)
+
+    o = jax.jit(lambda *a: flash_attention(*a, **kw))(q, k, v)
+    _close(o, attention_ref(q, k, v, **kw), jnp.float32)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v, **kw) ** 2)
+
+    g = jax.jit(jax.grad(loss(flash_attention),
+                         argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss(attention_ref), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, g_ref):
+        _close(a, b_, jnp.float32, rtol=1e-3, atol=1e-3)
+
+
 # ---------------------------------------------------------------------------
 # layer norm / rms norm
 # ---------------------------------------------------------------------------

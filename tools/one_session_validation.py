@@ -139,13 +139,19 @@ def main() -> int:
             ok = False
 
     # ---- kernel bench + sweep (same module, imported) --------------
-    import kernel_bench as kb
-
     def run_kb(argv, out_name, phase):
         nonlocal ok
         if stamped(phase):
             return
         log(f"== {phase} ==")
+        try:
+            # import inside the phase guard: an import-time failure
+            # must cost only this phase, not the whole session
+            import kernel_bench as kb
+        except Exception as e:
+            log(f"{phase}: kernel_bench import failed: {e!r}")
+            ok = False
+            return
         tee = Tee(os.path.join(ART, out_name), sys.stdout)
         old_argv = sys.argv
         sys.argv = ["kernel_bench.py"] + argv
@@ -187,18 +193,22 @@ def main() -> int:
     # ---- tracked metrics (bench.py's child body, in-process) -------
     if not stamped("bench"):
         log("== bench ==")
-        import bench as bench_mod
+        bench_mod = None
         tee = Tee(os.path.join(ART, "bench_raw.jsonl"), sys.stdout)
         try:
+            import bench as bench_mod
             with contextlib.redirect_stdout(tee):
                 bench_mod.run_child("tpu")
         except Exception as e:
+            # keep bench_mod if the import succeeded: run_child flushes
+            # each metric as it lands, so a mid-run crash still leaves
+            # salvageable lines in bench_raw.jsonl
             log(f"bench raised: {e!r}")
             ok = False
         finally:
             tee.close()
-        out = bench_mod._last_json_line(
-            open(os.path.join(ART, "bench_raw.jsonl")).read())
+        out = (None if bench_mod is None else bench_mod._last_json_line(
+            open(os.path.join(ART, "bench_raw.jsonl")).read()))
         if out is not None:
             with open(os.path.join(ART, "bench_tpu.json"), "w") as f:
                 json.dump(out, f)
@@ -215,8 +225,8 @@ def main() -> int:
     # ---- profiler trace of the north-star step ---------------------
     if not stamped("trace"):
         log("== trace ==")
-        from profile_step import capture_trace
         try:
+            from profile_step import capture_trace
             summary = capture_trace(os.path.join(ART, "trace"), jax,
                                     on_tpu=True)
             with open(os.path.join(ART, "trace_summary.txt"), "w") as f:
