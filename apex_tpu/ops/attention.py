@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -112,7 +113,6 @@ def _block_cap(dp: int):
     ``explicit`` tells _block to complain loudly when the requested cap
     can't be honored (the measured table is advisory — a non-dividing
     measured cap quietly falls back to 128-blocks for that shape)."""
-    import os
     env = os.environ.get("APEX_TPU_ATTN_BLOCK_CAP")
     if env:
         try:
@@ -714,6 +714,36 @@ def dropout_keep_ref(seed, b, h, sq, sk, rate):
     return keep.reshape(b, h, sq, sk)
 
 
+def _dense_fallback_fits(q_shape, k_shape) -> bool:
+    """Memory gate on the unfused escape hatch: the dense path
+    materializes the (B, H, Sq, Sk) f32 score tensor (several live
+    copies under remat — the round-4 window hit a 48G HBM request at
+    s=8192 on a 16G chip when measured prefs routed attention to XLA).
+    The measured preference table only speaks for the shapes the bench
+    ran (Sq·Sk <= 2048²); past this element budget the flash kernel is
+    the only memory-safe implementation and the preference is ignored.
+    Operator overrides (APEX_TPU_DISABLE_PALLAS, APEX_TPU_PREFER_XLA)
+    are NOT subject to this gate — see _dispatch.prefs_disabled.
+    """
+    b, h, sq = q_shape[0], q_shape[1], q_shape[2]
+    sk = k_shape[2]
+    env = os.environ.get("APEX_TPU_ATTN_DENSE_MAX_SCORES")
+    budget = 2 ** 27
+    if env:
+        try:
+            iv = int(env)
+        except ValueError:
+            iv = -1
+        if iv > 0:
+            budget = iv
+        else:
+            import warnings
+            warnings.warn(
+                f"APEX_TPU_ATTN_DENSE_MAX_SCORES={env!r} is not a "
+                f"positive integer; using the default budget {budget}")
+    return b * h * sq * sk <= budget
+
+
 def flash_attention(q, k, v, causal=False, scale=None,
                     segment_ids: Optional[Tuple[jax.Array,
                                                 jax.Array]] = None,
@@ -764,7 +794,10 @@ def flash_attention(q, k, v, causal=False, scale=None,
         dt = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype),
                                v.dtype)
         q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
-    if not op_enabled(_attn_family(q.dtype)):
+    fam = _attn_family(q.dtype)
+    if not op_enabled(fam) and not (
+            _dispatch.prefs_disabled(fam)
+            and not _dense_fallback_fits(q.shape, k.shape)):
         sc = scale if scale is not None else _default_scale(q.shape[-1])
         # jax.checkpoint: don't hold the (Sq, Sk) probability residual
         # between fwd and bwd on the escape-hatch path

@@ -123,6 +123,7 @@ def _neutral_dispatch(monkeypatch):
     Dispatch-mechanism tests override _PREFS/env explicitly."""
     from apex_tpu.ops import _dispatch
     monkeypatch.setattr(_dispatch, "_PREFS", {})
+    monkeypatch.setattr(_dispatch, "_ATTN_CAPS", {})
     monkeypatch.delenv("APEX_TPU_PREFER_PALLAS", raising=False)
     monkeypatch.delenv("APEX_TPU_PREFER_XLA", raising=False)
 

@@ -486,6 +486,56 @@ def test_fused_dropout_dispatch_stable(monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_dense_fallback_memory_gate(monkeypatch):
+    """A measured prefer-XLA preference must not route LONG sequences
+    to the dense fallback: the (B, H, Sq, Sk) f32 score tensor grows
+    quadratically (48G HBM at s=8192 in the round-4 window) while the
+    flash kernel is O(S).  Past the element budget the preference is
+    ignored; under it the measured choice stands."""
+    from apex_tpu.ops import _dispatch
+    from apex_tpu.ops import attention as A
+
+    routed = []
+    monkeypatch.setattr(
+        A, "_flash",
+        lambda q, *a, **k: (routed.append("flash"), q * 0)[1])
+    monkeypatch.setattr(
+        A, "attention_ref",
+        lambda q, *a, **k: (routed.append("dense"), q * 0)[1])
+    monkeypatch.setattr(_dispatch, "_PREFS",
+                        {"attention": False, "attention_f32": False})
+
+    small = jnp.zeros((1, 2, 128, 64), jnp.bfloat16)
+    A.flash_attention(small, small, small, causal=True)
+    assert routed == ["dense"]          # measured preference honored
+
+    big = jnp.zeros((1, 1, 16384, 64), jnp.bfloat16)
+    routed.clear()
+    A.flash_attention(big, big, big, causal=True)
+    assert routed == ["flash"]          # 16384^2 >= budget: gate wins
+
+    # budget is operator-tunable; shrinking it flips the small shape
+    monkeypatch.setenv("APEX_TPU_ATTN_DENSE_MAX_SCORES", "1024")
+    routed.clear()
+    A.flash_attention(small, small, small, causal=True)
+    assert routed == ["flash"]
+    monkeypatch.delenv("APEX_TPU_ATTN_DENSE_MAX_SCORES")
+
+    # operator overrides are NOT subject to the gate: the global escape
+    # hatch and an explicit PREFER_XLA must reach the dense path even at
+    # shapes the gate would veto (jvp-over-custom_vjp, miscompile
+    # workarounds — the operator knows why they asked)
+    monkeypatch.setattr(_dispatch, "_DISABLE_PALLAS", True)
+    routed.clear()
+    A.flash_attention(big, big, big, causal=True)
+    assert routed == ["dense"]
+    monkeypatch.setattr(_dispatch, "_DISABLE_PALLAS", False)
+    monkeypatch.setenv("APEX_TPU_PREFER_XLA", "attention")
+    routed.clear()
+    A.flash_attention(big, big, big, causal=True)
+    assert routed == ["dense"]
+
+
 def test_attn_block_cap_measured_table(monkeypatch):
     """The sweep-written attn_block_cap table in dispatch_prefs.json
     sets the default geometry per padded head dim; the env knob still
