@@ -1,0 +1,167 @@
+"""Amortized on-device timing for tunneled TPU sessions.
+
+Round-4 field data (tools/artifacts/bench_kernels.jsonl): through the
+axon relay every dispatch costs ~10-19 ms of host wall time, and
+dispatches do NOT pipeline — a loop of async calls pays the full
+round trip per call.  Microkernels in the 50 µs - 5 ms range are
+therefore invisible to dispatch-per-iteration timing: every shape in
+the round-4 bench measured 10-19 ms regardless of size, and the
+speedup column was noise compressed toward 1.
+
+The fix is structural: run the measured function N times SERIALLY
+INSIDE one compiled program (``lax.fori_loop``), so one dispatch
+amortizes over N executions.  Each iteration's inputs and EVERY
+output leaf pass through one ``lax.optimization_barrier`` whose
+results all feed the next iteration's carry: the barrier pins every
+output to be computed in full (no dead-code elimination, no slicing
+the computation down to the one element a naive dependence would
+read), and the carry's dependence on the outputs stops
+loop-invariant hoisting and cross-iteration CSE.  A scalar built from
+every barrier result gates a no-op select on the carried leaf — the
+select's predicate is data-dependent (the compiler cannot fold it),
+but when the outputs are finite it selects the ORIGINAL leaf, so the
+carried values are bit-identical across iterations, zeros and -0.0
+included.
+
+This measures the framework, not the relay: a real TPU VM dispatches
+locally, and training loops there run whole steps per dispatch anyway.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["chunked_train_bench", "cost_flops", "dispatch_overhead_ms",
+           "loop_on_device", "sync", "timeit"]
+
+
+def sync(o) -> None:
+    """Force completion via a tiny host fetch.  The tunnel's
+    block_until_ready can return early; fetching one scalar slice
+    cannot, and it never ships a full array through the relay."""
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    np.asarray(leaf[(0,) * (leaf.ndim - 1)][:1] if leaf.ndim else leaf)
+
+
+def loop_on_device(f, n: int):
+    """jit-compiled ``g(*args)`` running ``f`` ``n`` times serially on
+    device with an iteration-to-iteration data dependence (see module
+    docstring).  ``f``'s positional args must be arrays (pytrees of
+    arrays work); close over static configuration."""
+
+    def g(*args):
+        flat, treedef = jax.tree_util.tree_flatten(args)
+        idx = next((i for i, a in enumerate(flat)
+                    if jnp.issubdtype(a.dtype, jnp.floating)), 0)
+
+        def body(_, fl):
+            out = f(*jax.tree_util.tree_unflatten(treedef, fl))
+            out_leaves = jax.tree_util.tree_leaves(out)
+            tied = lax.optimization_barrier(tuple(fl)
+                                            + tuple(out_leaves))
+            new_fl = list(tied[:len(fl)])
+            # one scalar per barrier result keeps every result live;
+            # when the outputs are finite the where selects the
+            # original leaf bit-exactly (a NaN output poisons the
+            # carry — benched functions are expected to stay finite)
+            s = sum((t.ravel()[0] if t.ndim else t).astype(jnp.float32)
+                    for t in tied[len(fl):])
+            new_fl[idx] = jnp.where(
+                jnp.isnan(s),
+                jnp.asarray(s, dtype=new_fl[idx].dtype), new_fl[idx])
+            return new_fl
+
+        return lax.fori_loop(0, n, body, flat)
+
+    return jax.jit(g)
+
+
+def timeit(f, *args, iters: int = 20, reps: int = 3) -> float:
+    """Median ms per execution of ``f(*args)``: ``reps`` timed
+    dispatches of an ``iters``-iteration on-device loop (one warmup
+    dispatch first for compilation).  Residual dispatch overhead is
+    one round trip / ``iters`` (~0.5 ms at the observed 10 ms RTT)."""
+    g = loop_on_device(f, iters)
+    sync(g(*args))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        o = g(*args)
+        sync(o)
+        times.append((time.perf_counter() - t0) / iters * 1e3)
+    return statistics.median(times)
+
+
+def cost_flops(jitted, *args):
+    """FLOPs of one compiled call from XLA's cost analysis (the
+    persistent compilation cache dedupes the compile with the later
+    execution).  None if the backend doesn't report it."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = ca.get("flops")
+        return float(f) if f and f > 0 else None
+    except Exception:
+        return None
+
+
+def chunked_train_bench(step_fn, state, batch, *, steps: int,
+                        chunk: int, want_flops: bool = True):
+    """Time a training loop with ``chunk`` steps per dispatch.
+
+    ``step_fn(state, step, *batch) -> state`` threads the full carry
+    (params/optimizer/loss...) exactly like a Python step loop; the
+    chunking only changes how often the host dispatches, which through
+    the tunnel costs a non-pipelining round trip per call (relay cost,
+    not framework cost — a real TPU VM dispatches locally).
+
+    Returns {state, step_ms, steps_per_dispatch, flops_per_step}.
+    flops_per_step comes from the SAME compiled program the timing
+    runs (no second single-step compile burning window time); pass
+    want_flops=False where MFU won't be reported (the CPU fallback) —
+    cost analysis via .lower().compile() is a second fresh compile
+    when the persistent cache is cold, minutes of XLA:CPU conv time
+    for a number nothing reads."""
+    n_chunks = max(1, steps // chunk)
+
+    def multi(state, step0, *b):
+        return lax.fori_loop(
+            0, chunk, lambda i, s: step_fn(s, step0 + i, *b), state)
+
+    mj = jax.jit(multi, donate_argnums=(0,))
+    flops = (cost_flops(mj, state, jnp.int32(1), *batch)
+             if want_flops else None)
+
+    state = mj(state, jnp.int32(1), *batch)     # warmup (compile)
+    sync(state)
+    t0 = time.perf_counter()
+    for c in range(n_chunks):
+        state = mj(state, jnp.int32(1 + (c + 1) * chunk), *batch)
+    sync(state)
+    dt = time.perf_counter() - t0
+    n = n_chunks * chunk
+    return {"state": state, "step_ms": dt / n * 1e3,
+            "steps_per_dispatch": chunk,
+            "flops_per_step": (flops / chunk) if flops else None}
+
+
+def dispatch_overhead_ms(reps: int = 10) -> float:
+    """Median wall time of one dispatch of a trivial jitted program —
+    the per-call relay round trip that amortized timing divides away.
+    Recorded alongside bench rows so artifacts quantify the tunnel."""
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((8, 128), jnp.float32)
+    sync(f(x))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(f(x))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(times)

@@ -34,7 +34,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 import traceback
 
 A100_IMGS_PER_SEC = 2500.0
@@ -57,19 +56,6 @@ def _bf16_peak():
             return peak
     return None
 
-
-def _cost_flops(jitted, *args):
-    """FLOPs of one compiled step from XLA's cost analysis (also
-    triggers the compile, which later calls reuse via the jit cache).
-    None if the backend doesn't report it."""
-    try:
-        ca = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        f = ca.get("flops")
-        return float(f) if f and f > 0 else None
-    except Exception:
-        return None
 
 
 def _mfu(flops, step_s, on_tpu):
@@ -137,31 +123,22 @@ def bench_resnet50_amp_o2(jax, jnp, on_tpu):
         new_params = amp.master_params_to_model_params(params, new_masters)
         return new_params, new_masters, opt_state, new_stats, loss
 
-    step_jit = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
+    from apex_tpu.benchlib import chunked_train_bench
 
-    params_b, masters = params_bf16, masters0
-    opt_state, stats = opt.opt_state, batch_stats
-
-    flops = _cost_flops(step_jit, params_b, masters, opt_state, stats,
-                        jnp.int32(1), x, labels)
-
-    for i in range(3):  # warmup (compile)
-        params_b, masters, opt_state, stats, loss = step_jit(
-            params_b, masters, opt_state, stats, jnp.int32(i + 1), x,
-            labels)
-    float(loss)  # host fetch: tunneled block_until_ready can return early
-
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params_b, masters, opt_state, stats, loss = step_jit(
-            params_b, masters, opt_state, stats, jnp.int32(i + 4), x,
-            labels)
-    float(loss)  # forces the full donated-buffer chain to materialize
-    dt = time.perf_counter() - t0
-    return {"imgs_per_sec": batch * steps / dt,
+    r = chunked_train_bench(
+        lambda c, step, x, y: train_step(c[0], c[1], c[2], c[3],
+                                         step, x, y),
+        (params_bf16, masters0, opt.opt_state, batch_stats,
+         jnp.float32(0)),
+        (x, labels), steps=steps, chunk=10 if on_tpu else steps,
+        want_flops=on_tpu)
+    float(r["state"][4])  # loss: forces the donated-buffer chain
+    return {"imgs_per_sec": batch / r["step_ms"] * 1e3,
             "batch": batch, "image_size": size,
-            "step_ms": dt / steps * 1e3,
-            "mfu": _mfu(flops, dt / steps, on_tpu)}
+            "step_ms": r["step_ms"],
+            "steps_per_dispatch": r["steps_per_dispatch"],
+            "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
+                        on_tpu)}
 
 
 def bench_bert_lamb(jax, jnp, on_tpu):
@@ -213,48 +190,27 @@ def bench_bert_lamb(jax, jnp, on_tpu):
         new_params = amp.master_params_to_model_params(params, new_masters)
         return new_params, new_masters, opt_state, loss
 
-    step_jit = jax.jit(train_step, donate_argnums=(0, 1, 2))
-    masters, opt_state = masters0, opt.opt_state
-    p = params_bf16
-    flops = _cost_flops(step_jit, p, masters, opt_state, jnp.int32(1),
-                        tokens, mlm_labels)
-    for i in range(2):  # warmup
-        p, masters, opt_state, loss = step_jit(
-            p, masters, opt_state, jnp.int32(i + 1), tokens, mlm_labels)
-    float(loss)  # host fetch: tunneled block_until_ready can return early
+    from apex_tpu.benchlib import chunked_train_bench
 
-    t0 = time.perf_counter()
-    for i in range(steps):
-        p, masters, opt_state, loss = step_jit(
-            p, masters, opt_state, jnp.int32(i + 3), tokens, mlm_labels)
-    float(loss)
-    dt = time.perf_counter() - t0
-    return {"step_ms": dt / steps * 1e3, "config": config,
+    r = chunked_train_bench(
+        lambda c, step, t, y: train_step(c[0], c[1], c[2], step, t, y),
+        (params_bf16, masters0, opt.opt_state, jnp.float32(0)),
+        (tokens, mlm_labels), steps=steps,
+        chunk=10 if on_tpu else steps, want_flops=on_tpu)
+    float(r["state"][3])  # loss
+    return {"step_ms": r["step_ms"], "config": config,
             "batch": batch, "seq": seq,
-            "mfu": _mfu(flops, dt / steps, on_tpu)}
+            "steps_per_dispatch": r["steps_per_dispatch"],
+            "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
+                        on_tpu)}
 
 
 def bench_flash_attention(jax, jnp, on_tpu):
     """Flash kernel vs unfused XLA oracle (VERDICT r1 #3 done-criterion:
     kernel >= oracle at 2k; kernel handles 8k).  TPU only — interpret
     mode timings are meaningless."""
-    import numpy as np
+    from apex_tpu.benchlib import timeit as time_fn
     from apex_tpu.ops.attention import attention_ref, flash_attention
-
-    def sync(o):
-        # scalar-slice fetch: forces completion without shipping the
-        # whole array through the tunnel
-        leaf = jax.tree_util.tree_leaves(o)[0]
-        np.asarray(leaf[(0,) * (leaf.ndim - 1)][:1])
-
-    def time_fn(f, *args, iters=20):
-        o = f(*args)
-        sync(o)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            o = f(*args)
-        sync(o)
-        return (time.perf_counter() - t0) / iters * 1e3
 
     out = {}
     for s, run_oracle in ((2048, True), (8192, False)):
@@ -346,6 +302,13 @@ def run_child(backend):
         return
 
     try:
+        from apex_tpu.benchlib import dispatch_overhead_ms
+        out["extra"]["dispatch_overhead_ms"] = round(
+            dispatch_overhead_ms(), 3)
+    except Exception:
+        pass
+
+    try:
         r = bench_resnet50_amp_o2(jax, jnp, on_tpu)
         out["value"] = round(r["imgs_per_sec"], 2)
         out["vs_baseline"] = round(r["imgs_per_sec"] / A100_IMGS_PER_SEC,
@@ -353,6 +316,8 @@ def run_child(backend):
         out["extra"]["resnet50_step_ms"] = round(r["step_ms"], 2)
         out["extra"]["resnet50_batch"] = r["batch"]
         out["extra"]["resnet50_image_size"] = r["image_size"]
+        out["extra"]["resnet50_steps_per_dispatch"] = r.get(
+            "steps_per_dispatch")
         if r.get("mfu") is not None:
             out["extra"]["resnet50_mfu"] = r["mfu"]
     except Exception:

@@ -428,5 +428,20 @@ def test_activation_offload_grads():
     off = offload_checkpoint(block, offload_names=("ffn_hidden",))
     g_off = jax.jit(jax.grad(loss(off), argnums=(0, 1)))(w1, w2, x)
     g_ref = jax.jit(jax.grad(loss(block), argnums=(0, 1)))(w1, w2, x)
+    # The terminal forces --xla_allow_excess_precision=true, under
+    # which the UNrematerialized program may keep the f32 gelu output
+    # where it only feeds a dot, while the offloaded program rounds h
+    # through bf16 at the host boundary (round-4 window: every diff
+    # was <= 1 bf16 ulp of the row scale; the fixed atol=0.02 flagged
+    # near-zero elements).  Compare up to one bf16 rounding of each
+    # ROW's dominant term — global-max scaling would grant large-row
+    # slack to small rows and hide a real offload bug there.
     for a, b in zip(g_off, g_ref):
-        _close(a, b, jnp.bfloat16)
+        a32 = np.asarray(a, np.float32)
+        b32 = np.asarray(b, np.float32)
+        row = np.max(np.abs(b32), axis=-1, keepdims=True)
+        tol = 2.0 ** -7 * row + 0.02 * np.abs(b32) + 1e-6
+        bad = np.abs(a32 - b32) > tol
+        assert not bad.any(), (
+            f"{bad.sum()} elements beyond row-scaled bf16 tolerance; "
+            f"max diff {np.max(np.abs(a32 - b32)):.4g}")

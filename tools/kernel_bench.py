@@ -8,9 +8,13 @@ Prints one JSON line per kernel:
     {"kernel": "...", "shape": "...", "dtype": "...",
      "kernel_ms": K, "oracle_ms": O, "speedup": O/K, "backend": "tpu"}
 
-Methodology: jit both paths, one warmup call (compile), then median of
-5 timed loops of `iters` calls each, synchronized by a scalar fetch (the
-tunnel's block_until_ready can return early; a tiny host fetch cannot).
+Methodology (apex_tpu.benchlib): each path runs `iters` times serially
+INSIDE one compiled fori_loop, so one tunnel dispatch amortizes over
+all iterations.  Round-4 field data showed per-dispatch overhead of
+~10-19 ms that does not pipeline — dispatch-per-iteration timing made
+every microkernel measure the relay, not the op (all shapes 10-19 ms,
+speedups compressed toward 1).  A dispatch_overhead_ms row is emitted
+so each artifact quantifies the tunnel it was measured through.
 """
 
 from __future__ import annotations
@@ -18,31 +22,13 @@ from __future__ import annotations
 import argparse
 import functools
 import json
-import statistics
-import time
-
-import numpy as np
 
 
-def _sync(o):
-    """Scalar-slice fetch: forces completion without a full-array ravel
-    (same idiom as bench.py's sync)."""
-    import jax
-    leaf = jax.tree_util.tree_leaves(o)[0]
-    np.asarray(leaf[(0,) * (leaf.ndim - 1)][:1] if leaf.ndim else leaf)
-
-
-def time_fn(f, *args, iters=10, reps=5):
-    o = f(*args)
-    _sync(o)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            o = f(*args)
-        _sync(o)
-        times.append((time.perf_counter() - t0) / iters * 1e3)
-    return statistics.median(times)
+def time_fn(f, *args, iters=10, reps=3):
+    """Median ms per execution, amortized on device (see module
+    docstring; benchlib imported lazily so --help needs no jax)."""
+    from apex_tpu.benchlib import timeit
+    return timeit(f, *args, iters=iters, reps=reps)
 
 
 def bench_pair(name, shape_desc, dtype, kern, oracle, *args, grad=False):
@@ -163,6 +149,11 @@ def main():
         print(json.dumps({"backend": backend,
                           "note": "kernel timings skipped off-TPU"}))
         return
+
+    from apex_tpu.benchlib import dispatch_overhead_ms
+    print(json.dumps({"dispatch_overhead_ms":
+                      round(dispatch_overhead_ms(), 3),
+                      "backend": backend}), flush=True)
 
     from apex_tpu.ops import attention as attn
     from apex_tpu.ops import layer_norm as ln
