@@ -82,20 +82,35 @@ def loop_on_device(f, n: int):
     return jax.jit(g)
 
 
-def timeit(f, *args, iters: int = 20, reps: int = 3) -> float:
+def timeit(f, *args, iters: int = 20, reps: int = 3,
+           adaptive: bool = False) -> float:
     """Median ms per execution of ``f(*args)``: ``reps`` timed
     dispatches of an ``iters``-iteration on-device loop (one warmup
     dispatch first for compilation).  Residual dispatch overhead is
-    one round trip / ``iters`` (~0.5 ms at the observed 10 ms RTT)."""
-    g = loop_on_device(f, iters)
-    sync(g(*args))
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        o = g(*args)
-        sync(o)
-        times.append((time.perf_counter() - t0) / iters * 1e3)
-    return statistics.median(times)
+    one round trip / ``iters`` (~0.5 ms at the observed 10 ms RTT).
+
+    adaptive=True: when the probe shows a FAST body (per-iteration
+    time under ~2 ms, where even the amortized residual distorts the
+    ratio two fast paths are compared by), re-loop with enough
+    iterations that one dispatch runs ~200 ms of body — the RTT share
+    drops below ~5%.  Costs one extra compile of the (rolled, so
+    body-sized) loop; only worth it for microkernels."""
+
+    def run(n):
+        g = loop_on_device(f, n)
+        sync(g(*args))
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            o = g(*args)
+            sync(o)
+            times.append((time.perf_counter() - t0) / n * 1e3)
+        return statistics.median(times)
+
+    ms = run(iters)
+    if adaptive and ms < 2.0:
+        ms = run(min(500, max(iters + 1, int(200.0 / max(ms, 1e-3)))))
+    return ms
 
 
 def cost_flops(jitted, *args):

@@ -77,14 +77,11 @@ def _mfu(flops, step_s, on_tpu):
 # itself cpu-fallback when the TPU isn't granted.
 
 
-def bench_resnet50_amp_o2(jax, jnp, on_tpu):
+def _resnet50_one_batch(jax, jnp, on_tpu, batch, size, steps):
     from apex_tpu import amp
+    from apex_tpu.benchlib import chunked_train_bench
     from apex_tpu.models import resnet50
     from apex_tpu.optimizers import FusedSGD
-
-    batch = 128 if on_tpu else 8
-    size = 224 if on_tpu else 64
-    steps = 50 if on_tpu else 3
 
     model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.key(0)
@@ -123,8 +120,6 @@ def bench_resnet50_amp_o2(jax, jnp, on_tpu):
         new_params = amp.master_params_to_model_params(params, new_masters)
         return new_params, new_masters, opt_state, new_stats, loss
 
-    from apex_tpu.benchlib import chunked_train_bench
-
     r = chunked_train_bench(
         lambda c, step, x, y: train_step(c[0], c[1], c[2], c[3],
                                          step, x, y),
@@ -139,6 +134,29 @@ def bench_resnet50_amp_o2(jax, jnp, on_tpu):
             "steps_per_dispatch": r["steps_per_dispatch"],
             "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
                         on_tpu)}
+
+
+def bench_resnet50_amp_o2(jax, jnp, on_tpu):
+    """North-star metric.  On hardware, batch is swept (the b128 MFU of
+    0.25 in the round-4 window says the MXU is underfed; the reference
+    target is imgs/sec/chip at the submitter's batch of choice) and the
+    best throughput is reported, every candidate recorded in extra."""
+    size = 224 if on_tpu else 64
+    steps = 50 if on_tpu else 3
+    best, sweep = None, {}
+    for batch in ((128, 256) if on_tpu else (8,)):
+        try:
+            r = _resnet50_one_batch(jax, jnp, on_tpu, batch, size, steps)
+        except Exception as e:  # e.g. OOM at the larger batch
+            sweep[f"b{batch}_error"] = repr(e)[:200]
+            continue
+        sweep[f"b{batch}_imgs_per_sec"] = round(r["imgs_per_sec"], 2)
+        if best is None or r["imgs_per_sec"] > best["imgs_per_sec"]:
+            best = r
+    if best is None:
+        raise RuntimeError(f"resnet50: no batch size succeeded: {sweep}")
+    best["batch_sweep"] = sweep
+    return best
 
 
 def bench_bert_lamb(jax, jnp, on_tpu):
@@ -305,8 +323,8 @@ def run_child(backend):
         from apex_tpu.benchlib import dispatch_overhead_ms
         out["extra"]["dispatch_overhead_ms"] = round(
             dispatch_overhead_ms(), 3)
-    except Exception:
-        pass
+    except Exception as e:
+        out["errors"].append(f"dispatch_overhead: {e!r}")
 
     try:
         r = bench_resnet50_amp_o2(jax, jnp, on_tpu)
@@ -318,6 +336,7 @@ def run_child(backend):
         out["extra"]["resnet50_image_size"] = r["image_size"]
         out["extra"]["resnet50_steps_per_dispatch"] = r.get(
             "steps_per_dispatch")
+        out["extra"]["resnet50_batch_sweep"] = r.get("batch_sweep")
         if r.get("mfu") is not None:
             out["extra"]["resnet50_mfu"] = r["mfu"]
     except Exception:

@@ -26,9 +26,12 @@ import json
 
 def time_fn(f, *args, iters=10, reps=3):
     """Median ms per execution, amortized on device (see module
-    docstring; benchlib imported lazily so --help needs no jax)."""
+    docstring; benchlib imported lazily so --help needs no jax).
+    adaptive: sub-2ms bodies re-loop to ~200 ms per dispatch so the
+    residual RTT share stays below ~5% — write_prefs flips routing on
+    these ratios, so they must not carry relay noise."""
     from apex_tpu.benchlib import timeit
-    return timeit(f, *args, iters=iters, reps=reps)
+    return timeit(f, *args, iters=iters, reps=reps, adaptive=True)
 
 
 def bench_pair(name, shape_desc, dtype, kern, oracle, *args, grad=False):
