@@ -14,6 +14,13 @@ _spec = importlib.util.spec_from_file_location(
 kb = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(kb)
 
+_ospec = importlib.util.spec_from_file_location(
+    "one_session_validation",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools", "one_session_validation.py"))
+osv = importlib.util.module_from_spec(_ospec)
+_ospec.loader.exec_module(osv)
+
 
 class TestSelectAttnCaps:
     def test_lowest_mean_relative_time_wins(self):
@@ -81,3 +88,33 @@ class TestWritePrefs:
         assert kb.write_prefs(rows, str(p)) == {"welford": True}
         assert json.loads(p.read_text())["prefer_pallas"] == {
             "welford": True}
+
+
+class TestRelayDeathWatchdogParser:
+    """The validator's mid-session relay-death detector keys off the
+    same ss -tln listener parse as tunnel_watch.sh; a parse bug either
+    hard-exits a healthy session (false death) or leaves the next
+    window blocked behind a wedged client (missed death)."""
+
+    HEADER = "State  Recv-Q Send-Q Local Address:Port  Peer Address:Port\n"
+
+    def test_relay_ports_count_as_alive(self):
+        txt = (self.HEADER
+               + "LISTEN 0 64 127.0.0.1:8117 0.0.0.0:*\n"
+               + "LISTEN 0 128 0.0.0.0:2024 0.0.0.0:*\n")
+        assert osv._has_nonbaseline_listener(txt)
+
+    def test_baseline_only_means_dead(self):
+        txt = (self.HEADER
+               + "LISTEN 0 128 0.0.0.0:2024 0.0.0.0:*\n"
+               + "LISTEN 0 1024 127.0.0.1:48271 0.0.0.0:*\n")
+        assert not osv._has_nonbaseline_listener(txt)
+
+    def test_empty_and_header_only_mean_dead(self):
+        assert not osv._has_nonbaseline_listener("")
+        assert not osv._has_nonbaseline_listener(self.HEADER)
+
+    def test_port_suffix_collision_not_excluded(self):
+        # 127.0.0.1:12024 must NOT match the :2024 baseline anchor
+        txt = self.HEADER + "LISTEN 0 64 127.0.0.1:12024 0.0.0.0:*\n"
+        assert osv._has_nonbaseline_listener(txt)

@@ -83,6 +83,67 @@ class Tee(io.TextIOBase):
         self.f.close()
 
 
+def _has_nonbaseline_listener(ss_text: str) -> bool:
+    """Parse `ss -tln` output: any listener besides the baseline ports
+    (48271, 2024 — same exclusion as tools/tunnel_watch.sh)?"""
+    for line in ss_text.splitlines()[1:]:
+        parts = line.split()
+        if len(parts) >= 4 and not re.search(r":(48271|2024)$",
+                                             parts[3]):
+            return True
+    return False
+
+
+def _relay_listening() -> bool:
+    """True when any non-baseline local listener exists (the relay's
+    ports).  Purely passive: reads the kernel's socket table, opens no
+    connection."""
+    import subprocess
+    try:
+        r = subprocess.run(["ss", "-tln"], capture_output=True,
+                           text=True, timeout=10)
+        if r.returncode != 0:
+            return True  # ss itself failed: can't tell, assume alive
+    except Exception:
+        return True      # can't tell: assume alive, never false-kill
+    return _has_nonbaseline_listener(r.stdout)
+
+
+def _arm_relay_death_watchdog(poll_s: int = 20, misses: int = 6):
+    """Daemon thread: once a TPU session is live, if the relay's
+    listeners stay gone for ``misses`` consecutive polls (~2 min), the
+    session is unrecoverable — a pending PJRT call then hangs FOREVER
+    (round-4 field data: the 04:26Z relay death left the validator
+    wedged mid-test for 50+ min until killed by hand), which also
+    wedges the tunnel watcher whose fire() is waiting on this process.
+    Log, stamp a marker, and hard-exit 3.  os._exit is deliberate: the
+    relay is gone, there is no session left to wedge, and a clean
+    interpreter shutdown would block on the same hung runtime."""
+    import threading
+
+    def watch():
+        gone = 0
+        while True:
+            time.sleep(poll_s)
+            if _relay_listening():
+                gone = 0
+                continue
+            gone += 1
+            if gone >= misses:
+                log(f"relay listeners gone for {gone * poll_s}s — "
+                    f"session unrecoverable, exiting 3 (watcher will "
+                    f"re-fire on the next relay)")
+                with open(os.path.join(ART, "relay_death.json"),
+                          "w") as f:
+                    json.dump({"ts": ts(),
+                               "note": "relay died mid-session"}, f)
+                sys.stdout.flush()
+                os._exit(3)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="relay-death-watchdog").start()
+
+
 def main() -> int:
     os.makedirs(ART, exist_ok=True)
     os.chdir(ROOT)
@@ -118,6 +179,7 @@ def main() -> int:
                        "note": "no TPU session available"}, f)
         return 3
 
+    _arm_relay_death_watchdog()
     ok = True
 
     # ---- smoke -----------------------------------------------------
