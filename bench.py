@@ -34,6 +34,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 import traceback
 
 A100_IMGS_PER_SEC = 2500.0
@@ -159,13 +160,7 @@ def bench_resnet50_amp_o2(jax, jnp, on_tpu):
     return best
 
 
-def bench_bert_lamb(jax, jnp, on_tpu):
-    """BERT-Large FusedLAMB step time (BASELINE tracked metric 2).
-
-    On the cpu-fallback path a tiny proxy config runs instead (a real
-    BERT-L CPU step takes minutes); the emitted dict carries the config
-    so the two are never confused.
-    """
+def _bert_lamb_one_batch(jax, jnp, on_tpu, batch, seq, steps, config):
     from apex_tpu import amp
     from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
     from apex_tpu.models.bert import bert_large, BertModel
@@ -173,14 +168,10 @@ def bench_bert_lamb(jax, jnp, on_tpu):
 
     if on_tpu:
         model = bert_large(dtype=jnp.bfloat16)
-        batch, seq, config = 8, 512, "bert-large b8 s512"
-        steps = 20
     else:
         model = BertModel(vocab_size=1024, hidden_size=128, num_heads=4,
                           num_layers=2, max_seq_len=128,
                           dtype=jnp.bfloat16)
-        batch, seq, config = 2, 64, "tiny-cpu-proxy"
-        steps = 2
 
     vocab = model.vocab_size
     tokens = jax.random.randint(jax.random.key(0), (batch, seq), 0, vocab)
@@ -221,6 +212,25 @@ def bench_bert_lamb(jax, jnp, on_tpu):
             "steps_per_dispatch": r["steps_per_dispatch"],
             "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
                         on_tpu)}
+
+
+def bench_bert_lamb(jax, jnp, on_tpu):
+    """BERT-Large FusedLAMB step time (BASELINE tracked metric 2) at
+    the fixed b8 s512 config (step-time numbers only compare at a
+    fixed config).  The b32 throughput datapoint runs SEPARATELY in
+    run_child, after this tracked metric has been flushed — a hang or
+    watchdog kill during the extra must not lose a metric that already
+    finished.
+
+    On the cpu-fallback path a tiny proxy config runs instead (a real
+    BERT-L CPU step takes minutes); the emitted dict carries the config
+    so the two are never confused.
+    """
+    if not on_tpu:
+        return _bert_lamb_one_batch(jax, jnp, False, 2, 64, 2,
+                                    "tiny-cpu-proxy")
+    return _bert_lamb_one_batch(jax, jnp, True, 8, 512, 20,
+                                "bert-large b8 s512")
 
 
 def bench_flash_attention(jax, jnp, on_tpu):
@@ -298,8 +308,12 @@ def run_child(backend):
         import jax
         # Persistent executable cache: repeat bench runs skip the
         # multi-minute first compile of the train steps.
-        from apex_tpu.platform import enable_compilation_cache
+        from apex_tpu.platform import enable_compilation_cache, \
+            select_platform
         enable_compilation_cache()
+        select_platform()  # honor APEX_TPU_PLATFORM (e.g. cpu): skip
+        #                    the ~25-min hung-tunnel init when the
+        #                    operator already knows there's no TPU
         if not on_tpu:
             # sitecustomize force-registers the axon TPU plugin; env vars
             # are too late once jax is imported, so flip the live config
@@ -359,8 +373,10 @@ def run_child(backend):
         out["errors"].append(
             "bert_lamb: " + traceback.format_exc(limit=3).replace("\n", " | "))
 
-    # flash kernel vs oracle LAST: both tracked metrics are already
-    # flushed if this hangs and the watchdog fires
+    # extras AFTER both tracked metrics are flushed: a hang + watchdog
+    # kill in here truncates only the extras.  flash (a VERDICT
+    # done-criterion) runs BEFORE the OOM-prone b32 leg so a hang
+    # there can't truncate it.
     if on_tpu:
         print(_dump(out), flush=True)
         try:
@@ -370,7 +386,52 @@ def run_child(backend):
                 "flash_attention: "
                 + traceback.format_exc(limit=3).replace("\n", " | "))
 
+        print(_dump(out), flush=True)
+        try:
+            # BERT-L at b32: the throughput/MFU story (b8 ran at MFU
+            # 0.34; larger batches amortize fixed per-step work)
+            r32 = _bert_lamb_one_batch(jax, jnp, True, 32, 512, 20,
+                                       "bert-large b32 s512")
+            out["extra"]["bert_b32_step_ms"] = round(r32["step_ms"], 2)
+            out["extra"]["bert_b32_tokens_per_sec"] = round(
+                32 * 512 / r32["step_ms"] * 1e3, 1)
+            if r32.get("mfu") is not None:
+                out["extra"]["bert_b32_mfu"] = r32["mfu"]
+        except Exception as e:
+            # e.g. OOM — recorded in extra, NOT in errors: a failed
+            # EXTRA must not block the validator's bench stamp when
+            # both tracked metrics landed clean
+            out["extra"]["bert_b32_error"] = repr(e)[:200]
+
     print(_dump(out), flush=True)
+
+
+def _cached_tpu_result():
+    """The most recent committed hardware measurement
+    (tools/artifacts/bench_tpu.json), relabeled backend "tpu-cached"
+    with its capture time, or None.  Only a clean real-TPU line
+    qualifies (backend tpu, positive value)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "artifacts", "bench_tpu.json")
+    try:
+        with open(path) as f:
+            cached = json.load(f)
+        if (cached.get("backend") != "tpu"
+                or float(cached.get("value", 0)) <= 0):
+            return None
+        cached["backend"] = "tpu-cached"
+        # the capture session's own errors describe THAT session (and
+        # can carry multi-KB ANSI tracebacks); keep a stub, not the body
+        cached["errors"] = [e[:160] for e in cached.get("errors", [])]
+        # capture time: the validator embeds measured_at at write time;
+        # mtime is only a fallback (it is checkout time on a fresh
+        # clone, not capture time)
+        measured = cached.pop("measured_at", None) or time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(path)))
+        cached.setdefault("extra", {})["cached_measured_at"] = measured
+        return cached
+    except Exception:
+        return None
 
 
 def _env_float(name, default):
@@ -443,30 +504,60 @@ def main():
     backend = "tpu" if on_tpu else "cpu"
 
     # Leash covers a worst-case init stall (~25 min) plus the bench
-    # itself; the child flushes the primary metric as soon as ResNet
-    # finishes, so even a later hang+kill salvages the north star.
+    # itself — now including the b256 ResNet and b32 BERT sweep legs
+    # (each a fresh multi-minute remote compile); the child flushes
+    # each tracked metric as it lands, so even a late hang+kill
+    # salvages everything already measured.
     child_timeout = _env_float("APEX_TPU_BENCH_CHILD_TIMEOUT",
-                               2700.0 if on_tpu else 1200.0)
+                               3900.0 if on_tpu else 1500.0)
     out, err = _run_bench_child(backend, child_timeout)
-    # A TPU child that errored fast (backend raised instead of hanging)
-    # still prints a value-0 line — that's a failure for salvage
-    # purposes, not a result.
+    # A TPU child that errored fast (value-0 line) OR that initialized
+    # CPU and relabeled itself cpu-fallback did NOT measure hardware —
+    # both fall through to the cached-window / CPU-proxy ladder.
     tpu_failed = backend == "tpu" and (
-        out is None or float(out.get("value", 0)) <= 0)
+        out is None or float(out.get("value", 0)) <= 0
+        or out.get("backend") != "tpu")
     if out is not None and not tpu_failed:
         print(json.dumps(out))
         return
 
     if backend == "tpu":
-        # TPU child hung/crashed/zeroed after a clean probe — salvage a
-        # labeled CPU datapoint rather than returning nothing.
+        # TPU child hung/crashed/zeroed — before degrading to the CPU
+        # proxy, surface the most recent REAL hardware measurement if
+        # one exists (tools/artifacts/bench_tpu.json, written by the
+        # one-session validator inside a tunnel window).  Clearly
+        # labeled: backend "tpu-cached" + the capture timestamp — a
+        # recorded chip number with honest provenance beats a
+        # meaningless CPU-proxy line when the tunnel happens to be
+        # down at report time.
         if out is not None:
-            err = "; ".join(["tpu child returned value 0"]
+            err = "; ".join(["tpu child did not measure hardware"]
                             + out.get("errors", []))
-        cpu_out, err2 = _run_bench_child("cpu-fallback", child_timeout)
+        cached = _cached_tpu_result()
+        if cached is not None:
+            cached.setdefault("errors", []).append(
+                f"live tpu attempt failed ({err}); value is the "
+                f"round's recorded hardware window")
+            print(json.dumps(cached))
+            return
+        # no cached hardware number: a CPU-proxy liveness line.  The
+        # failed child may itself BE that line (it initialized CPU and
+        # ran the proxy shapes) — reuse it rather than re-running.
+        if (out is not None and out.get("backend") == "cpu-fallback"
+                and float(out.get("value", 0)) > 0):
+            cpu_out, err2 = dict(out), None
+            # fresh errors list + short note: the joined `err` above
+            # CONTAINS this same errors list, so appending it back
+            # onto the shared (aliased) list would double every entry
+            cpu_out["errors"] = list(out.get("errors", []))
+            err = "tpu child did not measure hardware (ran cpu-fallback)"
+        else:
+            cpu_out, err2 = _run_bench_child("cpu-fallback",
+                                             child_timeout)
         if cpu_out is not None:
             cpu_out.setdefault("errors", []).append(f"tpu attempt: {err}")
-            if out is not None:
+            if out is not None and cpu_out is not out \
+                    and cpu_out.get("extra") is not out.get("extra"):
                 # Keep any metric the TPU child DID measure (e.g. BERT
                 # succeeded while ResNet OOMed) — real-hardware numbers
                 # beat the CPU proxy.
