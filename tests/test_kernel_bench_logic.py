@@ -118,3 +118,65 @@ class TestRelayDeathWatchdogParser:
         # 127.0.0.1:12024 must NOT match the :2024 baseline anchor
         txt = self.HEADER + "LISTEN 0 64 127.0.0.1:12024 0.0.0.0:*\n"
         assert osv._has_nonbaseline_listener(txt)
+
+
+class TestTraceOpSummarizer:
+    """profile_step.summarize_device_ops distills the profiler's
+    Chrome trace into the top-device-ops table; it must aggregate ONLY
+    the device XLA-Ops thread (the round-4 capture had 998909 host
+    python events vs 434 device ops — counting hosts would bury the
+    signal it exists to surface)."""
+
+    def _ps(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "profile_step",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tools", "profile_step.py"))
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        return m
+
+    def _write_trace(self, tmp_path, events):
+        import gzip
+        d = tmp_path / "plugins" / "profile" / "2026_01_01"
+        d.mkdir(parents=True)
+        with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        return str(tmp_path)
+
+    def test_aggregates_device_ops_only(self, tmp_path):
+        ps = self._ps()
+        events = [
+            {"ph": "M", "pid": 3, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 3, "tid": 7, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "/host:CPU"}},
+            {"ph": "M", "pid": 9, "tid": 1, "name": "thread_name",
+             "args": {"name": "python"}},
+            # device ops: fusion.1 twice (3ms), conv once (1ms)
+            {"ph": "X", "pid": 3, "tid": 7, "name": "fusion.1",
+             "dur": 2000},
+            {"ph": "X", "pid": 3, "tid": 7, "name": "fusion.1",
+             "dur": 1000},
+            {"ph": "X", "pid": 3, "tid": 7, "name": "conv", "dur": 1000},
+            # host noise that must NOT count
+            {"ph": "X", "pid": 9, "tid": 1, "name": "python_call",
+             "dur": 999999},
+            # device process, non-op thread must not count either
+            {"ph": "X", "pid": 3, "tid": 8, "name": "Steps",
+             "dur": 888888},
+        ]
+        rows = ps.summarize_device_ops(self._write_trace(tmp_path,
+                                                         events))
+        assert rows == [["fusion.1", 3.0, 75.0], ["conv", 1.0, 25.0]]
+
+    def test_empty_or_missing_trace(self, tmp_path):
+        ps = self._ps()
+        assert ps.summarize_device_ops(str(tmp_path)) == []
+        rows = ps.summarize_device_ops(self._write_trace(
+            tmp_path, [{"ph": "M", "pid": 3, "name": "process_name",
+                        "args": {"name": "/device:TPU:0"}}]))
+        assert rows == []

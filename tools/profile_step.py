@@ -33,9 +33,24 @@ def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
 
     import bench
 
+    # Device-only trace: the round-4 window's capture drowned in ~1M
+    # host python events (the device "XLA Ops" thread recorded 37 ms
+    # of a 46 s wall — useless for an op breakdown).  Host/python
+    # tracers off; trace ONE batch leg at the tracked b128 config with
+    # a short step count so device events stay within buffer.
+    opts = None
+    try:
+        opts = jax.profiler.ProfileOptions()
+        opts.host_tracer_level = 0
+        opts.python_tracer_level = 0
+    except Exception:
+        pass  # older jax: fall back to a default-options trace
+
     t0 = time.perf_counter()
-    with jax.profiler.trace(outdir):
-        r = bench.bench_resnet50_amp_o2(jax, jnp, on_tpu)
+    with jax.profiler.trace(outdir, profiler_options=opts):
+        r = bench._resnet50_one_batch(
+            jax, jnp, on_tpu, 128 if on_tpu else 8,
+            224 if on_tpu else 64, 20 if on_tpu else 2)
     out = {"trace_dir": outdir,
            "backend": "tpu" if on_tpu else jax.default_backend(),
            "wall_s": round(time.perf_counter() - t0, 1),
@@ -43,7 +58,47 @@ def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
            "imgs_per_sec": round(r["imgs_per_sec"], 1)}
     if r.get("mfu") is not None:
         out["mfu"] = r["mfu"]
+    try:
+        out["top_device_ops"] = summarize_device_ops(outdir)
+    except Exception as e:  # summary is best-effort, trace is the point
+        out["top_device_ops_error"] = repr(e)[:120]
     return out
+
+
+def summarize_device_ops(outdir: str, top: int = 12):
+    """Top device ops by total time from the Chrome-format trace the
+    profiler writes (device thread named "XLA Ops" under a /device:*
+    process).  Returns [[name, total_ms, pct], ...] — the op-level
+    step breakdown docs/perf.md's MFU work needs, computed without
+    any xprof/tensorboard dependency."""
+    import collections
+    import glob
+    import gzip
+
+    paths = glob.glob(os.path.join(
+        outdir, "plugins", "profile", "*", "*.trace.json.gz"))
+    if not paths:
+        return []
+    d = json.load(gzip.open(sorted(paths)[-1]))
+    ev = d.get("traceEvents", [])
+    device_pids = {e.get("pid") for e in ev
+                   if e.get("ph") == "M"
+                   and e.get("name") == "process_name"
+                   and "/device:" in str(e.get("args", {}).get("name"))}
+    op_tids = {(e.get("pid"), e.get("tid")) for e in ev
+               if e.get("ph") == "M" and e.get("name") == "thread_name"
+               and e.get("pid") in device_pids
+               and e.get("args", {}).get("name") == "XLA Ops"}
+    agg = collections.Counter()
+    for e in ev:
+        if (e.get("ph") == "X"
+                and (e.get("pid"), e.get("tid")) in op_tids):
+            agg[e["name"]] += e.get("dur", 0)
+    total = sum(agg.values())
+    if not total:
+        return []
+    return [[name, round(dur / 1e3, 3), round(dur / total * 100, 1)]
+            for name, dur in agg.most_common(top)]
 
 
 def main():
