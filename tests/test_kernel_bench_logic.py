@@ -7,19 +7,20 @@ import importlib.util
 import json
 import os
 
-_spec = importlib.util.spec_from_file_location(
-    "kernel_bench",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 "tools", "kernel_bench.py"))
-kb = importlib.util.module_from_spec(_spec)
-_spec.loader.exec_module(kb)
 
-_ospec = importlib.util.spec_from_file_location(
-    "one_session_validation",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 "tools", "one_session_validation.py"))
-osv = importlib.util.module_from_spec(_ospec)
-_ospec.loader.exec_module(osv)
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", f"{name}.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+kb = _load_tool("kernel_bench")
+osv = _load_tool("one_session_validation")
+ps = _load_tool("profile_step")
 
 
 class TestSelectAttnCaps:
@@ -127,16 +128,6 @@ class TestTraceOpSummarizer:
     python events vs 434 device ops — counting hosts would bury the
     signal it exists to surface)."""
 
-    def _ps(self):
-        import importlib.util
-        spec = importlib.util.spec_from_file_location(
-            "profile_step",
-            os.path.join(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))), "tools", "profile_step.py"))
-        m = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(m)
-        return m
-
     def _write_trace(self, tmp_path, events):
         import gzip
         d = tmp_path / "plugins" / "profile" / "2026_01_01"
@@ -146,7 +137,6 @@ class TestTraceOpSummarizer:
         return str(tmp_path)
 
     def test_aggregates_device_ops_only(self, tmp_path):
-        ps = self._ps()
         events = [
             {"ph": "M", "pid": 3, "name": "process_name",
              "args": {"name": "/device:TPU:0"}},
@@ -174,7 +164,6 @@ class TestTraceOpSummarizer:
         assert rows == [["fusion.1", 3.0, 75.0], ["conv", 1.0, 25.0]]
 
     def test_empty_or_missing_trace(self, tmp_path):
-        ps = self._ps()
         assert ps.summarize_device_ops(str(tmp_path)) == []
         rows = ps.summarize_device_ops(self._write_trace(
             tmp_path, [{"ph": "M", "pid": 3, "name": "process_name",

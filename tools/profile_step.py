@@ -26,9 +26,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
-    """Trace ONE run of bench.py's exact north-star step (so the trace
-    matches the reported number) and return the summary dict.  Shared
-    by the standalone CLI below and the one-session validator."""
+    """Trace the north-star training step at the tracked b128 config
+    (a short 20-step leg — NOT bench.py's full b128/b256 sweep, whose
+    reported number may come from a different batch; compare this
+    summary's step_ms against the matching batch_sweep entry) and
+    return the summary dict.  Shared by the standalone CLI below and
+    the one-session validator."""
     import jax.numpy as jnp
 
     import bench
@@ -47,7 +50,11 @@ def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
         pass  # older jax: fall back to a default-options trace
 
     t0 = time.perf_counter()
-    with jax.profiler.trace(outdir, profiler_options=opts):
+    # only pass the kwarg when options exist: a jax old enough to lack
+    # ProfileOptions also lacks the profiler_options parameter
+    tr = (jax.profiler.trace(outdir, profiler_options=opts)
+          if opts is not None else jax.profiler.trace(outdir))
+    with tr:
         r = bench._resnet50_one_batch(
             jax, jnp, on_tpu, 128 if on_tpu else 8,
             224 if on_tpu else 64, 20 if on_tpu else 2)
@@ -79,7 +86,8 @@ def summarize_device_ops(outdir: str, top: int = 12):
         outdir, "plugins", "profile", "*", "*.trace.json.gz"))
     if not paths:
         return []
-    d = json.load(gzip.open(sorted(paths)[-1]))
+    with gzip.open(sorted(paths)[-1]) as f:
+        d = json.load(f)
     ev = d.get("traceEvents", [])
     device_pids = {e.get("pid") for e in ev
                    if e.get("ph") == "M"
