@@ -21,6 +21,38 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """(N, H, W, C) -> (N, H/b, W/b, b*b*C); channel order
+    (row-parity, col-parity, C) row-major — the kernel fold in
+    ResNet's space-to-depth stem depends on exactly this order."""
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"space_to_depth: spatial dims must be multiples of "
+            f"{block}, got {h}x{w} — the default 7x7 stem "
+            f"(stem_space_to_depth=False) accepts any size")
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def fold_stem_kernel(w7: jax.Array) -> jax.Array:
+    """Fold a (7, 7, C, F) stride-2 stem kernel into the (4, 4, 4*C, F)
+    stride-1 kernel that acts on space-to-depth(2) input.
+
+    The MLPerf TPU ResNet transform: the 7x7 stride-2 conv wastes the
+    128-lane MXU on C=3 inputs (~2% utilization); zero-pad the kernel
+    to 8x8 (one leading row/col — that tap only ever reads the extra
+    pad column, contributing zero) and fold 2x2 spatial parity into
+    channels.  With input padding (2, 1) per spatial dim the result is
+    exactly the original convolution (tested to numerical equality in
+    tests/test_models.py)."""
+    w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    k, _, c, f = 4, 4, w7.shape[2], w7.shape[3]
+    w4 = w8.reshape(k, 2, k, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return w4.reshape(k, k, 4 * c, f)
+
+
 class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
@@ -83,6 +115,12 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: jnp.dtype = jnp.float32
     norm_cls: Optional[Callable] = None   # e.g. parallel.SyncBatchNorm
+    # MXU-efficient stem (MLPerf space-to-depth transform): same
+    # function as the 7x7/s2 conv, computed as a 4x4/s1 conv over
+    # space-to-depth(2) input so the MXU sees 12 input channels
+    # instead of 3.  Opt-in: the param tree differs from the default
+    # stem (stem_conv vs Conv_0).
+    stem_space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -93,9 +131,20 @@ class ResNet(nn.Module):
                                      epsilon=1e-5, dtype=jnp.float32,
                                      param_dtype=jnp.float32)
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), (2, 2),
-                    padding=[(3, 3), (3, 3)], use_bias=False,
-                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        if self.stem_space_to_depth:
+            w7 = self.param("stem_conv",
+                            nn.initializers.lecun_normal(),
+                            (7, 7, x.shape[-1], self.width),
+                            jnp.float32)
+            x = jax.lax.conv_general_dilated(
+                space_to_depth(x, 2),
+                fold_stem_kernel(w7).astype(self.dtype),
+                window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2),
+                        padding=[(3, 3), (3, 3)], use_bias=False,
+                        dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = norm()(x, use_running_average=not train)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])

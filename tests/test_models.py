@@ -56,6 +56,42 @@ def test_resnet18_forward_and_train_step():
     assert np.isfinite(total)
 
 
+def test_resnet_space_to_depth_stem_equals_7x7():
+    """The MXU-efficient stem is the SAME function as the 7x7/s2 conv:
+    fold_stem_kernel + space_to_depth must reproduce it to numerical
+    equality (the transform is exact in exact arithmetic), and the
+    opt-in model must train."""
+    from apex_tpu.models.resnet import fold_stem_kernel, space_to_depth
+
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    w7 = jax.random.normal(jax.random.key(1), (7, 7, 3, 16)) * 0.1
+    ref = jax.lax.conv_general_dilated(
+        x, w7, (2, 2), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = jax.lax.conv_general_dilated(
+        space_to_depth(x, 2), fold_stem_kernel(w7), (1, 1),
+        [(2, 1), (2, 1)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    model = resnet18(num_classes=10, stem_space_to_depth=True)
+    variables = model.init(jax.random.key(2), x, train=False)
+    assert "stem_conv" in variables["params"]
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+
+    def loss_fn(params):
+        out, _ = model.apply(
+            {"params": params,
+             "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        return jnp.mean(out ** 2)
+
+    g = jax.grad(loss_fn)(variables["params"])
+    assert np.isfinite(sum(float(jnp.sum(l))
+                           for l in jax.tree_util.tree_leaves(g)))
+
+
 def test_gpt_single_device_loss_decreases():
     model = GPTModel(vocab_size=64, hidden_size=32, num_heads=4,
                      num_layers=2, max_seq_len=16)
