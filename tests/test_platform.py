@@ -1,0 +1,41 @@
+"""apex_tpu.platform: the backend-override and compile-cache knobs
+every tool (bench.py, tools/*) depends on.  A regression here silently
+turns 'run on CPU' into 'hang claiming the TPU tunnel' — the exact
+failure mode select_platform exists to prevent under sitecustomize
+hooks that override JAX_PLATFORMS."""
+
+import jax
+
+from apex_tpu import platform as plat
+
+
+def _restore(key, value):
+    jax.config.update(key, value)
+
+
+def test_select_platform_env_and_arg(monkeypatch):
+    orig = jax.config.jax_platforms
+    try:
+        monkeypatch.delenv("APEX_TPU_PLATFORM", raising=False)
+        assert plat.select_platform() is None      # env default kept
+        monkeypatch.setenv("APEX_TPU_PLATFORM", "cpu")
+        assert plat.select_platform() == "cpu"     # env honored
+        monkeypatch.setenv("APEX_TPU_PLATFORM", "something-else")
+        assert plat.select_platform("cpu") == "cpu"  # arg beats env
+        assert jax.config.jax_platforms == "cpu"
+    finally:
+        _restore("jax_platforms", orig)
+
+
+def test_enable_compilation_cache_config(monkeypatch):
+    orig_dir = jax.config.jax_compilation_cache_dir
+    orig_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        plat.enable_compilation_cache(min_compile_secs=2.5)
+        assert str(jax.config.jax_compilation_cache_dir).endswith(
+            ".jax_cache")
+        assert (jax.config.jax_persistent_cache_min_compile_time_secs
+                == 2.5)
+    finally:
+        _restore("jax_compilation_cache_dir", orig_dir)
+        _restore("jax_persistent_cache_min_compile_time_secs", orig_min)
