@@ -63,7 +63,21 @@ phases_remaining() {
 fire() {
     log "firing run_tpu_validation.sh (reason: $1, relay=$2)"
     bash tools/run_tpu_validation.sh >> "$ART/validation_run.log" 2>&1
-    log "validation attempt finished rc=$? (see validation_run.log)"
+    local rc=$?
+    log "validation attempt finished rc=$rc (see validation_run.log)"
+    # Window evidence is the scarcest artifact in the project: commit
+    # it the moment an attempt ends, so a container restart between
+    # windows cannot lose it.  Partial attempts are evidence too.
+    if ! git diff --quiet -- tools/artifacts apex_tpu/ops/dispatch_prefs.json 2>/dev/null \
+        || [ -n "$(git status --porcelain tools/artifacts apex_tpu/ops/dispatch_prefs.json 2>/dev/null)" ]; then
+        git add tools/artifacts apex_tpu/ops/dispatch_prefs.json 2>/dev/null
+        # pathspec on the commit: unrelated staged work must not ride
+        # along into the watcher's automatic evidence commit
+        git commit -q \
+            -m "Window artifacts: validation attempt $(ts) rc=$rc (auto-committed by tunnel watcher)" \
+            -- tools/artifacts apex_tpu/ops/dispatch_prefs.json \
+            2>> "$LOG" && log "artifacts committed"
+    fi
 }
 
 log "watcher v2 armed (pid $$): poll=${POLL}s settle=${SETTLE}" \
