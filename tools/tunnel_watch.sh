@@ -67,16 +67,21 @@ fire() {
     log "validation attempt finished rc=$rc (see validation_run.log)"
     # Window evidence is the scarcest artifact in the project: commit
     # it the moment an attempt ends, so a container restart between
-    # windows cannot lose it.  Partial attempts are evidence too.
-    if ! git diff --quiet -- tools/artifacts apex_tpu/ops/dispatch_prefs.json 2>/dev/null \
-        || [ -n "$(git status --porcelain tools/artifacts apex_tpu/ops/dispatch_prefs.json 2>/dev/null)" ]; then
-        git add tools/artifacts apex_tpu/ops/dispatch_prefs.json 2>/dev/null
-        # pathspec on the commit: unrelated staged work must not ride
-        # along into the watcher's automatic evidence commit
-        git commit -q \
-            -m "Window artifacts: validation attempt $(ts) rc=$rc (auto-committed by tunnel watcher)" \
-            -- tools/artifacts apex_tpu/ops/dispatch_prefs.json \
-            2>> "$LOG" && log "artifacts committed"
+    # windows cannot lose it.  Partial attempts are evidence too (and
+    # this log itself is in the pathspec, so there is always something
+    # to commit).  The pathspec on the commit keeps unrelated staged
+    # work out; on failure, unstage the paths so they cannot ride into
+    # someone's NEXT unrelated commit either.
+    git add tools/artifacts apex_tpu/ops/dispatch_prefs.json 2>> "$LOG"
+    if git commit -q \
+        -m "Window artifacts: validation attempt $(ts) rc=$rc (auto-committed by tunnel watcher)" \
+        -- tools/artifacts apex_tpu/ops/dispatch_prefs.json \
+        2>> "$LOG"; then
+        log "artifacts committed"
+    else
+        git reset -q -- tools/artifacts apex_tpu/ops/dispatch_prefs.json \
+            2>> "$LOG"
+        log "artifact commit FAILED (paths unstaged; see stderr above)"
     fi
 }
 
