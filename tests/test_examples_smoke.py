@@ -44,6 +44,16 @@ def test_gpt_block_tiny(capsys):
     assert "step time" in capsys.readouterr().out
 
 
+def test_train_tp_converges(capsys):
+    _run("examples/simple/train_tp.py", [])
+    assert "OK: loss" in capsys.readouterr().out
+
+
+def test_train_ddp_converges(capsys):
+    _run("examples/simple/distributed/train_ddp.py", [])
+    assert "OK: loss" in capsys.readouterr().out
+
+
 def test_train_pp_1f1b_converges(capsys):
     _run("examples/simple/train_pp.py", [])
     assert "OK: loss" in capsys.readouterr().out
