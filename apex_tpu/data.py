@@ -161,3 +161,74 @@ def prefetch_to_device(it: Iterable[Any], depth: int = 2,
                        sharding: Optional[Any] = None):
     """Functional spelling of DevicePrefetcher (flax-utils-style name)."""
     return DevicePrefetcher(it, depth=depth, sharding=sharding)
+
+
+def pack_sequences(sequences, max_len: int, pad_id: int = 0):
+    """Pack variable-length token sequences into fixed (B, max_len)
+    rows for segment-masked attention (the reference's fmha packed
+    varlen contract — apex/contrib/fmha in SURVEY.md §2.3; here the
+    flash kernel's ``segment_ids`` routing does the masking).
+
+    First-fit-decreasing bin packing on the host (numpy).  Returns a
+    dict of (B, max_len) int32 arrays:
+
+    - ``tokens``: packed ids, ``pad_id`` in the tail of each row
+    - ``segment_ids``: 1, 2, ... per packed sequence, 0 on padding —
+      the unpacking key (and the downstream padding mask)
+    - ``q_segment_ids`` / ``kv_segment_ids``: the attention form —
+      pass ``(q_segment_ids, kv_segment_ids)`` to ``flash_attention``.
+      Padding carries DISJOINT ids per side (-1 vs -2, the
+      contrib.fmha convention), so pad rows are fully masked and
+      output exact zeros; real segments never see padding or each
+      other
+    - ``positions``: 0-based position WITHIN each sequence (for RoPE /
+      learned position lookups), 0 on padding
+
+    Sequences longer than ``max_len`` raise — truncation policy is the
+    caller's decision, not a packer default.
+    """
+    import numpy as np
+
+    seqs = [np.asarray(s, dtype=np.int32).reshape(-1) for s in sequences]
+    too_long = [i for i, s in enumerate(seqs) if len(s) > max_len]
+    if too_long:
+        raise ValueError(
+            f"pack_sequences: sequence(s) {too_long[:5]} longer than "
+            f"max_len={max_len}; truncate or split before packing")
+    empty = [i for i, s in enumerate(seqs) if len(s) == 0]
+    if empty:
+        # an empty sequence would silently vanish from the packed
+        # output and desync any caller zipping labels by input index
+        raise ValueError(
+            f"pack_sequences: sequence(s) {empty[:5]} are empty; "
+            f"filter them out (and their labels) before packing")
+
+    order = sorted(range(len(seqs)), key=lambda i: -len(seqs[i]))
+    bins = []          # list of (free, [seq_idx, ...])
+    for i in order:
+        need = len(seqs[i])
+        for b in bins:
+            if b[0] >= need:
+                b[0] -= need
+                b[1].append(i)
+                break
+        else:
+            bins.append([max_len - need, [i]])
+
+    B = len(bins)
+    tokens = np.full((B, max_len), pad_id, dtype=np.int32)
+    segment_ids = np.zeros((B, max_len), dtype=np.int32)
+    positions = np.zeros((B, max_len), dtype=np.int32)
+    for r, (_, members) in enumerate(bins):
+        off = 0
+        for seg, i in enumerate(members, start=1):
+            n = len(seqs[i])
+            tokens[r, off:off + n] = seqs[i]
+            segment_ids[r, off:off + n] = seg
+            positions[r, off:off + n] = np.arange(n)
+            off += n
+    pad = segment_ids == 0
+    return {"tokens": tokens, "segment_ids": segment_ids,
+            "positions": positions,
+            "q_segment_ids": np.where(pad, -1, segment_ids),
+            "kv_segment_ids": np.where(pad, -2, segment_ids)}

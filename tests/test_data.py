@@ -112,3 +112,89 @@ def test_close_wakes_blocked_consumer():
     assert got.get(timeout=5.0) == "stop"
     t.join(timeout=5.0)
     assert not t.is_alive()
+
+
+class TestPackSequences:
+    """pack_sequences + the flash kernel's segment-id routing must
+    together equal per-sequence attention computed separately — the
+    packed-varlen contract (reference: contrib/fmha)."""
+
+    def test_packing_invariants(self):
+        from apex_tpu.data import pack_sequences
+
+        rng = np.random.default_rng(0)
+        lens = [7, 3, 9, 1, 5, 5, 2, 8]
+        seqs = [rng.integers(1, 100, size=n) for n in lens]
+        out = pack_sequences(seqs, max_len=16, pad_id=0)
+        toks, segs, pos = (out["tokens"], out["segment_ids"],
+                           out["positions"])
+        assert toks.shape == segs.shape == pos.shape
+        assert toks.shape[1] == 16
+        # every token survives, grouped contiguously, positions 0..n-1
+        seen = []
+        for r in range(toks.shape[0]):
+            for seg in range(1, int(segs[r].max()) + 1):
+                m = segs[r] == seg
+                assert m.sum() > 0
+                idx = np.flatnonzero(m)
+                assert (np.diff(idx) == 1).all()          # contiguous
+                np.testing.assert_array_equal(
+                    pos[r, idx], np.arange(len(idx)))
+                seen.append(toks[r, idx].tolist())
+        assert sorted(map(tuple, seen)) == sorted(
+            tuple(s.tolist()) for s in seqs)
+        # padding is segment 0, pad_id, position 0
+        padm = segs == 0
+        assert (toks[padm] == 0).all() and (pos[padm] == 0).all()
+
+    def test_too_long_or_empty_raises(self):
+        from apex_tpu.data import pack_sequences
+        with pytest.raises(ValueError, match="longer than"):
+            pack_sequences([list(range(20))], max_len=16)
+        with pytest.raises(ValueError, match="empty"):
+            pack_sequences([[1, 2], []], max_len=16)
+
+    def test_packed_attention_matches_per_sequence(self):
+        from apex_tpu.data import pack_sequences
+        from apex_tpu.ops.attention import flash_attention
+
+        rng = np.random.default_rng(1)
+        lens = [48, 31, 17, 64, 9]
+        d, L = 32, 128
+        # per-sequence q/k/v derived deterministically from token ids so
+        # the packed and unpacked paths see identical values
+        seqs = [rng.integers(1, 50, size=n) for n in lens]
+        packed = pack_sequences(seqs, max_len=L, pad_id=0)
+        qids = jnp.asarray(packed["q_segment_ids"])
+        kvids = jnp.asarray(packed["kv_segment_ids"])
+        B = qids.shape[0]
+
+        def feats(tok_row):  # (L,) -> (1, 1, L, d)
+            base = jnp.asarray(tok_row, jnp.float32)[:, None]
+            ang = base * (jnp.arange(d, dtype=jnp.float32)[None] + 1.0)
+            return (jnp.stack([jnp.sin(ang), jnp.cos(ang)],
+                              -1).reshape(len(tok_row), 2 * d)
+                    [:, :d][None, None] * 0.3)
+
+        q = jnp.concatenate([feats(packed["tokens"][r]) for r in
+                             range(B)], axis=0)
+        o_packed = flash_attention(q, q, q, causal=False,
+                                   segment_ids=(qids, kvids))
+        for r in range(B):
+            for seg in range(1, int(np.max(packed["segment_ids"][r]))
+                             + 1):
+                idx = np.flatnonzero(packed["segment_ids"][r] == seg)
+                qs = q[r:r + 1, :, idx, :]
+                o_ref = flash_attention(qs, qs, qs, causal=False)
+                np.testing.assert_allclose(
+                    np.asarray(o_packed[r:r + 1, :, idx, :],
+                               np.float32),
+                    np.asarray(o_ref, np.float32), rtol=2e-5,
+                    atol=2e-5)
+        # disjoint pad ids per side (-1 vs -2, the contrib.fmha
+        # convention): pad rows are fully masked and output EXACT
+        # zeros — no downstream masking needed
+        padm = np.asarray(packed["segment_ids"]) == 0
+        assert (np.asarray(o_packed, np.float32)
+                [np.broadcast_to(padm[:, None, :, None],
+                                 o_packed.shape)] == 0).all()
