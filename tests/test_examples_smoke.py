@@ -37,6 +37,13 @@ def test_dcgan_two_scalers(capsys):
 
 
 @pytest.mark.slow
+def test_bert_pretrain_mlm_tiny(capsys):
+    _run("examples/bert/pretrain_mlm.py",
+         ["--cpu", "--steps", "2"])
+    assert "step time" in capsys.readouterr().out
+
+
+@pytest.mark.slow
 def test_gpt_block_tiny(capsys):
     _run("examples/gpt/train_block.py",
          ["--cpu", "--steps", "2", "--layers", "1", "--hidden", "64",
