@@ -58,7 +58,6 @@ def _bf16_peak():
     return None
 
 
-
 def _mfu(flops, step_s, on_tpu):
     if not (flops and on_tpu):
         return None
