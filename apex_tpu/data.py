@@ -227,8 +227,8 @@ def pack_sequences(sequences, max_len: int, pad_id: int = 0):
             segment_ids[r, off:off + n] = seg
             positions[r, off:off + n] = np.arange(n)
             off += n
-    pad = segment_ids == 0
+    from apex_tpu.ops.attention import packed_segment_ids
+    q_ids, kv_ids = packed_segment_ids(segment_ids, xp=np)
     return {"tokens": tokens, "segment_ids": segment_ids,
             "positions": positions,
-            "q_segment_ids": np.where(pad, -1, segment_ids),
-            "kv_segment_ids": np.where(pad, -2, segment_ids)}
+            "q_segment_ids": q_ids, "kv_segment_ids": kv_ids}

@@ -31,7 +31,8 @@ from jax.ad_checkpoint import checkpoint_name
 
 from apex_tpu import comm
 from apex_tpu.normalization import FusedLayerNorm
-from apex_tpu.ops.attention import (flash_attention, ring_attention,
+from apex_tpu.ops.attention import (flash_attention,
+                                    packed_segment_ids, ring_attention,
                                     ulysses_attention)
 from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
 from apex_tpu.transformer import tensor_parallel as tp
@@ -50,8 +51,26 @@ class GPTLayer(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
-        """x: (s[, /tp if SP], b, h) -> same shape."""
+    def __call__(self, x, segment_ids=None, positions=None):
+        """x: (s[, /tp if SP], b, h) -> same shape.
+
+        segment_ids (b, s) / positions (b, s): packed-batch form
+        (apex_tpu.data.pack_sequences) — attention masks across
+        segments (disjoint padding ids per side, so padding rows
+        output zeros) and RoPE rotates by within-sequence positions.
+        BOTH or NEITHER: one-sided packing silently corrupts the
+        other half (unmasked cross-segment attention, or every
+        non-first segment rotated by its row offset).  Unsupported
+        together with context_parallel (a packed row's segments would
+        straddle ctx shards)."""
+        if (segment_ids is None) != (positions is None):
+            raise ValueError(
+                "packed batches need BOTH segment_ids and positions "
+                "(apex_tpu.data.pack_sequences emits both)")
+        if segment_ids is not None and self.context_parallel:
+            raise NotImplementedError(
+                "packed segment_ids with context_parallel: split "
+                "sequences across rows instead of packing, or drop cp")
         h = self.hidden_size
         ffn = self.ffn_hidden_size or 4 * h
         tp_size = comm.model_parallel_size()
@@ -94,15 +113,24 @@ class GPTLayer(nn.Module):
         if self.use_rope:
             inv = 1.0 / (10000.0 ** (
                 jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-            pos = jnp.arange(s_full, dtype=jnp.float32)
-            if self.context_parallel:
-                # positions are GLOBAL: offset by this ctx shard's start
-                # (mirrors ring_attention's qpos computation)
-                pos = pos + (jax.lax.axis_index(comm.AXIS_CTX)
-                             * s_full).astype(jnp.float32)
-            freqs = jnp.einsum("s,d->sd", pos, inv)
-            freqs = jnp.concatenate([freqs, freqs], axis=-1)
-            freqs = freqs[:, None, None, :]
+            if positions is not None:
+                # packed: within-sequence positions, per row ->
+                # freqs (s, b, 1, d) broadcasting over heads
+                pos = jnp.transpose(positions, (1, 0)).astype(
+                    jnp.float32)                        # (s, b)
+                freqs = jnp.einsum("sb,d->sbd", pos, inv)
+                freqs = jnp.concatenate([freqs, freqs], axis=-1)
+                freqs = freqs[:, :, None, :]
+            else:
+                pos = jnp.arange(s_full, dtype=jnp.float32)
+                if self.context_parallel:
+                    # positions are GLOBAL: offset by this ctx
+                    # shard's start (mirrors ring_attention's qpos)
+                    pos = pos + (jax.lax.axis_index(comm.AXIS_CTX)
+                                 * s_full).astype(jnp.float32)
+                freqs = jnp.einsum("s,d->sd", pos, inv)
+                freqs = jnp.concatenate([freqs, freqs], axis=-1)
+                freqs = freqs[:, None, None, :]
             # rope expects (s, b, heads, d)
             def rope(t):
                 t_sbhd = jnp.transpose(t, (2, 0, 1, 3))
@@ -118,6 +146,13 @@ class GPTLayer(nn.Module):
                 raise ValueError(
                     f"cp_strategy must be 'ring' or 'ulysses', got "
                     f"{self.cp_strategy!r}")
+        elif segment_ids is not None:
+            # disjoint pad ids per side (-1/-2): pad rows attend
+            # nowhere and output exact zeros — convention single-
+            # sourced in ops.attention.packed_segment_ids
+            attn = flash_attention(q, k, v, causal=True,
+                                   segment_ids=packed_segment_ids(
+                                       segment_ids))
         else:
             attn = flash_attention(q, k, v, causal=True)
         attn = jnp.transpose(attn, (2, 0, 1, 3)).reshape(
@@ -147,13 +182,14 @@ class GPTStage(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None, positions=None):
         for i in range(self.num_layers):
             x = GPTLayer(self.hidden_size, self.num_heads,
                          self.ffn_hidden_size,
                          sequence_parallel=self.sequence_parallel,
                          use_rope=self.use_rope, dtype=self.dtype,
-                         name=f"layer_{i}")(x)
+                         name=f"layer_{i}")(x, segment_ids=segment_ids,
+                                            positions=positions)
         return x
 
 
@@ -175,8 +211,22 @@ class GPTModel(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, segment_ids=None, positions=None):
+        """tokens (b, s) -> vocab-parallel logits (s, b, V/tp).
+
+        segment_ids / positions (both (b, s)): packed-batch training
+        (apex_tpu.data.pack_sequences) — position lookups use the
+        within-sequence positions and attention is segment-masked;
+        pad rows (segment 0) produce garbage logits to be masked in
+        the loss (e.g. padding_idx labels)."""
         b, s = tokens.shape
+        if positions is not None and s > self.max_seq_len:
+            # the unpacked path fails loudly via broadcast shape
+            # mismatch; the gather path would silently CLAMP
+            # out-of-range positions to the table's last row
+            raise ValueError(
+                f"packed rows of length {s} exceed max_seq_len="
+                f"{self.max_seq_len}; pack at max_len <= max_seq_len")
         embed = tp.VocabParallelEmbedding(self.vocab_size,
                                           self.hidden_size, name="embed")
         x = embed(tokens)                              # (b, s, h)
@@ -185,7 +235,8 @@ class GPTModel(nn.Module):
                              nn.initializers.normal(0.02),
                              (self.max_seq_len, self.hidden_size),
                              jnp.float32)
-            x = x + pos[:s][None, :, :]
+            x = x + (pos[positions] if positions is not None
+                     else pos[:s][None, :, :])
         x = jnp.transpose(x, (1, 0, 2))                # (s, b, h)
         if self.sequence_parallel:
             x = mappings.scatter_to_sequence_parallel_region(x)
@@ -195,7 +246,8 @@ class GPTModel(nn.Module):
                          self.ffn_hidden_size,
                          sequence_parallel=self.sequence_parallel,
                          use_rope=self.use_rope, dtype=self.dtype,
-                         name=f"layer_{i}")(x)
+                         name=f"layer_{i}")(x, segment_ids=segment_ids,
+                                            positions=positions)
         # The head's d/dx from the LOCAL vocab shard is a partial sum
         # over tp ranks; exactly ONE f-mapping must sync it (Megatron's
         # parallel_lm_logits layout).  Under SP that role is played by
@@ -216,8 +268,18 @@ class GPTModel(nn.Module):
                          preferred_element_type=jnp.float32)
         return logits                                  # (s, b, V/tp) f32
 
-    def loss(self, variables, tokens, labels):
-        logits = self.apply(variables, tokens)         # (s, b, V/tp)
+    def loss(self, variables, tokens, labels, segment_ids=None,
+             positions=None):
+        """Mean CE; with packed inputs, padding positions
+        (segment 0) are excluded from the mean — their logits are
+        garbage by contract."""
+        logits = self.apply(variables, tokens,
+                            segment_ids=segment_ids,
+                            positions=positions)       # (s, b, V/tp)
         labels_sb = jnp.transpose(labels, (1, 0))      # (s, b)
         per_tok = tp.vocab_parallel_cross_entropy(logits, labels_sb)
-        return jnp.mean(per_tok)
+        if segment_ids is None:
+            return jnp.mean(per_tok)
+        keep = jnp.transpose(segment_ids > 0, (1, 0))  # (s, b)
+        return (jnp.sum(per_tok * keep)
+                / jnp.maximum(jnp.sum(keep), 1))

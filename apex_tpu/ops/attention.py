@@ -744,6 +744,18 @@ def _dense_fallback_fits(q_shape, k_shape) -> bool:
     return b * h * sq * sk <= budget
 
 
+def packed_segment_ids(segment_ids, xp=jnp):
+    """(q_ids, kv_ids) for a packed batch's base segment array
+    ((B, S); 1.. per sequence, 0 on padding — the
+    apex_tpu.data.pack_sequences form).  Padding gets DISJOINT ids per
+    side (-1 on q, -2 on kv, the contrib.fmha convention) so pad rows
+    attend nowhere and output exact zeros.  The single home of that
+    convention — data.pack_sequences (xp=numpy, host side) and the
+    GPT packed path (traced) both derive from here."""
+    return (xp.where(segment_ids > 0, segment_ids, -1),
+            xp.where(segment_ids > 0, segment_ids, -2))
+
+
 def flash_attention(q, k, v, causal=False, scale=None,
                     segment_ids: Optional[Tuple[jax.Array,
                                                 jax.Array]] = None,

@@ -92,6 +92,66 @@ def test_resnet_space_to_depth_stem_equals_7x7():
                            for l in jax.tree_util.tree_leaves(g)))
 
 
+@pytest.mark.parametrize("use_rope", [False, True])
+def test_gpt_packed_batch_matches_per_sequence(use_rope):
+    """Packed-batch GPT (segment-masked attention + within-sequence
+    positions, apex_tpu.data.pack_sequences form) must produce, for
+    every packed sequence, exactly the logits of running that sequence
+    alone — the packed-pretraining contract."""
+    from apex_tpu.data import pack_sequences
+
+    model = GPTModel(vocab_size=64, hidden_size=32, num_heads=4,
+                     num_layers=2, max_seq_len=64, use_rope=use_rope)
+    rng = np.random.default_rng(3)
+    seqs = [rng.integers(1, 64, size=n) for n in (17, 9, 23, 5)]
+    packed = pack_sequences(seqs, max_len=32, pad_id=0)
+    tokens = jnp.asarray(packed["tokens"])
+    variables = model.init(jax.random.key(0), tokens)
+
+    logits = model.apply(
+        variables, tokens,
+        segment_ids=jnp.asarray(packed["segment_ids"]),
+        positions=jnp.asarray(packed["positions"]))     # (s, b, V)
+
+    for r in range(tokens.shape[0]):
+        segs = packed["segment_ids"][r]
+        for seg in range(1, int(segs.max()) + 1):
+            idx = np.flatnonzero(segs == seg)
+            alone = model.apply(
+                variables, tokens[r:r + 1, idx])        # (n, 1, V)
+            np.testing.assert_allclose(
+                np.asarray(logits[idx, r, :], np.float32),
+                np.asarray(alone[:, 0, :], np.float32),
+                rtol=2e-4, atol=2e-4)
+
+    # one-sided packing is a silent-corruption trap: rejected loudly
+    with pytest.raises(ValueError, match="BOTH segment_ids"):
+        model.apply(variables, tokens,
+                    segment_ids=jnp.asarray(packed["segment_ids"]))
+    # packed loss masks padding and forwards the packing args
+    labels = jnp.asarray(np.roll(packed["tokens"], -1, axis=1))
+    loss_val = model.loss(variables, tokens, labels,
+                          segment_ids=jnp.asarray(
+                              packed["segment_ids"]),
+                          positions=jnp.asarray(packed["positions"]))
+    assert np.isfinite(float(loss_val))
+
+
+def test_gpt_packed_rejects_overlong_rows():
+    """Learned-position models: the position gather would silently
+    CLAMP out-of-range indices; the packed path must fail loudly when
+    rows exceed max_seq_len."""
+    model = GPTModel(vocab_size=64, hidden_size=32, num_heads=4,
+                     num_layers=1, max_seq_len=16)
+    tokens = jnp.ones((1, 32), jnp.int32)
+    variables = model.init(jax.random.key(0), jnp.ones((1, 8),
+                                                       jnp.int32))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.apply(variables, tokens,
+                    segment_ids=jnp.ones((1, 32), jnp.int32),
+                    positions=jnp.zeros((1, 32), jnp.int32))
+
+
 def test_gpt_single_device_loss_decreases():
     model = GPTModel(vocab_size=64, hidden_size=32, num_heads=4,
                      num_layers=2, max_seq_len=16)
