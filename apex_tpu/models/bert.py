@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from apex_tpu import comm
 from apex_tpu.normalization import FusedLayerNorm
-from apex_tpu.ops.attention import attention_ref, flash_attention
+from apex_tpu.ops.attention import (attention_ref, flash_attention,
+                                    packed_segment_ids)
 from apex_tpu.transformer import tensor_parallel as tp
 
 
@@ -31,8 +32,16 @@ class BertLayer(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, attn_mask=None):
-        """x: (s, b, h); attn_mask: additive (b, 1, s, s) or None."""
+    def __call__(self, x, attn_mask=None, segment_ids=None):
+        """x: (s, b, h); attn_mask: additive (b, 1, s, s) or None;
+        segment_ids: (b, s) packed-batch form
+        (apex_tpu.data.pack_sequences) routed through the flash
+        kernel's segment masking — mutually exclusive with
+        attn_mask."""
+        if attn_mask is not None and segment_ids is not None:
+            raise ValueError(
+                "pass attn_mask OR segment_ids, not both (packed "
+                "batches carry their mask in the segment ids)")
         h = self.hidden_size
         ffn = self.ffn_hidden_size or 4 * h
         tp_size = comm.model_parallel_size()
@@ -65,7 +74,11 @@ class BertLayer(nn.Module):
         y = y.reshape(s_full, b, local_heads, 3 * head_dim)
         q, k, v = jnp.split(y, 3, axis=-1)
         q, k, v = (jnp.transpose(t, (1, 2, 0, 3)) for t in (q, k, v))
-        if attn_mask is None:
+        if segment_ids is not None:
+            attn = flash_attention(q, k, v, False,
+                                   segment_ids=packed_segment_ids(
+                                       segment_ids))
+        elif attn_mask is None:
             attn = flash_attention(q, k, v, False)
         else:
             attn = attention_ref(q, k, v, mask=attn_mask)
@@ -88,15 +101,36 @@ class BertModel(nn.Module):
     sequence_parallel: bool = False
 
     @nn.compact
-    def __call__(self, tokens, token_type_ids=None, attention_mask=None):
-        """tokens: (b, s) -> sequence output (s, b, h)."""
+    def __call__(self, tokens, token_type_ids=None, attention_mask=None,
+                 segment_ids=None, positions=None):
+        """tokens: (b, s) -> sequence output (s, b, h).
+
+        segment_ids / positions (both (b, s)): packed-batch form
+        (apex_tpu.data.pack_sequences) — BOTH or NEITHER; position
+        lookups use within-sequence positions and attention is
+        segment-masked (pad rows garbage, mask downstream via
+        segment_ids == 0).  Mutually exclusive with attention_mask.
+        NOTE: BERT "token type" (sentence A/B) ids remain
+        token_type_ids — unrelated to packing segment ids."""
+        if (segment_ids is None) != (positions is None):
+            raise ValueError(
+                "packed batches need BOTH segment_ids and positions "
+                "(apex_tpu.data.pack_sequences emits both)")
+        if segment_ids is not None and attention_mask is not None:
+            raise ValueError(
+                "pass attention_mask OR segment_ids, not both")
         b, s = tokens.shape
+        if positions is not None and s > self.max_seq_len:
+            raise ValueError(
+                f"packed rows of length {s} exceed max_seq_len="
+                f"{self.max_seq_len}; pack at max_len <= max_seq_len")
         embed = tp.VocabParallelEmbedding(self.vocab_size,
                                           self.hidden_size, name="embed")
         x = embed(tokens)
         pos = self.param("pos_embedding", nn.initializers.normal(0.02),
                          (self.max_seq_len, self.hidden_size), jnp.float32)
-        x = x + pos[:s][None, :, :]
+        x = x + (pos[positions] if positions is not None
+                 else pos[:s][None, :, :])
         if token_type_ids is not None:
             seg = self.param("segment_embedding",
                              nn.initializers.normal(0.02),
@@ -116,7 +150,8 @@ class BertModel(nn.Module):
         for i in range(self.num_layers):
             x = BertLayer(self.hidden_size, self.num_heads,
                           sequence_parallel=self.sequence_parallel,
-                          dtype=self.dtype, name=f"layer_{i}")(x, mask)
+                          dtype=self.dtype, name=f"layer_{i}")(
+                x, mask, segment_ids=segment_ids)
         if self.sequence_parallel:
             x = tp.gather_from_sequence_parallel_region(x)
         return x
