@@ -39,6 +39,11 @@ def parse_args():
     p.add_argument("--weight-decay", type=float, default=0.01)
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (see apex_tpu.platform)")
+    p.add_argument("--packed", action="store_true",
+                   help="pack a varlen synthetic corpus into fixed "
+                        "rows (apex_tpu.data.pack_sequences): "
+                        "segment-masked attention, per-sequence "
+                        "positions, padding excluded from the loss")
     p.add_argument("--offload-state", action="store_true",
                    help="keep LAMB state in pinned host memory "
                         "(apex_tpu.offload)")
@@ -72,28 +77,59 @@ def main():
                     masters=amp_state.master_params,
                     offload_state=args.offload_state)
 
-    def loss_fn(p, tokens, labels):
-        logits = model.mlm_logits({"params": p}, tokens)   # (s,b,V) f32
+    def loss_fn(p, tokens, labels, segment_ids=None, positions=None):
+        logits = model.mlm_logits({"params": p}, tokens,
+                                  segment_ids=segment_ids,
+                                  positions=positions)     # (s,b,V) f32
         flat = logits.transpose(1, 0, 2).reshape(-1, vocab)
+        # padding_idx labels (-1 on packed padding) drop out of the CE
         losses = softmax_cross_entropy_loss(
             flat, labels.reshape(-1), smoothing=0.0, padding_idx=-1)
-        return jnp.mean(losses)
+        n = jnp.maximum(jnp.sum(labels.reshape(-1) != -1), 1)
+        return jnp.sum(losses) / n
 
     wrapped = amp_state.wrap_forward(loss_fn, cast_argnums=())
 
     @jax.jit
-    def step(p, scaler, tokens, labels):
+    def step(p, scaler, tokens, labels, segment_ids=None,
+             positions=None):
         return amp.scaled_value_and_grad(wrapped, scaler, p, tokens,
-                                         labels)
+                                         labels,
+                                         segment_ids=segment_ids,
+                                         positions=positions)
 
     # ONE fixed synthetic batch: overfitting it makes the descent
     # visible (fresh random labels would just sit at uniform entropy)
-    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, vocab)
-    labels = jax.random.randint(jax.random.key(2), (batch, seq), 0, vocab)
+    pack_kw = {}
+    if args.packed:
+        import numpy as _np
+
+        from apex_tpu.data import pack_sequences
+        rng = _np.random.default_rng(1)
+        lens = rng.integers(seq // 4, seq, size=2 * batch)
+        packed = pack_sequences(
+            [rng.integers(1, vocab, size=n) for n in lens], max_len=seq)
+        tokens = jnp.asarray(packed["tokens"])[:batch]
+        segs = _np.asarray(packed["segment_ids"])[:batch]
+        labels = _np.array(rng.integers(0, vocab,
+                                        size=tokens.shape))
+        labels[segs == 0] = -1           # padding out of the loss
+        labels = jnp.asarray(labels)
+        pack_kw = {"segment_ids": jnp.asarray(segs),
+                   "positions": jnp.asarray(
+                       packed["positions"])[:batch]}
+        frac = float((segs > 0).mean())
+        print(f"packed {len(lens)} varlen seqs -> "
+              f"{tokens.shape[0]} rows, {frac:.0%} tokens real")
+    else:
+        tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                    vocab)
+        labels = jax.random.randint(jax.random.key(2), (batch, seq), 0,
+                                    vocab)
     t0 = None
     for i in range(args.steps):
         loss, grads, found_inf = step(opt.params, amp_state.scaler,
-                                      tokens, labels)
+                                      tokens, labels, **pack_kw)
         if int(found_inf) == 0:
             opt.step(grads)
         amp_state = amp.update_scaler(amp_state, found_inf)

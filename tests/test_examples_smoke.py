@@ -44,6 +44,14 @@ def test_bert_pretrain_mlm_tiny(capsys):
 
 
 @pytest.mark.slow
+def test_bert_pretrain_mlm_packed(capsys):
+    _run("examples/bert/pretrain_mlm.py",
+         ["--cpu", "--steps", "2", "--packed"])
+    out = capsys.readouterr().out
+    assert "packed" in out and "step time" in out
+
+
+@pytest.mark.slow
 def test_gpt_block_tiny(capsys):
     _run("examples/gpt/train_block.py",
          ["--cpu", "--steps", "2", "--layers", "1", "--hidden", "64",
