@@ -119,7 +119,8 @@ def main():
                    "positions": jnp.asarray(
                        packed["positions"])[:batch]}
         frac = float((segs > 0).mean())
-        print(f"packed {len(lens)} varlen seqs -> "
+        kept = sum(len(_np.unique(r[r > 0])) for r in segs)
+        print(f"packed: kept {kept} of {len(lens)} varlen seqs in "
               f"{tokens.shape[0]} rows, {frac:.0%} tokens real")
     else:
         tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
@@ -142,8 +143,11 @@ def main():
     jax.block_until_ready(opt.params)
     if t0 and args.steps > 1:
         dt = (time.time() - t0) / (args.steps - 1)
+        # packed rows contain padding: count REAL tokens only, so the
+        # packed and unpacked numbers compare honestly
+        real = tokens.shape[0] * seq * (frac if args.packed else 1.0)
         print(f"step time {dt*1e3:.1f} ms  "
-              f"({batch*seq/dt:.0f} tokens/sec)")
+              f"({real/dt:.0f} tokens/sec)")
 
 
 if __name__ == "__main__":
