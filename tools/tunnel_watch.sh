@@ -28,7 +28,10 @@ LOG="$ART/tunnel_watch.log"
 
 POLL=20           # seconds between passive ss polls
 SETTLE=6          # consecutive polls listeners must persist (~2 min)
-RETRY_QUIET=7200  # same-relay retry period (one patient attempt/2h)
+RETRY_QUIET=3600  # same-relay retry period: a retry is probe-free and
+                  # resolves to a clean exit if no session is granted,
+                  # so the cost of retrying hourly is small next to the
+                  # cost of sitting out a live window
 
 BASELINE_RE=':(48271|2024)$'
 
