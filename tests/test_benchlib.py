@@ -100,3 +100,28 @@ def test_int_only_args_still_loop():
     g = benchlib.loop_on_device(lambda a: a * 2, 3)
     (ox,) = g(x)
     np.testing.assert_array_equal(np.asarray(ox), np.asarray(x))
+
+
+def test_chunked_train_bench_threads_state():
+    """The chunked loop must run step_fn chunk*n_chunks times with the
+    carry threaded exactly like a Python loop (same final state), and
+    report a positive per-step time."""
+    def step_fn(state, step, lr):
+        w, loss = state
+        w = w - lr * (w - 3.0)
+        return (w, jnp.mean(w))
+
+    w0 = jnp.full((8,), 10.0)
+    lr = jnp.float32(0.5)
+    r = benchlib.chunked_train_bench(
+        step_fn, (w0, jnp.float32(0)), (lr,), steps=6, chunk=3,
+        want_flops=False)
+    assert r["step_ms"] > 0
+    assert r["steps_per_dispatch"] == 3
+    assert r["flops_per_step"] is None
+    # warmup chunk + 2 timed chunks = 9 steps total
+    w_ref = np.full((8,), 10.0, np.float32)
+    for _ in range(9):
+        w_ref = w_ref - 0.5 * (w_ref - 3.0)
+    np.testing.assert_allclose(np.asarray(r["state"][0]), w_ref,
+                               rtol=1e-6)
