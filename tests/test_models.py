@@ -230,6 +230,58 @@ def test_gpt_tp_matches_tp1(sequence_parallel):
 
 
 @pytest.mark.parametrize("sequence_parallel", [False, True])
+def test_gpt_packed_tp_matches_tp1(sequence_parallel):
+    """Packed batches under tp=4 (+SP) == the tp=1 packed run with the
+    same weights: the segment mask and per-sequence positions must
+    survive the Megatron sharding (attention sees the gathered full
+    sequence under SP, so the full-length (b, s) packing arrays apply
+    unchanged)."""
+    from apex_tpu.data import pack_sequences
+
+    V, H, NH, L, S = 64, 32, 4, 2, 16
+    rng = np.random.default_rng(9)
+    packed = pack_sequences(
+        [rng.integers(1, V, size=n) for n in (9, 6, 11, 4)],
+        max_len=S)
+    tokens = jnp.asarray(packed["tokens"])
+    segs = jnp.asarray(packed["segment_ids"])
+    pos = jnp.asarray(packed["positions"])
+    labels = jnp.asarray(
+        np.where(packed["segment_ids"] > 0,
+                 np.roll(packed["tokens"], -1, axis=1), 0))
+
+    comm.initialize(data=8)
+    probe = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                     num_layers=L, max_seq_len=S)
+    shape = jax.eval_shape(probe.init, jax.random.key(1), tokens)
+    specs = jax.tree_util.tree_map_with_path(_megatron_spec_for, shape)
+    comm.destroy()
+
+    mesh = comm.initialize(data=2, model=4)
+    model = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                     num_layers=L, max_seq_len=S,
+                     sequence_parallel=sequence_parallel)
+    variables = jax.jit(comm.shard_map(
+        lambda key, tok: model.init(key, tok), mesh,
+        in_specs=(P(), P()), out_specs=specs))(
+        jax.random.key(1), tokens)
+    loss_tp = jax.jit(comm.shard_map(
+        lambda v, t, l, s_, p_: model.loss(v, t, l, segment_ids=s_,
+                                           positions=p_),
+        mesh, in_specs=(specs, P(), P(), P(), P()), out_specs=P()))(
+        variables, tokens, labels, segs, pos)
+
+    comm.destroy()
+    comm.initialize(data=8)
+    model1 = GPTModel(vocab_size=V, hidden_size=H, num_heads=NH,
+                      num_layers=L, max_seq_len=S)
+    loss_ref = model1.loss(variables, tokens, labels,
+                           segment_ids=segs, positions=pos)
+    np.testing.assert_allclose(float(loss_tp), float(loss_ref),
+                               rtol=2e-4)
+
+
+@pytest.mark.parametrize("sequence_parallel", [False, True])
 def test_bert_tp_matches_tp1(sequence_parallel):
     """BERT under tp=4 (+SP scatter/gather) == same weights at tp=1."""
     V, H, NH, L, S, B = 64, 32, 4, 2, 16, 2
