@@ -48,6 +48,9 @@ SLOW_TESTS = {
     "test_models.py::test_gpt_single_device_loss_decreases",
     "test_models.py::test_resnet18_forward_and_train_step",
     "test_models.py::test_gpt_tp_matches_tp1",
+    "test_models.py::test_gpt_packed_tp_matches_tp1",
+    "test_models.py::test_gpt_packed_batch_matches_per_sequence",
+    "test_models.py::test_bert_packed_batch_matches_per_sequence",
     "test_models.py::test_gpt_tp_GRADS_match_tp1",
     "test_models.py::test_bert_tp_GRADS_match_tp1",
     "test_models.py::test_4d_assembly_grads_match_single_device",
