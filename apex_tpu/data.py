@@ -232,3 +232,78 @@ def pack_sequences(sequences, max_len: int, pad_id: int = 0):
     return {"tokens": tokens, "segment_ids": segment_ids,
             "positions": positions,
             "q_segment_ids": q_ids, "kv_segment_ids": kv_ids}
+
+
+def pack_dataset(sequences, max_len: int, rows_per_batch: int,
+                 pad_id: int = 0, buffer_batches: int = 8):
+    """Stream packed batches from an iterable of token sequences.
+
+    Buffers ``rows_per_batch * buffer_batches`` sequences, packs the
+    buffer with :func:`pack_sequences` (FFD packs best with many
+    candidates), and yields dicts shaped exactly like its output but
+    with EXACTLY ``rows_per_batch`` rows per batch — fixed shapes, so
+    one jit compilation serves the whole stream and the result feeds
+    :class:`DevicePrefetcher` directly::
+
+        batches = pack_dataset(corpus_iter, max_len=2048,
+                               rows_per_batch=8)
+        for batch in prefetch_to_device(batches, depth=2):
+            step(params, batch["tokens"], batch["segment_ids"], ...)
+
+    Rows left over when a buffer doesn't fill a whole batch are
+    unpacked back into the carry (no mid-stream padding waste); only
+    the stream's FINAL partial batch is padded with all-padding rows
+    (segment 0 everywhere — downstream loss masking by
+    ``segment_ids == 0`` already ignores them).  Sequences longer than
+    ``max_len`` or empty raise, as in pack_sequences.
+    """
+    import numpy as np
+
+    from apex_tpu.ops.attention import packed_segment_ids
+
+    # pad-row fills: segment 0 + the q/kv ids the single-home helper
+    # assigns to padding (never hardcode the -1/-2 convention here)
+    _qpad, _kvpad = packed_segment_ids(np.zeros((), np.int32), xp=np)
+    pad_fill = {"tokens": pad_id, "segment_ids": 0, "positions": 0,
+                "q_segment_ids": int(_qpad), "kv_segment_ids": int(_kvpad)}
+
+    def chunks(buf, final):
+        """Yield full batches; return leftover sequences (or pad out
+        the last batch when final)."""
+        packed = pack_sequences(buf, max_len, pad_id=pad_id)
+        rows = packed["tokens"].shape[0]
+        full = rows - rows % rows_per_batch
+        for start in range(0, full, rows_per_batch):
+            yield {k: v[start:start + rows_per_batch]
+                   for k, v in packed.items()}
+        leftover = []
+        if rows != full:
+            tail = {k: v[full:] for k, v in packed.items()}
+            if final:
+                short = rows_per_batch - tail["tokens"].shape[0]
+                yield {k: np.concatenate(
+                    [v, np.full((short, max_len), pad_fill[k],
+                                dtype=v.dtype)], axis=0)
+                    for k, v in tail.items()}
+            else:
+                segs, toks = tail["segment_ids"], tail["tokens"]
+                for r in range(toks.shape[0]):
+                    for seg in range(1, int(segs[r].max()) + 1):
+                        leftover.append(toks[r][segs[r] == seg])
+        return leftover
+
+    # flush by TOKEN count, not sequence count: tokens >= threshold
+    # guarantees >= rows_per_batch * buffer_batches bins, so at least
+    # one FULL batch is emitted per flush and the carry always shrinks
+    # below a batch's worth (sequence-count flushing degraded to a
+    # full repack per input sequence for short sequences)
+    buf, toks = [], 0
+    threshold = rows_per_batch * buffer_batches * max_len
+    for s in sequences:
+        buf.append(s)
+        toks += len(s)
+        if toks >= threshold:
+            buf = yield from chunks(buf, final=False)
+            toks = sum(len(x) for x in buf)
+    if buf:
+        yield from chunks(buf, final=True)

@@ -234,3 +234,47 @@ class TestPackSequences:
         assert (np.asarray(o_packed, np.float32)
                 [np.broadcast_to(padm[:, None, :, None],
                                  o_packed.shape)] == 0).all()
+
+
+class TestPackDataset:
+    """Streaming packer: fixed batch shapes, every token exactly once,
+    padding only in the final batch."""
+
+    def test_stream_invariants(self):
+        from apex_tpu.data import pack_dataset
+
+        rng = np.random.default_rng(11)
+        lens = rng.integers(1, 33, size=137)
+        seqs = [rng.integers(1, 1000, size=n) for n in lens]
+        batches = list(pack_dataset(iter(seqs), max_len=32,
+                                    rows_per_batch=4,
+                                    buffer_batches=3))
+        assert batches, "no batches emitted"
+        recovered = []
+        for i, b in enumerate(batches):
+            assert b["tokens"].shape == (4, 32)
+            assert set(b) == {"tokens", "segment_ids", "positions",
+                              "q_segment_ids", "kv_segment_ids"}
+            all_pad_rows = (b["segment_ids"] == 0).all(axis=1)
+            if all_pad_rows.any():
+                # padding rows only in the FINAL batch, only at the end
+                assert i == len(batches) - 1
+            for r in range(4):
+                segs = b["segment_ids"][r]
+                for seg in range(1, int(segs.max(initial=0)) + 1):
+                    recovered.append(
+                        tuple(b["tokens"][r][segs == seg]))
+        assert sorted(recovered) == sorted(
+            tuple(s.tolist()) for s in seqs)
+
+    def test_small_stream_single_padded_batch(self):
+        from apex_tpu.data import pack_dataset
+
+        batches = list(pack_dataset([[1, 2, 3]], max_len=8,
+                                    rows_per_batch=4))
+        assert len(batches) == 1
+        b = batches[0]
+        assert b["tokens"].shape == (4, 8)
+        assert (b["segment_ids"][1:] == 0).all()
+        assert (b["q_segment_ids"][1:] == -1).all()
+        assert (b["kv_segment_ids"][1:] == -2).all()
