@@ -415,13 +415,14 @@ def run_child(backend):
     print(_dump(out), flush=True)
 
 
-def _cached_tpu_result():
+def _cached_tpu_result(path=None):
     """The most recent committed hardware measurement
     (tools/artifacts/bench_tpu.json), relabeled backend "tpu-cached"
     with its capture time, or None.  Only a clean real-TPU line
     qualifies (backend tpu, positive value)."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "tools", "artifacts", "bench_tpu.json")
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "artifacts", "bench_tpu.json")
     try:
         with open(path) as f:
             cached = json.load(f)
