@@ -431,8 +431,10 @@ def _cached_tpu_result(path=None):
             return None
         cached["backend"] = "tpu-cached"
         # the capture session's own errors describe THAT session (and
-        # can carry multi-KB ANSI tracebacks); keep a stub, not the body
-        cached["errors"] = [e[:160] for e in cached.get("errors", [])]
+        # can carry multi-KB ANSI tracebacks); keep a prefixed stub so
+        # a reader cannot mistake them for THIS report's failures
+        cached["errors"] = ["captured: " + e[:150]
+                            for e in cached.get("errors", [])]
         # capture time: the validator embeds measured_at at write time;
         # mtime is only a fallback (it is checkout time on a fresh
         # clone, not capture time)

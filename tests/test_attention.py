@@ -608,6 +608,7 @@ def test_dispatch_prefs_attn_caps_parse(tmp_path, monkeypatch):
     p = tmp_path / "prefs.json"
     p.write_text(_json.dumps({
         "prefer_pallas": {"attention": True},
+        "methodology": "amortized",
         "attn_block_cap": {"128": 256, "256": "512", "64": "auto",
                            "bad": 100, "worse": -128}}))
     monkeypatch.setattr(_dispatch, "_PREFS_PATH", str(p))
@@ -616,6 +617,15 @@ def test_dispatch_prefs_attn_caps_parse(tmp_path, monkeypatch):
     # 100 is not a 128-multiple, -128 is negative, "auto" is not an
     # int: each dropped per-entry WITHOUT discarding prefer_pallas
     assert caps == {"128": 256, "256": 512}
+
+    # a table without the amortized-methodology stamp is provisional
+    # (pre-amortization runs timed the relay RTT, not the kernels —
+    # routing AND cap winners alike were drawn from noise): the whole
+    # table is inert until a re-measure stamps it
+    p.write_text(_json.dumps({
+        "prefer_pallas": {"attention": False},
+        "attn_block_cap": {"128": 256}}))
+    assert _dispatch._load_prefs() == ({}, {})
 
     p.write_text("{truncated")
     assert _dispatch._load_prefs() == ({}, {})

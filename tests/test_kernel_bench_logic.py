@@ -58,7 +58,8 @@ class TestSelectAttnCaps:
 class TestWritePrefs:
     def test_merge_preserves_attn_caps(self, tmp_path):
         p = tmp_path / "prefs.json"
-        p.write_text(json.dumps({"attn_block_cap": {"128": 256}}))
+        p.write_text(json.dumps({"methodology": "amortized",
+                                 "attn_block_cap": {"128": 256}}))
         rows = [
             {"kernel": "fused_layer_norm", "speedup": 1.3, "backend": "tpu"},
             {"kernel": "fused_layer_norm_grad", "speedup": 1.1,
@@ -71,6 +72,8 @@ class TestWritePrefs:
         assert doc["prefer_pallas"] == prefs == {
             "layer_norm": True, "attention": False}
         assert doc["backend"] == "tpu"
+        # the stamp that lets _load_prefs trust this table's routing
+        assert doc["methodology"] == "amortized"
 
     def test_any_slower_shape_flips_family_to_xla(self, tmp_path):
         p = tmp_path / "prefs.json"
@@ -80,6 +83,40 @@ class TestWritePrefs:
              "backend": "tpu"},
         ]
         assert kb.write_prefs(rows, str(p)) == {"attention": False}
+
+    def test_stale_era_tables_not_laundered(self, tmp_path):
+        # read-modify-write + a whole-file methodology stamp must not
+        # re-bless the OTHER table's dispatch-per-iteration data: a
+        # prefs-only run drops the old caps, a sweep-only merge (via
+        # _load_trusted_doc) drops the old routing
+        p = tmp_path / "prefs.json"
+        p.write_text(json.dumps({
+            "methodology": "dispatch-per-iteration",
+            "prefer_pallas": {"attention": False},
+            "attn_block_cap": {"128": 256}}))
+        kb.write_prefs([{"kernel": "welford_mean_var", "speedup": 1.2,
+                         "backend": "tpu"}], str(p))
+        doc = json.loads(p.read_text())
+        assert doc["methodology"] == "amortized"
+        assert "attn_block_cap" not in doc       # stale caps dropped
+        assert doc["prefer_pallas"] == {"welford": True}
+
+        p.write_text(json.dumps({
+            "methodology": "dispatch-per-iteration",
+            "prefer_pallas": {"attention": False},
+            "attn_block_cap": {"128": 256}}))
+        doc = kb._load_trusted_doc(str(p))
+        assert "prefer_pallas" not in doc
+        assert "attn_block_cap" not in doc
+
+        # an amortized-era doc survives the merge intact
+        p.write_text(json.dumps({
+            "methodology": "amortized",
+            "attn_block_cap": {"128": 512}}))
+        kb.write_prefs([{"kernel": "welford_mean_var", "speedup": 1.2,
+                         "backend": "tpu"}], str(p))
+        assert json.loads(p.read_text())["attn_block_cap"] == {
+            "128": 512}
 
     def test_corrupt_existing_file_does_not_abort(self, tmp_path):
         p = tmp_path / "prefs.json"
@@ -119,6 +156,27 @@ class TestRelayDeathWatchdogParser:
         # 127.0.0.1:12024 must NOT match the :2024 baseline anchor
         txt = self.HEADER + "LISTEN 0 64 127.0.0.1:12024 0.0.0.0:*\n"
         assert osv._has_nonbaseline_listener(txt)
+
+    def test_port_set_for_armtime_snapshot(self):
+        # the watchdog keys death to the ports seen at arm time; the
+        # parser must return the SET, and known infra listeners (sshd
+        # :22) must be excluded up front — inside the arm set they
+        # would block the death verdict for the whole session
+        txt = (self.HEADER
+               + "LISTEN 0 64 127.0.0.1:8117 0.0.0.0:*\n"
+               + "LISTEN 0 64 127.0.0.1:9001 0.0.0.0:*\n"
+               + "LISTEN 0 64 0.0.0.0:22 0.0.0.0:*\n"
+               + "LISTEN 0 128 0.0.0.0:2024 0.0.0.0:*\n")
+        assert osv._nonbaseline_ports(txt) == {8117, 9001}
+        # arm-time {8117, 9001} vs current {9001}: one relay port
+        # still up -> intersection nonempty -> alive (conservative);
+        # current {9999} (all arm-time ports gone, new relay's port
+        # up) -> dead, freeing the watcher to fire at the new relay
+        armed = osv._nonbaseline_ports(txt)
+        assert armed & osv._nonbaseline_ports(
+            self.HEADER + "LISTEN 0 64 127.0.0.1:9001 0.0.0.0:*\n")
+        assert not (armed & osv._nonbaseline_ports(
+            self.HEADER + "LISTEN 0 64 127.0.0.1:9999 0.0.0.0:*\n"))
 
 
 class TestTraceOpSummarizer:
@@ -194,7 +252,8 @@ class TestCachedTpuResult:
         assert c["backend"] == "tpu-cached"
         assert c["extra"]["cached_measured_at"] == "2026-07-31T03:41:18Z"
         assert "measured_at" not in c            # moved into extra
-        assert len(c["errors"][0]) == 160        # stubbed, not carried
+        # stubbed AND marked as the capture session's, not this run's
+        assert c["errors"][0] == "captured: " + "x" * 150
 
         # non-TPU or zero-valued lines never qualify
         p.write_text(json.dumps({"metric": "m", "value": 1.5,

@@ -225,3 +225,4 @@ class TestDispatchPrefs:
         # one slow shape disables the family; missing speedups ignored
         assert prefs == {"layer_norm": False, "attention": True}
         assert data["prefer_pallas"] == prefs
+        assert data["methodology"] == "amortized"

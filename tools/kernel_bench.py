@@ -93,6 +93,27 @@ _OP_FAMILY = {
 }
 
 
+def _load_trusted_doc(path):
+    """Existing prefs doc for read-modify-write, with any tables from
+    a NON-amortized era stripped first: the whole-file methodology
+    stamp both writers emit would otherwise launder the OTHER table's
+    stale dispatch-per-iteration data into trusted steering (a
+    --write-prefs-only run must not re-bless old sweep caps, nor a
+    sweep-only run old prefer_pallas booleans)."""
+    try:
+        with open(path) as f:
+            out = json.load(f)
+        if not isinstance(out, dict):
+            return {}
+    except Exception:
+        return {}
+    if out.get("methodology") != "amortized":
+        for stale in ("prefer_pallas", "speedups", "attn_block_cap",
+                      "backend", "attn_sweep_backend"):
+            out.pop(stale, None)
+    return out
+
+
 def write_prefs(rows, path):
     """Distill measured rows into the dispatch preference table
     (VERDICT r2 #2): an op family prefers Pallas only if NO measured
@@ -110,15 +131,14 @@ def write_prefs(rows, path):
             continue
         fam.setdefault(op, []).append(float(r["speedup"]))
     prefs = {op: min(sp) >= 1.0 for op, sp in fam.items()}
-    try:
-        with open(path) as f:
-            out = json.load(f)
-        if not isinstance(out, dict):
-            out = {}
-    except Exception:
-        out = {}
+    out = _load_trusted_doc(path)
     out.update({"prefer_pallas": prefs,
                 "source": "tools/kernel_bench.py",
+                # time_fn uses benchlib's amortized adaptive timer;
+                # _load_prefs only lets prefer_pallas steer dispatch
+                # under this stamp (pre-amortization tables measured
+                # the relay, not the kernels)
+                "methodology": "amortized",
                 "backend": rows[0]["backend"] if rows else "unknown",
                 "speedups": {op: sorted(sp) for op, sp in fam.items()}})
     with open(path, "w") as f:
@@ -306,14 +326,14 @@ def main():
         caps_out = select_attn_caps(sweep_times)
         if caps_out:
             from apex_tpu.ops import _dispatch
-            try:
-                with open(_dispatch._PREFS_PATH) as f:
-                    prefs_doc = json.load(f)
-            except Exception:
-                prefs_doc = {"prefer_pallas": {},
-                             "source": "tools/kernel_bench.py"}
+            prefs_doc = _load_trusted_doc(_dispatch._PREFS_PATH)
+            prefs_doc.setdefault("source", "tools/kernel_bench.py")
             prefs_doc.setdefault("attn_block_cap", {}).update(caps_out)
             prefs_doc["attn_sweep_backend"] = backend
+            # the sweep times with the same amortized timer; a
+            # sweep-only run must still produce a table _load_prefs
+            # will trust (see write_prefs)
+            prefs_doc["methodology"] = "amortized"
             with open(_dispatch._PREFS_PATH, "w") as f:
                 json.dump(prefs_doc, f, indent=1, sort_keys=True)
                 f.write("\n")

@@ -83,30 +83,40 @@ class Tee(io.TextIOBase):
         self.f.close()
 
 
-def _has_nonbaseline_listener(ss_text: str) -> bool:
-    """Parse `ss -tln` output: any listener besides the baseline ports
-    (48271, 2024 — same exclusion as tools/tunnel_watch.sh)?"""
+def _nonbaseline_ports(ss_text: str) -> set:
+    """Parse `ss -tln` output into the set of listening ports besides
+    the baseline ones (48271, 2024 — same exclusion as
+    tools/tunnel_watch.sh — plus sshd's 22: a long-lived infra
+    listener must never enter the relay watch set, where it would
+    block the death verdict for the whole session)."""
+    ports = set()
     for line in ss_text.splitlines()[1:]:
         parts = line.split()
-        if len(parts) >= 4 and not re.search(r":(48271|2024)$",
+        if len(parts) >= 4 and not re.search(r":(48271|2024|22)$",
                                              parts[3]):
-            return True
-    return False
+            m = re.search(r":(\d+)$", parts[3])
+            if m:
+                ports.add(int(m.group(1)))
+    return ports
 
 
-def _relay_listening() -> bool:
-    """True when any non-baseline local listener exists (the relay's
-    ports).  Purely passive: reads the kernel's socket table, opens no
-    connection."""
+def _has_nonbaseline_listener(ss_text: str) -> bool:
+    return bool(_nonbaseline_ports(ss_text))
+
+
+def _listener_ports():
+    """Current non-baseline listening ports, or None when the socket
+    table can't be read (never false-kill on a parse failure).  Purely
+    passive: reads the kernel's socket table, opens no connection."""
     import subprocess
     try:
         r = subprocess.run(["ss", "-tln"], capture_output=True,
                            text=True, timeout=10)
         if r.returncode != 0:
-            return True  # ss itself failed: can't tell, assume alive
+            return None  # ss itself failed: can't tell
     except Exception:
-        return True      # can't tell: assume alive, never false-kill
-    return _has_nonbaseline_listener(r.stdout)
+        return None      # can't tell: assume alive, never false-kill
+    return _nonbaseline_ports(r.stdout)
 
 
 def _arm_relay_death_watchdog(poll_s: int = 20, misses: int = 6):
@@ -118,14 +128,48 @@ def _arm_relay_death_watchdog(poll_s: int = 20, misses: int = 6):
     wedges the tunnel watcher whose fire() is waiting on this process.
     Log, stamp a marker, and hard-exit 3.  os._exit is deliberate: the
     relay is gone, there is no session left to wedge, and a clean
-    interpreter shutdown would block on the same hung runtime."""
+    interpreter shutdown would block on the same hung runtime.
+
+    Death is keyed to the ports recorded AT ARM TIME: "any
+    non-baseline listener exists" as a liveness test is blinded
+    forever by one unrelated long-lived listener (sshd, a docker
+    proxy), and an environment whose TPU session needs no local relay
+    listener would be hard-killed while healthy ~2 min in.  Watching
+    the arm-time set instead: death = every arm-time port gone, and an
+    empty arm-time set disarms the watchdog rather than killing a
+    healthy session.  Failure modes are deliberately asymmetric: a
+    long-lived unrelated listener that slips past the baseline
+    exclusion into the arm set BLOCKS the verdict (missed death — the
+    pre-watchdog failure mode, recoverable by hand), never forces a
+    false kill of a healthy session.
+
+    A NEW port appearing while every arm-time port is gone still
+    counts as death, deliberately: a relay restart never preserves the
+    old session (round-4 admission model — only the first client
+    after a restart is admitted), so the fresh listener belongs to a
+    fresh relay, and exiting promptly is what frees the tunnel
+    watcher to fire a new validator at it."""
     import threading
 
     def watch():
+        # arm INSIDE the thread: a transient ss failure (None) at arm
+        # time must delay arming, not silently disarm the watchdog for
+        # the whole session
+        armed = _listener_ports()
+        while armed is None:
+            time.sleep(poll_s)
+            armed = _listener_ports()
+        if not armed:
+            log("relay-death watchdog NOT armed: no non-baseline "
+                "listener at arm time (this session holds no local "
+                "relay port to watch)")
+            return
+        log(f"relay-death watchdog armed on ports {sorted(armed)}")
         gone = 0
         while True:
             time.sleep(poll_s)
-            if _relay_listening():
+            cur = _listener_ports()
+            if cur is None or (cur & armed):
                 gone = 0
                 continue
             gone += 1

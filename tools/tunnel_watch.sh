@@ -33,7 +33,10 @@ RETRY_QUIET=3600  # same-relay retry period: a retry is probe-free and
                   # so the cost of retrying hourly is small next to the
                   # cost of sitting out a live window
 
-BASELINE_RE=':(48271|2024)$'
+# 48271/2024: this box's standing listeners; 22: sshd on any box —
+# infra listeners must neither trigger a fire nor enter the relay
+# fingerprint (same exclusion as one_session_validation.py)
+BASELINE_RE=':(48271|2024|22)$'
 
 ts() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
 log() { echo "$(ts) $*" >> "$LOG"; }
