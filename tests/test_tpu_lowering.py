@@ -76,6 +76,23 @@ def test_lower_flash_attention_dropout():
     lower_tpu(grad_of(lambda q, s: f(q, s), 1), q, s)
 
 
+def test_lower_flash_attention_single_kv_block():
+    """nk == 1 geometry takes the dedicated scratch-free fast-path
+    body (_fwd_kernel_1kv) — its own Mosaic lowering, every variant:
+    ± causal, ± lse (inference), fused dropout."""
+    import functools
+
+    from apex_tpu.ops.attention import flash_attention
+    q = jnp.zeros((1, 2, 512, 64), jnp.bfloat16)
+    for causal in (False, True):
+        f = functools.partial(flash_attention, causal=causal)
+        lower_tpu(lambda q, f=f: f(q, q, q), q)            # no-lse fwd
+        lower_tpu(grad_of(lambda q, f=f: f(q, q, q), 1), q)  # lse fwd
+    s = jnp.int32(3)
+    lower_tpu(lambda q, s: flash_attention(
+        q, q, q, True, dropout_rate=0.1, dropout_seed=s), q, s)
+
+
 def test_lower_flash_attention_gqa():
     """GQA/MQA geometry (kv rows indexed through _kv_row, dkv grid
     folding the q group into its sequential axis) must pass the Mosaic
