@@ -270,9 +270,14 @@ class GPTModel(nn.Module):
 
     def loss(self, variables, tokens, labels, segment_ids=None,
              positions=None):
-        """Mean CE; with packed inputs, padding positions
-        (segment 0) are excluded from the mean — their logits are
-        garbage by contract."""
+        """Mean CE; with packed inputs, two position classes are
+        excluded from the mean: padding (segment 0), whose logits are
+        garbage by contract, and each segment's FINAL position — with
+        the documented shift-by-one label construction (labels[i] =
+        tokens[i+1], docs/transformer.md) a packed segment's last
+        token would otherwise train against the NEXT segment's first
+        token.  Callers that already set an ignore label there lose
+        nothing; callers that shifted naively are silently correct."""
         logits = self.apply(variables, tokens,
                             segment_ids=segment_ids,
                             positions=positions)       # (s, b, V/tp)
@@ -280,6 +285,9 @@ class GPTModel(nn.Module):
         per_tok = tp.vocab_parallel_cross_entropy(logits, labels_sb)
         if segment_ids is None:
             return jnp.mean(per_tok)
-        keep = jnp.transpose(segment_ids > 0, (1, 0))  # (s, b)
+        seg_sb = jnp.transpose(segment_ids, (1, 0))    # (s, b)
+        next_seg = jnp.concatenate(
+            [seg_sb[1:], jnp.zeros_like(seg_sb[:1])], axis=0)
+        keep = (seg_sb > 0) & (next_seg == seg_sb)
         return (jnp.sum(per_tok * keep)
                 / jnp.maximum(jnp.sum(keep), 1))

@@ -136,6 +136,30 @@ def test_gpt_packed_batch_matches_per_sequence(use_rope):
                           positions=jnp.asarray(packed["positions"]))
     assert np.isfinite(float(loss_val))
 
+    # the keep-mask excludes padding AND each segment's final
+    # position: with the shift-by-one labels above, a boundary
+    # position's target is the NEXT segment's first token.  Pin the
+    # mask two ways: (a) the loss equals the manually masked mean of
+    # raw per-token CE; (b) poisoning every excluded label leaves the
+    # loss bit-identical.
+    seg = packed["segment_ids"].T                        # (s, b)
+    nxt = np.concatenate([seg[1:], np.zeros_like(seg[:1])])
+    keep = (seg > 0) & (nxt == seg)
+    logp = np.asarray(jax.nn.log_softmax(
+        np.asarray(logits, np.float32), axis=-1))
+    per_tok = -np.take_along_axis(
+        logp, np.asarray(labels).T[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(
+        float(loss_val), (per_tok * keep).sum() / keep.sum(),
+        rtol=1e-3)
+    poisoned = np.asarray(labels).copy()
+    poisoned[~keep.T] = 1
+    loss_poison = model.loss(variables, tokens, jnp.asarray(poisoned),
+                             segment_ids=jnp.asarray(
+                                 packed["segment_ids"]),
+                             positions=jnp.asarray(packed["positions"]))
+    assert float(loss_val) == float(loss_poison)
+
 
 def test_gpt_packed_rejects_overlong_rows():
     """Learned-position models: the position gather would silently
