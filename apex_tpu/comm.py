@@ -135,6 +135,7 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    timeout: Optional[float] = None,
     **mesh_axes,
 ) -> Mesh:
     """Multi-host entry point (SURVEY.md §2.6; reference idiom:
@@ -178,6 +179,10 @@ def initialize_distributed(
             kw["num_processes"] = num_processes
         if process_id is not None:
             kw["process_id"] = process_id
+        if timeout is not None:
+            # reference parity: init_process_group(timeout=...); jax's
+            # default is 300 s of silent coordinator retry
+            kw["initialization_timeout"] = timeout
         # pod_runtime with no explicit coords: argless autodetect
         try:
             jax.distributed.initialize(**kw)

@@ -43,6 +43,7 @@ import pytest  # noqa: E402
 SLOW_MODULES = {
     "test_L1_trajectory.py",      # reference L1 tier: whole-training
     "test_examples_smoke.py",     # reference L6 tier: runs examples
+    "test_distributed_launch.py",  # spawns multi-process jax workers
 }
 SLOW_TESTS = {
     "test_models.py::test_gpt_single_device_loss_decreases",
