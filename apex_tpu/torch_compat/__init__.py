@@ -1,0 +1,24 @@
+"""torch-facing compatibility frontend (SURVEY.md §0's public contract).
+
+The reference is a torch extension: ``from apex import amp;
+model, opt = amp.initialize(model, opt, opt_level="O2")`` — keep your
+torch training loop.  On this stack the TPU is reachable only through
+JAX (no torch_xla exists here), so the TPU compute path is the
+JAX-native core package; THIS subpackage reproduces the reference's
+torch API for torch-on-CPU — the reference's own "Python-only install"
+degradation (no CUDA extensions → pure-Python amp), and BASELINE.md
+config 1 (ResNet-18 amp O0/O1, one process, CPU).
+
+    from apex_tpu.torch_compat import amp
+    model, optimizer = amp.initialize(model, optimizer, opt_level="O1")
+    with amp.scale_loss(loss, optimizer) as scaled_loss:
+        scaled_loss.backward()
+    optimizer.step()
+
+docs/porting.md maps each reference surface to its JAX-native
+equivalent for the TPU path.
+"""
+
+from . import amp
+
+__all__ = ["amp"]

@@ -1,0 +1,421 @@
+"""apex.amp for torch models on CPU — the reference's pure-Python amp.
+
+Reference surfaces reproduced (upstream-expected paths, SURVEY.md §2.1;
+the reference mount was empty, so no line numbers):
+
+- ``apex/amp/frontend.py`` — ``initialize`` + the O0–O3 ``Properties``
+  tables with per-kwarg override, ``state_dict``/``load_state_dict``.
+- ``apex/amp/wrap.py`` + ``lists/`` — O1 monkey-patching of torch
+  functions per FP16/FP32 lists (GEMM/conv → half; softmax/log/exp/
+  norm/loss → fp32).
+- ``apex/amp/_initialize.py``/``_process_optimizer.py`` — O2 model
+  cast with batchnorm exemption, input casting on ``forward``, fp32
+  master params, patched ``optimizer.step`` (skip on overflow, master
+  step + copy-back).
+- ``apex/amp/scaler.py``/``handle.py`` — dynamic loss scaling
+  (grow ×2 per 2000 clean steps, backoff ×0.5 on inf/nan) and the
+  ``scale_loss`` context manager.
+
+Deliberate deviations, documented in PARITY.md: CPU torch only (the
+TPU path is the JAX-native core; no torch_xla exists on this stack);
+``cast_model_type`` defaults to ``torch.bfloat16`` — the CPU-native
+half type — instead of fp16 (override with
+``cast_model_type=torch.float16`` for reference-exact dtypes).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import warnings
+
+import torch
+import torch.nn.functional as F
+
+__all__ = ["initialize", "scale_loss", "state_dict", "load_state_dict",
+           "master_params", "deinitialize", "Properties", "LossScaler"]
+
+_CPU_HALF = torch.bfloat16     # fp16 matmuls exist on CPU but crawl
+
+
+class Properties:
+    """Resolved option bundle for one ``initialize`` call (reference:
+    frontend.py's Properties; attributes, not a dict, so user code that
+    reads ``amp._amp_state.opt_properties.loss_scale`` ports over)."""
+
+    def __init__(self, **kw):
+        self.opt_level = kw["opt_level"]
+        self.cast_model_type = kw["cast_model_type"]
+        self.patch_torch_functions = kw["patch_torch_functions"]
+        self.keep_batchnorm_fp32 = kw["keep_batchnorm_fp32"]
+        self.master_weights = kw["master_weights"]
+        self.loss_scale = kw["loss_scale"]
+
+
+_OPT_LEVELS = {
+    "O0": dict(cast_model_type=None, patch_torch_functions=False,
+               keep_batchnorm_fp32=None, master_weights=False,
+               loss_scale=1.0),
+    "O1": dict(cast_model_type=None, patch_torch_functions=True,
+               keep_batchnorm_fp32=None, master_weights=False,
+               loss_scale="dynamic"),
+    "O2": dict(cast_model_type=_CPU_HALF, patch_torch_functions=False,
+               keep_batchnorm_fp32=True, master_weights=True,
+               loss_scale="dynamic"),
+    "O3": dict(cast_model_type=_CPU_HALF, patch_torch_functions=False,
+               keep_batchnorm_fp32=False, master_weights=False,
+               loss_scale=1.0),
+}
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: apex/amp/scaler.py): backoff
+    ×0.5 on overflow, grow ×2 after 2000 consecutive clean steps."""
+
+    def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
+                 scale_factor=2.0, scale_window=2000):
+        self.dynamic = loss_scale == "dynamic"
+        self._scale = float(init_scale if self.dynamic else loss_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def loss_scale(self):
+        return self._scale
+
+    def update_scale(self, overflow: bool):
+        if not self.dynamic:
+            return
+        if overflow:
+            self._scale = max(self._scale / self._factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self._scale *= self._factor
+                self._unskipped = 0
+
+
+class _AmpState:
+    def __init__(self):
+        self.initialized = False
+        self.opt_properties = None
+        self.loss_scalers = []
+        self.optimizers = []
+        self._patches = []       # (owner, name, original)
+        self._forward_patched = []  # (model, original_forward)
+
+
+_amp_state = _AmpState()
+
+
+# ---------------------------------------------------------------------------
+# O1: monkey-patched op lists (reference: apex/amp/lists/*.py)
+# ---------------------------------------------------------------------------
+
+# GEMM/conv-class ops run in half precision...
+_FP16_FUNCS = [
+    (torch, "mm"), (torch, "matmul"), (torch, "bmm"), (torch, "addmm"),
+    (torch, "addbmm"), (torch, "baddbmm"), (torch, "conv1d"),
+    (torch, "conv2d"), (torch, "conv3d"),
+    (F, "linear"), (F, "conv1d"), (F, "conv2d"), (F, "conv3d"),
+]
+# ...reductions/exponentials/losses in fp32
+_FP32_FUNCS = [
+    (torch, "exp"), (torch, "log"), (torch, "pow"), (torch, "softmax"),
+    (torch, "log_softmax"),
+    (F, "softmax"), (F, "log_softmax"), (F, "cross_entropy"),
+    (F, "nll_loss"), (F, "mse_loss"), (F, "l1_loss"),
+    (F, "layer_norm"), (F, "group_norm"), (F, "cosine_similarity"),
+]
+
+
+def _cast_tree(x, dtype):
+    if isinstance(x, torch.Tensor) and x.is_floating_point() \
+            and x.dtype != dtype:
+        return x.to(dtype)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_cast_tree(v, dtype) for v in x)
+    if isinstance(x, dict):      # dict batches (the collate pattern)
+        return type(x)((k, _cast_tree(v, dtype)) for k, v in x.items())
+    return x
+
+
+def _wrap_cast(fn, dtype):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return fn(*_cast_tree(list(args), dtype),
+                  **{k: _cast_tree(v, dtype) for k, v in kwargs.items()})
+    wrapper._amp_original = fn
+    return wrapper
+
+
+def _patch_torch_functions(half_dtype):
+    for owner, name in _FP16_FUNCS:
+        fn = getattr(owner, name, None)
+        if fn is None or hasattr(fn, "_amp_original"):
+            continue
+        _amp_state._patches.append((owner, name, fn))
+        setattr(owner, name, _wrap_cast(fn, half_dtype))
+    for owner, name in _FP32_FUNCS:
+        fn = getattr(owner, name, None)
+        if fn is None or hasattr(fn, "_amp_original"):
+            continue
+        _amp_state._patches.append((owner, name, fn))
+        setattr(owner, name, _wrap_cast(fn, torch.float32))
+
+
+# ---------------------------------------------------------------------------
+# O2: model cast + master weights (reference: _initialize.py,
+# _process_optimizer.py)
+# ---------------------------------------------------------------------------
+
+def _cast_model(model, dtype, keep_batchnorm_fp32):
+    bn_saved = []
+    if keep_batchnorm_fp32:
+        # snapshot BN params/buffers BEFORE the cast: .to(dtype) then
+        # .float() would round-trip them through the half type and
+        # shear mantissa bits off the fp32 stats
+        for m in model.modules():
+            if isinstance(m, torch.nn.modules.batchnorm._BatchNorm):
+                saved = {k: v.clone() for k, v in
+                         list(m.named_parameters(recurse=False))
+                         + list(m.named_buffers(recurse=False))}
+                bn_saved.append((m, saved))
+    model.to(dtype)
+    for m, saved in bn_saved:
+        for k, v in saved.items():
+            getattr(m, k).data = v
+        # half activations meet fp32 BN params: run the BN itself in
+        # fp32 and hand back the half dtype (reference semantics of
+        # keep_batchnorm_fp32; CPU batch_norm rejects mixed dtypes)
+        if not hasattr(m.forward, "_amp_original"):
+            m.forward = _wrap_bn_fp32(m, m.forward, dtype)
+    orig_forward = model.forward
+
+    @functools.wraps(orig_forward)
+    def forward(*args, **kwargs):
+        return orig_forward(*_cast_tree(list(args), dtype),
+                            **{k: _cast_tree(v, dtype)
+                               for k, v in kwargs.items()})
+
+    forward._amp_original = orig_forward
+    model.forward = forward
+    _amp_state._forward_patched.append((model, orig_forward))
+
+
+def _wrap_bn_fp32(module, orig, half_dtype):
+    @functools.wraps(orig)
+    def forward(x, *args, **kwargs):
+        return orig(x.float(), *args, **kwargs).to(half_dtype)
+
+    forward._amp_original = orig
+    _amp_state._forward_patched.append((module, orig))
+    return forward
+
+
+def _process_optimizer(optimizer, props):
+    """Patch ``step`` (and wire master weights under O2): unscaling and
+    the overflow verdict happen in ``scale_loss.__exit__``; the patched
+    step consumes the verdict — skip entirely on overflow, otherwise
+    step (the fp32 masters, if any) and copy back down."""
+    optimizer._amp_overflow = False
+    optimizer._amp_masters = []       # [(master_param, model_param)]
+
+    if props.master_weights:
+        for group in optimizer.param_groups:
+            new_params = []
+            for p in group["params"]:
+                if p.requires_grad and p.is_floating_point() \
+                        and p.dtype != torch.float32:
+                    master = p.detach().clone().float()
+                    master.requires_grad_(True)
+                    optimizer._amp_masters.append((master, p))
+                    new_params.append(master)
+                else:
+                    new_params.append(p)
+            group["params"] = new_params
+
+    orig_step = optimizer.step
+
+    @functools.wraps(orig_step)
+    def step(closure=None):
+        if optimizer._amp_overflow:
+            optimizer._amp_overflow = False
+            return None   # reference behavior: skipped step, no update
+        out = orig_step(closure) if closure is not None else orig_step()
+        with torch.no_grad():
+            for master, model_p in optimizer._amp_masters:
+                model_p.copy_(master.to(model_p.dtype))
+        return out
+
+    step._amp_original = orig_step
+    optimizer.step = step
+
+    if optimizer._amp_masters:
+        # the param groups now hold the fp32 masters, so the stock
+        # zero_grad no longer reaches the MODEL params backward
+        # actually writes to — without this, model grads accumulate
+        # across steps (reference: _process_optimizer patches
+        # zero_grad for exactly this)
+        orig_zero = optimizer.zero_grad
+
+        @functools.wraps(orig_zero)
+        def zero_grad(set_to_none: bool = True):
+            orig_zero(set_to_none=set_to_none)
+            for _, model_p in optimizer._amp_masters:
+                model_p.grad = None
+
+        zero_grad._amp_original = orig_zero
+        optimizer.zero_grad = zero_grad
+
+
+def _grads_for(optimizer):
+    """(grad, param) pairs the unscale/overflow pass walks: the MODEL
+    grads (where backward deposited them), plus the master mirror."""
+    pairs = []
+    seen_masters = {id(m) for m, _ in optimizer._amp_masters}
+    for group in optimizer.param_groups:
+        for p in group["params"]:
+            if id(p) in seen_masters:
+                continue            # masters get grads via the copy below
+            if p.grad is not None:
+                pairs.append((p.grad, p))
+    for master, model_p in optimizer._amp_masters:
+        if model_p.grad is not None:
+            pairs.append((model_p.grad, model_p))
+    return pairs
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def initialize(models, optimizers=None, opt_level="O1", **overrides):
+    """Reference: apex.amp.initialize.  Accepts one model/optimizer or
+    lists of either; returns the same shape it was given."""
+    if opt_level not in _OPT_LEVELS:
+        raise ValueError(
+            f"opt_level must be one of {sorted(_OPT_LEVELS)}, got "
+            f"{opt_level!r}")
+    if _amp_state.initialized:
+        # a second pass over an already-processed optimizer would
+        # orphan its masters (param_groups hold fp32 copies the model
+        # grads no longer reach) — undo everything first so re-init
+        # behaves like a fresh init
+        warnings.warn("amp.initialize called twice; undoing previous "
+                      "patches and reinitializing")
+        deinitialize()
+    patch_dtype = overrides.pop("patch_dtype", _CPU_HALF)
+    opts = dict(_OPT_LEVELS[opt_level])
+    for k, v in overrides.items():
+        if v is None:
+            continue
+        if k not in opts:
+            raise TypeError(f"unknown amp.initialize option {k!r}")
+        opts[k] = v
+    props = Properties(opt_level=opt_level, **opts)
+
+    models_list = models if isinstance(models, (list, tuple)) \
+        else [models]
+    opt_list = ([] if optimizers is None
+                else optimizers if isinstance(optimizers, (list, tuple))
+                else [optimizers])
+
+    if props.cast_model_type is not None:
+        for m in models_list:
+            _cast_model(m, props.cast_model_type,
+                        props.keep_batchnorm_fp32)
+    if props.patch_torch_functions:
+        _patch_torch_functions(patch_dtype)
+
+    _amp_state.opt_properties = props
+    _amp_state.optimizers = list(opt_list)
+    _amp_state.loss_scalers = [LossScaler(props.loss_scale)
+                               for _ in (opt_list or [None])]
+    for opt in opt_list:
+        _process_optimizer(opt, props)
+    _amp_state.initialized = True
+
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizer, loss_id=0):
+    """Reference: apex.amp.handle.scale_loss.  Multiplies the loss by
+    the current scale for backward; on exit unscales the grads in
+    place, detects inf/nan, posts the skip verdict to the patched
+    ``optimizer.step``, and updates the dynamic scale."""
+    if not _amp_state.initialized:
+        raise RuntimeError("amp.scale_loss used before amp.initialize")
+    if not hasattr(optimizer, "_amp_masters"):
+        raise RuntimeError(
+            "this optimizer was not prepared by amp.initialize — pass "
+            "it to amp.initialize(models, optimizers, ...) first")
+    scaler = _amp_state.loss_scalers[loss_id]
+    scale = scaler.loss_scale()
+    yield loss.float() * scale
+
+    overflow = False
+    with torch.no_grad():
+        for grad, _ in _grads_for(optimizer):
+            if not torch.isfinite(grad).all():
+                overflow = True
+                break
+        if not overflow and scale != 1.0:
+            for grad, _ in _grads_for(optimizer):
+                grad.mul_(1.0 / scale)
+        if not overflow:
+            for master, model_p in optimizer._amp_masters:
+                if model_p.grad is not None:
+                    master.grad = model_p.grad.float()
+    optimizer._amp_overflow = overflow
+    scaler.update_scale(overflow)
+
+
+def master_params(optimizer):
+    """Reference: apex.amp.master_params — iterate the fp32 params the
+    optimizer actually steps."""
+    for group in optimizer.param_groups:
+        yield from group["params"]
+
+
+def state_dict():
+    """Reference: amp.state_dict — loss-scaler state for checkpoints."""
+    return {f"loss_scaler{i}": {"loss_scale": s.loss_scale(),
+                                "unskipped": s._unskipped}
+            for i, s in enumerate(_amp_state.loss_scalers)}
+
+
+def load_state_dict(sd):
+    for i, s in enumerate(_amp_state.loss_scalers):
+        entry = sd.get(f"loss_scaler{i}")
+        if entry:
+            s._scale = float(entry["loss_scale"])
+            s._unskipped = int(entry["unskipped"])
+
+
+def deinitialize():
+    """Undo every monkey-patch (not in the reference, which patches for
+    the life of the process; here so test suites and notebooks can
+    restore a clean torch)."""
+    for owner, name, fn in reversed(_amp_state._patches):
+        setattr(owner, name, fn)
+    for model, fwd in reversed(_amp_state._forward_patched):
+        model.forward = fwd
+    for opt in _amp_state.optimizers:
+        if hasattr(opt.step, "_amp_original"):
+            opt.step = opt.step._amp_original
+        if hasattr(opt.zero_grad, "_amp_original"):
+            opt.zero_grad = opt.zero_grad._amp_original
+        if getattr(opt, "_amp_masters", None):
+            # put the MODEL params back in the groups so the optimizer
+            # (and any later re-initialize) sees the real parameters
+            swap = {id(m): mp for m, mp in opt._amp_masters}
+            for group in opt.param_groups:
+                group["params"] = [swap.get(id(p), p)
+                                   for p in group["params"]]
+            opt._amp_masters = []
+    _amp_state.__init__()

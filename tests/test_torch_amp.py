@@ -1,0 +1,263 @@
+"""torch-facing amp shim (apex_tpu.torch_compat.amp) vs plain torch.
+
+The reference's public contract is torch-facing (`import apex;
+amp.initialize(...)`, SURVEY.md §0) and its pure-Python install runs
+amp with no extensions at all — BASELINE config 1.  These tests mirror
+the reference L0 run_amp pattern: train small torch models on CPU
+through the shim, oracle = the same model trained in plain fp32.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import torch.nn as nn  # noqa: E402
+
+from apex_tpu.torch_compat import amp  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_amp():
+    yield
+    amp.deinitialize()
+
+
+def _tiny_model(seed=0, bn=False):
+    torch.manual_seed(seed)
+    layers = [nn.Conv2d(3, 8, 3, padding=1)]
+    if bn:
+        layers.append(nn.BatchNorm2d(8))
+    layers += [nn.ReLU(), nn.Flatten(), nn.Linear(8 * 8 * 8, 10)]
+    return nn.Sequential(*layers)
+
+
+def _batch(seed=1):
+    g = torch.Generator().manual_seed(seed)
+    x = torch.randn(4, 3, 8, 8, generator=g)
+    y = torch.randint(0, 10, (4,), generator=g)
+    return x, y
+
+
+def _train(model, optimizer, steps=5, use_amp=True):
+    losses = []
+    crit = nn.CrossEntropyLoss()
+    for _ in range(steps):
+        x, y = _batch()
+        optimizer.zero_grad()
+        loss = crit(model(x).float(), y)
+        if use_amp:
+            with amp.scale_loss(loss, optimizer) as scaled:
+                scaled.backward()
+        else:
+            loss.backward()
+        optimizer.step()
+        losses.append(float(loss.detach()))
+    return losses
+
+
+def test_o0_matches_plain_fp32_exactly():
+    """O0 is a no-op: identical trajectory to untouched torch."""
+    m_ref = _tiny_model()
+    o_ref = torch.optim.SGD(m_ref.parameters(), lr=0.1)
+    ref = _train(m_ref, o_ref, use_amp=False)
+
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O0")
+    got = _train(m, o)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O3"])
+def test_levels_train_close_to_fp32(opt_level):
+    """Mixed-precision trajectories track the fp32 oracle (reference
+    L1 tier semantics: training-dynamics equivalence, not exact
+    numerics)."""
+    m_ref = _tiny_model(bn=(opt_level == "O2"))
+    o_ref = torch.optim.SGD(m_ref.parameters(), lr=0.05)
+    ref = _train(m_ref, o_ref, use_amp=False)
+
+    m = _tiny_model(bn=(opt_level == "O2"))
+    o = torch.optim.SGD(m.parameters(), lr=0.05)
+    m, o = amp.initialize(m, o, opt_level=opt_level)
+    got = _train(m, o)
+    assert got[-1] < got[0]                      # it learns
+    np.testing.assert_allclose(got, ref, rtol=0.15, atol=0.15)
+
+
+def test_o2_model_is_half_bn_is_fp32():
+    m = _tiny_model(bn=True)
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    assert m[0].weight.dtype == torch.bfloat16    # conv cast
+    assert m[1].weight.dtype == torch.float32     # BN kept fp32
+    assert m[1].running_mean.dtype == torch.float32
+    # masters: the optimizer steps fp32 copies of the half params
+    masters = list(amp.master_params(o))
+    assert all(p.dtype == torch.float32 for p in masters)
+    # fp32 inputs are cast at forward; output comes back half
+    out = m(torch.randn(2, 3, 8, 8))
+    assert out.dtype == torch.bfloat16
+
+
+def test_o2_master_weights_stay_synced():
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    _train(m, o, steps=3)
+    for master, model_p in o._amp_masters:
+        np.testing.assert_allclose(
+            model_p.detach().float().numpy(),
+            master.detach().to(model_p.dtype).float().numpy())
+
+
+def test_dynamic_scaler_backs_off_on_inf_then_recovers():
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    x, y = _batch()
+    crit = nn.CrossEntropyLoss()
+    scaler = amp._amp_state.loss_scalers[0]
+    s0 = scaler.loss_scale()
+
+    o.zero_grad()
+    loss = crit(m(x).float(), y)
+    with amp.scale_loss(loss, o) as scaled:
+        scaled.backward()
+        # poison a MODEL grad (where backward deposits) before the
+        # context exit runs the unscale/overflow pass
+        next(iter(m.parameters())).grad[0] = float("inf")
+    o.step()
+    assert scaler.loss_scale() == s0 / 2         # backoff
+
+    o.zero_grad()
+    loss = crit(m(x).float(), y)
+    with amp.scale_loss(loss, o) as scaled:
+        scaled.backward()
+    o.step()
+    assert scaler.loss_scale() == s0 / 2         # clean: no growth yet
+    assert scaler._unskipped == 1
+
+
+def test_skipped_step_leaves_params_untouched():
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    x, y = _batch()
+    crit = nn.CrossEntropyLoss()
+    o.zero_grad()
+    loss = crit(m(x).float(), y)
+    with amp.scale_loss(loss, o) as scaled:
+        scaled.backward()
+        next(iter(m.parameters())).grad[0] = float("nan")
+    snap = [p.detach().clone() for p in amp.master_params(o)]
+    model_snap = [p.detach().clone() for p in m.parameters()]
+    o.step()
+    for p, s in zip(amp.master_params(o), snap):
+        assert torch.equal(p.detach(), s)
+    for p, s in zip(m.parameters(), model_snap):
+        assert torch.equal(p.detach(), s)
+
+
+def test_scaler_grows_after_window():
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.01)
+    m, o = amp.initialize(m, o, opt_level="O1")
+    scaler = amp._amp_state.loss_scalers[0]
+    scaler._window = 3                           # shrink for the test
+    s0 = scaler.loss_scale()
+    _train(m, o, steps=3)
+    assert scaler.loss_scale() == s0 * 2
+
+
+def test_state_dict_roundtrip():
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    amp._amp_state.loss_scalers[0]._scale = 1024.0
+    amp._amp_state.loss_scalers[0]._unskipped = 7
+    sd = amp.state_dict()
+
+    amp.deinitialize()
+    m2 = _tiny_model()
+    o2 = torch.optim.SGD(m2.parameters(), lr=0.1)
+    amp.initialize(m2, o2, opt_level="O2")
+    amp.load_state_dict(sd)
+    assert amp._amp_state.loss_scalers[0].loss_scale() == 1024.0
+    assert amp._amp_state.loss_scalers[0]._unskipped == 7
+
+
+def test_o1_patches_and_deinitialize_restores():
+    import torch.nn.functional as F
+    orig_linear = F.linear
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    amp.initialize(m, o, opt_level="O1")
+    assert hasattr(F.linear, "_amp_original")
+    # GEMM runs half under the patch (model params stay fp32)
+    out = m(torch.randn(2, 3, 8, 8))
+    assert out.dtype == torch.bfloat16
+    assert m[0].weight.dtype == torch.float32
+    # fp32-list ops come back fp32 even on half inputs
+    sm = F.softmax(torch.randn(4, 4, dtype=torch.bfloat16), dim=-1)
+    assert sm.dtype == torch.float32
+    amp.deinitialize()
+    assert F.linear is orig_linear
+
+
+def test_double_initialize_is_a_fresh_init():
+    """A second initialize on the same model/optimizer must undo the
+    first (a naive second _process_optimizer pass would orphan the
+    masters and silently stop training)."""
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    with pytest.warns(UserWarning, match="twice"):
+        m, o = amp.initialize(m, o, opt_level="O2")
+    assert len(o._amp_masters) > 0               # masters re-wired
+    losses = _train(m, o, steps=3)
+    assert losses[-1] < losses[0]                # still learns
+    for master, model_p in o._amp_masters:
+        np.testing.assert_allclose(
+            model_p.detach().float().numpy(),
+            master.detach().to(model_p.dtype).float().numpy())
+
+
+def test_bad_opt_level_and_unknown_option():
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    with pytest.raises(ValueError, match="opt_level"):
+        amp.initialize(m, o, opt_level="O4")
+    with pytest.raises(TypeError, match="unknown"):
+        amp.initialize(m, o, opt_level="O1", not_an_option=1)
+
+
+def test_unprepared_optimizer_fails_loudly():
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    amp.initialize(m, opt_level="O1")           # optimizer-less form
+    loss = m(torch.randn(2, 3, 8, 8)).float().sum()
+    with pytest.raises(RuntimeError, match="not prepared"):
+        with amp.scale_loss(loss, o):
+            pass
+
+
+def test_o2_dict_inputs_are_cast():
+    """Dict batches (the HF/collate pattern) must be cast at forward
+    like positional tensors (reference: the amp applier walks
+    mappings)."""
+
+    class DictNet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 4)
+
+        def forward(self, batch):
+            return self.lin(batch["x"])
+
+    m = DictNet()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    out = m({"x": torch.randn(2, 8)})           # fp32 in a dict
+    assert out.dtype == torch.bfloat16
