@@ -1,14 +1,16 @@
-"""apex.pyprof parity shim (reference: historical apex/pyprof — nvtx
-annotation toolkit wrapping torch functions with
-torch.cuda.nvtx.range_push/pop, SURVEY.md §5 tracing).
+"""apex.pyprof parity shim (reference: historical apex/pyprof — BOTH
+halves: the nvtx annotation toolkit wrapping torch functions with
+torch.cuda.nvtx.range_push/pop, and the pyprof/prof parsers that
+turned captured profiles into per-kernel tables; SURVEY.md §5
+tracing).
 
-TPU equivalent: `jax.named_scope` annotations (visible in XProf/
-TensorBoard traces) and `jax.profiler` trace capture — strictly better
-tooling for free.  The nvtx push/pop surface is preserved so reference
-code annotating hot regions ports unchanged.
+TPU equivalents: `jax.named_scope` annotations + `jax.profiler` trace
+capture (the nvtx half, `apex_tpu.pyprof.nvtx`), and the trace
+distiller that parses the written profile into a top-device-ops table
+(the prof half, `apex_tpu.pyprof.prof`).
 """
 
-from apex_tpu.pyprof import nvtx  # noqa: F401
+from apex_tpu.pyprof import nvtx, prof  # noqa: F401
 
 _enabled = False
 
@@ -24,4 +26,4 @@ def enabled() -> bool:
     return _enabled
 
 
-__all__ = ["init", "enabled", "nvtx"]
+__all__ = ["init", "enabled", "nvtx", "prof"]

@@ -1,0 +1,83 @@
+"""Kernel-parse half of the pyprof shim (reference: apex/pyprof/prof —
+the toolkit that parsed captured profiles into per-kernel tables;
+SURVEY.md §5 tracing).
+
+The TPU capture side is `jax.profiler.trace` (driven by
+tools/profile_step.py or `apex_tpu.pyprof.profile`); THIS module turns
+the written trace directory into the op-level table the reference's
+parsers produced — top device ops by total time, from the
+Chrome-format trace, with no xprof/tensorboard dependency.
+
+    from apex_tpu.pyprof import prof
+    rows = prof.summarize_device_ops("/tmp/apex_tpu_trace")
+
+    python -m apex_tpu.pyprof.prof /tmp/apex_tpu_trace
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+
+__all__ = ["summarize_device_ops", "main"]
+
+
+def summarize_device_ops(outdir: str, top: int = 12):
+    """Top device ops by total time from the Chrome-format trace the
+    profiler writes (device thread named "XLA Ops" under a /device:*
+    process).  Returns [[name, total_ms, pct], ...].
+
+    Only the device op thread is aggregated: the round-4 capture held
+    ~1M host python events against 434 device ops — counting hosts
+    would bury the signal this table exists to surface."""
+    paths = glob.glob(os.path.join(
+        outdir, "plugins", "profile", "*", "*.trace.json.gz"))
+    if not paths:
+        return []
+    with gzip.open(sorted(paths)[-1]) as f:
+        d = json.load(f)
+    ev = d.get("traceEvents", [])
+    device_pids = {e.get("pid") for e in ev
+                   if e.get("ph") == "M"
+                   and e.get("name") == "process_name"
+                   and "/device:" in str(e.get("args", {}).get("name"))}
+    op_tids = {(e.get("pid"), e.get("tid")) for e in ev
+               if e.get("ph") == "M" and e.get("name") == "thread_name"
+               and e.get("pid") in device_pids
+               and e.get("args", {}).get("name") == "XLA Ops"}
+    agg = collections.Counter()
+    for e in ev:
+        if (e.get("ph") == "X"
+                and (e.get("pid"), e.get("tid")) in op_tids):
+            agg[e["name"]] += e.get("dur", 0)
+    total = sum(agg.values())
+    if not total:
+        return []
+    return [[name, round(dur / 1e3, 3), round(dur / total * 100, 1)]
+            for name, dur in agg.most_common(top)]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="op-level table from a jax.profiler trace dir")
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=12)
+    args = ap.parse_args(argv)
+    rows = summarize_device_ops(args.trace_dir, top=args.top)
+    if not rows:
+        print("no device op events found (host-only trace, or wrong "
+              "directory)")
+        return 1
+    w = max(len(r[0]) for r in rows)
+    for name, ms, pct in rows:
+        print(f"{name:<{w}}  {ms:>10.3f} ms  {pct:>5.1f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

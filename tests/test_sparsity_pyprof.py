@@ -92,6 +92,38 @@ def test_nvtx_push_pop_and_annotate():
     assert float(f(jnp.float32(3))) == 6.0
 
 
+def test_pyprof_prof_parses_trace_dir(tmp_path, capsys):
+    """The prof half (reference: apex/pyprof/prof parsers) lives in the
+    package and renders the top-device-ops table from a written trace
+    dir; tools/profile_step.summarize_device_ops is an alias of it."""
+    import gzip
+    import json
+
+    from apex_tpu.pyprof import prof
+
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 7, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 3, "tid": 7, "name": "fusion.9",
+         "dur": 3000},
+        {"ph": "X", "pid": 3, "tid": 7, "name": "conv", "dur": 1000},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    rows = prof.summarize_device_ops(str(tmp_path))
+    assert rows == [["fusion.9", 3.0, 75.0], ["conv", 1.0, 25.0]]
+
+    assert prof.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "fusion.9" in out and "75.0%" in out
+    assert prof.main([str(tmp_path / "nothing")]) == 1
+
+
 def test_testing_commons_builds_mesh():
     from apex_tpu.transformer.testing import commons, global_vars
     mesh = commons.initialize_distributed(tensor_model_parallel_size=2,

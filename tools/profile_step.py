@@ -73,40 +73,12 @@ def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
 
 
 def summarize_device_ops(outdir: str, top: int = 12):
-    """Top device ops by total time from the Chrome-format trace the
-    profiler writes (device thread named "XLA Ops" under a /device:*
-    process).  Returns [[name, total_ms, pct], ...] — the op-level
-    step breakdown docs/perf.md's MFU work needs, computed without
-    any xprof/tensorboard dependency."""
-    import collections
-    import glob
-    import gzip
-
-    paths = glob.glob(os.path.join(
-        outdir, "plugins", "profile", "*", "*.trace.json.gz"))
-    if not paths:
-        return []
-    with gzip.open(sorted(paths)[-1]) as f:
-        d = json.load(f)
-    ev = d.get("traceEvents", [])
-    device_pids = {e.get("pid") for e in ev
-                   if e.get("ph") == "M"
-                   and e.get("name") == "process_name"
-                   and "/device:" in str(e.get("args", {}).get("name"))}
-    op_tids = {(e.get("pid"), e.get("tid")) for e in ev
-               if e.get("ph") == "M" and e.get("name") == "thread_name"
-               and e.get("pid") in device_pids
-               and e.get("args", {}).get("name") == "XLA Ops"}
-    agg = collections.Counter()
-    for e in ev:
-        if (e.get("ph") == "X"
-                and (e.get("pid"), e.get("tid")) in op_tids):
-            agg[e["name"]] += e.get("dur", 0)
-    total = sum(agg.values())
-    if not total:
-        return []
-    return [[name, round(dur / 1e3, 3), round(dur / total * 100, 1)]
-            for name, dur in agg.most_common(top)]
+    """Delegates to the package home of the parser
+    (apex_tpu.pyprof.prof — the reference's pyprof/prof kernel-parse
+    half lives in the PACKAGE, not the tools dir); kept as an alias so
+    runbooks and older artifacts' provenance notes stay valid."""
+    from apex_tpu.pyprof.prof import summarize_device_ops as impl
+    return impl(outdir, top=top)
 
 
 def main():
