@@ -4,6 +4,8 @@ per rank, the reference's `torch.distributed.launch` child shape
 "nccl") inside each launched process).
 
 Run:  python _dist_worker.py <rank> <world> <port>
+or (launcher mode — rendezvous already in the env, the way
+`python -m apex_tpu.launch` spawns workers):  python _dist_worker.py
 
 Pins the CPU platform BEFORE first backend use (sitecustomize registers
 the axon TPU plugin in every python process; a test worker must never
@@ -23,12 +25,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def main() -> int:
-    rank, world, port = (int(sys.argv[1]), int(sys.argv[2]),
-                         sys.argv[3])
-    # launcher env contract (what comm.initialize_distributed parses)
-    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-    os.environ["WORLD_SIZE"] = str(world)
-    os.environ["RANK"] = str(rank)
+    if len(sys.argv) > 1:
+        rank, world, port = (int(sys.argv[1]), int(sys.argv[2]),
+                             sys.argv[3])
+        # launcher env contract (what comm.initialize_distributed
+        # parses)
+        os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        os.environ["WORLD_SIZE"] = str(world)
+        os.environ["RANK"] = str(rank)
+    else:                       # apex_tpu.launch already set the env
+        rank = int(os.environ["RANK"])
+        world = int(os.environ["WORLD_SIZE"])
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
     import jax

@@ -15,6 +15,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -67,6 +68,54 @@ def test_multiprocess_handshake_and_psum(world):
         assert p.returncode == 0, (
             f"rank {r} rc={p.returncode}\n{out[-4000:]}")
         assert f"DIST_OK {r}" in out, f"rank {r}:\n{out[-4000:]}"
+
+
+def test_launcher_spawns_world_and_propagates_failure():
+    """`python -m apex_tpu.launch` (reference: torch.distributed.launch)
+    sets the env contract for N workers, reaps them, and propagates
+    the first nonzero exit while tearing the rest down."""
+    env = _clean_env()
+    p = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.launch", "--nproc", "2",
+         _WORKER],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**env, "PYTHONPATH": os.path.dirname(
+            os.path.dirname(_WORKER))},
+        timeout=240)
+    assert p.returncode == 0, p.stdout[-4000:]
+    assert "DIST_OK 0" in p.stdout and "DIST_OK 1" in p.stdout
+
+    # multi-node shape without a shared coordinator is a config error
+    p = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.launch", "--nproc", "2",
+         "--nnodes", "2", _WORKER],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**env, "PYTHONPATH": os.path.dirname(
+            os.path.dirname(_WORKER))},
+        timeout=60)
+    assert p.returncode == 2
+    assert "--coordinator" in p.stdout
+
+def test_launcher_tears_down_siblings_on_crash(tmp_path):
+    """One crashed rank must fail the whole launch promptly — a
+    sibling blocked in a collective would otherwise hang forever
+    (torchrun semantics)."""
+    crash = tmp_path / "crash.py"
+    crash.write_text(
+        "import os, sys, time\n"
+        "if os.environ['RANK'] == '1':\n"
+        "    sys.exit(7)\n"
+        "time.sleep(120)\n")
+    t0 = time.time()
+    p = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.launch", "--nproc", "2",
+         str(crash)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**_clean_env(), "PYTHONPATH": os.path.dirname(
+            os.path.dirname(_WORKER))},
+        timeout=90)
+    assert p.returncode == 7, p.stdout[-2000:]
+    assert time.time() - t0 < 60    # sibling killed, not waited out
 
 
 def test_worker_rejects_bad_rendezvous():
