@@ -26,6 +26,7 @@ half type — instead of fp16 (override with
 from __future__ import annotations
 
 import contextlib
+import copy
 import functools
 import warnings
 
@@ -104,6 +105,8 @@ class _AmpState:
         self.optimizers = []
         self._patches = []       # (owner, name, original)
         self._forward_patched = []  # (model, original_forward)
+        self._cast_models = []   # (model, {name: fp32 tensor})
+        self._orig_fp32 = {}     # id(cast param) -> original fp32
 
 
 _amp_state = _AmpState()
@@ -134,10 +137,15 @@ def _cast_tree(x, dtype):
     if isinstance(x, torch.Tensor) and x.is_floating_point() \
             and x.dtype != dtype:
         return x.to(dtype)
+    if isinstance(x, tuple) and hasattr(x, "_fields"):   # namedtuple
+        return type(x)(*(_cast_tree(v, dtype) for v in x))
     if isinstance(x, (list, tuple)):
         return type(x)(_cast_tree(v, dtype) for v in x)
-    if isinstance(x, dict):      # dict batches (the collate pattern)
-        return type(x)((k, _cast_tree(v, dtype)) for k, v in x.items())
+    if isinstance(x, dict):      # dict batches (the collate pattern);
+        out = copy.copy(x)       # copy preserves subclass state
+        for k, v in x.items():   # (defaultdict factory, OrderedDict)
+            out[k] = _cast_tree(v, dtype)
+        return out
     return x
 
 
@@ -171,11 +179,20 @@ def _patch_torch_functions(half_dtype):
 # ---------------------------------------------------------------------------
 
 def _cast_model(model, dtype, keep_batchnorm_fp32):
+    # snapshot EVERY float tensor before the cast: (a) BN restoration
+    # below must be exact, not a half round-trip; (b) O2 masters copy
+    # from these originals instead of re-upcasting rounded half params
+    # (same fidelity rule as the JAX amp path); (c) deinitialize puts
+    # the fp32 model back so the module is usable after un-patching
+    saved_model = {
+        name: t.detach().clone()
+        for name, t in (list(model.named_parameters())
+                        + list(model.named_buffers()))
+        if t.is_floating_point() and t.dtype != dtype}
+    _amp_state._cast_models.append((model, saved_model))
+    param_names = {name: p for name, p in model.named_parameters()}
     bn_saved = []
     if keep_batchnorm_fp32:
-        # snapshot BN params/buffers BEFORE the cast: .to(dtype) then
-        # .float() would round-trip them through the half type and
-        # shear mantissa bits off the fp32 stats
         for m in model.modules():
             if isinstance(m, torch.nn.modules.batchnorm._BatchNorm):
                 saved = {k: v.clone() for k, v in
@@ -183,6 +200,9 @@ def _cast_model(model, dtype, keep_batchnorm_fp32):
                          + list(m.named_buffers(recurse=False))}
                 bn_saved.append((m, saved))
     model.to(dtype)
+    for name, p in param_names.items():   # param objects survive .to()
+        if name in saved_model:
+            _amp_state._orig_fp32[id(p)] = saved_model[name]
     for m, saved in bn_saved:
         for k, v in saved.items():
             getattr(m, k).data = v
@@ -228,7 +248,11 @@ def _process_optimizer(optimizer, props):
             for p in group["params"]:
                 if p.requires_grad and p.is_floating_point() \
                         and p.dtype != torch.float32:
-                    master = p.detach().clone().float()
+                    # prefer the pre-cast fp32 original captured by
+                    # _cast_model over re-upcasting the rounded half
+                    orig = _amp_state._orig_fp32.get(id(p))
+                    master = (orig.detach().clone() if orig is not None
+                              else p.detach().clone().float())
                     master.requires_grad_(True)
                     optimizer._amp_masters.append((master, p))
                     new_params.append(master)
@@ -343,11 +367,19 @@ def initialize(models, optimizers=None, opt_level="O1", **overrides):
 
 
 @contextlib.contextmanager
-def scale_loss(loss, optimizer, loss_id=0):
+def scale_loss(loss, optimizer, loss_id=0, delay_unscale=False):
     """Reference: apex.amp.handle.scale_loss.  Multiplies the loss by
     the current scale for backward; on exit unscales the grads in
     place, detects inf/nan, posts the skip verdict to the patched
-    ``optimizer.step``, and updates the dynamic scale."""
+    ``optimizer.step``, and updates the dynamic scale.
+
+    delay_unscale=True (reference escape hatch for gradient
+    accumulation): the exit does NOTHING — grads stay scaled and keep
+    accumulating; only the final micro-batch's scale_loss (with the
+    default delay_unscale=False) unscales the sum and renders the
+    overflow verdict.  Without it, each exit would divide the
+    accumulated sum by the scale again, destroying every earlier
+    micro-batch's contribution."""
     if not _amp_state.initialized:
         raise RuntimeError("amp.scale_loss used before amp.initialize")
     if not hasattr(optimizer, "_amp_masters"):
@@ -357,6 +389,8 @@ def scale_loss(loss, optimizer, loss_id=0):
     scaler = _amp_state.loss_scalers[loss_id]
     scale = scaler.loss_scale()
     yield loss.float() * scale
+    if delay_unscale:
+        return
 
     overflow = False
     with torch.no_grad():
@@ -398,13 +432,22 @@ def load_state_dict(sd):
 
 
 def deinitialize():
-    """Undo every monkey-patch (not in the reference, which patches for
-    the life of the process; here so test suites and notebooks can
-    restore a clean torch)."""
+    """Undo every monkey-patch AND restore cast models to their exact
+    pre-cast fp32 tensors (not in the reference, which patches for the
+    life of the process; here so test suites and notebooks can restore
+    a clean torch — a model left in half with its input-cast wrapper
+    removed would be unusable)."""
     for owner, name, fn in reversed(_amp_state._patches):
         setattr(owner, name, fn)
     for model, fwd in reversed(_amp_state._forward_patched):
         model.forward = fwd
+    for model, saved in reversed(_amp_state._cast_models):
+        tensors = dict(model.named_parameters())
+        tensors.update(model.named_buffers())
+        for name, orig in saved.items():
+            t = tensors.get(name)
+            if t is not None:
+                t.data = orig
     for opt in _amp_state.optimizers:
         if hasattr(opt.step, "_amp_original"):
             opt.step = opt.step._amp_original
@@ -412,8 +455,15 @@ def deinitialize():
             opt.zero_grad = opt.zero_grad._amp_original
         if getattr(opt, "_amp_masters", None):
             # put the MODEL params back in the groups so the optimizer
-            # (and any later re-initialize) sees the real parameters
+            # (and any later re-initialize) sees the real parameters —
+            # carrying the TRAINED fp32 values from the masters (this
+            # runs after the pre-cast snapshot restore above, so where
+            # a master exists the trained value wins; without masters,
+            # O3-style, deinitialize rolls back to the pre-cast
+            # weights)
             swap = {id(m): mp for m, mp in opt._amp_masters}
+            for master, model_p in opt._amp_masters:
+                model_p.data = master.detach().clone()
             for group in opt.param_groups:
                 group["params"] = [swap.get(id(p), p)
                                    for p in group["params"]]

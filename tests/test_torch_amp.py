@@ -233,6 +233,82 @@ def test_bad_opt_level_and_unknown_option():
         amp.initialize(m, o, opt_level="O1", not_an_option=1)
 
 
+def test_gradient_accumulation_with_delay_unscale():
+    """The reference pattern: N-1 backwards under
+    delay_unscale=True accumulate SCALED grads untouched; the final
+    scale_loss unscales the sum once.  Without the flag each exit
+    would divide the accumulated sum again."""
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    crit = nn.CrossEntropyLoss()
+    x1, y1 = _batch(1)
+    x2, y2 = _batch(2)
+
+    o.zero_grad()
+    with amp.scale_loss(crit(m(x1).float(), y1), o,
+                        delay_unscale=True) as s:
+        s.backward()
+    with amp.scale_loss(crit(m(x2).float(), y2), o) as s:
+        s.backward()
+
+    # oracle: fp32 model, two plain accumulated backwards
+    m_ref = _tiny_model()
+    loss = (crit(m_ref(x1), y1) + crit(m_ref(x2), y2))
+    loss.backward()
+    g_amp = next(iter(m.parameters())).grad.float()
+    g_ref = next(iter(m_ref.parameters())).grad
+    np.testing.assert_allclose(np.asarray(g_amp), np.asarray(g_ref),
+                               rtol=0.08, atol=0.02)
+
+
+def test_cast_tree_handles_namedtuple_and_defaultdict():
+    import collections
+    import typing
+
+    class Batch(typing.NamedTuple):
+        x: torch.Tensor
+        n: int
+
+    b = Batch(torch.randn(2, 4), 3)
+    out = amp._cast_tree(b, torch.bfloat16)
+    assert isinstance(out, Batch)
+    assert out.x.dtype == torch.bfloat16 and out.n == 3
+
+    d = collections.defaultdict(list, {"x": torch.randn(2, 4)})
+    out = amp._cast_tree(d, torch.bfloat16)
+    assert isinstance(out, collections.defaultdict)
+    assert out.default_factory is list
+    assert out["x"].dtype == torch.bfloat16
+
+
+def test_deinitialize_restores_usable_fp32_model():
+    """After deinitialize a cast model must be plain fp32 and callable
+    on fp32 inputs, carrying the TRAINED values (from the masters)."""
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    _train(m, o, steps=2)
+    trained = [mast.detach().clone() for mast, _ in o._amp_masters]
+    amp.deinitialize()
+    assert all(p.dtype == torch.float32 for p in m.parameters())
+    m(torch.randn(2, 3, 8, 8))                   # usable on fp32 input
+    for p, want in zip((p for p in m.parameters()
+                        if p.requires_grad), trained):
+        np.testing.assert_allclose(p.detach().numpy(), want.numpy())
+
+
+def test_o2_masters_copy_pre_cast_fp32():
+    """Masters must come from the ORIGINAL fp32 values, not from
+    re-upcasting the rounded bf16 params (the JAX amp path's rule)."""
+    m = _tiny_model()
+    orig = next(iter(m.parameters())).detach().clone()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    master = o._amp_masters[0][0]
+    assert torch.equal(master.detach(), orig)    # exact, no bf16 trip
+
+
 def test_unprepared_optimizer_fails_loudly():
     m = _tiny_model()
     o = torch.optim.SGD(m.parameters(), lr=0.1)
