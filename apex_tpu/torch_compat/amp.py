@@ -331,6 +331,7 @@ def initialize(models, optimizers=None, opt_level="O1", **overrides):
                       "patches and reinitializing")
         deinitialize()
     patch_dtype = overrides.pop("patch_dtype", _CPU_HALF)
+    num_losses = overrides.pop("num_losses", None)
     opts = dict(_OPT_LEVELS[opt_level])
     for k, v in overrides.items():
         if v is None:
@@ -355,10 +356,24 @@ def initialize(models, optimizers=None, opt_level="O1", **overrides):
 
     _amp_state.opt_properties = props
     _amp_state.optimizers = list(opt_list)
-    _amp_state.loss_scalers = [LossScaler(props.loss_scale)
-                               for _ in (opt_list or [None])]
+    # reference: num_losses > 1 gives each loss its own scaler (the
+    # scale_loss(loss_id=...) companion); default one per optimizer
+    _amp_state.loss_scalers = [
+        LossScaler(props.loss_scale)
+        for _ in range(num_losses or max(1, len(opt_list)))]
     for opt in opt_list:
         _process_optimizer(opt, props)
+    # the snapshot's job for master-paired params is done (masters
+    # seeded from it; deinitialize restores those from the TRAINED
+    # masters) — drop the redundant fp32 copies so O2 doesn't hold a
+    # third full-model buffer for the life of the process
+    mastered = {id(mp) for opt in opt_list
+                for _, mp in getattr(opt, "_amp_masters", [])}
+    for m, saved in _amp_state._cast_models:
+        for name, p in m.named_parameters():
+            if id(p) in mastered:
+                saved.pop(name, None)
+    _amp_state._orig_fp32.clear()      # only needed to seed masters
     _amp_state.initialized = True
 
     if optimizers is None:
@@ -446,7 +461,11 @@ def deinitialize():
         tensors.update(model.named_buffers())
         for name, orig in saved.items():
             t = tensors.get(name)
-            if t is not None:
+            # only un-cast tensors that are STILL cast: an fp32-exempt
+            # tensor (keep_batchnorm_fp32 params, running stats) has
+            # been training in place — overwriting it with the
+            # pre-cast snapshot would roll its training back
+            if t is not None and t.dtype != orig.dtype:
                 t.data = orig
     for opt in _amp_state.optimizers:
         if hasattr(opt.step, "_amp_original"):

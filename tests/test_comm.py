@@ -77,6 +77,13 @@ def test_initialize_distributed_passes_coordinates(monkeypatch):
         process_id=0, data=8)
     assert called == {"coordinator_address": "10.0.0.1:1234",
                       "num_processes": 1, "process_id": 0}
+    # timeout= (reference parity: init_process_group(timeout=...))
+    # maps to jax's initialization_timeout and is never a mesh axis
+    called.clear()
+    comm.initialize_distributed(
+        coordinator_address="10.0.0.1:1234", num_processes=1,
+        process_id=0, timeout=5, data=8)
+    assert called["initialization_timeout"] == 5
 
 
 def test_initialize_distributed_env_var_triggers(monkeypatch):

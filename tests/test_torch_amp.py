@@ -171,6 +171,26 @@ def test_scaler_grows_after_window():
     assert scaler.loss_scale() == s0 * 2
 
 
+def test_num_losses_gives_independent_scalers():
+    """Reference: initialize(..., num_losses=N) + scale_loss(loss_id=i)
+    — an overflow on one loss must not back off the other's scale."""
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2", num_losses=2)
+    assert len(amp._amp_state.loss_scalers) == 2
+    x, y = _batch()
+    crit = nn.CrossEntropyLoss()
+    s0 = amp._amp_state.loss_scalers[0].loss_scale()
+
+    o.zero_grad()
+    loss = crit(m(x).float(), y)
+    with amp.scale_loss(loss, o, loss_id=0) as scaled:
+        scaled.backward()
+        next(iter(m.parameters())).grad[0] = float("inf")
+    assert amp._amp_state.loss_scalers[0].loss_scale() == s0 / 2
+    assert amp._amp_state.loss_scalers[1].loss_scale() == s0
+
+
 def test_state_dict_roundtrip():
     m = _tiny_model()
     o = torch.optim.SGD(m.parameters(), lr=0.1)
@@ -296,6 +316,23 @@ def test_deinitialize_restores_usable_fp32_model():
     for p, want in zip((p for p in m.parameters()
                         if p.requires_grad), trained):
         np.testing.assert_allclose(p.detach().numpy(), want.numpy())
+
+
+def test_deinitialize_keeps_trained_bn_fp32():
+    """fp32-exempt tensors (BN params + running stats) train IN PLACE
+    under O2 — deinitialize must not roll them back to the pre-cast
+    snapshot."""
+    m = _tiny_model(bn=True)
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2")
+    _train(m, o, steps=3)
+    rm = m[1].running_mean.detach().clone()
+    w = m[1].weight.detach().clone()
+    assert not torch.equal(rm, torch.zeros_like(rm))   # stats trained
+    amp.deinitialize()
+    assert torch.equal(m[1].running_mean, rm)
+    assert torch.equal(m[1].weight, w)
+    assert all(p.dtype == torch.float32 for p in m.parameters())
 
 
 def test_o2_masters_copy_pre_cast_fp32():
