@@ -78,6 +78,7 @@ SLOW_TESTS = {
     "test_fused_softmax_rope.py::test_causal_softmax_matches_ref_and_grads",
     "test_contrib_multihead_attn.py::"
     "test_fmha_packed_matches_per_sequence_attention",
+    "test_kernel_bench_logic.py::test_tiny_cpu",  # packed-varlen bench
 }
 
 

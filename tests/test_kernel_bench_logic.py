@@ -23,6 +23,15 @@ osv = _load_tool("one_session_validation")
 ps = _load_tool("profile_step")
 
 
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
 class TestSelectAttnCaps:
     def test_lowest_mean_relative_time_wins(self):
         caps = kb.select_attn_caps({
@@ -229,18 +238,39 @@ class TestTraceOpSummarizer:
         assert rows == []
 
 
+class TestBertPackedVarlenBench:
+    """The packed-vs-dense varlen extra must run end to end on a tiny
+    model before it spends window time: both legs train, the real-token
+    accounting is consistent, and packed fits more real tokens into
+    the same device batch."""
+
+    def test_tiny_cpu(self):
+        import jax
+        import jax.numpy as jnp
+
+        bench = _load_bench()
+
+        from apex_tpu.models.bert import BertModel
+        tiny = BertModel(vocab_size=128, hidden_size=32, num_heads=4,
+                         num_layers=1, max_seq_len=64,
+                         dtype=jnp.float32)
+        out = bench.bench_bert_packed_varlen(
+            jax, jnp, model=tiny, rows=2, seq=64, steps=2, chunk=2)
+        for k in ("bert_varlen_packed_step_ms",
+                  "bert_varlen_dense_step_ms",
+                  "bert_varlen_packed_real_tokens_per_sec",
+                  "bert_varlen_dense_real_tokens_per_sec",
+                  "bert_varlen_packed_speedup"):
+            assert k in out and out[k] > 0, (k, out)
+
+
 class TestCachedTpuResult:
     """bench.py's report-time fallback ladder serves the recorded
     hardware window when the tunnel is down; a bug here either loses a
     real measurement or re-labels a CPU line as hardware."""
 
     def test_contract(self, tmp_path):
-        import importlib.util
-        spec = importlib.util.spec_from_file_location(
-            "bench_mod", os.path.join(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))), "bench.py"))
-        bench = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(bench)
+        bench = _load_bench()
 
         p = tmp_path / "bench_tpu.json"
         # clean TPU line with embedded capture time and a long error
