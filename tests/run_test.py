@@ -25,7 +25,8 @@ import sys
 
 SUITES = {
     "run_amp": ["tests/test_amp.py", "tests/test_amp_wrap.py",
-                "tests/test_L1_trajectory.py"],
+                "tests/test_L1_trajectory.py",
+                "tests/test_torch_amp.py"],
     "run_optimizers": ["tests/test_multi_tensor.py",
                        "tests/test_optimizers.py",
                        "tests/test_distributed_optimizers.py"],
@@ -44,7 +45,8 @@ SUITES = {
                     "tests/test_contrib_misc.py",
                     "tests/test_sparsity_pyprof.py"],
     "run_distributed": ["tests/test_parallel.py",
-                        "tests/test_wgrad.py"],
+                        "tests/test_wgrad.py",
+                        "tests/test_distributed_launch.py"],
     "run_checkpoint": ["tests/test_native_checkpoint.py",
                        "tests/test_resilience.py"],
     "run_models": ["tests/test_models.py"],
@@ -52,6 +54,10 @@ SUITES = {
     "run_data": ["tests/test_data.py"],
     "run_offload": ["tests/test_offload.py"],
     "run_quantization": ["tests/test_quantization.py"],
+    # harness/tooling logic (platform select, amortized timer, the
+    # kernel-bench distillers that write dispatch defaults)
+    "run_harness": ["tests/test_platform.py", "tests/test_benchlib.py",
+                    "tests/test_kernel_bench_logic.py"],
     # AOT Mosaic lowering for the TPU platform — runs in CPU CI
     "run_tpu_lowering": ["tests/test_tpu_lowering.py"],
     # TPU-only: needs APEX_TPU_SMOKE=1 and a real chip (else skips)

@@ -8,14 +8,18 @@ import json
 import os
 
 
-def _load_tool(name):
-    spec = importlib.util.spec_from_file_location(
-        name,
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "tools", f"{name}.py"))
+def _load_path(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
     return m
+
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    return _load_path(name, os.path.join(_ROOT, "tools", f"{name}.py"))
 
 
 kb = _load_tool("kernel_bench")
@@ -24,12 +28,7 @@ ps = _load_tool("profile_step")
 
 
 def _load_bench():
-    spec = importlib.util.spec_from_file_location(
-        "bench_mod", os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "bench.py"))
-    m = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(m)
-    return m
+    return _load_path("bench_mod", os.path.join(_ROOT, "bench.py"))
 
 
 class TestSelectAttnCaps:
@@ -236,6 +235,24 @@ class TestTraceOpSummarizer:
             tmp_path, [{"ph": "M", "pid": 3, "name": "process_name",
                         "args": {"name": "/device:TPU:0"}}]))
         assert rows == []
+
+
+def test_run_test_suite_map_covers_every_test_file():
+    """The reference-shaped suite driver (tests/run_test.py) maps suite
+    names onto pytest files; a new test module left out of the map is
+    silently skipped by `--include`-style invocations."""
+    import glob
+
+    rt = _load_path("run_test_mod",
+                    os.path.join(_ROOT, "tests", "run_test.py"))
+    mapped = {f for fs in rt.SUITES.values() for f in fs}
+    have = {"tests/" + os.path.basename(p)
+            for p in glob.glob(os.path.join(_ROOT, "tests",
+                                            "test_*.py"))}
+    assert have <= mapped, f"unmapped test files: {sorted(have - mapped)}"
+    # and no dangling entries: a renamed module must not leave a map
+    # entry pytest would abort on
+    assert mapped <= have, f"stale suite entries: {sorted(mapped - have)}"
 
 
 class TestBertPackedVarlenBench:
