@@ -52,6 +52,13 @@ def parse_args():
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (hosted-TPU images "
                         "override JAX_PLATFORMS; see apex_tpu.platform)")
+    p.add_argument("--stem-space-to-depth", action="store_true",
+                   help="MXU-efficient stem: compute the 7x7/s2 stem "
+                        "conv as a 4x4/s1 conv over space-to-depth "
+                        "input (same function, pinned by tests; the "
+                        "MXU sees 12 input channels instead of 3 — "
+                        "the MLPerf TPU ResNet transform bench.py "
+                        "uses on hardware)")
     return p.parse_args()
 
 
@@ -67,6 +74,8 @@ def main():
           f"on {jax.default_backend()}")
 
     kwargs = dict(num_classes=1000)
+    if args.stem_space_to_depth:
+        kwargs["stem_space_to_depth"] = True
     if args.sync_bn:
         # reference: apex.parallel.convert_syncbn_model(model); here the
         # model takes the norm class directly

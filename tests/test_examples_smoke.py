@@ -52,6 +52,16 @@ def test_imagenet_tiny_cpu(capsys):
     assert "throughput" in capsys.readouterr().out
 
 
+def test_imagenet_space_to_depth_stem(capsys):
+    # the MXU-efficient stem bench.py enables on hardware, reachable
+    # from the reference-shaped CLI too
+    _run("examples/imagenet/main_amp.py",
+         ["--cpu", "--steps", "2", "--batch-size", "2",
+          "--image-size", "32", "--arch", "resnet18",
+          "--stem-space-to-depth"])
+    assert "throughput" in capsys.readouterr().out
+
+
 def test_dcgan_two_scalers(capsys):
     _run("examples/dcgan/main_amp.py",
          ["--cpu", "--steps", "2", "--batch-size", "4"])
