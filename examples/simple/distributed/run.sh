@@ -1,0 +1,20 @@
+#!/bin/bash
+# Reference parity: examples/simple/distributed/run.sh launched the
+# DDP example with `python -m torch.distributed.launch`.  Same shape
+# here, two flavors:
+#
+#   ./run.sh            # SPMD: ONE process drives the whole mesh
+#   ./run.sh multiproc  # N OS processes + rendezvous (the reference's
+#                       # launch-per-rank flow; gloo on CPU)
+set -eu
+cd "$(dirname "$0")/../../.."
+
+if [ "${1:-spmd}" = "multiproc" ]; then
+    PYTHONPATH=. exec python -m apex_tpu.launch --nproc "${NPROC:-2}" \
+        examples/simple/distributed/train_multiproc.py
+else
+    PYTHONPATH=. \
+    XLA_FLAGS="--xla_force_host_platform_device_count=${NDEV:-8}" \
+    JAX_PLATFORMS=cpu exec python \
+        examples/simple/distributed/train_ddp.py
+fi
