@@ -68,6 +68,7 @@ SLOW_TESTS = {
     "test_attention.py::test_ring_attention_grads_match_full",
     "test_attention.py::test_ring_kernel_matches_ring_ref",
     "test_attention.py::test_flash_attention_multiblock_tiling",
+    "test_attention.py::test_single_kv_fast_path_matches_generic_kernel",
     "test_attention.py::test_flash_attention_segment_ids_grads",
     "test_attention.py::test_ulysses_attention_grads_match_full",
     "test_moe.py::test_expert_parallel_grads_finite_and_match",

@@ -183,6 +183,39 @@ def test_flash_attention_multiblock_tiling(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_single_kv_fast_path_matches_generic_kernel(causal,
+                                                    monkeypatch):
+    """The nk==1 scratch-free forward (round 5) vs the generic online
+    kernel on the SAME inputs — kernel-to-kernel, tighter than the
+    oracle-tolerance grids: forcing a 128 cap makes the same s=256
+    shape tile as two KV blocks through the generic body."""
+    from apex_tpu.ops import _dispatch
+
+    # pin the geometry sources: a dev-shell cap export or a measured
+    # table entry would silently tile BOTH legs multi-block and the
+    # comparison would cover nothing
+    monkeypatch.delenv("APEX_TPU_ATTN_BLOCK_CAP", raising=False)
+    monkeypatch.setattr(_dispatch, "_ATTN_CAPS", {})
+    q, k, v = qkv(jax.random.key(9), b=1, h=2, s=256, d=64)
+
+    def fwd_and_grads():
+        o = attn.flash_attention(q, k, v, causal)
+        g = jax.grad(lambda *a: jnp.sum(
+            attn.flash_attention(*a, causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        return (o,) + g
+
+    assert attn._geom(q, k)[7] == 256       # bk covers skp: nk == 1
+    fast = fwd_and_grads()          # default cap 512 -> nk == 1
+    monkeypatch.setenv("APEX_TPU_ATTN_BLOCK_CAP", "128")
+    assert attn._geom(q, k)[7] == 128       # forced: nk == 2
+    generic = fwd_and_grads()
+    for a, b_ in zip(fast, generic):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_kernel_matches_ring_ref(causal):
     """The flash-kernel ring == the jnp blockwise ring (fwd + grads),
     on multi-128-block per-shard lengths."""
