@@ -54,6 +54,13 @@ def main(argv=None) -> int:
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
+    if args.nproc < 1 or args.nnodes < 1:
+        ap.error(f"--nproc/--nnodes must be >= 1 (got {args.nproc}/"
+                 f"{args.nnodes}); a zero-worker launch exiting 0 "
+                 "would report success with no training run")
+    if not 0 <= args.node_rank < args.nnodes:
+        ap.error(f"--node-rank {args.node_rank} outside "
+                 f"[0, {args.nnodes})")
     if args.nnodes > 1 and not args.coordinator:
         ap.error("--coordinator host:port is required with --nnodes>1 "
                  "(every node must name the same rendezvous)")

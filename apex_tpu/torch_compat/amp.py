@@ -71,14 +71,19 @@ _OPT_LEVELS = {
 
 class LossScaler:
     """Dynamic loss scaling (reference: apex/amp/scaler.py): backoff
-    ×0.5 on overflow, grow ×2 after 2000 consecutive clean steps."""
+    ×0.5 on overflow, grow ×2 after 2000 consecutive clean steps,
+    clamped to [min_loss_scale, max_loss_scale]."""
 
     def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
-                 scale_factor=2.0, scale_window=2000):
+                 scale_factor=2.0, scale_window=2000,
+                 min_loss_scale=None, max_loss_scale=2.0 ** 24):
         self.dynamic = loss_scale == "dynamic"
         self._scale = float(init_scale if self.dynamic else loss_scale)
         self._factor = scale_factor
         self._window = scale_window
+        self._min = (1.0 if min_loss_scale is None
+                     else float(min_loss_scale))
+        self._max = float(max_loss_scale)
         self._unskipped = 0
 
     def loss_scale(self):
@@ -88,18 +93,20 @@ class LossScaler:
         if not self.dynamic:
             return
         if overflow:
-            self._scale = max(self._scale / self._factor, 1.0)
+            self._scale = max(self._scale / self._factor, self._min)
             self._unskipped = 0
         else:
             self._unskipped += 1
             if self._unskipped >= self._window:
-                self._scale *= self._factor
+                self._scale = min(self._scale * self._factor,
+                                  self._max)
                 self._unskipped = 0
 
 
 class _AmpState:
     def __init__(self):
         self.initialized = False
+        self.enabled = True
         self.opt_properties = None
         self.loss_scalers = []
         self.optimizers = []
@@ -152,8 +159,19 @@ def _cast_tree(x, dtype):
 def _wrap_cast(fn, dtype):
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        if "out" in kwargs:
+            # out= is a destination, not an operand: casting it would
+            # leave the caller's buffer unwritten, and NOT casting it
+            # trips torch's dtype check against the cast operands —
+            # the reference bans out= on patched ops the same way
+            raise NotImplementedError(
+                f"amp O1: out= is not supported on the patched op "
+                f"{getattr(fn, '__name__', fn)!s} — drop out= (use "
+                f"the return value) or initialize without "
+                f"patch_torch_functions")
         return fn(*_cast_tree(list(args), dtype),
-                  **{k: _cast_tree(v, dtype) for k, v in kwargs.items()})
+                  **{k: _cast_tree(v, dtype)
+                     for k, v in kwargs.items()})
     wrapper._amp_original = fn
     return wrapper
 
@@ -178,7 +196,8 @@ def _patch_torch_functions(half_dtype):
 # _process_optimizer.py)
 # ---------------------------------------------------------------------------
 
-def _cast_model(model, dtype, keep_batchnorm_fp32):
+def _cast_model(model, dtype, keep_batchnorm_fp32,
+                cast_model_outputs=None):
     # snapshot EVERY float tensor before the cast: (a) BN restoration
     # below must be exact, not a half round-trip; (b) O2 masters copy
     # from these originals instead of re-upcasting rounded half params
@@ -215,9 +234,12 @@ def _cast_model(model, dtype, keep_batchnorm_fp32):
 
     @functools.wraps(orig_forward)
     def forward(*args, **kwargs):
-        return orig_forward(*_cast_tree(list(args), dtype),
-                            **{k: _cast_tree(v, dtype)
-                               for k, v in kwargs.items()})
+        out = orig_forward(*_cast_tree(list(args), dtype),
+                           **{k: _cast_tree(v, dtype)
+                              for k, v in kwargs.items()})
+        if cast_model_outputs is not None:
+            out = _cast_tree(out, cast_model_outputs)
+        return out
 
     forward._amp_original = orig_forward
     model.forward = forward
@@ -332,6 +354,16 @@ def initialize(models, optimizers=None, opt_level="O1", **overrides):
         deinitialize()
     patch_dtype = overrides.pop("patch_dtype", _CPU_HALF)
     num_losses = overrides.pop("num_losses", None)
+    # reference-surface kwargs (apex/amp/frontend.py): verbosity is
+    # accepted and ignored (we don't print banners); enabled=False
+    # makes the whole frontend a no-op passthrough; the scale bounds
+    # feed the LossScaler; cast_model_outputs casts what the patched
+    # forward RETURNS
+    overrides.pop("verbosity", None)
+    enabled = overrides.pop("enabled", True)
+    min_loss_scale = overrides.pop("min_loss_scale", None)
+    max_loss_scale = overrides.pop("max_loss_scale", 2.0 ** 24)
+    cast_model_outputs = overrides.pop("cast_model_outputs", None)
     opts = dict(_OPT_LEVELS[opt_level])
     for k, v in overrides.items():
         if v is None:
@@ -347,10 +379,19 @@ def initialize(models, optimizers=None, opt_level="O1", **overrides):
                 else optimizers if isinstance(optimizers, (list, tuple))
                 else [optimizers])
 
+    _amp_state.enabled = bool(enabled)
+    if not _amp_state.enabled:
+        # reference: enabled=False leaves models/optimizers untouched;
+        # scale_loss degrades to a passthrough
+        _amp_state.opt_properties = props
+        _amp_state.initialized = True
+        return models if optimizers is None else (models, optimizers)
+
     if props.cast_model_type is not None:
         for m in models_list:
             _cast_model(m, props.cast_model_type,
-                        props.keep_batchnorm_fp32)
+                        props.keep_batchnorm_fp32,
+                        cast_model_outputs)
     if props.patch_torch_functions:
         _patch_torch_functions(patch_dtype)
 
@@ -359,7 +400,8 @@ def initialize(models, optimizers=None, opt_level="O1", **overrides):
     # reference: num_losses > 1 gives each loss its own scaler (the
     # scale_loss(loss_id=...) companion); default one per optimizer
     _amp_state.loss_scalers = [
-        LossScaler(props.loss_scale)
+        LossScaler(props.loss_scale, min_loss_scale=min_loss_scale,
+                   max_loss_scale=max_loss_scale)
         for _ in range(num_losses or max(1, len(opt_list)))]
     for opt in opt_list:
         _process_optimizer(opt, props)
@@ -397,6 +439,9 @@ def scale_loss(loss, optimizer, loss_id=0, delay_unscale=False):
     micro-batch's contribution."""
     if not _amp_state.initialized:
         raise RuntimeError("amp.scale_loss used before amp.initialize")
+    if not _amp_state.enabled:
+        yield loss                  # enabled=False: pure passthrough
+        return
     if not hasattr(optimizer, "_amp_masters"):
         raise RuntimeError(
             "this optimizer was not prepared by amp.initialize — pass "

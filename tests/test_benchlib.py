@@ -94,6 +94,44 @@ def test_timeit_and_overhead_smoke():
     assert benchlib.dispatch_overhead_ms(reps=3) > 0
 
 
+def test_timeit_adaptive_converges_past_relay_share(monkeypatch):
+    """ADVICE r4: a 50 µs body probed through a 10 ms RTT must re-loop
+    until one dispatch runs ~200 ms of wall (relay share <= ~6%) — the
+    old single re-loop capped at 500 iterations left ~28% relay share
+    and biased every fast kernel's speedup toward 1.  Simulated clock:
+    wall per dispatch = RTT + n * body."""
+    body_ms, rtt_ms = 0.05, 10.0
+    clock = [0.0]
+    ns = []
+
+    class FakeG:
+        def __init__(self, n):
+            self.n = n
+
+        def __call__(self, *a):
+            ns.append(self.n)
+            clock[0] += (rtt_ms + self.n * body_ms) / 1e3
+            return jnp.float32(0)
+
+    monkeypatch.setattr(benchlib, "loop_on_device",
+                        lambda f, n: FakeG(n))
+    monkeypatch.setattr(benchlib, "sync", lambda o: None)
+    monkeypatch.setattr(benchlib.time, "perf_counter",
+                        lambda: clock[0])
+
+    ms = benchlib.timeit(lambda x: x, None, iters=20, adaptive=True)
+    n_final = ns[-1]
+    assert n_final * body_ms + rtt_ms >= 180.0      # target body met
+    assert ms <= body_ms * 1.06                     # <= ~6% residual
+    assert len({n for n in ns}) >= 3                # probed, re-looped
+    # non-adaptive keeps the probe's relay-dominated number
+    clock[0] = 0.0
+    ns.clear()
+    ms_raw = benchlib.timeit(lambda x: x, None, iters=20,
+                             adaptive=False)
+    assert ms_raw > body_ms * 5                     # RTT-dominated
+
+
 def test_int_only_args_still_loop():
     """No floating-point arg to perturb: the int fallback arm."""
     x = jnp.arange(256, dtype=jnp.int32)

@@ -85,16 +85,22 @@ def test_launcher_spawns_world_and_propagates_failure():
     assert p.returncode == 0, p.stdout[-4000:]
     assert "DIST_OK 0" in p.stdout and "DIST_OK 1" in p.stdout
 
-    # multi-node shape without a shared coordinator is a config error
-    p = subprocess.run(
-        [sys.executable, "-m", "apex_tpu.launch", "--nproc", "2",
-         "--nnodes", "2", _WORKER],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env={**env, "PYTHONPATH": os.path.dirname(
-            os.path.dirname(_WORKER))},
-        timeout=60)
-    assert p.returncode == 2
-    assert "--coordinator" in p.stdout
+    # config errors are rejected up front (torchrun semantics): a
+    # multi-node shape without a shared coordinator, and a zero-worker
+    # launch that would otherwise exit 0 with no training run
+    launch_env = {**env, "PYTHONPATH": os.path.dirname(
+        os.path.dirname(_WORKER))}
+    for argv, needle in (
+            (["--nproc", "2", "--nnodes", "2"], "--coordinator"),
+            (["--nproc", "0"], "must be >= 1"),
+            (["--nproc", "2", "--nnodes", "2", "--node-rank", "2",
+              "--coordinator", "127.0.0.1:1"], "node-rank")):
+        p = subprocess.run(
+            [sys.executable, "-m", "apex_tpu.launch", *argv, _WORKER],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=launch_env, timeout=60)
+        assert p.returncode == 2, (argv, p.stdout[-500:])
+        assert needle in p.stdout, (argv, p.stdout[-500:])
 
 def test_launcher_tears_down_siblings_on_crash(tmp_path):
     """One crashed rank must fail the whole launch promptly — a

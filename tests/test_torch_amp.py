@@ -244,6 +244,57 @@ def test_double_initialize_is_a_fresh_init():
             master.detach().to(model_p.dtype).float().numpy())
 
 
+def test_reference_kwargs_accepted():
+    """apex example code ported verbatim uses verbosity / enabled /
+    min_loss_scale / max_loss_scale / cast_model_outputs — they must
+    work, not TypeError."""
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    m, o = amp.initialize(m, o, opt_level="O2", verbosity=0,
+                          min_loss_scale=128.0, max_loss_scale=2.0 ** 18,
+                          cast_model_outputs=torch.float32)
+    out = m(torch.randn(2, 3, 8, 8))
+    assert out.dtype == torch.float32           # cast_model_outputs
+    s = amp._amp_state.loss_scalers[0]
+    assert (s._min, s._max) == (128.0, 2.0 ** 18)
+    s._scale = 128.0
+    s.update_scale(overflow=True)
+    assert s.loss_scale() == 128.0              # floor holds
+    s._scale, s._unskipped, s._window = 2.0 ** 18, 0, 1
+    s.update_scale(overflow=False)
+    assert s.loss_scale() == 2.0 ** 18          # ceiling holds
+
+    amp.deinitialize()
+    m2 = _tiny_model()
+    o2 = torch.optim.SGD(m2.parameters(), lr=0.1)
+    w0 = next(iter(m2.parameters())).detach().clone()
+    m2, o2 = amp.initialize(m2, o2, opt_level="O2", enabled=False)
+    assert next(iter(m2.parameters())).dtype == torch.float32  # untouched
+    crit = nn.CrossEntropyLoss()
+    x, y = _batch()
+    o2.zero_grad()
+    loss = crit(m2(x), y)
+    with amp.scale_loss(loss, o2) as scaled:
+        assert scaled is loss                   # pure passthrough
+        scaled.backward()
+    o2.step()
+    assert not torch.equal(next(iter(m2.parameters())).detach(), w0)
+
+
+def test_o1_out_kwarg_fails_loudly():
+    """out= under O1 is unsupportable either way (cast it and the
+    caller's buffer is never written; don't and torch rejects the
+    dtype mix) — the shim must fail with a clear error, like the
+    reference's ban, never corrupt silently."""
+    m = _tiny_model()
+    o = torch.optim.SGD(m.parameters(), lr=0.1)
+    amp.initialize(m, o, opt_level="O1")
+    a = torch.randn(4, 4)
+    buf = torch.empty(4, 4)
+    with pytest.raises(NotImplementedError, match="out="):
+        torch.mm(a, a, out=buf)
+
+
 def test_bad_opt_level_and_unknown_option():
     m = _tiny_model()
     o = torch.optim.SGD(m.parameters(), lr=0.1)
