@@ -1,0 +1,28 @@
+"""apexlint: AST-based static analysis for JAX/TPU hazards.
+
+The paper's contract is "wrap your model, keep your training loop" —
+and on TPU that contract breaks silently: a host sync serializes the
+step pipeline, a strongly-typed constant demotes a bf16 fused path, a
+Python branch on a tracer aborts jit, a forgotten donation doubles
+state HBM.  apexlint catches these statically, before hardware time.
+
+Usage:
+    python -m apex_tpu.lint apex_tpu/          # lint a tree
+    python -m apex_tpu.lint --list-rules       # rule catalog
+    tools/lint.py --json apex_tpu/             # CI wrapper
+
+Rule catalog and suppression syntax: docs/lint.md.  The package's own
+tree must stay clean: tests/test_lint.py::test_package_self_check runs
+the linter over apex_tpu/ in the tier-1 suite.
+
+This package never imports the code it lints — analysis is pure
+``ast``, so fixtures with deliberate hazards (tests/lint_fixtures/)
+lint safely.
+"""
+
+from apex_tpu.lint.findings import Finding
+from apex_tpu.lint.engine import Rule, lint_paths, lint_source
+from apex_tpu.lint.rules import all_rules, rule_catalog
+
+__all__ = ["Finding", "Rule", "all_rules", "lint_paths", "lint_source",
+           "rule_catalog"]

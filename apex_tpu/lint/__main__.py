@@ -1,0 +1,9 @@
+import sys
+
+from apex_tpu.lint.cli import main
+
+try:
+    rc = main()
+except BrokenPipeError:     # `... | head` closed the pipe mid-report
+    rc = 0
+sys.exit(rc)

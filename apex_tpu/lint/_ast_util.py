@@ -1,0 +1,265 @@
+"""Shared AST analysis for apexlint rules.
+
+One FileContext per linted file caches everything more than one rule
+wants: the import alias map (so ``jnp.dot`` and
+``jax.numpy.dot`` resolve to the same canonical name), the set of
+functions that are jitted (decorator or ``jax.jit(f)`` call site), the
+set of Pallas kernel bodies (passed to ``pl.pallas_call`` or taking
+``*_ref`` params), and the intra-file call graph used for
+"reachable from a jitted function" queries.
+
+Everything here is a static over/under-approximation by design: rules
+must stay cheap (no imports of the linted code, ever) and quiet
+(precision beats recall — a missed hazard costs a code review, a false
+positive costs the linter its credibility).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# canonical spellings rules match against
+JIT_WRAPPERS = {"jax.jit", "jax.pmap", "jax.experimental.pjit.pjit"}
+PALLAS_CALL = "jax.experimental.pallas.pallas_call"
+
+
+def parse_source(src: str, path: str) -> ast.Module:
+    return ast.parse(src, filename=path)
+
+
+def build_alias_map(tree: ast.Module) -> Dict[str, str]:
+    """Map local names to canonical dotted module/object paths.
+
+    ``import jax.numpy as jnp``          -> {"jnp": "jax.numpy"}
+    ``from jax.experimental import pallas as pl`` -> {"pl": "..pallas"}
+    ``from jax import jit``              -> {"jit": "jax.jit"}
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+class FileContext:
+    """Per-file lazily-computed analysis shared by all rules."""
+
+    def __init__(self, path: str, src: str, tree: ast.Module):
+        self.path = path
+        self.src = src
+        self.tree = tree
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # ---- name resolution -------------------------------------------------
+
+    @functools.cached_property
+    def aliases(self) -> Dict[str, str]:
+        return build_alias_map(self.tree)
+
+    def qualname(self, node: ast.expr) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, or None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def is_call_to(self, node: ast.AST, *names: str) -> bool:
+        return (isinstance(node, ast.Call)
+                and self.qualname(node.func) in names)
+
+    # ---- structure -------------------------------------------------------
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+    def enclosing_function(self, node: ast.AST):
+        for a in self.ancestors(node):
+            if isinstance(a, FunctionNode):
+                return a
+        return None
+
+    @functools.cached_property
+    def functions(self) -> Dict[str, ast.AST]:
+        """All function/method defs by bare name (last def wins —
+        intra-file linting tolerates shadowing)."""
+        return {n.name: n for n in ast.walk(self.tree)
+                if isinstance(n, FunctionNode)}
+
+    def param_names(self, fn) -> List[str]:
+        a = fn.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    # ---- jit detection ---------------------------------------------------
+
+    def _jit_callable(self, node: ast.expr) -> Optional[ast.Call]:
+        """If ``node`` evaluates to a jit transform application, return
+        the Call carrying its kwargs (static_argnums, donate_argnums).
+
+        Handles ``jax.jit``-as-decorator (no kwargs — returns a
+        synthesized empty Call), ``jax.jit(...)``, and
+        ``functools.partial(jax.jit, ...)``.
+        """
+        if self.qualname(node) in JIT_WRAPPERS:
+            return ast.Call(func=node, args=[], keywords=[])
+        if isinstance(node, ast.Call):
+            q = self.qualname(node.func)
+            if q in JIT_WRAPPERS:
+                return node
+            if q == "functools.partial" and node.args and \
+                    self.qualname(node.args[0]) in JIT_WRAPPERS:
+                return node
+        return None
+
+    @functools.cached_property
+    def jit_sites(self) -> List[Tuple[str, ast.AST, ast.Call]]:
+        """(function name, site node, jit Call with kwargs) for every
+        jit application whose target is a function defined in this file.
+
+        Covers decorators and ``jax.jit(f, ...)`` / ``jax.jit(self.f,
+        ...)`` call sites.
+        """
+        sites: List[Tuple[str, ast.AST, ast.Call]] = []
+        for fn in ast.walk(self.tree):
+            if isinstance(fn, FunctionNode):
+                for dec in fn.decorator_list:
+                    call = self._jit_callable(dec)
+                    if call is not None:
+                        sites.append((fn.name, dec, call))
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) \
+                    and self.qualname(node.func) in JIT_WRAPPERS \
+                    and node.args:
+                target = node.args[0]
+                name = None
+                if isinstance(target, ast.Name):
+                    name = target.id
+                elif isinstance(target, ast.Attribute):
+                    name = target.attr        # jax.jit(self._step)
+                if name in self.functions:
+                    sites.append((name, node, node))
+        return sites
+
+    @functools.cached_property
+    def jitted_functions(self) -> Set[str]:
+        return {name for name, _, _ in self.jit_sites}
+
+    def jit_static_params(self, fn) -> Set[str]:
+        """Parameter names marked static in any jit site for ``fn``."""
+        params = [p for p in self.param_names(fn) if p != "self"]
+        static: Set[str] = set()
+        for name, _, call in self.jit_sites:
+            if name != fn.name:
+                continue
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    for v in ast.walk(kw.value):
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, str):
+                            static.add(v.value)
+                elif kw.arg == "static_argnums":
+                    for v in ast.walk(kw.value):
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, int) \
+                                and 0 <= v.value < len(params):
+                            static.add(params[v.value])
+        return static
+
+    # ---- Pallas kernel detection ----------------------------------------
+
+    @functools.cached_property
+    def kernel_functions(self) -> Set[str]:
+        """Functions that are Pallas kernel bodies: passed (possibly
+        through functools.partial) as the first argument of
+        ``pl.pallas_call``, or — the repo convention — taking ``*_ref``
+        parameters / named ``*_kernel``."""
+        kernels: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if not self.is_call_to(node, PALLAS_CALL) or not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Call) and \
+                    self.qualname(target.func) == "functools.partial" \
+                    and target.args:
+                target = target.args[0]
+            if isinstance(target, ast.Name):
+                kernels.add(target.id)
+        for name, fn in self.functions.items():
+            if name.endswith("_kernel"):
+                kernels.add(name)
+            elif sum(p.endswith("_ref") for p in self.param_names(fn)) >= 2:
+                kernels.add(name)
+        return kernels
+
+    # ---- reachability ----------------------------------------------------
+
+    @functools.cached_property
+    def call_graph(self) -> Dict[str, Set[str]]:
+        """caller name -> bare callee names, for functions in this file.
+        ``self.f(...)`` and ``f(...)`` both resolve by last name — an
+        over-approximation that suits intra-file hot-path tracing."""
+        graph: Dict[str, Set[str]] = {n: set() for n in self.functions}
+        for name, fn in self.functions.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    callee = node.func.attr
+                if callee in self.functions and callee != name:
+                    graph[name].add(callee)
+        return graph
+
+    @functools.cached_property
+    def jit_reachable(self) -> Set[str]:
+        """Functions reachable (intra-file) from a jit root: a jitted
+        function, a Pallas kernel body, or a train-step-named def."""
+        roots = set(self.jitted_functions) | set(self.kernel_functions)
+        roots.update(n for n in self.functions
+                     if "train_step" in n or n.endswith("step_fn"))
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.call_graph.get(cur, ()))
+        return seen
+
+    def functions_in(self, names: Set[str]) -> Iterator[ast.AST]:
+        for name in sorted(names):
+            fn = self.functions.get(name)
+            if fn is not None:
+                yield fn

@@ -1,0 +1,81 @@
+"""apexlint command line: ``python -m apex_tpu.lint <paths>``.
+
+Exit codes (tools/lint.py and CI rely on these):
+  0  no findings
+  1  findings reported
+  2  usage error (no such path, empty selection)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from apex_tpu.lint.engine import collect_files, lint_paths
+from apex_tpu.lint.reporters import render_json, render_text
+from apex_tpu.lint.rules import rule_catalog
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.lint",
+        description="apexlint: static analysis for JAX/TPU hazards "
+                    "(tracer leaks, dtype promotion, recompile "
+                    "triggers, Pallas geometry).")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint")
+    p.add_argument("--json", action="store_true",
+                   help="emit machine-readable JSON instead of text")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run exclusively")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _csv(s: str):
+    return {x.strip() for x in s.split(",") if x.strip()} or None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, name, desc in rule_catalog():
+            print(f"{rid}  {name}\n    {desc}")
+        return 0
+    if not args.paths:
+        print("usage: python -m apex_tpu.lint <paths> "
+              "(try --list-rules)", file=sys.stderr)
+        return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"apexlint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    known = {rid.upper() for rid, _, _ in rule_catalog()}
+    for flag, ids in (("--select", _csv(args.select)),
+                      ("--ignore", _csv(args.ignore))):
+        bad = {i.upper() for i in ids or ()} - known
+        if bad:
+            print(f"apexlint: {flag} names unknown rule id(s): "
+                  f"{', '.join(sorted(bad))} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+    files = collect_files(args.paths)
+    if not files:
+        print(f"apexlint: no Python files under "
+              f"{', '.join(args.paths)}", file=sys.stderr)
+        return 2
+    findings = lint_paths(files, select=_csv(args.select),
+                          ignore=_csv(args.ignore))
+    render = render_json if args.json else render_text
+    print(render(findings, len(files)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

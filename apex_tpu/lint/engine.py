@@ -1,0 +1,141 @@
+"""apexlint driver: file collection, rule running, suppressions.
+
+Suppression syntax (docs/lint.md):
+
+  x = foo()        # apexlint: disable=APX101,APX301   (this line)
+  # apexlint: disable-next=APX601                      (next line)
+  # apexlint: skip-file                                (whole file)
+
+``disable=all`` silences every rule on the line.  Suppressions are
+matched against rule ids case-insensitively.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from apex_tpu.lint import _ast_util
+from apex_tpu.lint.findings import ERROR, Finding, sort_key
+
+_PRAGMA = "apexlint:"
+
+
+class Rule:
+    """One hazard family.  Subclasses set id/name/description and
+    implement check()."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: _ast_util.FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx, node, message, severity=None) -> Finding:
+        return Finding(
+            path=ctx.path, line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            rule_id=self.id, rule_name=self.name, message=message,
+            severity=severity or getattr(self, "severity", "warning"))
+
+
+def _parse_pragmas(src: str) -> Tuple[bool, Dict[int, Set[str]]]:
+    """(skip_file, {line: {suppressed rule ids (upper) or "ALL"}})."""
+    skip = False
+    per_line: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string.lstrip("#").strip()
+            if not text.lower().startswith(_PRAGMA):
+                continue
+            body = text[len(_PRAGMA):].strip()
+            if body.replace("-", "_") == "skip_file":
+                skip = True
+                continue
+            for directive, offset in (("disable-next=", 1),
+                                      ("disable=", 0)):
+                if body.startswith(directive):
+                    ids = {r.strip().upper()
+                           for r in body[len(directive):].split(",")
+                           if r.strip()}
+                    line = tok.start[0] + offset
+                    per_line.setdefault(line, set()).update(ids)
+                    break
+    except tokenize.TokenError:
+        pass
+    return skip, per_line
+
+
+def _suppressed(f: Finding, per_line: Dict[int, Set[str]]) -> bool:
+    ids = per_line.get(f.line)
+    return bool(ids) and ("ALL" in ids or f.rule_id.upper() in ids)
+
+
+def lint_source(src: str, path: str,
+                rules: Sequence[Rule]) -> List[Finding]:
+    """Lint one in-memory source.  A syntax error yields a single
+    APX000 finding rather than crashing the run."""
+    skip, per_line = _parse_pragmas(src)
+    if skip:
+        return []
+    try:
+        tree = _ast_util.parse_source(src, path)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1,
+                        col=(e.offset or 0) + 1 if e.offset else 1,
+                        rule_id="APX000", rule_name="parse-error",
+                        message=f"could not parse: {e.msg}",
+                        severity=ERROR)]
+    ctx = _ast_util.FileContext(path, src, tree)
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(f for f in rule.check(ctx)
+                        if not _suppressed(f, per_line))
+    return sorted(findings, key=sort_key)
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in {"__pycache__", ".git"})
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py") or os.path.isfile(p):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+               select: Optional[Set[str]] = None,
+               ignore: Optional[Set[str]] = None) -> List[Finding]:
+    from apex_tpu.lint.rules import all_rules
+    active = list(rules) if rules is not None else all_rules()
+    if select:
+        sel = {s.upper() for s in select}
+        active = [r for r in active if r.id.upper() in sel]
+    if ignore:
+        ign = {s.upper() for s in ignore}
+        active = [r for r in active if r.id.upper() not in ign]
+    findings: List[Finding] = []
+    for path in collect_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                path=path, line=1, col=1, rule_id="APX000",
+                rule_name="parse-error", message=f"could not read: {e}",
+                severity=ERROR))
+            continue
+        findings.extend(lint_source(src, path, active))
+    return findings

@@ -1,0 +1,39 @@
+"""Finding record and severity levels for apexlint.
+
+A finding is one (file, line, rule) diagnostic.  Findings are plain
+data — rendering lives in reporters.py, policy (what exits non-zero)
+in cli.py — so machine consumers (tools/lint.py --json, CI) get the
+same objects the text reporter prints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Severities order worst-first so max(findings, key=SEVERITIES.index)
+# style checks read naturally; both currently exit non-zero.
+ERROR = "error"
+WARNING = "warning"
+SEVERITIES = (ERROR, WARNING)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    rule_name: str
+    message: str
+    severity: str = WARNING
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule_id} [{self.rule_name}] {self.message}")
+
+
+def sort_key(f: Finding):
+    return (f.path, f.line, f.col, f.rule_id)

@@ -1,0 +1,38 @@
+"""Finding renderers: human text and machine JSON.
+
+Both consume the same Finding list lint_paths returns, so the CI
+wrapper (tools/lint.py --json) and a terminal run can never disagree
+about what was found.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import List, Sequence
+
+from apex_tpu.lint.findings import Finding
+
+
+def render_text(findings: Sequence[Finding],
+                files_checked: int) -> str:
+    lines: List[str] = [f.format() for f in findings]
+    by_rule = collections.Counter(f.rule_id for f in findings)
+    if findings:
+        summary = ", ".join(f"{rid}: {n}"
+                            for rid, n in sorted(by_rule.items()))
+        lines.append(f"apexlint: {len(findings)} finding"
+                     f"{'s' if len(findings) != 1 else ''} in "
+                     f"{files_checked} files ({summary})")
+    else:
+        lines.append(f"apexlint: {files_checked} files clean")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding],
+                files_checked: int) -> str:
+    return json.dumps({
+        "files_checked": files_checked,
+        "finding_count": len(findings),
+        "findings": [f.to_dict() for f in findings],
+    }, indent=2, sort_keys=True)

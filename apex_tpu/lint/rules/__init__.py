@@ -1,0 +1,43 @@
+"""apexlint rule registry.
+
+Rules register by being listed here; ordering is the catalog order
+(docs/lint.md) and the text reporter's grouping order.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from apex_tpu.lint.engine import Rule
+from apex_tpu.lint.rules.host_sync import HostSyncRule
+from apex_tpu.lint.rules.dtype_promotion import (
+    Float64Rule, MatmulAccumulationRule, StrongScalarRule)
+from apex_tpu.lint.rules.retrace import (
+    JitInHotPathRule, TracedBranchRule, TracedRangeRule)
+from apex_tpu.lint.rules.donation import DonationRule
+from apex_tpu.lint.rules.pallas_geometry import (
+    BlockShapeRule, ProgramIdArithmeticRule)
+from apex_tpu.lint.rules.import_env import ImportTimeEnvRule
+
+_RULE_CLASSES = (
+    HostSyncRule,
+    MatmulAccumulationRule,
+    Float64Rule,
+    StrongScalarRule,
+    TracedBranchRule,
+    JitInHotPathRule,
+    TracedRangeRule,
+    DonationRule,
+    BlockShapeRule,
+    ProgramIdArithmeticRule,
+    ImportTimeEnvRule,
+)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULE_CLASSES]
+
+
+def rule_catalog():
+    """(id, name, description) rows for --list-rules and the docs."""
+    return [(cls.id, cls.name, cls.description) for cls in _RULE_CLASSES]

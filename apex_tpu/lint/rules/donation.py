@@ -1,0 +1,61 @@
+"""APX401 training-step jit without buffer donation.
+
+A training step threads params/optimizer state through itself: the old
+buffers are dead the moment the new ones exist.  Without
+``donate_argnums`` XLA must keep both generations live, doubling the
+HBM footprint of the largest arrays in the program — the difference
+between a model fitting on a chip or not.  (apex_tpu.benchlib's
+``chunked_train_bench`` donates its carry for exactly this reason.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.engine import Rule
+
+_STATE_MARKERS = ("state", "params", "master")
+_STEP_MARKERS = ("step", "update")
+
+
+def _is_step_like(name: str) -> bool:
+    n = name.lower()
+    return any(m in n for m in _STEP_MARKERS)
+
+
+class DonationRule(Rule):
+    id = "APX401"
+    name = "train-step-without-donation"
+    description = (
+        "A jit of a step/update function that threads state-like "
+        "arguments (params/opt_state) without `donate_argnums`: old and "
+        "new state coexist in HBM.  Donate the carried buffers (or "
+        "suppress where aliasing is impossible, e.g. host-offloaded "
+        "out_shardings).")
+
+    def check(self, ctx):
+        seen = set()
+        for name, site, call in ctx.jit_sites:
+            if not _is_step_like(name):
+                continue
+            fn = ctx.functions.get(name)
+            if fn is None:
+                continue
+            params = [p.lower() for p in ctx.param_names(fn)
+                      if p != "self"]
+            carried = [p for p in params
+                       if any(m in p for m in _STATE_MARKERS)]
+            if not carried:
+                continue
+            if any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in call.keywords):
+                continue
+            key = (name, getattr(site, "lineno", 0))
+            if key in seen:
+                continue
+            seen.add(key)
+            yield self.finding(
+                ctx, site,
+                f"jit of step function `{name}` threads "
+                f"{', '.join(carried)} without donate_argnums; donate "
+                "the carried state to halve its HBM footprint")

@@ -1,0 +1,112 @@
+"""APX201/APX202/APX203 dtype-promotion hazards.
+
+TPU mixed-precision paths live or die by dtype discipline: the MXU
+accumulates in f32 only when asked (``preferred_element_type``), bf16
+storage silently promotes to f32 when mixed with a strongly-typed
+float constant, and float64 doesn't exist on the hardware at all
+(x64-disabled JAX silently downcasts; x64-enabled falls off the fast
+path).  Python scalar literals are WEAKLY typed in JAX and are the
+right way to write constants in low-precision code — these rules only
+fire on the strongly-typed spellings.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.engine import Rule
+
+_DOT_CALLS = {"jax.numpy.dot", "jax.numpy.matmul", "jax.numpy.einsum",
+              "jax.lax.dot", "jax.lax.dot_general"}
+_F64 = {"numpy.float64", "jax.numpy.float64"}
+_STRONG_CONSTRUCTORS = {"jax.numpy.float32", "numpy.float32",
+                        "jax.numpy.array", "jax.numpy.asarray",
+                        "numpy.array", "numpy.asarray"}
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+class MatmulAccumulationRule(Rule):
+    id = "APX201"
+    name = "matmul-no-preferred-element-type"
+    description = (
+        "`dot`/`matmul`/`einsum` in a Pallas kernel without "
+        "`preferred_element_type`: the MXU accumulates bf16 inputs in "
+        "bf16/f16 partials instead of f32, quietly losing precision in "
+        "the fused path.")
+
+    def check(self, ctx):
+        for fn in ctx.functions_in(ctx.kernel_functions):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and ctx.qualname(node.func) in _DOT_CALLS \
+                        and not _has_kw(node, "preferred_element_type"):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{ctx.qualname(node.func)}` in kernel "
+                        f"`{fn.name}` lacks preferred_element_type; pass "
+                        "preferred_element_type=jnp.float32 for f32 MXU "
+                        "accumulation")
+
+
+class Float64Rule(Rule):
+    id = "APX202"
+    name = "float64-on-tpu"
+    description = (
+        "float64 in device code: TPUs have no f64 units — with x64 "
+        "disabled JAX silently downcasts, with it enabled the op falls "
+        "off the fast path.  Host-side (numpy) f64 is fine and not "
+        "flagged.")
+
+    def check(self, ctx):
+        hot = ctx.jit_reachable | ctx.kernel_functions
+        for fn in ctx.functions_in(hot):
+            for node in ast.walk(fn):
+                q = None
+                if isinstance(node, (ast.Attribute, ast.Name)):
+                    q = ctx.qualname(node)
+                elif isinstance(node, ast.Constant) \
+                        and node.value == "float64":
+                    q = "'float64'"
+                if q in _F64 or q == "'float64'":
+                    yield self.finding(
+                        ctx, node,
+                        f"{q} in device-reachable `{fn.name}`: use "
+                        "float32 (or bfloat16) — TPU has no f64")
+                    break   # one per function is enough signal
+
+
+class StrongScalarRule(Rule):
+    id = "APX203"
+    name = "strong-scalar-promotes-bf16"
+    description = (
+        "A strongly-typed float constant (`jnp.float32(2.0)`, "
+        "`jnp.array(2.0)` with no dtype) as an arithmetic operand in a "
+        "Pallas kernel: mixing it with a bf16 ref load promotes the "
+        "whole expression to f32, demoting the fused bf16 path.  Use a "
+        "bare Python literal (weakly typed) or an explicit "
+        "dtype-matched constant.")
+
+    def check(self, ctx):
+        for fn in ctx.functions_in(ctx.kernel_functions):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.BinOp):
+                    continue
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Call) \
+                            and ctx.qualname(side.func) in \
+                            _STRONG_CONSTRUCTORS \
+                            and side.args \
+                            and isinstance(side.args[0], ast.Constant) \
+                            and isinstance(side.args[0].value, float) \
+                            and not _has_kw(side, "dtype") \
+                            and len(side.args) < 2:
+                        yield self.finding(
+                            ctx, side,
+                            f"strongly-typed constant "
+                            f"`{ctx.qualname(side.func)}"
+                            f"({side.args[0].value!r})` in kernel "
+                            f"`{fn.name}` arithmetic promotes bf16 "
+                            "operands; use a bare Python literal")

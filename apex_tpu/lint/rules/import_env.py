@@ -1,0 +1,47 @@
+"""APX601 environment read frozen at import time.
+
+``X = os.environ.get(...)`` at module scope bakes the environment into
+the first import: tests that monkeypatch the variable, launchers that
+set it after import, and REPL users all silently get the stale value
+(the exact failure mode apex_tpu/ops/_dispatch.py documents for
+APEX_TPU_FORCE_MOSAIC).  Read the environment inside the function that
+needs it; genuinely import-time-only knobs (logging verbosity) get an
+explicit ``# apexlint: disable=APX601`` allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.engine import Rule
+
+_ENV_CALLS = {"os.environ.get", "os.getenv"}
+
+
+class ImportTimeEnvRule(Rule):
+    id = "APX601"
+    name = "env-read-at-import"
+    description = (
+        "`os.environ` read at module import time: the value freezes at "
+        "first import, defeating monkeypatch/launcher overrides.  Read "
+        "it per call, or allowlist deliberate import-time knobs.")
+
+    def _is_env_read(self, ctx, node) -> bool:
+        if isinstance(node, ast.Call) \
+                and ctx.qualname(node.func) in _ENV_CALLS:
+            return True
+        return (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and ctx.qualname(node.value) == "os.environ")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not self._is_env_read(ctx, node):
+                continue
+            if ctx.enclosing_function(node) is not None:
+                continue
+            yield self.finding(
+                ctx, node,
+                "environment read at import time freezes the value for "
+                "the process; move it into the consuming function or "
+                "allowlist with `# apexlint: disable=APX601`")

@@ -1,0 +1,115 @@
+"""APX501/APX502 Pallas TPU geometry hazards.
+
+The TPU vector unit is (8, 128)-tiled: a BlockSpec whose trailing dims
+aren't (sublane, lane) aligned either fails Mosaic verification or
+silently pads — burning VMEM and masking a geometry bug until a shape
+change trips it (see /opt/skills guidance baked into docs/kernels.md).
+Grid-edge arithmetic on ``pl.program_id`` without a guard reads/writes
+out of the logical array in the last block.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.engine import Rule
+
+_BLOCKSPEC = ("jax.experimental.pallas.BlockSpec",
+              "jax.experimental.pallas.tpu.BlockSpec")
+_SUBLANE, _LANE = 8, 128
+
+
+class BlockShapeRule(Rule):
+    id = "APX501"
+    name = "unaligned-block-shape"
+    description = (
+        "A literal BlockSpec block shape whose lane dim isn't a "
+        "multiple of 128 or whose sublane dim isn't 1 or a multiple of "
+        "8: Mosaic pads (VMEM waste) or rejects the kernel outright.")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.qualname(node.func) in _BLOCKSPEC
+                    and node.args
+                    and isinstance(node.args[0], ast.Tuple)):
+                continue
+            if any(kw.arg == "memory_space" for kw in node.keywords):
+                # SMEM/ANY blocks (scalar accumulators) aren't lane-tiled
+                continue
+            dims = node.args[0].elts
+            if len(dims) < 2:
+                continue
+            lane, sub = dims[-1], dims[-2]
+            if isinstance(lane, ast.Constant) \
+                    and isinstance(lane.value, int) \
+                    and lane.value % _LANE != 0:
+                yield self.finding(
+                    ctx, node,
+                    f"block lane dim {lane.value} is not a multiple of "
+                    f"{_LANE}; pad the last block dim to the VPU lane "
+                    "width")
+            if isinstance(sub, ast.Constant) \
+                    and isinstance(sub.value, int) \
+                    and sub.value != 1 and sub.value % _SUBLANE != 0:
+                yield self.finding(
+                    ctx, node,
+                    f"block sublane dim {sub.value} is not 1 or a "
+                    f"multiple of {_SUBLANE}; align the second-to-last "
+                    "block dim to the sublane tile")
+
+
+def _program_id_names(fn, ctx):
+    """Variables assigned from pl.program_id(...) in this function."""
+    names = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and ctx.is_call_to(node.value,
+                                   "jax.experimental.pallas.program_id"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+class ProgramIdArithmeticRule(Rule):
+    id = "APX502"
+    name = "unguarded-program-id-arithmetic"
+    description = (
+        "`pl.program_id` offset arithmetic (`i + 1`, `i - 1`) in a "
+        "kernel with no `pl.when` guard and no modulo wrap: the first/"
+        "last grid step indexes outside the logical array.")
+
+    def check(self, ctx):
+        for fn in ctx.functions_in(ctx.kernel_functions):
+            has_when = any(
+                ctx.is_call_to(n, "jax.experimental.pallas.when")
+                for n in ast.walk(fn))
+            if has_when:
+                continue
+            pid_names = _program_id_names(fn, ctx)
+
+            def is_pid(e):
+                return (isinstance(e, ast.Name) and e.id in pid_names) \
+                    or ctx.is_call_to(
+                        e, "jax.experimental.pallas.program_id")
+
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, (ast.Add, ast.Sub))
+                        and (is_pid(node.left) or is_pid(node.right))):
+                    continue
+                guarded = any(
+                    isinstance(a, ast.BinOp)
+                    and isinstance(a.op, ast.Mod)
+                    for a in ctx.ancestors(node))
+                if not guarded and not any(
+                        isinstance(p, ast.BinOp)
+                        and isinstance(p.op, ast.Mod)
+                        for p in ast.walk(node)):
+                    yield self.finding(
+                        ctx, node,
+                        f"program_id offset arithmetic in kernel "
+                        f"`{fn.name}` has no pl.when guard or modulo "
+                        "wrap; the grid edge reads out of bounds")
+                    break   # one per kernel keeps the signal readable

@@ -1,0 +1,143 @@
+"""APX301/APX302/APX303 retrace and concretization triggers.
+
+``jax.jit`` specializes on Python control flow at trace time: a branch
+on a traced value aborts compilation (ConcretizationTypeError), and a
+jit wrapper constructed inside a hot function or loop builds a fresh
+cache entry per call — the program recompiles every step and the
+"compile once, dispatch forever" contract (PAPER.md §0) silently
+becomes "compile forever".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from apex_tpu.lint.engine import Rule
+from apex_tpu.lint.findings import ERROR
+
+from apex_tpu.lint._ast_util import JIT_WRAPPERS
+
+_NUMERIC_CMPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.Eq, ast.NotEq)
+
+
+def _traced_name_in_test(test: ast.expr, traced: Set[str]):
+    """A traced parameter used where Python needs a bool NOW: the bare
+    name, `not name`, or a numeric comparison on it.  `is (not) None`,
+    `isinstance`, and attribute probes (`x.ndim`, `x.dtype`) are
+    trace-time-static and deliberately not matched."""
+    if isinstance(test, ast.Name) and test.id in traced:
+        return test
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _traced_name_in_test(test.operand, traced)
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            hit = _traced_name_in_test(v, traced)
+            if hit is not None:
+                return hit
+        return None
+    if isinstance(test, ast.Compare) \
+            and all(isinstance(op, _NUMERIC_CMPS) for op in test.ops):
+        for side in [test.left] + list(test.comparators):
+            if isinstance(side, ast.Name) and side.id in traced:
+                return side
+    return None
+
+
+class TracedBranchRule(Rule):
+    id = "APX301"
+    name = "traced-value-python-branch"
+    severity = ERROR
+    description = (
+        "`if`/`while` on a traced parameter inside a jitted function: "
+        "tracing aborts with ConcretizationTypeError.  Use `lax.cond`/"
+        "`jnp.where`, or mark the argument static.")
+
+    def check(self, ctx):
+        for fn in ctx.functions_in(ctx.jitted_functions):
+            static = ctx.jit_static_params(fn)
+            traced = {p for p in ctx.param_names(fn)
+                      if p != "self" and p not in static}
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    hit = _traced_name_in_test(node.test, traced)
+                    if hit is not None:
+                        kind = ("while"
+                                if isinstance(node, ast.While) else "if")
+                        yield self.finding(
+                            ctx, node,
+                            f"Python `{kind}` on traced parameter "
+                            f"`{hit.id}` in jitted `{fn.name}`; use "
+                            "lax.cond/jnp.where or static_argnums")
+
+
+class JitInHotPathRule(Rule):
+    id = "APX302"
+    name = "jit-construction-in-hot-path"
+    description = (
+        "`jax.jit(...)` constructed inside a loop or immediately "
+        "invoked: every pass builds a fresh wrapper whose cache is "
+        "thrown away — the step recompiles each call.  Hoist the "
+        "jitted callable to module/init scope.")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or ctx.qualname(node.func) not in JIT_WRAPPERS:
+                continue
+            in_loop = False
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.For, ast.While)):
+                    in_loop = True
+                    break
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break
+            if in_loop:
+                yield self.finding(
+                    ctx, node,
+                    "`jax.jit` constructed inside a loop "
+                    "recompiles every iteration; hoist it out")
+                continue
+            # immediate invocation is only a hazard where it repeats:
+            # inside the jit-reachable set or a step-like function.
+            # One-shot `jax.jit(init)(key, x)` at setup is idiomatic.
+            parent = ctx.parents.get(node)
+            enclosing = ctx.enclosing_function(node)
+            if isinstance(parent, ast.Call) and parent.func is node \
+                    and enclosing is not None \
+                    and (enclosing.name in ctx.jit_reachable
+                         or "step" in enclosing.name.lower()):
+                yield self.finding(
+                    ctx, node,
+                    "`jax.jit(f)(...)` immediate invocation in hot "
+                    f"`{enclosing.name}`: the compiled cache dies with "
+                    "the wrapper; bind `g = jax.jit(f)` once and call "
+                    "`g`")
+
+
+class TracedRangeRule(Rule):
+    id = "APX303"
+    name = "traced-value-in-range"
+    severity = ERROR
+    description = (
+        "`range(n)` on a traced parameter inside a jitted function: "
+        "Python iteration needs a concrete int, so tracing aborts — "
+        "and making it static instead retraces per distinct value.  "
+        "Use `lax.fori_loop`/`lax.scan`.")
+
+    def check(self, ctx):
+        for fn in ctx.functions_in(ctx.jitted_functions):
+            static = ctx.jit_static_params(fn)
+            traced = {p for p in ctx.param_names(fn)
+                      if p != "self" and p not in static}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "range" \
+                        and any(isinstance(a, ast.Name)
+                                and a.id in traced for a in node.args):
+                    yield self.finding(
+                        ctx, node,
+                        f"`range()` over traced parameter in jitted "
+                        f"`{fn.name}`; use lax.fori_loop/lax.scan")

@@ -382,6 +382,9 @@ def _keep_mask(seed, i_flat, rows, cols, rate):
                 + rows.astype(jnp.int32) * jnp.int32(-1654467297)
                 + cols.astype(jnp.int32) * jnp.int32(2024237689))
     # unsigned compare in int32: flip the sign bit of both sides
+    # host math on the STATIC rate (per contract above), not a traced
+    # concretization
+    # apexlint: disable-next=APX101
     thresh = min(int((1.0 - rate) * 4294967296.0), 4294967295)
     tu = thresh ^ 0x80000000
     t = jnp.int32(tu - (1 << 32) if tu >= (1 << 31) else tu)

@@ -116,15 +116,20 @@ class FusedOptimizerBase:
             self.opt_state = place_on_host(self.opt_state)
             self._fused_offload = on_tpu()
             if self._fused_offload:
-                self._jit_step = jax.jit(
+                # no donation: the state crosses memory kinds
+                # (pinned_host in, device math, pinned_host out) and
+                # donating across spaces is not aliasable anyway
+                self._jit_step = jax.jit(  # apexlint: disable=APX401
                     self._full_step_offload,
                     out_shardings=(None, None,
                                    tree_map(_host_sharding,
                                             self.opt_state)))
             else:
-                self._jit_step = jax.jit(self._full_step)
+                self._jit_step = jax.jit(self._full_step,
+                                         donate_argnums=(2,))
         else:
-            self._jit_step = jax.jit(self._full_step)
+            self._jit_step = jax.jit(self._full_step,
+                                     donate_argnums=(2,))
 
     # ---- functional core -------------------------------------------------
     def init_state(self, params: Pytree) -> Pytree:
@@ -185,17 +190,27 @@ class FusedOptimizerBase:
 
     # ---- serialization (torch Optimizer.state_dict shape) ---------------
     def state_dict(self):
+        # copy the state out: the next step() DONATES self.opt_state to
+        # the compiled update, which deletes the buffers a by-reference
+        # snapshot would still point at ("Array has been deleted" at
+        # serialization time)
         return {
             "step": int(self.step_count),
             "hypers": dict(self.hypers),
-            "state": self.opt_state,
+            "state": tree_map(
+                lambda x: jnp.array(x, copy=True)
+                if isinstance(x, jax.Array) else x, self.opt_state),
             "masters": self.masters,
         }
 
     def load_state_dict(self, sd):
         self.step_count = jnp.int32(sd["step"])
         self.hypers.update(sd["hypers"])
-        self.opt_state = sd["state"]
+        # copy: step() donates opt_state to the compiled update, and the
+        # caller's checkpoint dict must stay readable after we step
+        self.opt_state = tree_map(
+            lambda x: jnp.array(x, copy=True)
+            if isinstance(x, jax.Array) else x, sd["state"])
         if self.offload_state:
             # restore must respect the host-residency invariant NOW —
             # waiting for the next step to re-home it would leave the

@@ -16,6 +16,9 @@ def set_logging_level(verbosity) -> None:
     logging.getLogger(_LOGGER_NAME).setLevel(verbosity)
 
 
-_env_level = os.environ.get("APEX_TPU_LOG_LEVEL")
+_env_level = os.environ.get(  # apexlint: disable=APX601
+    "APEX_TPU_LOG_LEVEL")  # deliberate: the reference contract is
+# "honors the env var at import"; later changes go via
+# set_logging_level()
 if _env_level:
     set_logging_level(_env_level)

@@ -22,6 +22,7 @@ is the multi-host / multi-process shape.
 
 from __future__ import annotations
 
+import functools
 import os
 import sys
 
@@ -98,7 +99,7 @@ def main() -> int:
 
     x, y = put((batch, 16), x_rows), put((batch,), y_rows)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, i, x, y):
         def loss_fn(p):
             logits = model.apply({"params": p}, x)

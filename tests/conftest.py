@@ -80,6 +80,9 @@ SLOW_TESTS = {
     "test_contrib_multihead_attn.py::"
     "test_fmha_packed_matches_per_sequence_attention",
     "test_kernel_bench_logic.py::test_tiny_cpu",  # packed-varlen bench
+    # three CLI subprocesses, each paying the jax import; the tier-1
+    # lint gate is test_package_self_check, which stays fast-tier
+    "test_lint.py::test_cli_exit_codes_and_json",
 }
 
 

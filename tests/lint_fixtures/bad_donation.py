@@ -1,0 +1,17 @@
+# apexlint fixture: donation family (APX401) — a step jit threading
+# state without donate_argnums keeps two state generations in HBM.
+import jax
+
+
+def train_step(params, opt_state, batch):
+    grads = jax.grad(lambda p: (p * batch).sum())(params)
+    new_params = params - 1e-3 * grads
+    return new_params, opt_state
+
+
+update = jax.jit(train_step)                   # APX401
+
+
+@jax.jit
+def ema_update(ema_state, value):               # APX401 (decorator form)
+    return 0.9 * ema_state + 0.1 * value

@@ -1,0 +1,15 @@
+# apexlint fixture: dtype-promotion family (APX201/APX202/APX203).
+import jax
+import jax.numpy as jnp
+
+
+def matmul_kernel(x_ref, w_ref, o_ref):
+    x = x_ref[...]
+    w = w_ref[...]
+    acc = jnp.dot(x, w)                        # APX201: bf16 partials
+    o_ref[...] = acc * jnp.float32(0.5)        # APX203: strong scalar
+
+
+@jax.jit
+def upcast(x):
+    return x.astype(jnp.float64)               # APX202: f64 on TPU

@@ -1,0 +1,38 @@
+# apexlint fixture: every host sync below must trip APX101 (and only
+# APX101 — donation is satisfied so families stay isolated).
+# These files are linted as TEXT, never imported.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    loss = jnp.mean(batch)
+    scalar = loss.item()                 # APX101: .item()
+    host = np.asarray(state)             # APX101: np.asarray
+    f = float(loss)                      # APX101: float() concretizes
+    fetched = jax.device_get(state)      # APX101: device_get
+    state.block_until_ready()            # APX101: pipeline stall
+    return state - loss, (scalar, host, f, fetched)
+
+
+def log_metrics(state):
+    # reached from train_step? no call edge — but this one IS called
+    return summarize(state)
+
+
+def summarize(state):
+    return state
+
+
+def hot_helper(state):
+    """Called from train_step's callee chain: still jit-reachable."""
+    return int(jnp.sum(state))           # APX101: int() concretizes
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def outer_step(state):
+    return hot_helper(state)

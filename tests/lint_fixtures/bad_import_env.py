@@ -1,0 +1,6 @@
+# apexlint fixture: import-time environment family (APX601).
+import os
+
+DEBUG = os.environ.get("APEX_FIXTURE_DEBUG", "") == "1"    # APX601
+LEVEL = os.environ["APEX_FIXTURE_LEVEL"]                   # APX601
+ALT = os.getenv("APEX_FIXTURE_ALT", "fallback")            # APX601

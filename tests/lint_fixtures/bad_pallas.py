@@ -1,0 +1,19 @@
+# apexlint fixture: Pallas geometry family (APX501/APX502).
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def shift_kernel(x_ref, o_ref):
+    i = pl.program_id(0)
+    o_ref[...] = x_ref[i + 1]                  # APX502: unguarded edge
+
+
+def shifted(x):
+    return pl.pallas_call(
+        shift_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((7, 100), lambda i: (i, 0))],   # APX501
+        out_specs=pl.BlockSpec((8, 100), lambda i: (i, 0)),    # APX501
+        out_shape=jax.ShapeDtypeStruct((28, 100), jnp.float32),
+    )(x)

@@ -1,0 +1,22 @@
+# apexlint fixture: retrace/concretization family (APX301/302/303).
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def clipped_update(params, grad_norm, n):
+    if grad_norm > 1.0:                        # APX301: traced branch
+        params = params / grad_norm
+    while grad_norm > 2.0:                     # APX301: traced while
+        grad_norm = grad_norm / 2.0
+    for _ in range(n):                         # APX303: traced range
+        params = params * 0.5
+    return params
+
+
+def relaunch(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v + 1)(x))    # APX302: per-call jit
+    return out

@@ -1,0 +1,24 @@
+# apexlint fixture: donated twin of bad_donation.
+import functools
+
+import jax
+
+
+def train_step(params, opt_state, batch):
+    grads = jax.grad(lambda p: (p * batch).sum())(params)
+    new_params = params - 1e-3 * grads
+    return new_params, opt_state
+
+
+update = jax.jit(train_step, donate_argnums=(0, 1))
+
+
+@functools.partial(jax.jit, donate_argnames=("ema_state",))
+def ema_update(ema_state, value):
+    return 0.9 * ema_state + 0.1 * value
+
+
+@jax.jit
+def evaluate(params, batch):
+    """No state threads through: nothing to donate, not step-named."""
+    return (params * batch).sum()

@@ -1,0 +1,22 @@
+# apexlint fixture: dtype-disciplined twin of bad_dtype.
+import jax
+import jax.numpy as jnp
+
+
+def matmul_kernel(x_ref, w_ref, o_ref):
+    x = x_ref[...]
+    w = w_ref[...]
+    acc = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    # bare Python literal: weakly typed, keeps the bf16 path bf16
+    o_ref[...] = (acc * 0.5).astype(o_ref.dtype)
+
+
+@jax.jit
+def upcast(x):
+    return x.astype(jnp.float32)
+
+
+def host_norm(x):
+    """Host-side numpy f64 is fine — not device-reachable."""
+    import numpy as np
+    return float(np.linalg.norm(np.asarray(x, np.float64)))

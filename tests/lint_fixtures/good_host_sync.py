@@ -1,0 +1,20 @@
+# apexlint fixture: the negative twin of bad_host_sync — device math
+# stays on device, host syncs live outside the jit-reachable set.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    loss = jnp.mean(batch)
+    return state - loss
+
+
+def report(state):
+    """Host-side reporting: nothing jitted reaches this, so syncing
+    here is fine (and the right place for it)."""
+    arr = np.asarray(state)
+    return float(arr.mean()), int(arr.size)

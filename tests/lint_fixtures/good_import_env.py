@@ -1,0 +1,15 @@
+# apexlint fixture: env reads deferred to call time, plus one
+# deliberate import-time knob behind the documented allowlist pragma.
+import os
+
+
+def debug_enabled() -> bool:
+    return os.environ.get("APEX_FIXTURE_DEBUG", "") == "1"
+
+
+def level() -> str:
+    return os.environ["APEX_FIXTURE_LEVEL"]
+
+
+KNOB = os.environ.get(  # apexlint: disable=APX601
+    "APEX_FIXTURE_IMPORT_KNOB")

@@ -1,0 +1,29 @@
+# apexlint fixture: geometry-clean twin of bad_pallas — (8, 128)-tiled
+# blocks, grid edges guarded by pl.when or a modulo wrap.
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def shift_kernel(x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i > 0)
+    def _():
+        o_ref[...] = x_ref[...] + (i - 1)      # guarded by pl.when
+
+
+def rotate_kernel(x_ref, o_ref):
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    o_ref[...] = x_ref[...] * ((i + 1) % n)    # modulo wrap
+
+
+def shifted(x):
+    return pl.pallas_call(
+        shift_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(x)

@@ -1,0 +1,29 @@
+# apexlint fixture: trace-safe twin of bad_retrace — lax control flow
+# for traced values, statics marked static, jit bound once.
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
+def clipped_update(params, grad_norm, n):
+    params = jnp.where(grad_norm > 1.0, params / grad_norm, params)
+    if n > 4:        # fine: n is static_argnums
+        params = params * 2.0
+    return lax.fori_loop(0, n, lambda i, p: p * 0.5, params)
+
+
+_step = jax.jit(lambda v: v + 1)
+
+
+def relaunch(xs):
+    return [_step(x) for x in xs]
+
+
+@jax.jit
+def masked(x, mask):
+    if mask is None:         # fine: trace-time shape-level branch
+        return x
+    return jnp.where(mask, x, 0.0)

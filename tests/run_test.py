@@ -58,6 +58,7 @@ SUITES = {
     # kernel-bench distillers that write dispatch defaults)
     "run_harness": ["tests/test_platform.py", "tests/test_benchlib.py",
                     "tests/test_kernel_bench_logic.py"],
+    "run_lint": ["tests/test_lint.py"],
     # AOT Mosaic lowering for the TPU platform — runs in CPU CI
     "run_tpu_lowering": ["tests/test_tpu_lowering.py"],
     # TPU-only: needs APEX_TPU_SMOKE=1 and a real chip (else skips)

@@ -596,11 +596,11 @@ def test_dense_fallback_memory_gate(monkeypatch):
     # hatch and an explicit PREFER_XLA must reach the dense path even at
     # shapes the gate would veto (jvp-over-custom_vjp, miscompile
     # workarounds — the operator knows why they asked)
-    monkeypatch.setattr(_dispatch, "_DISABLE_PALLAS", True)
+    monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "1")
     routed.clear()
     A.flash_attention(big, big, big, causal=True)
     assert routed == ["dense"]
-    monkeypatch.setattr(_dispatch, "_DISABLE_PALLAS", False)
+    monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS")
     monkeypatch.setenv("APEX_TPU_PREFER_XLA", "attention")
     routed.clear()
     A.flash_attention(big, big, big, causal=True)

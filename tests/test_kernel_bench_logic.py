@@ -135,6 +135,39 @@ class TestWritePrefs:
         assert json.loads(p.read_text())["prefer_pallas"] == {
             "welford": True}
 
+    def test_discarded_stale_table_warns(self, tmp_path, monkeypatch):
+        """A prefs table dropped for lacking the amortized stamp must
+        say so: silence here hid a stale-benchmark misconfiguration
+        (the operator believes measured routing is active when the
+        design default is)."""
+        import pytest
+        from apex_tpu.ops import _dispatch
+        p = tmp_path / "prefs.json"
+        p.write_text(json.dumps({
+            "methodology": "dispatch-per-iteration",
+            "prefer_pallas": {"softmax": False}}))
+        monkeypatch.setattr(_dispatch, "_PREFS_PATH", str(p))
+        with pytest.warns(RuntimeWarning, match="IGNORED"):
+            assert _dispatch._load_prefs() == ({}, {})
+
+    def test_absent_or_trusted_table_stays_silent(self, tmp_path,
+                                                  monkeypatch):
+        """Only the DISCARD warns: a missing file and an amortized
+        table are both healthy states."""
+        import warnings
+        from apex_tpu.ops import _dispatch
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            monkeypatch.setattr(_dispatch, "_PREFS_PATH",
+                                str(tmp_path / "absent.json"))
+            assert _dispatch._load_prefs() == ({}, {})
+            good = tmp_path / "good.json"
+            good.write_text(json.dumps({
+                "methodology": "amortized",
+                "prefer_pallas": {"softmax": False}}))
+            monkeypatch.setattr(_dispatch, "_PREFS_PATH", str(good))
+            assert _dispatch._load_prefs() == ({"softmax": False}, {})
+
 
 class TestRelayDeathWatchdogParser:
     """The validator's mid-session relay-death detector keys off the

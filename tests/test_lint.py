@@ -1,0 +1,151 @@
+"""apexlint: fixture matrix, suppression semantics, CLI contract, and
+the tier-1 self-check keeping apex_tpu/ itself lint-clean.
+
+Fixtures in tests/lint_fixtures/ are linted as text, never imported —
+the bad ones contain deliberate hazards that would not survive a real
+trace.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from apex_tpu.lint import all_rules, lint_paths, lint_source, rule_catalog
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+REPO = os.path.dirname(HERE)
+
+# fixture file -> exactly the rule ids it must (and may) trigger;
+# equality keeps each fixture family-pure so one rule's drift can't
+# hide behind another's findings
+BAD_FIXTURES = {
+    "bad_host_sync.py": {"APX101"},
+    "bad_dtype.py": {"APX201", "APX202", "APX203"},
+    "bad_retrace.py": {"APX301", "APX302", "APX303"},
+    "bad_donation.py": {"APX401"},
+    "bad_pallas.py": {"APX501", "APX502"},
+    "bad_import_env.py": {"APX601"},
+}
+GOOD_FIXTURES = [
+    "good_host_sync.py", "good_dtype.py", "good_retrace.py",
+    "good_donation.py", "good_pallas.py", "good_import_env.py",
+]
+
+
+def _lint_fixture(name):
+    return lint_paths([os.path.join(FIXTURES, name)])
+
+
+@pytest.mark.parametrize("name,expected", sorted(BAD_FIXTURES.items()))
+def test_bad_fixture_flags_its_family(name, expected):
+    findings = _lint_fixture(name)
+    assert {f.rule_id for f in findings} == expected
+    # each finding carries a usable location and message
+    for f in findings:
+        assert f.line > 0 and f.message and f.path.endswith(name)
+
+
+@pytest.mark.parametrize("name", GOOD_FIXTURES)
+def test_good_fixture_is_clean(name):
+    findings = _lint_fixture(name)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_every_rule_family_has_fixture_coverage():
+    """The acceptance contract: >=6 families, each with a positive
+    (bad fixture) and a negative (good twin)."""
+    covered = set().union(*BAD_FIXTURES.values())
+    families = {rid[:4] for rid, _, _ in rule_catalog()}
+    assert {rid[:4] for rid in covered} == families
+    assert len(BAD_FIXTURES) >= 6 == len(GOOD_FIXTURES)
+    ids = [r.id for r in all_rules()]
+    assert len(ids) == len(set(ids))
+
+
+# ---- suppression semantics ------------------------------------------------
+
+_BAD_LINE = "import os\nX = os.environ.get('A')\n"
+
+
+def test_suppress_same_line():
+    src = "import os\nX = os.environ.get('A')  # apexlint: disable=APX601\n"
+    assert lint_source(src, "f.py", all_rules()) == []
+
+
+def test_suppress_next_line():
+    src = ("import os\n# apexlint: disable-next=APX601\n"
+           "X = os.environ.get('A')\n")
+    assert lint_source(src, "f.py", all_rules()) == []
+
+
+def test_suppress_all_and_wrong_rule():
+    base = _BAD_LINE
+    assert lint_source(base, "f.py", all_rules()) != []
+    hit = base.replace("\n", "  # apexlint: disable=all\n", 2)
+    assert lint_source(hit, "f.py", all_rules()) == []
+    miss = base.replace("\n", "  # apexlint: disable=APX101\n", 2)
+    assert lint_source(miss, "f.py", all_rules()) != []
+
+
+def test_skip_file():
+    src = "# apexlint: skip-file\n" + _BAD_LINE
+    assert lint_source(src, "f.py", all_rules()) == []
+
+
+def test_syntax_error_reports_apx000():
+    findings = lint_source("def broken(:\n", "f.py", all_rules())
+    assert [f.rule_id for f in findings] == ["APX000"]
+
+
+# ---- CLI contract ---------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "apex_tpu.lint", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+
+
+def test_package_self_check():
+    """Tier-1 gate: the shipped tree must stay apexlint-clean."""
+    proc = _run_cli("apex_tpu/")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_exit_codes_and_json():
+    bad = os.path.join("tests", "lint_fixtures", "bad_import_env.py")
+    proc = _run_cli("--json", bad)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["files_checked"] == 1
+    assert payload["finding_count"] == len(payload["findings"]) > 0
+    assert {f["rule_id"] for f in payload["findings"]} == {"APX601"}
+    assert _run_cli("no/such/path.py").returncode == 2
+    assert _run_cli("--select", "APX999", "apex_tpu/").returncode == 2
+    assert _run_cli("--list-rules").returncode == 0
+    # tools/lint.py defaults to apex_tpu/ even when an option value is
+    # the only non-dash token (`--select APX101` is not a path)
+    proc = subprocess.run(
+        [sys.executable, "tools/lint.py", "--select", "APX601"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_select_and_ignore_filters():
+    path = os.path.join(FIXTURES, "bad_dtype.py")
+    only = lint_paths([path], select={"APX201"})
+    assert {f.rule_id for f in only} == {"APX201"}
+    rest = lint_paths([path], ignore={"APX201"})
+    assert "APX201" not in {f.rule_id for f in rest} and rest
+
+
+def test_in_process_self_check_matches_cli():
+    """Same invariant as test_package_self_check without the subprocess
+    (runs in the fast tier): apex_tpu/ has zero findings."""
+    findings = lint_paths([os.path.join(REPO, "apex_tpu")])
+    assert findings == [], [f.format() for f in findings]

@@ -176,7 +176,7 @@ class TestDispatchPrefs:
 
     def test_disabled_pallas_wins_over_everything(self, monkeypatch):
         from apex_tpu.ops import _dispatch
-        monkeypatch.setattr(_dispatch, "_DISABLE_PALLAS", True)
+        monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "1")
         monkeypatch.setenv("APEX_TPU_PREFER_PALLAS", "softmax")
         assert not _dispatch.op_enabled("softmax")
 

@@ -110,3 +110,25 @@ def test_offload_state_rehomed_on_restore():
     opt2.load_state_dict(sd)
     for leaf in jax.tree_util.tree_leaves(opt2.opt_state):
         assert leaf.sharding.memory_kind == "pinned_host"
+
+
+def test_state_dict_snapshot_survives_donating_step():
+    """step() donates opt_state to the compiled update; a state_dict
+    taken BEFORE that step must stay readable (it must not alias the
+    soon-to-be-deleted buffers), and a restored checkpoint dict must
+    likewise survive the restoring optimizer's next step."""
+    from apex_tpu.optimizers import FusedAdam
+    params = {"w": jnp.ones((8,))}
+    g = {"w": jnp.full((8,), 0.5)}
+    opt = FusedAdam(params, lr=1e-2)
+    opt.step(g)
+    sd = opt.state_dict()
+    opt.step(g)                       # donates the live opt_state
+    for leaf in jax.tree_util.tree_leaves(sd["state"]):
+        np.asarray(leaf)              # snapshot buffers still alive
+
+    opt2 = FusedAdam(params, lr=1e-2)
+    opt2.load_state_dict(sd)
+    opt2.step(g)
+    for leaf in jax.tree_util.tree_leaves(sd["state"]):
+        np.asarray(leaf)              # checkpoint dict still alive

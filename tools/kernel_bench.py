@@ -296,6 +296,9 @@ def main():
                     continue
                 os.environ["APEX_TPU_ATTN_BLOCK_CAP"] = str(cap)
                 try:
+                    # re-jit per cap ON PURPOSE: the env knob changes
+                    # kernel geometry, so each cap must compile fresh
+                    # apexlint: disable-next=APX302
                     fn = jax.jit(jax.grad(
                         lambda q, k, v: jnp.sum(attn.flash_attention(
                             q, k, v, causal=True).astype(jnp.float32) ** 2),

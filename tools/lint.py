@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""CI wrapper for apexlint (docs/lint.md).
+
+Identical behavior to ``python -m apex_tpu.lint`` — same flags, same
+exit codes (0 clean / 1 findings / 2 usage) — but runnable straight
+from a checkout with no install: it puts the repo root on sys.path
+first.  With no paths it lints the package tree, so CI is one line:
+
+    python tools/lint.py --json
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from apex_tpu.lint.cli import _build_parser, main  # noqa: E402
+
+
+def run(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # decide "no paths given" with the real parser, not a token scan —
+    # `--select APX101` has a non-dash token that is not a path
+    probe, _ = _build_parser().parse_known_args(argv)
+    if not probe.paths and not probe.list_rules:
+        argv.append(os.path.join(_ROOT, "apex_tpu"))
+    return main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(run())
