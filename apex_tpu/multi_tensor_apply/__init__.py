@@ -15,6 +15,7 @@ from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
     flatten_tensors,
     unflatten_tensors,
 )
+from apex_tpu.multi_tensor_apply.packer import Bucket, BucketPlan, LeafSpec
 
 __all__ = [
     "MultiTensorApply",
@@ -23,4 +24,7 @@ __all__ = [
     "unflatten",
     "flatten_tensors",
     "unflatten_tensors",
+    "Bucket",
+    "BucketPlan",
+    "LeafSpec",
 ]

@@ -22,12 +22,17 @@ def flatten(tensors: Sequence[jax.Array]) -> jax.Array:
 
 
 def unflatten(flat: jax.Array, like: Sequence[jax.Array]) -> List[jax.Array]:
-    """apex_C.unflatten parity: split a flat buffer back to shapes of `like`."""
-    sizes = [int(t.size) for t in like]
+    """apex_C.unflatten parity: split a flat buffer back to shapes of `like`.
+
+    Offsets are Python ints, so these are STATIC ``lax.slice``s — a
+    dynamic_slice here would hide the fixed layout from XLA and block
+    its static-offset folding for no benefit."""
     splits = []
     offset = 0
-    for t, n in zip(like, sizes):
-        splits.append(jax.lax.dynamic_slice_in_dim(flat, offset, n).reshape(t.shape))
+    for t in like:
+        n = int(t.size)
+        splits.append(
+            jax.lax.slice(flat, (offset,), (offset + n,)).reshape(t.shape))
         offset += n
     return splits
 

@@ -1,0 +1,213 @@
+"""One-time bucket packing for the fused optimizers.
+
+The reference's ``multi_tensor_apply`` re-chunks the tensor lists on
+every step (cheap on CUDA — it's host pointer math).  On TPU the analog
+must not re-trace or re-concatenate per step, so the plan is computed
+ONCE at optimizer init: dtype-homogeneous parameter leaves are assigned
+to buckets, each bucket a single flat HBM buffer with static
+shape+offset metadata.  The jitted optimizer step then runs one flat
+Pallas kernel per bucket (see apex_tpu.ops.multi_tensor), and the
+packed buffers are the persistent representation — params, masters and
+optimizer state stay packed BETWEEN steps.  Unpacking (static
+``lax.slice`` + reshape per leaf, offsets are Python ints) happens only
+on the rare host-facing paths: ``state_dict()``, ``load_state_dict()``
+and the ``params`` property.
+
+Per-tensor semantics (LAMB trust ratios, NovoGrad per-tensor second
+moments) survive packing through each bucket's ``segment_ids``: a
+sorted i32 element->leaf map the segmented kernels reduce over.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+class LeafSpec(NamedTuple):
+    index: int            # position in tree_leaves order
+    shape: Tuple[int, ...]
+    size: int             # element count
+    offset: int           # element offset inside the bucket buffer
+
+
+class Bucket(NamedTuple):
+    dtype: Any            # work (stepped) dtype of every leaf in here
+    model_dtype: Any      # model-param dtype (== dtype without masters)
+    leaves: Tuple[LeafSpec, ...]
+    size: int             # total element count (exact, unpadded)
+
+
+def _leaf_arrays(tree) -> List[jax.Array]:
+    return jax.tree_util.tree_leaves(tree)
+
+
+class BucketPlan:
+    """Static packing plan for one params pytree.
+
+    Built from the WORK tree (masters when mixed-precision, else the
+    params themselves) plus, when masters exist, the model params tree
+    — buckets are keyed on (work dtype, model dtype) so the
+    master->model writeback stays a single-dtype cast per bucket.
+    """
+
+    def __init__(self, treedef, buckets: Sequence[Bucket]):
+        self.treedef = treedef
+        self.buckets = tuple(buckets)
+        self.n_leaves = sum(len(b.leaves) for b in self.buckets)
+        self._seg_ids = None
+
+    # ---- construction ----------------------------------------------------
+    @classmethod
+    def from_tree(cls, work: Pytree,
+                  model: Optional[Pytree] = None) -> Optional["BucketPlan"]:
+        """Build a plan, or None when packing is unsupported: empty
+        trees, non-floating leaves (nothing for an optimizer kernel to
+        do with them), or multi-device leaves (concatenation would
+        destroy their sharding — the per-leaf path preserves it)."""
+        work_leaves, treedef = jax.tree_util.tree_flatten(work)
+        if not work_leaves:
+            return None
+        model_leaves = (jax.tree_util.tree_leaves(model)
+                        if model is not None else work_leaves)
+        if len(model_leaves) != len(work_leaves):
+            return None
+        groups = {}
+        for i, (w, p) in enumerate(zip(work_leaves, model_leaves)):
+            if not (hasattr(w, "dtype") and hasattr(w, "shape")):
+                return None
+            if not jnp.issubdtype(w.dtype, jnp.floating):
+                return None
+            if isinstance(w, jax.Array) and len(w.sharding.device_set) > 1:
+                return None
+            key = (jnp.dtype(w.dtype), jnp.dtype(p.dtype))
+            groups.setdefault(key, []).append((i, w))
+        buckets = []
+        for (wdt, mdt), entries in groups.items():
+            specs, offset = [], 0
+            for i, w in entries:
+                size = int(np.prod(w.shape)) if w.shape else 1
+                specs.append(LeafSpec(i, tuple(w.shape), size, offset))
+                offset += size
+            buckets.append(Bucket(wdt, mdt, tuple(specs), offset))
+        return cls(treedef, buckets)
+
+    # ---- packing ---------------------------------------------------------
+    def pack(self, tree: Pytree, dtypes=None) -> List[jax.Array]:
+        """Pytree -> one flat buffer per bucket.  Trace-safe (the
+        jitted step packs the incoming grads this way: one concatenate
+        per bucket, not per leaf).  ``dtypes``: per-bucket target dtype
+        (defaults to whatever concatenation yields — homogeneous
+        inputs keep their dtype)."""
+        leaves = _leaf_arrays(tree)
+        out = []
+        for bi, b in enumerate(self.buckets):
+            parts = [jnp.ravel(leaves[s.index]) for s in b.leaves]
+            buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            want = dtypes[bi] if dtypes is not None else None
+            if want is not None and buf.dtype != want:
+                buf = buf.astype(want)
+            out.append(buf)
+        return out
+
+    def pack_work(self, tree: Pytree) -> List[jax.Array]:
+        return self.pack(tree, dtypes=[b.dtype for b in self.buckets])
+
+    def pack_model(self, tree: Pytree) -> List[jax.Array]:
+        return self.pack(tree, dtypes=[b.model_dtype for b in self.buckets])
+
+    # ---- unpacking -------------------------------------------------------
+    def _unpack_leaves(self, bufs: Sequence[jax.Array],
+                       dtypes=None) -> List[jax.Array]:
+        leaves: List[Optional[jax.Array]] = [None] * self.n_leaves
+        for bi, b in enumerate(self.buckets):
+            buf = bufs[bi]
+            want = dtypes[bi] if dtypes is not None else None
+            for s in b.leaves:
+                # static offsets -> lax.slice: XLA sees fixed layout
+                leaf = jax.lax.slice(buf, (s.offset,),
+                                     (s.offset + s.size,)).reshape(s.shape)
+                if want is not None and leaf.dtype != want:
+                    leaf = leaf.astype(want)
+                leaves[s.index] = leaf
+        return leaves
+
+    def unpack(self, bufs: Sequence[jax.Array]) -> Pytree:
+        """Per-bucket flat buffers -> pytree in the WORK dtypes."""
+        return jax.tree_util.tree_unflatten(
+            self.treedef,
+            self._unpack_leaves(bufs, [b.dtype for b in self.buckets]))
+
+    def unpack_model(self, bufs: Sequence[jax.Array]) -> Pytree:
+        """Per-bucket flat buffers -> pytree in the MODEL dtypes."""
+        return jax.tree_util.tree_unflatten(
+            self.treedef,
+            self._unpack_leaves(bufs,
+                                [b.model_dtype for b in self.buckets]))
+
+    # ---- optimizer-state packing ----------------------------------------
+    # Generic rule covering every fused optimizer's state layout:
+    #   * a state field whose leaves mirror the param shapes packs into
+    #     per-bucket flat buffers (exp_avg, exp_avg_sq, momentum, sum);
+    #   * a state field whose leaves are all scalars packs into one
+    #     (num_segments,) vector per bucket (NovoGrad's per-tensor
+    #     second moment), indexed by the bucket-local leaf ordinal.
+    def pack_state_field(self, field: Pytree) -> List[jax.Array]:
+        leaves = _leaf_arrays(field)
+        if len(leaves) != self.n_leaves:
+            raise ValueError("state field does not mirror the plan's tree")
+        if all(getattr(l, "shape", ()) == () for l in leaves):
+            return [jnp.stack([jnp.asarray(leaves[s.index], jnp.float32)
+                               for s in b.leaves])
+                    for b in self.buckets]
+        return self.pack(field)
+
+    def unpack_state_field(self, bufs: Sequence[jax.Array]) -> Pytree:
+        # Per-leaf-scalar layout iff every bucket's buffer is exactly
+        # (num leaves,).  When that coincides with the flat layout
+        # (every param leaf itself a scalar) the two agree elementwise,
+        # so either unpack is correct.  State dtypes (f32 moments even
+        # for bf16 work buffers) are preserved: no work-dtype cast here.
+        scalar = all(tuple(bufs[bi].shape) == (len(b.leaves),)
+                     for bi, b in enumerate(self.buckets))
+        flat = all(bufs[bi].size == b.size
+                   for bi, b in enumerate(self.buckets))
+        if scalar and not flat:
+            leaves: List[Optional[jax.Array]] = [None] * self.n_leaves
+            for bi, b in enumerate(self.buckets):
+                for j, s in enumerate(b.leaves):
+                    leaves[s.index] = bufs[bi][j]
+            return jax.tree_util.tree_unflatten(self.treedef, leaves)
+        return jax.tree_util.tree_unflatten(
+            self.treedef, self._unpack_leaves(bufs, dtypes=None))
+
+    # ---- segment metadata ------------------------------------------------
+    def segment_ids(self, bucket_index: int) -> jax.Array:
+        """Sorted i32 element->bucket-local-leaf map for one bucket
+        (computed once, cached; feeds the segmented LAMB/NovoGrad
+        kernels)."""
+        if self._seg_ids is None:
+            self._seg_ids = {}
+        ids = self._seg_ids.get(bucket_index)
+        if ids is None:
+            b = self.buckets[bucket_index]
+            ids = jnp.asarray(
+                np.repeat(np.arange(len(b.leaves), dtype=np.int32),
+                          [s.size for s in b.leaves]))
+            self._seg_ids[bucket_index] = ids
+        return ids
+
+    def num_segments(self, bucket_index: int) -> int:
+        return len(self.buckets[bucket_index].leaves)
+
+    def describe(self) -> List[dict]:
+        """Human/bench-facing plan summary."""
+        return [{"dtype": str(np.dtype(b.dtype)),
+                 "model_dtype": str(np.dtype(b.model_dtype)),
+                 "leaves": len(b.leaves), "elements": b.size}
+                for b in self.buckets]

@@ -43,6 +43,13 @@ from apex_tpu import comm
 from apex_tpu.ops import _dispatch
 from apex_tpu.ops._dispatch import interpret_mode, op_enabled
 
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; support
+# both so the kernels trace on either side of the rename (the old name
+# is what CPU CI ships; BENCH_r05 caught the new-name-only spelling
+# crashing every flash bench leg on the 0.4.x interpreter path)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 _NEG = -1e30
 _LANES = 128
 
@@ -467,7 +474,7 @@ def _fwd_pallas(q, k, v, scale, causal, segment_ids, need_lse=True,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
         name="apex_flash_attention_fwd",
@@ -668,7 +675,7 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids,
         out_specs=[pl.BlockSpec((1, bq, dp), lambda i, j, kk: (i, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, sqp, dp), q.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
         name="apex_flash_attention_dq",
@@ -709,7 +716,7 @@ def _bwd_pallas(q, k, v, o, lse, do, scale, causal, segment_ids,
             pltpu.VMEM((bk, dp), jnp.float32),
             pltpu.VMEM((bk, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
         name="apex_flash_attention_dkv",

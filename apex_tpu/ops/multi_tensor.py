@@ -75,6 +75,13 @@ def _f32(x):
     return x.astype(jnp.float32)
 
 
+def _all_finite(x):
+    """Kernel-safe finiteness reduction: Mosaic has no is_finite
+    lowering, but abs+lt covers it — |nan| < inf and |inf| < inf are
+    both False, so the complement flags exactly the non-finite lanes."""
+    return jnp.all(jnp.abs(x) < jnp.float32(jnp.inf))
+
+
 # ---------------------------------------------------------------------------
 # scale (+ non-finite check)   [reference: multi_tensor_scale_kernel.cu]
 # ---------------------------------------------------------------------------
@@ -89,7 +96,7 @@ def _scale_kernel(s_ref, x_ref, o_ref, flag_ref):
     x = _f32(x_ref[...])
     y = x * s_ref[0]
     o_ref[...] = y.astype(o_ref.dtype)
-    bad = jnp.logical_not(jnp.all(jnp.isfinite(y))).astype(jnp.int32)
+    bad = jnp.logical_not(_all_finite(y)).astype(jnp.int32)
     flag_ref[0] = jnp.maximum(flag_ref[0], bad)
 
 
@@ -139,7 +146,7 @@ def _axpby_kernel(s_ref, x_ref, y_ref, o_ref, flag_ref):
 
     r = s_ref[0] * _f32(x_ref[...]) + s_ref[1] * _f32(y_ref[...])
     o_ref[...] = r.astype(o_ref.dtype)
-    bad = jnp.logical_not(jnp.all(jnp.isfinite(r))).astype(jnp.int32)
+    bad = jnp.logical_not(_all_finite(r)).astype(jnp.int32)
     flag_ref[0] = jnp.maximum(flag_ref[0], bad)
 
 
@@ -309,17 +316,17 @@ def flat_adam_ref(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay, step,
 # SGD (momentum/nesterov/wd) step   [reference: multi_tensor_sgd_kernel.cu]
 # ---------------------------------------------------------------------------
 
-def _sgd_kernel(nesterov, use_momentum, first_run,
+def _sgd_kernel(nesterov, use_momentum,
                 s_ref, p_ref, g_ref, b_ref, po_ref, bo_ref):
-    lr, momentum, dampening, wd, inv_scale = (
-        s_ref[0], s_ref[1], s_ref[2], s_ref[3], s_ref[4])
+    lr, momentum, dampening, wd, inv_scale, first = (
+        s_ref[0], s_ref[1], s_ref[2], s_ref[3], s_ref[4], s_ref[5])
     p = _f32(p_ref[...])
     g = _f32(g_ref[...]) * inv_scale + wd * p
     if use_momentum:
-        if first_run:
-            buf = g
-        else:
-            buf = momentum * b_ref[...] + (1.0 - dampening) * g
+        # first_run may be traced (step == 1 inside a jitted facade
+        # step): select instead of Python-branching
+        buf = jnp.where(first > 0, g,
+                        momentum * b_ref[...] + (1.0 - dampening) * g)
         step_dir = (g + momentum * buf) if nesterov else buf
         bo_ref[...] = buf
     else:
@@ -331,7 +338,9 @@ def _sgd_kernel(nesterov, use_momentum, first_run,
 def flat_sgd(p, g, momentum_buf, *, lr, momentum=0.0, dampening=0.0,
              weight_decay=0.0, nesterov=False, first_run=False,
              grad_scale=1.0):
-    """One fused SGD step over flat buffers; returns (p, momentum_buf)."""
+    """One fused SGD step over flat buffers; returns (p, momentum_buf).
+
+    ``first_run`` may be a Python bool or a traced bool scalar."""
     if not op_enabled("multi_tensor"):
         return flat_sgd_ref(
             p, g, momentum_buf, lr=lr, momentum=momentum, dampening=dampening,
@@ -342,12 +351,13 @@ def flat_sgd(p, g, momentum_buf, *, lr, momentum=0.0, dampening=0.0,
         jnp.asarray(dampening, jnp.float32),
         jnp.asarray(weight_decay, jnp.float32),
         1.0 / jnp.asarray(grad_scale, jnp.float32),
+        jnp.asarray(first_run, jnp.float32),
     ])
     p2d, n = _as_tiles(p)
     g2d, _ = _as_tiles(g)
     b2d, _ = _as_tiles(momentum_buf)
     kernel = functools.partial(
-        _sgd_kernel, bool(nesterov), momentum != 0.0, bool(first_run))
+        _sgd_kernel, bool(nesterov), momentum != 0.0)
     po, bo = pl.pallas_call(
         kernel,
         grid=(_grid(p2d.shape[0]),),
@@ -372,12 +382,322 @@ def flat_sgd_ref(p, g, momentum_buf, *, lr, momentum=0.0, dampening=0.0,
     gf = gf + jnp.asarray(weight_decay, jnp.float32) * pf
     mom = jnp.asarray(momentum, jnp.float32)
     if momentum != 0.0:
-        if first_run:
-            buf = gf
-        else:
-            buf = mom * momentum_buf + (1 - jnp.asarray(dampening, jnp.float32)) * gf
+        # first_run may be traced: select, don't branch
+        buf = jnp.where(
+            jnp.asarray(first_run, jnp.bool_), gf,
+            mom * momentum_buf
+            + (1 - jnp.asarray(dampening, jnp.float32)) * gf)
         step_dir = gf + mom * buf if nesterov else buf
     else:
         buf = momentum_buf
         step_dir = gf
     return (pf - jnp.asarray(lr, jnp.float32) * step_dir).astype(p.dtype), buf
+
+
+# ---------------------------------------------------------------------------
+# Adagrad step   [reference: multi_tensor_adagrad.cu]
+# ---------------------------------------------------------------------------
+
+def _adagrad_kernel(s_ref, p_ref, g_ref, h_ref, po_ref, ho_ref):
+    lr, eps, wd, inv_scale = s_ref[0], s_ref[1], s_ref[2], s_ref[3]
+    p = _f32(p_ref[...])
+    g = _f32(g_ref[...]) * inv_scale + wd * p
+    h = h_ref[...] + g * g
+    ho_ref[...] = h
+    po_ref[...] = (p - lr * g / (jnp.sqrt(h) + eps)).astype(po_ref.dtype)
+
+
+def flat_adagrad(p, g, h, *, lr, eps, weight_decay=0.0, grad_scale=1.0):
+    """One fused Adagrad step over flat buffers; returns (p, h).
+
+    h is the running sum of squared (decayed) gradients, f32.
+    """
+    if not op_enabled("multi_tensor"):
+        return flat_adagrad_ref(p, g, h, lr=lr, eps=eps,
+                                weight_decay=weight_decay,
+                                grad_scale=grad_scale)
+    s = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        1.0 / jnp.asarray(grad_scale, jnp.float32),
+    ])
+    p2d, n = _as_tiles(p)
+    g2d, _ = _as_tiles(g)
+    h2d, _ = _as_tiles(h)
+    po, ho = pl.pallas_call(
+        _adagrad_kernel,
+        grid=(_grid(p2d.shape[0]),),
+        in_specs=[_smem_spec()] + [_vec_spec()] * 3,
+        out_specs=[_vec_spec()] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct(p2d.shape, p.dtype),
+            jax.ShapeDtypeStruct(h2d.shape, jnp.float32),
+        ],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_adagrad",
+    )(s, p2d, g2d, h2d)
+    return _from_tiles(po, n), _from_tiles(ho, n)
+
+
+def flat_adagrad_ref(p, g, h, *, lr, eps, weight_decay=0.0, grad_scale=1.0):
+    pf = _f32(p)
+    gf = _f32(g) / jnp.asarray(grad_scale, jnp.float32)
+    gf = gf + jnp.asarray(weight_decay, jnp.float32) * pf
+    h = h + gf * gf
+    return (pf - jnp.asarray(lr, jnp.float32) * gf /
+            (jnp.sqrt(h) + jnp.asarray(eps, jnp.float32))).astype(p.dtype), h
+
+
+# ---------------------------------------------------------------------------
+# segmented reductions over a bucket (per-TENSOR norms inside one flat
+# buffer; segment ids come from the bucket plan and are SORTED because
+# leaves are concatenated in order)
+# ---------------------------------------------------------------------------
+
+def flat_segment_sumsq(x, seg_ids, num_segments: int):
+    """Per-segment sum of squares of a flat buffer, f32 accumulation.
+
+    One XLA sorted-segment reduce — not a per-leaf loop; the elementwise
+    heavy lifting around it stays in the flat Pallas kernels."""
+    xf = _f32(x)
+    return jax.ops.segment_sum(xf * xf, seg_ids,
+                               num_segments=num_segments,
+                               indices_are_sorted=True)
+
+
+# ---------------------------------------------------------------------------
+# NovoGrad step (segmented)   [reference: multi_tensor_novograd.cu]
+# ---------------------------------------------------------------------------
+
+def _novograd_apply_kernel(grad_averaging, reg_inside_moment,
+                           s_ref, p_ref, g_ref, m_ref, d_ref,
+                           po_ref, mo_ref):
+    lr, b1, wd, inv_scale, first = (
+        s_ref[0], s_ref[1], s_ref[2], s_ref[3], s_ref[4])
+    p = _f32(p_ref[...])
+    gn = _f32(g_ref[...]) * inv_scale * d_ref[...]
+    if reg_inside_moment:
+        gn = gn + wd * p
+    coeff = (1.0 - b1) if grad_averaging else 1.0
+    m = jnp.where(first > 0, gn, b1 * m_ref[...] + coeff * gn)
+    mo_ref[...] = m
+    update = m if reg_inside_moment else m + wd * p
+    po_ref[...] = (p - lr * update).astype(po_ref.dtype)
+
+
+def flat_novograd(p, g, m, v_seg, seg_ids, *, lr, beta1, beta2, eps,
+                  weight_decay=0.0, first_run=False, grad_averaging=True,
+                  init_zero=False, reg_inside_moment=False, grad_scale=1.0):
+    """One fused NovoGrad step over a flat bucket; returns (p, m, v_seg).
+
+    ``v_seg`` is the per-TENSOR second moment, one f32 scalar per bucket
+    segment (shape ``(num_segments,)``); ``seg_ids`` maps each element of
+    the flat buffer to its segment (sorted, from the bucket plan).  The
+    per-segment gradient norms are one sorted-segment reduce; the
+    normalizer reaches the elementwise Pallas kernel as a gathered
+    per-element buffer, so the heavy math is still one grid launch.
+    ``first_run`` may be a Python bool or a traced bool scalar.
+    """
+    if not op_enabled("multi_tensor"):
+        return flat_novograd_ref(
+            p, g, m, v_seg, seg_ids, lr=lr, beta1=beta1, beta2=beta2,
+            eps=eps, weight_decay=weight_decay, first_run=first_run,
+            grad_averaging=grad_averaging, init_zero=init_zero,
+            reg_inside_moment=reg_inside_moment, grad_scale=grad_scale)
+    num_seg = v_seg.shape[0]
+    inv_scale = 1.0 / jnp.asarray(grad_scale, jnp.float32)
+    b2 = jnp.asarray(beta2, jnp.float32)
+    first = jnp.asarray(first_run, jnp.bool_)
+    g_norm_sq = flat_segment_sumsq(_f32(g) * inv_scale, seg_ids, num_seg)
+    if init_zero:
+        v_new = jnp.where(first, (1 - b2) * g_norm_sq,
+                          b2 * v_seg + (1 - b2) * g_norm_sq)
+    else:
+        v_new = jnp.where(first, g_norm_sq,
+                          b2 * v_seg + (1 - b2) * g_norm_sq)
+    inv_denom = 1.0 / (jnp.sqrt(v_new) + jnp.asarray(eps, jnp.float32))
+    d_elem = inv_denom[seg_ids]              # one gather, not per leaf
+    s = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32), inv_scale,
+        jnp.asarray(first, jnp.float32),
+    ])
+    p2d, n = _as_tiles(p)
+    g2d, _ = _as_tiles(g)
+    m2d, _ = _as_tiles(m)
+    d2d, _ = _as_tiles(d_elem)
+    kernel = functools.partial(_novograd_apply_kernel,
+                               bool(grad_averaging),
+                               bool(reg_inside_moment))
+    po, mo = pl.pallas_call(
+        kernel,
+        grid=(_grid(p2d.shape[0]),),
+        in_specs=[_smem_spec()] + [_vec_spec()] * 4,
+        out_specs=[_vec_spec()] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct(p2d.shape, p.dtype),
+            jax.ShapeDtypeStruct(m2d.shape, jnp.float32),
+        ],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_novograd",
+    )(s, p2d, g2d, m2d, d2d)
+    return _from_tiles(po, n), _from_tiles(mo, n), v_new
+
+
+def flat_novograd_ref(p, g, m, v_seg, seg_ids, *, lr, beta1, beta2, eps,
+                      weight_decay=0.0, first_run=False,
+                      grad_averaging=True, init_zero=False,
+                      reg_inside_moment=False, grad_scale=1.0):
+    num_seg = v_seg.shape[0]
+    pf = _f32(p)
+    gf = _f32(g) / jnp.asarray(grad_scale, jnp.float32)
+    b1 = jnp.asarray(beta1, jnp.float32)
+    b2 = jnp.asarray(beta2, jnp.float32)
+    wd = jnp.asarray(weight_decay, jnp.float32)
+    first = jnp.asarray(first_run, jnp.bool_)
+    g_norm_sq = flat_segment_sumsq(gf, seg_ids, num_seg)
+    if init_zero:
+        v_new = jnp.where(first, (1 - b2) * g_norm_sq,
+                          b2 * v_seg + (1 - b2) * g_norm_sq)
+    else:
+        v_new = jnp.where(first, g_norm_sq,
+                          b2 * v_seg + (1 - b2) * g_norm_sq)
+    denom = jnp.sqrt(v_new) + jnp.asarray(eps, jnp.float32)
+    gn = gf / denom[seg_ids]
+    if reg_inside_moment:
+        gn = gn + wd * pf
+    coeff = (1 - b1) if grad_averaging else jnp.float32(1.0)
+    m = jnp.where(first, gn, b1 * m + coeff * gn)
+    update = m if reg_inside_moment else m + wd * pf
+    return ((pf - jnp.asarray(lr, jnp.float32) * update).astype(p.dtype),
+            m, v_new)
+
+
+# ---------------------------------------------------------------------------
+# LAMB step (segmented)   [reference: multi_tensor_lamb.cu stage1+stage2]
+# ---------------------------------------------------------------------------
+
+def _lamb_moment_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,
+                        mo_ref, vo_ref, uo_ref):
+    b1, b2, eps, wd, c1r, c2r, gmul = (
+        s_ref[0], s_ref[1], s_ref[2], s_ref[3],
+        s_ref[4], s_ref[5], s_ref[6])
+    p = _f32(p_ref[...])
+    g = _f32(g_ref[...]) * gmul
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    mo_ref[...] = m
+    vo_ref[...] = v
+    uo_ref[...] = (m * c1r) / (jnp.sqrt(v * c2r) + eps) + wd * p
+
+
+def _apply_update_kernel(p_ref, u_ref, f_ref, po_ref):
+    po_ref[...] = (_f32(p_ref[...])
+                   - f_ref[...] * u_ref[...]).astype(po_ref.dtype)
+
+
+def flat_lamb(p, g, m, v, seg_ids, num_segments: int, *, lr, beta1, beta2,
+              eps, weight_decay=0.0, step=1, bias_correction=True,
+              grad_scale=1.0, clip_coeff=1.0, use_nvlamb=False):
+    """One fused LAMB step over a flat bucket; returns (p, m, v).
+
+    Two grid launches per bucket (the reference's stage1+stage2 shape):
+    moments + unscaled update, then the trust-ratio-scaled apply.  The
+    per-TENSOR trust ratio ||p||/||update|| is computed from bucket
+    ``seg_ids`` with one sorted-segment reduce per norm — per-tensor
+    semantics preserved without per-tensor kernels.  ``clip_coeff`` is
+    the precomputed global-grad-norm clip factor (stage-1 side input).
+    """
+    step = jnp.asarray(step, jnp.float32)
+    b1 = jnp.asarray(beta1, jnp.float32)
+    b2 = jnp.asarray(beta2, jnp.float32)
+    wd = jnp.asarray(weight_decay, jnp.float32)
+    if bias_correction:
+        c1r = 1.0 / (1.0 - b1 ** step)
+        c2r = 1.0 / (1.0 - b2 ** step)
+    else:
+        c1r = c2r = jnp.float32(1.0)
+    gmul = (jnp.asarray(clip_coeff, jnp.float32)
+            / jnp.asarray(grad_scale, jnp.float32))
+    if not op_enabled("multi_tensor"):
+        return flat_lamb_ref(
+            p, g, m, v, seg_ids, num_segments, lr=lr, beta1=beta1,
+            beta2=beta2, eps=eps, weight_decay=weight_decay, step=step,
+            bias_correction=bias_correction, grad_scale=grad_scale,
+            clip_coeff=clip_coeff, use_nvlamb=use_nvlamb)
+    s = jnp.stack([b1, b2, jnp.asarray(eps, jnp.float32), wd,
+                   c1r, c2r, gmul])
+    p2d, n = _as_tiles(p)
+    g2d, _ = _as_tiles(g)
+    m2d, _ = _as_tiles(m)
+    v2d, _ = _as_tiles(v)
+    mo, vo, update2d = pl.pallas_call(
+        _lamb_moment_kernel,
+        grid=(_grid(p2d.shape[0]),),
+        in_specs=[_smem_spec()] + [_vec_spec()] * 4,
+        out_specs=[_vec_spec()] * 3,
+        out_shape=[
+            jax.ShapeDtypeStruct(m2d.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v2d.shape, jnp.float32),
+            jax.ShapeDtypeStruct(p2d.shape, jnp.float32),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_lamb_moments",
+    )(s, p2d, g2d, m2d, v2d)
+    update = _from_tiles(update2d, n)
+    factor_elem = _lamb_trust_factor(p, update, seg_ids, num_segments,
+                                     lr, wd, use_nvlamb)
+    f2d, _ = _as_tiles(factor_elem)
+    u2d, _ = _as_tiles(update)
+    po = pl.pallas_call(
+        _apply_update_kernel,
+        grid=(_grid(p2d.shape[0]),),
+        in_specs=[_vec_spec()] * 3,
+        out_specs=_vec_spec(),
+        out_shape=jax.ShapeDtypeStruct(p2d.shape, p.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_lamb_apply",
+    )(p2d, u2d, f2d)
+    return _from_tiles(po, n), _from_tiles(mo, n), _from_tiles(vo, n)
+
+
+def _lamb_trust_factor(p, update, seg_ids, num_segments, lr, wd,
+                       use_nvlamb):
+    """Per-element lr*trust buffer from per-segment norms (one gather)."""
+    p_norm = jnp.sqrt(flat_segment_sumsq(p, seg_ids, num_segments))
+    u_norm = jnp.sqrt(flat_segment_sumsq(update, seg_ids, num_segments))
+    trust = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
+    if not use_nvlamb:
+        # standard LAMB exempts decay-free tensors from layer adaptation;
+        # NVLAMB applies the trust ratio to every layer
+        trust = jnp.where(wd == 0.0, jnp.float32(1.0), trust)
+    return (jnp.asarray(lr, jnp.float32) * trust)[seg_ids]
+
+
+def flat_lamb_ref(p, g, m, v, seg_ids, num_segments: int, *, lr, beta1,
+                  beta2, eps, weight_decay=0.0, step=1,
+                  bias_correction=True, grad_scale=1.0, clip_coeff=1.0,
+                  use_nvlamb=False):
+    step = jnp.asarray(step, jnp.float32)
+    b1 = jnp.asarray(beta1, jnp.float32)
+    b2 = jnp.asarray(beta2, jnp.float32)
+    wd = jnp.asarray(weight_decay, jnp.float32)
+    pf = _f32(p)
+    gf = _f32(g) * (jnp.asarray(clip_coeff, jnp.float32)
+                    / jnp.asarray(grad_scale, jnp.float32))
+    m = b1 * m + (1 - b1) * gf
+    v = b2 * v + (1 - b2) * gf * gf
+    if bias_correction:
+        c1r = 1.0 / (1.0 - b1 ** step)
+        c2r = 1.0 / (1.0 - b2 ** step)
+    else:
+        c1r = c2r = jnp.float32(1.0)
+    update = (m * c1r) / (jnp.sqrt(v * c2r)
+                          + jnp.asarray(eps, jnp.float32)) + wd * pf
+    factor = _lamb_trust_factor(pf, update, seg_ids, num_segments,
+                                lr, wd, use_nvlamb)
+    return (pf - factor * update).astype(p.dtype), m, v

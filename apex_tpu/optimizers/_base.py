@@ -8,6 +8,20 @@ a pure, jitted ``(params, opt_state, grads, scalars) -> (params,
 opt_state)`` function, so the same math can also be embedded directly in a
 user's jitted train step via the ``functional_step`` attribute.
 
+Bucketed flat path (default, ``fuse_buckets=True``): at construction a
+one-time :class:`~apex_tpu.multi_tensor_apply.packer.BucketPlan`
+concatenates dtype-homogeneous leaves into flat HBM buffers, and the
+jitted step runs ONE flat Pallas kernel per bucket
+(apex_tpu.ops.multi_tensor) — the TPU realization of the reference's
+``multi_tensor_apply`` + ``amp_C`` design.  Params, masters and
+optimizer state stay PACKED between steps; the per-leaf pytree view is
+rebuilt lazily (one compiled unpack program) only for ``state_dict()``,
+``load_state_dict()`` and the ``params``/``masters`` properties, and the
+checkpoint layout is unchanged — old per-leaf checkpoints load into
+bucketed optimizers and vice versa.  ``fuse_buckets=False`` (or any
+tree the packer declines: non-float leaves, multi-device shardings)
+falls back to the traced per-leaf update.
+
 Master weights: when params are bf16/fp16 and ``master_weights=True`` the
 facade keeps f32 masters, steps those, and writes back model-dtype params
 (reference O2 contract, apex/amp/_process_optimizer.py).
@@ -15,24 +29,62 @@ facade keeps f32 masters, steps those, and writes back model-dtype params
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.multi_tensor_apply.packer import BucketPlan
+
 Pytree = Any
 tree_map = jax.tree_util.tree_map
 
+# in-jit "move to device memory" marker: jax.memory.Space.Device where it
+# exists, else the older TransferToMemoryKind spelling
+try:
+    _DEVICE_MEMORY = jax.memory.Space.Device
+except AttributeError:
+    try:
+        from jax.sharding import TransferToMemoryKind as _TTMK
+    except ImportError:  # pre-public spelling
+        from jax._src.sharding_impls import TransferToMemoryKind as _TTMK
+    _DEVICE_MEMORY = _TTMK("device")
+
+
+def _memory_kinds(x: jax.Array):
+    dev = next(iter(x.sharding.device_set))
+    try:
+        return {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return set()
+
 
 def _host_sharding(x: jax.Array):
-    """The array's own sharding, re-homed to pinned host memory (the
-    TPU host-offload target; CPU also exposes the kind)."""
-    return x.sharding.with_memory_kind("pinned_host")
+    """The array's own sharding, re-homed to host memory: pinned_host
+    (the TPU host-offload target) where the backend exposes it, else
+    unpinned_host (what older-jax CPU backends call their only space)."""
+    kinds = _memory_kinds(x)
+    if "pinned_host" in kinds:
+        return x.sharding.with_memory_kind("pinned_host")
+    if "unpinned_host" in kinds:
+        return x.sharding.with_memory_kind("unpinned_host")
+    return x.sharding
+
+
+def _device_sharding(x: jax.Array):
+    kinds = _memory_kinds(x)
+    if "device" in kinds:
+        return x.sharding.with_memory_kind("device")
+    dev = next(iter(x.sharding.device_set))
+    try:
+        return x.sharding.with_memory_kind(dev.default_memory().kind)
+    except Exception:
+        return x.sharding
 
 
 def place_on_host(tree: Pytree) -> Pytree:
-    """Eagerly move every array leaf to pinned host memory, preserving
-    its device/mesh sharding."""
+    """Eagerly move every array leaf to host memory, preserving its
+    device/mesh sharding."""
     return tree_map(
         lambda x: jax.device_put(x, _host_sharding(x))
         if isinstance(x, jax.Array) else x, tree)
@@ -40,8 +92,7 @@ def place_on_host(tree: Pytree) -> Pytree:
 
 def place_on_device(tree: Pytree) -> Pytree:
     return tree_map(
-        lambda x: jax.device_put(
-            x, x.sharding.with_memory_kind("device"))
+        lambda x: jax.device_put(x, _device_sharding(x))
         if isinstance(x, jax.Array) else x, tree)
 
 
@@ -59,12 +110,21 @@ def _is_low_precision(tree) -> bool:
                if jnp.issubdtype(l.dtype, jnp.floating))
 
 
+def _select(keep, new_tree, old_tree):
+    """Branch-free elementwise keep?new:old over matching pytrees (the
+    amp found_inf skip — mirrors amp.scaler.conditional_step, never a
+    host sync)."""
+    return tree_map(lambda a, b: jnp.where(keep, a, b), new_tree, old_tree)
+
+
 class FusedOptimizerBase:
-    """Subclasses set ``defaults`` and implement ``_step_math``."""
+    """Subclasses set ``defaults`` and implement ``_step_math`` (per-leaf
+    oracle path) plus ``_flat_bucket_step`` (bucketed flat path)."""
 
     def __init__(self, params: Pytree, master_weights: Optional[bool] = None,
                  masters: Optional[Pytree] = None,
-                 offload_state: bool = False, **hypers):
+                 offload_state: bool = False,
+                 fuse_buckets: bool = True, **hypers):
         self.hypers: Dict[str, Any] = dict(self.defaults)
         unknown = set(hypers) - set(self.hypers)
         if unknown:
@@ -90,7 +150,6 @@ class FusedOptimizerBase:
         if master_weights is None:
             master_weights = _is_low_precision(params)
         self.master_weights = master_weights and _is_low_precision(params)
-        self.params = params
         if not self.master_weights:
             masters = None
         else:
@@ -98,17 +157,42 @@ class FusedOptimizerBase:
                 lambda x: x.astype(jnp.float32)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x,
                 masters if masters is not None else params)
-        self.masters = masters
-        self.opt_state = self.init_state(masters if masters is not None
-                                         else params)
+        work = masters if masters is not None else params
+
+        # ---- bucket plan (tentpole): one-time packing layout --------------
+        self._plan = (BucketPlan.from_tree(
+            work, params if masters is not None else None)
+            if fuse_buckets else None)
+        self.fuse_buckets = self._plan is not None
+        self._params_tree = None
+        self._masters_tree = None
+        self._params_cache = None
+        self._masters_cache = None
+        if self._plan is not None:
+            self._param_bufs = self._plan.pack_model(params)
+            self._master_bufs = (self._plan.pack_work(masters)
+                                 if masters is not None else None)
+            self._params_cache = params
+            self._masters_cache = masters
+            self._unpack_model_jit = jax.jit(self._plan.unpack_model)
+            self._unpack_work_jit = jax.jit(self._plan.unpack)
+            self.opt_state = self.init_state_packed(self._plan, work)
+            self._full_step_impl = self._full_step_flat
+        else:
+            self._params_tree = params
+            self._masters_tree = masters
+            self.opt_state = self.init_state(work)
+            self._full_step_impl = self._full_step
         self.step_count = jnp.int32(0)
         # Host-offloaded optimizer state (beyond-reference; the HBM
         # relief the reference gets from ZeRO sharding alone).  On TPU
         # the step is ONE program: state transfers in from pinned host,
         # math runs on device, out_shardings land the new state back on
-        # host (XLA overlaps the DMAs with compute).  Elsewhere (CPU CI)
-        # the in-jit placement custom call doesn't exist, so step()
-        # moves the state eagerly around a plain device step.
+        # host (XLA overlaps the DMAs with compute).  Bucketed state
+        # offloads as WHOLE flat buffers — a handful of large DMAs
+        # instead of one per leaf.  Elsewhere (CPU CI) the in-jit
+        # placement custom call doesn't exist, so step() moves the
+        # state eagerly around a plain device step.
         self.offload_state = offload_state
         self._fused_offload = False
         if offload_state:
@@ -125,64 +209,222 @@ class FusedOptimizerBase:
                                    tree_map(_host_sharding,
                                             self.opt_state)))
             else:
-                self._jit_step = jax.jit(self._full_step,
+                self._jit_step = jax.jit(self._full_step_impl,
                                          donate_argnums=(2,))
         else:
-            self._jit_step = jax.jit(self._full_step,
+            self._jit_step = jax.jit(self._full_step_impl,
                                      donate_argnums=(2,))
+
+    # ---- packed views ----------------------------------------------------
+    @property
+    def params(self) -> Pytree:
+        """The current params pytree.  On the bucketed path this unpacks
+        lazily — ONE compiled slice-and-reshape program per step, cached
+        until the next step — so the packed buffers stay the canonical
+        representation."""
+        if self._plan is None:
+            return self._params_tree
+        if self._params_cache is None:
+            self._params_cache = self._unpack_model_jit(self._param_bufs)
+        return self._params_cache
+
+    @params.setter
+    def params(self, value: Pytree):
+        if self._plan is None:
+            self._params_tree = value
+        else:
+            self._param_bufs = self._plan.pack_model(value)
+            self._params_cache = value
+
+    @property
+    def masters(self) -> Optional[Pytree]:
+        if self._plan is None:
+            return self._masters_tree
+        if self._master_bufs is None:
+            return None
+        if self._masters_cache is None:
+            self._masters_cache = self._unpack_work_jit(self._master_bufs)
+        return self._masters_cache
+
+    @masters.setter
+    def masters(self, value: Optional[Pytree]):
+        if self._plan is None:
+            self._masters_tree = value
+        elif value is None:
+            self._master_bufs = None
+            self._masters_cache = None
+        else:
+            self._master_bufs = self._plan.pack_work(value)
+            self._masters_cache = value
 
     # ---- functional core -------------------------------------------------
     def init_state(self, params: Pytree) -> Pytree:
         raise NotImplementedError
 
+    def init_state_packed(self, plan: BucketPlan, work: Pytree) -> Pytree:
+        """Packed optimizer state: each field of the per-leaf state,
+        bucket-packed (param-shaped fields -> flat buffers; per-tensor
+        scalar fields -> one (num leaves,) vector per bucket)."""
+        state = self.init_state(work)
+        return {k: plan.pack_state_field(v) for k, v in state.items()}
+
     def _step_math(self, params, grads, opt_state, step, grad_scale, hypers):
-        """Pure update on the (possibly master) params."""
+        """Pure per-leaf update on the (possibly master) params."""
         raise NotImplementedError
 
+    def _flat_bucket_step(self, bucket_index: int, p, g, state, step,
+                          grad_scale, hypers, extra):
+        """One bucket's flat-kernel update: ``p``/``g`` are flat buffers,
+        ``state`` maps field name -> this bucket's buffer.  Returns
+        (new_p, new_state).  ``extra`` is whatever ``_flat_prologue``
+        returned (e.g. LAMB's global-norm clip coefficient)."""
+        raise NotImplementedError
+
+    def _flat_prologue(self, work_bufs, grad_bufs, step, grad_scale,
+                       hypers):
+        """Cross-bucket prologue for the flat path (default: nothing)."""
+        return None
+
+    def _flat_step_math(self, work_bufs, grad_bufs, opt_state, step,
+                        grad_scale, hypers):
+        extra = self._flat_prologue(work_bufs, grad_bufs, step,
+                                    grad_scale, hypers)
+        new_bufs: List[Any] = []
+        new_state: Dict[str, List[Any]] = {k: [] for k in opt_state}
+        for bi, (p, g) in enumerate(zip(work_bufs, grad_bufs)):
+            bucket_state = {k: v[bi] for k, v in opt_state.items()}
+            np_, ns = self._flat_bucket_step(
+                bi, p, g, bucket_state, step, grad_scale, hypers, extra)
+            new_bufs.append(np_)
+            for k in new_state:
+                new_state[k].append(ns[k])
+        return new_bufs, new_state
+
     def _full_step(self, params, masters, opt_state, grads, step, grad_scale,
-                   hypers):
+                   hypers, found_inf=None):
         work = masters if masters is not None else params
-        new_work, opt_state = self._step_math(
+        new_work, new_state = self._step_math(
             work, grads, opt_state, step, grad_scale, hypers)
+        if found_inf is not None:
+            keep = jnp.asarray(found_inf) == 0
+            new_work = _select(keep, new_work, work)
+            new_state = _select(keep, new_state, opt_state)
         if masters is not None:
             new_params = tree_map(lambda p, m: m.astype(p.dtype)
                                   if jnp.issubdtype(p.dtype, jnp.floating)
                                   else m, params, new_work)
-            return new_params, new_work, opt_state
-        return new_work, None, opt_state
+            return new_params, new_work, new_state
+        return new_work, None, new_state
+
+    def _full_step_flat(self, param_bufs, master_bufs, opt_state, grads,
+                        step, grad_scale, hypers, found_inf=None):
+        """Bucketed step body: grads pack (one concatenate per bucket),
+        then ONE flat kernel chain per bucket; params/masters/state go
+        in and come out packed."""
+        work_bufs = master_bufs if master_bufs is not None else param_bufs
+        grad_bufs = self._plan.pack(grads)
+        new_work, new_state = self._flat_step_math(
+            work_bufs, grad_bufs, opt_state, step, grad_scale, hypers)
+        if found_inf is not None:
+            keep = jnp.asarray(found_inf) == 0
+            new_work = _select(keep, new_work, work_bufs)
+            new_state = _select(keep, new_state, opt_state)
+        if master_bufs is not None:
+            new_params = [w.astype(b.model_dtype) for w, b in
+                          zip(new_work, self._plan.buckets)]
+            return new_params, new_work, new_state
+        return new_work, None, new_state
 
     def _full_step_offload(self, params, masters, opt_state, grads, step,
-                           grad_scale, hypers):
+                           grad_scale, hypers, found_inf=None):
         """TPU fused-offload step body: pull state from pinned host at
-        the top; out_shardings push the new state back."""
+        the top (whole flat buffers on the bucketed path); out_shardings
+        push the new state back."""
         opt_state = tree_map(
-            lambda x: jax.device_put(x, jax.memory.Space.Device),
-            opt_state)
-        return self._full_step(params, masters, opt_state, grads, step,
-                               grad_scale, hypers)
+            lambda x: jax.device_put(x, _DEVICE_MEMORY), opt_state)
+        return self._full_step_impl(params, masters, opt_state, grads,
+                                    step, grad_scale, hypers, found_inf)
+
+    def _state_is_packed(self, opt_state) -> bool:
+        """True only for the plan's OWN packed layout: every field is a
+        per-bucket list whose buffers structurally match the plan (1-D,
+        bucket-sized flat or per-leaf-scalar vector).  A per-leaf state
+        pytree that merely happens to be a list of the right length
+        (e.g. list-shaped params) must not be mistaken for packed."""
+        if self._plan is None or not isinstance(opt_state, dict) \
+                or not opt_state:
+            return False
+        buckets = self._plan.buckets
+        for field in opt_state.values():
+            if not isinstance(field, (list, tuple)) \
+                    or len(field) != len(buckets):
+                return False
+            for buf, b in zip(field, buckets):
+                if getattr(buf, "ndim", None) != 1:
+                    return False
+                if tuple(buf.shape) not in ((b.size,), (len(b.leaves),)):
+                    return False
+        return True
 
     def functional_step(self, params, opt_state, grads, step, grad_scale=1.0):
-        """Embed-in-your-own-jit entry point (no master handling)."""
-        return self._step_math(params, grads, opt_state, step,
-                               jnp.asarray(grad_scale, jnp.float32),
-                               dict(self.hypers))
+        """Embed-in-your-own-jit entry point (no master handling).
+
+        ``params``/``grads`` are pytrees; ``opt_state`` may be either a
+        per-leaf state pytree (per-leaf math runs) or this optimizer's
+        PACKED state (e.g. ``opt.opt_state`` of a bucketed optimizer) —
+        then the flat bucket kernels run, the new state comes back
+        packed, and the new params come back as a pytree (what a train
+        step's model apply needs anyway; the repack/unpack concatenates
+        and slices fuse into the caller's jit)."""
+        gs = jnp.asarray(grad_scale, jnp.float32)
+        hypers = dict(self.hypers)
+        if self._state_is_packed(opt_state):
+            work_bufs = self._plan.pack_work(params)
+            grad_bufs = self._plan.pack(grads)
+            new_bufs, new_state = self._flat_step_math(
+                work_bufs, grad_bufs, opt_state, step, gs, hypers)
+            return self._plan.unpack(new_bufs), new_state
+        return self._step_math(params, grads, opt_state, step, gs, hypers)
 
     # ---- stateful facade -------------------------------------------------
-    def step(self, grads: Pytree, grad_scale=1.0) -> Pytree:
-        """Apply one update; returns (and stores) the new params."""
+    def step(self, grads: Pytree, grad_scale=1.0, found_inf=None) -> Pytree:
+        """Apply one update; returns (and stores) the new params.
+
+        ``found_inf``: optional on-device i32/bool scalar (amp's overflow
+        flag from ``scaled_value_and_grad`` or ``flat_scale``).  When
+        given and nonzero, params/masters/state keep their old values
+        and the step count does not advance — a branch-free skip, never
+        a host sync."""
         self.step_count = self.step_count + 1
         state = self.opt_state
         eager_offload = self.offload_state and not self._fused_offload
         if eager_offload:   # CPU fallback: explicit round trip
             state = place_on_device(state)
-        self.params, self.masters, self.opt_state = self._jit_step(
-            self.params, self.masters, state, grads,
-            self.step_count, jnp.asarray(grad_scale, jnp.float32),
-            {k: jnp.asarray(v, jnp.float32) if isinstance(v, float) else v
-             for k, v in self.hypers.items()
-             if isinstance(v, (int, float)) and not isinstance(v, bool)})
+        traced_hypers = {
+            k: jnp.asarray(v, jnp.float32) if isinstance(v, float) else v
+            for k, v in self.hypers.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        if self._plan is not None:
+            self._param_bufs, self._master_bufs, self.opt_state = \
+                self._jit_step(self._param_bufs, self._master_bufs, state,
+                               grads, self.step_count,
+                               jnp.asarray(grad_scale, jnp.float32),
+                               traced_hypers, found_inf)
+            self._params_cache = None
+            self._masters_cache = None
+        else:
+            self._params_tree, self._masters_tree, self.opt_state = \
+                self._jit_step(self._params_tree, self._masters_tree, state,
+                               grads, self.step_count,
+                               jnp.asarray(grad_scale, jnp.float32),
+                               traced_hypers, found_inf)
         if eager_offload:
             self.opt_state = place_on_host(self.opt_state)
+        if found_inf is not None:
+            # a skipped step must not advance the bias-correction clock
+            self.step_count = jnp.where(jnp.asarray(found_inf) > 0,
+                                        self.step_count - 1,
+                                        self.step_count)
         return self.params
 
     def zero_grad(self):
@@ -190,6 +432,22 @@ class FusedOptimizerBase:
 
     # ---- serialization (torch Optimizer.state_dict shape) ---------------
     def state_dict(self):
+        if self._plan is not None:
+            # unpack to the per-leaf checkpoint layout (unchanged across
+            # packing, so per-leaf and bucketed optimizers interload).
+            # The slices are fresh buffers — safe against the next
+            # step()'s donation of the packed state.
+            state = self.opt_state
+            if self.offload_state:
+                state = place_on_device(state)
+            state_tree = {k: self._plan.unpack_state_field(v)
+                          for k, v in state.items()}
+            return {
+                "step": int(self.step_count),
+                "hypers": dict(self.hypers),
+                "state": state_tree,
+                "masters": self.masters,
+            }
         # copy the state out: the next step() DONATES self.opt_state to
         # the compiled update, which deletes the buffers a by-reference
         # snapshot would still point at ("Array has been deleted" at
@@ -206,11 +464,19 @@ class FusedOptimizerBase:
     def load_state_dict(self, sd):
         self.step_count = jnp.int32(sd["step"])
         self.hypers.update(sd["hypers"])
-        # copy: step() donates opt_state to the compiled update, and the
-        # caller's checkpoint dict must stay readable after we step
-        self.opt_state = tree_map(
-            lambda x: jnp.array(x, copy=True)
-            if isinstance(x, jax.Array) else x, sd["state"])
+        if self._plan is not None:
+            # per-leaf checkpoint layout -> packed buffers (the pack
+            # concatenates, so the checkpoint dict is never aliased by
+            # the donating step)
+            self.opt_state = {k: self._plan.pack_state_field(v)
+                              for k, v in sd["state"].items()}
+        else:
+            # copy: step() donates opt_state to the compiled update, and
+            # the caller's checkpoint dict must stay readable after we
+            # step
+            self.opt_state = tree_map(
+                lambda x: jnp.array(x, copy=True)
+                if isinstance(x, jax.Array) else x, sd["state"])
         if self.offload_state:
             # restore must respect the host-residency invariant NOW —
             # waiting for the next step to re-home it would leave the

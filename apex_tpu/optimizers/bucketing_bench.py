@@ -1,0 +1,77 @@
+"""Per-leaf vs bucketed optimizer-step microbench.
+
+The point of the bucketed flat path is amortizing per-leaf dispatch: a
+ResNet-50/BERT-sized pytree is hundreds of small XLA ops per step on
+the per-leaf path versus one flat Pallas kernel per dtype bucket.  This
+module times both paths over the SAME many-leaf pytree with benchlib's
+amortized on-device loop (one dispatch runs many steps serially, so a
+tunneled session measures the program, not the relay).
+
+Shared by bench.py (TPU extras), tools/kernel_bench.py (JSON row) and
+the tier-1 smoke test (tiny shapes, CPU: proves the harness, not
+performance).
+"""
+
+from __future__ import annotations
+
+
+def many_leaf_params(jax, jnp, layers: int = 48, hidden: int = 256):
+    """A transformer-ish pytree: per layer one square matrix plus three
+    small vectors — the shape mix (few big, many tiny leaves) where
+    per-leaf stepping drowns in dispatch."""
+    keys = jax.random.split(jax.random.key(0), layers)
+    return {
+        f"layer{i:03d}": {
+            "w": jax.random.normal(keys[i], (hidden, hidden), jnp.float32),
+            "b": jnp.zeros((hidden,), jnp.float32),
+            "scale": jnp.ones((hidden,), jnp.float32),
+            "shift": jnp.zeros((hidden,), jnp.float32),
+        }
+        for i in range(layers)
+    }
+
+
+def bench_optimizer_bucketing(layers: int = 48, hidden: int = 256,
+                              iters: int = 10, reps: int = 3,
+                              optimizer: str = "adam"):
+    """Times one optimizer step, per-leaf vs bucketed, on a many-leaf
+    pytree.  Returns a dict of ms timings plus the speedup and the
+    bucket plan summary."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.optimizers import FusedAdam, FusedLAMB, FusedSGD
+
+    cls = {"adam": FusedAdam, "sgd": FusedSGD, "lamb": FusedLAMB}[optimizer]
+    params = many_leaf_params(jax, jnp, layers, hidden)
+    grads = jax.tree_util.tree_map(lambda p: p * 1e-3 + 1e-4, params)
+
+    out = {
+        "optim": optimizer,
+        "optim_leaves": len(jax.tree_util.tree_leaves(params)),
+        "optim_elements": sum(int(l.size) for l in
+                              jax.tree_util.tree_leaves(params)),
+    }
+    for fuse, label in ((False, "perleaf"), (True, "bucketed")):
+        opt = cls(params, lr=1e-3, fuse_buckets=fuse)
+        if fuse:
+            out["optim_buckets"] = opt._plan.describe()
+            args = (opt._param_bufs, None, opt.opt_state)
+        else:
+            args = (opt.params, None, opt.opt_state)
+        hypers = {k: jnp.asarray(v, jnp.float32)
+                  for k, v in opt.hypers.items()
+                  if isinstance(v, float)}
+        # the pure step body (what a train loop embeds); jitted fresh ON
+        # PURPOSE: the loop has exactly two iterations (per-leaf vs
+        # bucketed are different programs), not a hot path
+        # apexlint: disable-next=APX302
+        step_fn = jax.jit(opt._full_step_impl)
+        ms = timeit(step_fn, *args, grads, jnp.int32(2),
+                    jnp.float32(1.0), hypers, iters=iters, reps=reps)
+        out[f"optim_step_{label}_ms"] = round(ms, 3)
+    if out["optim_step_bucketed_ms"]:
+        out["optim_bucketing_speedup"] = round(
+            out["optim_step_perleaf_ms"] / out["optim_step_bucketed_ms"], 2)
+    return out

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from apex_tpu.ops import multi_tensor as mt
 from apex_tpu.optimizers import _functional as F
 from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
@@ -28,3 +29,11 @@ class FusedAdagrad(FusedOptimizerBase):
         out = tree_map(leaf, params, grads, opt_state["sum"])
         new_p, new_s = unzip_tree(params, out, 2)
         return new_p, {"sum": new_s}
+
+    def _flat_bucket_step(self, bucket_index, p, g, state, step, grad_scale,
+                          hypers, extra):
+        h = self._merge_hypers(hypers)
+        po, ho = mt.flat_adagrad(
+            p, g, state["sum"], lr=h["lr"], eps=h["eps"],
+            weight_decay=h["weight_decay"], grad_scale=grad_scale)
+        return po, {"sum": ho}

@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops import multi_tensor as mt
 from apex_tpu.optimizers import _functional as F
 from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
@@ -49,3 +50,15 @@ class FusedAdam(FusedOptimizerBase):
                        opt_state["exp_avg_sq"])
         new_p, new_m, new_v = unzip_tree(params, out, 3)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
+    def _flat_bucket_step(self, bucket_index, p, g, state, step, grad_scale,
+                          hypers, extra):
+        h = self._merge_hypers(hypers)
+        po, mo, vo = mt.flat_adam(
+            p, g, state["exp_avg"], state["exp_avg_sq"],
+            lr=h["lr"], beta1=h["beta1"], beta2=h["beta2"], eps=h["eps"],
+            weight_decay=h["weight_decay"], step=step,
+            adam_w_mode=self.hypers["adam_w_mode"],
+            bias_correction=self.hypers["bias_correction"],
+            grad_scale=grad_scale)
+        return po, {"exp_avg": mo, "exp_avg_sq": vo}

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from apex_tpu.ops import multi_tensor as mt
 from apex_tpu.optimizers import _functional as F
 from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
@@ -55,6 +56,32 @@ class FusedLAMB(FusedOptimizerBase):
                        opt_state["exp_avg_sq"])
         new_p, new_m, new_v = unzip_tree(params, out, 3)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
+    def _flat_prologue(self, work_bufs, grad_bufs, step, grad_scale,
+                       hypers):
+        """Global-grad-norm clip coefficient, computed once across ALL
+        buckets (the reference's multi_tensor_l2norm prologue): one
+        fused reduction per bucket, rss-combined."""
+        h = self._merge_hypers(hypers)
+        gnorm = jnp.sqrt(sum(mt.flat_l2norm(g) ** 2 for g in grad_bufs))
+        gnorm = gnorm / grad_scale
+        maxn = h["max_grad_norm"]
+        return jnp.where((maxn > 0) & (gnorm > maxn),
+                         maxn / gnorm, jnp.float32(1.0))
+
+    def _flat_bucket_step(self, bucket_index, p, g, state, step, grad_scale,
+                          hypers, extra):
+        h = self._merge_hypers(hypers)
+        po, mo, vo = mt.flat_lamb(
+            p, g, state["exp_avg"], state["exp_avg_sq"],
+            self._plan.segment_ids(bucket_index),
+            self._plan.num_segments(bucket_index),
+            lr=h["lr"], beta1=h["beta1"], beta2=h["beta2"], eps=h["eps"],
+            weight_decay=h["weight_decay"], step=step,
+            bias_correction=self.hypers["bias_correction"],
+            grad_scale=grad_scale, clip_coeff=extra,
+            use_nvlamb=self.hypers["use_nvlamb"])
+        return po, {"exp_avg": mo, "exp_avg_sq": vo}
 
 
 class FusedMixedPrecisionLamb(FusedLAMB):

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from apex_tpu.ops import multi_tensor as mt
 from apex_tpu.optimizers import _functional as F
 from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
@@ -55,3 +56,21 @@ class FusedNovoGrad(FusedOptimizerBase):
                        opt_state["exp_avg_sq"])
         new_p, new_m, new_v = unzip_tree(params, out, 3)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
+    def _flat_bucket_step(self, bucket_index, p, g, state, step, grad_scale,
+                          hypers, extra):
+        if self.hypers["norm_type"] != 2:
+            raise ValueError("FusedNovoGrad only supports norm_type=2")
+        h = self._merge_hypers(hypers)
+        # per-tensor second moments ride the bucket's segment ids: the
+        # packed exp_avg_sq is one (num leaves,) vector per bucket
+        po, mo, vo = mt.flat_novograd(
+            p, g, state["exp_avg"], state["exp_avg_sq"],
+            self._plan.segment_ids(bucket_index),
+            lr=h["lr"], beta1=h["beta1"], beta2=h["beta2"], eps=h["eps"],
+            weight_decay=h["weight_decay"], first_run=step == 1,
+            grad_averaging=self.hypers["grad_averaging"],
+            init_zero=self.hypers["init_zero"],
+            reg_inside_moment=self.hypers["reg_inside_moment"],
+            grad_scale=grad_scale)
+        return po, {"exp_avg": mo, "exp_avg_sq": vo}

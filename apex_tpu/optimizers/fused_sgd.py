@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from apex_tpu.ops import multi_tensor as mt
 from apex_tpu.optimizers import _functional as F
 from apex_tpu.optimizers._base import FusedOptimizerBase, tree_map, unzip_tree
 
@@ -44,3 +45,15 @@ class FusedSGD(FusedOptimizerBase):
         out = tree_map(leaf, params, grads, opt_state["momentum_buffer"])
         new_p, new_b = unzip_tree(params, out, 2)
         return new_p, {"momentum_buffer": new_b}
+
+    def _flat_bucket_step(self, bucket_index, p, g, state, step, grad_scale,
+                          hypers, extra):
+        h = self._merge_hypers(hypers)
+        po, bo = mt.flat_sgd(
+            p, g, state["momentum_buffer"], lr=h["lr"],
+            momentum=self.hypers["momentum"],
+            dampening=self.hypers["dampening"],
+            weight_decay=h["weight_decay"],
+            nesterov=self.hypers["nesterov"],
+            first_run=step == 1, grad_scale=grad_scale)
+        return po, {"momentum_buffer": bo}

@@ -226,3 +226,131 @@ class TestDispatchPrefs:
         assert prefs == {"layer_norm": False, "attention": True}
         assert data["prefer_pallas"] == prefs
         assert data["methodology"] == "amortized"
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_flat_adagrad_matches_ref(dtype):
+    n = 2000
+    keys = jax.random.split(jax.random.key(4), 2)
+    p = jax.random.normal(keys[0], (n,), jnp.float32).astype(dtype)
+    g = jax.random.normal(keys[1], (n,), jnp.float32).astype(dtype)
+    h = jnp.abs(jax.random.normal(jax.random.key(5), (n,))) * 0.1
+    kw = dict(lr=1e-2, eps=1e-10, weight_decay=0.01)
+    po, ho = mt.flat_adagrad(p, g, h, **kw)
+    pr, hr = mt.flat_adagrad_ref(p, g, h, **kw)
+    np.testing.assert_allclose(np.asarray(po, np.float32),
+                               np.asarray(pr, np.float32), **tol(dtype))
+    np.testing.assert_allclose(ho, hr, rtol=1e-5, atol=1e-6)
+
+
+def _segmented_buffers(n_leaves=4, key=6):
+    sizes = [257, 128, 1000, 5]
+    n = sum(sizes)
+    seg = jnp.asarray(np.repeat(np.arange(n_leaves, dtype=np.int32),
+                                sizes))
+    ks = jax.random.split(jax.random.key(key), 4)
+    p = jax.random.normal(ks[0], (n,))
+    g = jax.random.normal(ks[1], (n,))
+    m = jax.random.normal(ks[2], (n,)) * 0.1
+    v = jnp.abs(jax.random.normal(ks[3], (n,))) * 0.1
+    return p, g, m, v, seg, n_leaves
+
+
+@pytest.mark.parametrize("use_nvlamb", [False, True])
+def test_flat_lamb_matches_ref(use_nvlamb):
+    p, g, m, v, seg, ns = _segmented_buffers()
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-6,
+              weight_decay=0.01, step=3, clip_coeff=0.7,
+              use_nvlamb=use_nvlamb)
+    po, mo, vo = mt.flat_lamb(p, g, m, v, seg, ns, **kw)
+    pr, mr, vr = mt.flat_lamb_ref(p, g, m, v, seg, ns, **kw)
+    np.testing.assert_allclose(po, pr, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mo, mr, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(vo, vr, rtol=1e-5, atol=1e-6)
+
+
+def test_flat_lamb_trust_ratio_is_per_segment():
+    """The segmented kernel must reproduce the per-leaf trust ratios —
+    not one bucket-global ratio."""
+    from apex_tpu.optimizers import _functional as F
+    p, g, m, v, seg, ns = _segmented_buffers()
+    sizes = [257, 128, 1000, 5]
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-6,
+              weight_decay=0.01, step=3)
+    po, _, _ = mt.flat_lamb(p, g, m, v, seg, ns, **kw)
+    o = 0
+    for sz in sizes:
+        sl = slice(o, o + sz)
+        pe, _, _ = F.lamb_step(p[sl], g[sl], m[sl], v[sl], **kw)
+        np.testing.assert_allclose(po[sl], pe, rtol=1e-5, atol=1e-6)
+        o += sz
+
+
+@pytest.mark.parametrize("first_run", [True, False])
+def test_flat_novograd_matches_per_leaf(first_run):
+    from apex_tpu.optimizers import _functional as F
+    p, g, m, _, seg, ns = _segmented_buffers(key=8)
+    sizes = [257, 128, 1000, 5]
+    vseg = jnp.abs(jax.random.normal(jax.random.key(9), (ns,))) * 0.2
+    kw = dict(lr=1e-3, beta1=0.95, beta2=0.98, eps=1e-8,
+              weight_decay=0.01, first_run=first_run)
+    po, mo, vo = mt.flat_novograd(p, g, m, vseg, seg, **kw)
+    pr, mr, vr = mt.flat_novograd_ref(p, g, m, vseg, seg, **kw)
+    np.testing.assert_allclose(po, pr, rtol=1e-5, atol=1e-6)
+    o = 0
+    for i, sz in enumerate(sizes):
+        sl = slice(o, o + sz)
+        pe, me, ve = F.novograd_step(p[sl], g[sl], m[sl], vseg[i], **kw)
+        np.testing.assert_allclose(po[sl], pe, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(vo[i], ve, rtol=1e-5, atol=1e-6)
+        o += sz
+
+
+def test_flat_sgd_traced_first_run():
+    """first_run may be a traced bool (step == 1 inside a jitted
+    optimizer step) on both the kernel and the ref path."""
+    n = 300
+    p = jax.random.normal(jax.random.key(0), (n,))
+    g = jax.random.normal(jax.random.key(1), (n,))
+    buf = jax.random.normal(jax.random.key(2), (n,))
+    kw = dict(lr=0.1, momentum=0.9, weight_decay=1e-4)
+
+    @jax.jit
+    def step(p, g, buf, count):
+        return mt.flat_sgd(p, g, buf, first_run=count == 1, **kw)
+
+    for count, want_first in ((1, True), (2, False)):
+        po, bo = step(p, g, buf, jnp.int32(count))
+        pr, br = mt.flat_sgd_ref(p, g, buf, first_run=want_first, **kw)
+        np.testing.assert_allclose(po, pr, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(bo, br, rtol=1e-6, atol=1e-7)
+
+
+class TestMultiTensorApplierMixedDtype:
+    """The reference dispatches per dtype group; extras (overflow flags,
+    norms) combine across groups — flags by max, norms by rss."""
+
+    def test_mixed_dtype_scale_groups_and_flags(self):
+        ts = [jnp.full((5,), 2.0, jnp.float32),
+              jnp.full((3, 3), -1.0, jnp.bfloat16),
+              jnp.full((7,), 4.0, jnp.float32)]
+        outs, flag = multi_tensor_applier(mt.flat_scale, None, [ts], 3.0)
+        assert [o.dtype for o in outs] == [t.dtype for t in ts]
+        np.testing.assert_allclose(np.asarray(outs[0]), 6.0)
+        np.testing.assert_allclose(np.asarray(outs[1], np.float32), -3.0)
+        np.testing.assert_allclose(np.asarray(outs[2]), 12.0)
+        assert int(flag) == 0
+
+    def test_mixed_dtype_flag_combines_by_max(self):
+        ts = [jnp.ones((4,), jnp.float32),
+              jnp.array([1.0, jnp.inf], jnp.bfloat16)]
+        _, flag = multi_tensor_applier(mt.flat_scale, None, [ts], 1.0)
+        assert int(flag) == 1
+
+    def test_mixed_dtype_norm_combines_by_rss(self):
+        ts = [jnp.full((4,), 3.0, jnp.float32),
+              jnp.full((4,), 4.0, jnp.bfloat16)]
+        (norm,) = multi_tensor_applier(mt.flat_l2norm, None, [ts])
+        want = np.sqrt(sum(float(jnp.sum(t.astype(jnp.float32) ** 2))
+                           for t in ts))
+        np.testing.assert_allclose(float(norm), want, rtol=1e-3)

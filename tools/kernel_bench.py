@@ -22,6 +22,13 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os as _os
+import sys as _sys
+
+# runnable straight from a checkout with no install (tools/lint.py idiom)
+_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _ROOT not in _sys.path:
+    _sys.path.insert(0, _ROOT)
 
 
 def time_fn(f, *args, iters=10, reps=3):
@@ -89,6 +96,7 @@ _OP_FAMILY = {
     "scaled_upper_triang_masked_softmax": "softmax",
     "softmax_cross_entropy": "xentropy",
     "flat_adam": "multi_tensor",
+    "flat_lamb": "multi_tensor",
     "welford_mean_var": "welford",
 }
 
@@ -361,6 +369,36 @@ def main():
         "flat_adam", f"n={n}", "f32",
         lambda *a: mt.flat_adam(*a, **kw),
         lambda *a: mt.flat_adam_ref(*a, **kw), p, g, m, v))
+    # segmented LAMB over the same buffer, carved into 256 "tensors"
+    import numpy as np
+    n_seg = 256
+    seg = jnp.asarray(np.repeat(np.arange(n_seg, dtype=np.int32),
+                                n // n_seg))
+    kwl = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-6,
+               weight_decay=0.01, step=3, clip_coeff=1.0)
+    rows.append(bench_pair(
+        "flat_lamb", f"n={n}/seg{n_seg}", "f32",
+        lambda p_, g_, m_, v_: mt.flat_lamb(p_, g_, m_, v_, seg, n_seg,
+                                            **kwl),
+        lambda p_, g_, m_, v_: mt.flat_lamb_ref(p_, g_, m_, v_, seg,
+                                                n_seg, **kwl),
+        p, g, m, v))
+
+    # per-leaf vs bucketed fused-optimizer step on a many-leaf pytree —
+    # the end-to-end number the flat kernels exist for (recorded in the
+    # bench round via bench.py extras too)
+    from apex_tpu.optimizers.bucketing_bench import \
+        bench_optimizer_bucketing
+    r = bench_optimizer_bucketing()
+    r["backend"] = backend
+    print(json.dumps(r), flush=True)
+    rows.append({
+        "kernel": "fused_adam_bucketed_step",
+        "shape": f"{r['optim_leaves']}leaves/{r['optim_elements']}elem",
+        "dtype": "f32",
+        "kernel_ms": r["optim_step_bucketed_ms"],
+        "oracle_ms": r["optim_step_perleaf_ms"],
+        "speedup": r.get("optim_bucketing_speedup")})
 
     for r in rows:
         r["backend"] = backend
