@@ -4,6 +4,10 @@ JAX-native surface:
   initialize(params, opt_level=...)       -> (params, AmpState)
   scaled_value_and_grad(loss_fn, state..) -> loss, unscaled grads, found_inf
   conditional_step / update_state         -> scaler-driven skip logic
+  FlatGradPipeline / FlatGrads            -> pack-once flat gradient path
+                                             (grads_layout="flat"; one fused
+                                             unscale+norm+clip kernel per
+                                             bucket, docs/amp.md)
   Policy / Properties / opt_level_properties
 
 The reference's op-classification lists (which torch ops run fp16 vs fp32,
@@ -31,6 +35,7 @@ from apex_tpu.amp.frontend import (
     state_dict,
     update_scaler,
 )
+from apex_tpu.amp.flat_pipeline import FlatGradPipeline, FlatGrads
 from apex_tpu.amp.wrap import auto_cast, cast_inputs
 from apex_tpu.amp import lists
 
@@ -41,5 +46,6 @@ __all__ = [
     "scaled_value_and_grad", "unscale_grads", "update_state",
     "AmpState", "initialize", "master_params_to_model_params",
     "update_scaler", "state_dict", "load_state_dict",
+    "FlatGradPipeline", "FlatGrads",
     "auto_cast", "cast_inputs", "lists",
 ]

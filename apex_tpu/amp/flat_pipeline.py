@@ -1,0 +1,204 @@
+"""Flat end-to-end AMP gradient pipeline: pack once, fuse everything.
+
+The per-leaf amp surface walks the gradient pytree three to four times
+per step — ``unscale_grads``, ``check_finite``, ``clip_grad_norm`` each
+sweep every leaf (clip_grad even ravels its own throwaway flat buffer),
+and the bucketed optimizer then re-packs the grads inside ``step()``.
+That is exactly the per-tensor-launch overhead upstream apex's ``amp_C``
+multi-tensor pipeline exists to kill (SURVEY.md §2.3).
+
+This module makes gradients live FLAT from loss to update:
+
+    scaled_value_and_grad          (grads w.r.t. model params)
+        └─ pack_grads              ONE concatenate per dtype bucket
+            └─ all-reduce          one psum per BUCKET, not per leaf
+                └─ flat_unscale_norm   unscale + non-finite + Σg² in
+                                       ONE HBM read per bucket
+                    └─ tiny combine    global norm, found_inf, clip_coef
+                        └─ optimizer.step(FlatGrads)
+                                       clip folds into the flat kernels'
+                                       grad scaling; grads never unpack
+
+The per-leaf path (amp.scaler + contrib.clip_grad) stays as the oracle
+and the fallback for trees the packer declines.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaleState, scale_loss
+from apex_tpu.multi_tensor_apply.packer import BucketPlan, cached_plan
+from apex_tpu.ops import multi_tensor as mt
+
+Pytree = Any
+
+
+class FlatGrads(NamedTuple):
+    """The flat pipeline's gradient bundle (a pytree — jit-safe).
+
+    ``bufs``: unscaled per-bucket flat gradient buffers in the plan's
+    layout.  ``grad_norm``: PRE-clip global L2 norm of the unscaled
+    gradients (f32; NaN when non-finite — see found_inf).  ``found_inf``:
+    i32 overflow flag (any non-finite unscaled element).  ``clip_coef``:
+    f32 global-norm clip coefficient in (0, 1], exactly 1.0 when no
+    clipping applies; fold it into the optimizer step, never into the
+    buffers (``FusedOptimizerBase.step`` does this for you).
+    """
+    bufs: List[jax.Array]
+    grad_norm: jax.Array
+    found_inf: jax.Array
+    clip_coef: jax.Array
+
+
+def _scaler_state(state) -> LossScaleState:
+    """Accept a LossScaleState or anything carrying one (AmpState)."""
+    return getattr(state, "scaler", state)
+
+
+class FlatGradPipeline:
+    """Pack-once gradient pipeline over a :class:`BucketPlan`.
+
+    Construct from a bucketed fused optimizer (reuses its plan — the
+    buffers then feed ``optimizer.step`` with ZERO re-packing) or from
+    a params/grads pytree (a standalone cached plan is built).
+
+    ``max_grad_norm > 0`` enables fused global-norm clipping: the norm
+    falls out of the unscale kernel for free and the clip coefficient
+    rides the optimizer kernels' existing grad scaling.  ``axis_name``
+    enables bucket-granular data-parallel all-reduce (one collective
+    per flat bucket) between pack and unscale, mirroring the reference
+    DDP's reduce-then-unscale ordering.
+    """
+
+    def __init__(self, optimizer=None, plan: Optional[BucketPlan] = None,
+                 params: Optional[Pytree] = None,
+                 max_grad_norm: float = 0.0,
+                 axis_name: Optional[str] = None,
+                 average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 eps: float = 1e-6,
+                 defer_plan: bool = False):
+        if plan is None and optimizer is not None:
+            plan = getattr(optimizer, "_plan", None)
+            if plan is None:
+                raise ValueError(
+                    "optimizer has no bucket plan (fuse_buckets=False or "
+                    "the packer declined its tree) — the flat pipeline "
+                    "needs the bucketed path; use the per-leaf amp "
+                    "surface instead")
+        if plan is None and params is not None:
+            plan = cached_plan(params)
+        if plan is None and not defer_plan:
+            raise ValueError("need one of optimizer=, plan= or params= "
+                             "(or defer_plan=True to derive the plan "
+                             "from the first gradient tree packed)")
+        self.plan = plan
+        self.optimizer = optimizer
+        self.max_grad_norm = float(max_grad_norm)
+        self.axis_name = axis_name
+        self.average = average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.eps = float(eps)
+
+    # ---- stages ----------------------------------------------------------
+    def pack(self, grads: Pytree) -> List[jax.Array]:
+        """Pytree -> per-bucket flat buffers (the ONE gradient pack);
+        already-packed input passes through untouched."""
+        if self.plan is None:   # defer_plan: derive from the first tree
+            self.plan = cached_plan(grads)
+            if self.plan is None:
+                raise ValueError(
+                    "flat pipeline: the packer declined this gradient "
+                    "tree (non-float or multi-device leaves) — use the "
+                    "per-leaf amp surface")
+        if self.plan.is_packed(grads):
+            return list(grads)
+        return self.plan.pack_grads(grads)
+
+    def reduce(self, bufs: List[jax.Array]) -> List[jax.Array]:
+        """Bucket-granular data-parallel all-reduce (no-op without
+        ``axis_name`` or outside shard_map/pmap)."""
+        if self.axis_name is None:
+            return bufs
+        from apex_tpu.parallel.distributed import all_reduce_flat_buffers
+        return all_reduce_flat_buffers(
+            bufs, self.axis_name, average=self.average,
+            gradient_predivide_factor=self.gradient_predivide_factor)
+
+    def unscale_and_norm(self, bufs: List[jax.Array],
+                         state=None, inv_scale=None) -> FlatGrads:
+        """One ``flat_unscale_norm`` kernel per bucket + tiny combine.
+
+        Pass either a scaler ``state`` (LossScaleState/AmpState) or an
+        explicit ``inv_scale``; omit both for already-unscaled grads
+        (inv_scale=1 — the kernel still yields norm + found_inf)."""
+        if inv_scale is None:
+            inv_scale = (1.0 / _scaler_state(state).loss_scale
+                         if state is not None else jnp.float32(1.0))
+        outs, norm_sqs, flags = [], [], []
+        for buf in bufs:
+            o, nsq, flag = mt.flat_unscale_norm(buf, inv_scale)
+            outs.append(o)
+            norm_sqs.append(nsq)
+            flags.append(flag)
+        found_inf = functools.reduce(jnp.maximum, flags)
+        norm = jnp.sqrt(sum(norm_sqs, jnp.float32(0.0)))
+        maxn = jnp.asarray(self.max_grad_norm, jnp.float32)
+        clip = jnp.where((maxn > 0) & (norm > maxn),
+                         maxn / (norm + self.eps), jnp.float32(1.0))
+        # overflow (inf/NaN norm): the step is skipped via found_inf
+        # regardless, so pin clip_coef to the neutral 1.0 — deterministic
+        # whether the norm overflowed to inf (clip would be 0) or NaN
+        # (comparison False); no 0-or-NaN coefficient ever leaks out
+        clip = jnp.where(found_inf > 0, jnp.float32(1.0), clip)
+        return FlatGrads(bufs=outs, grad_norm=norm,
+                         found_inf=found_inf, clip_coef=clip)
+
+    # ---- end-to-end ------------------------------------------------------
+    def scaled_value_and_grad(self, loss_fn, state, *args,
+                              has_aux: bool = False, **kwargs):
+        """value_and_grad of the LOSS-SCALED objective, gradients flat.
+
+        The flat analog of ``amp.scaled_value_and_grad``: returns
+        ``((loss, aux?), FlatGrads)`` where the FlatGrads buffers are
+        unscaled, reduced (when ``axis_name``), and carry the global
+        norm, overflow flag and clip coefficient — ready for
+        ``optimizer.step(flat_grads)``.
+        """
+        sstate = _scaler_state(state)
+
+        def scaled_fn(*a, **kw):
+            out = loss_fn(*a, **kw)
+            if has_aux:
+                loss, aux = out
+                return scale_loss(loss, sstate), aux
+            return scale_loss(out, sstate)
+
+        if has_aux:
+            (scaled, aux), grads = jax.value_and_grad(
+                scaled_fn, has_aux=True)(*args, **kwargs)
+        else:
+            scaled, grads = jax.value_and_grad(scaled_fn)(*args, **kwargs)
+            aux = None
+        flat = self.unscale_and_norm(self.reduce(self.pack(grads)), sstate)
+        loss = scaled / sstate.loss_scale
+        if has_aux:
+            return (loss, aux), flat
+        return loss, flat
+
+    def step(self, flat: FlatGrads, grad_scale=1.0) -> Pytree:
+        """``optimizer.step`` on the packed buffers — found_inf drives
+        the branch-free skip, clip_coef folds into the kernels."""
+        if self.optimizer is None:
+            raise ValueError("pipeline was built without an optimizer")
+        return self.optimizer.step(flat, grad_scale=grad_scale)
+
+    def grads_tree(self, flat: FlatGrads) -> Pytree:
+        """Unpack the buffers to a pytree (inspection/tests only — the
+        hot loop never needs this)."""
+        return self.plan.unpack_grads(flat.bufs)

@@ -62,6 +62,21 @@ class AmpState:
         cast = self.properties.cast_model_type
         return cast if cast is not None else jnp.bfloat16
 
+    def flat_pipeline(self, optimizer=None, plan=None,
+                      max_grad_norm: float = 0.0, axis_name=None,
+                      **kw):
+        """A :class:`~apex_tpu.amp.flat_pipeline.FlatGradPipeline` for
+        this amp state — the pack-once gradient path (one fused
+        unscale+norm+clip kernel per bucket, bucket-granular
+        all-reduce) feeding a bucketed fused optimizer.  Call its
+        ``scaled_value_and_grad(loss_fn, amp_state_or_scaler, ...)``
+        with this state's ``scaler`` threaded through the train step.
+        """
+        from apex_tpu.amp.flat_pipeline import FlatGradPipeline
+        return FlatGradPipeline(optimizer=optimizer, plan=plan,
+                                max_grad_norm=max_grad_norm,
+                                axis_name=axis_name, **kw)
+
     # --- apex serialization contract: amp.state_dict() round-trips the
     # loss scaler (scale + unskipped count), frontend.py parity ---
     def state_dict(self):

@@ -98,7 +98,8 @@ def update_state(state: LossScaleState, found_inf: jax.Array,
 
 
 def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
-                          has_aux: bool = False, **kwargs):
+                          has_aux: bool = False, grads_layout: str = "tree",
+                          plan=None, **kwargs):
     """value_and_grad of a LOSS-SCALED objective, then unscale.
 
     The canonical TPU replacement for the reference's
@@ -107,7 +108,30 @@ def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
     on-device found_inf flag for the conditional optimizer step.
 
     Returns ((loss, aux?), grads, found_inf).
+
+    ``grads_layout="flat"`` switches the gradient side to the flat
+    pipeline: grads come back as an ``amp.FlatGrads`` bundle — packed
+    ONCE into per-bucket flat buffers (``plan``: a BucketPlan, a
+    bucketed fused optimizer, or None to derive a cached plan from the
+    grads), unscaled by one fused kernel per bucket that also yields
+    the global norm and the overflow flag.  The per-leaf ``"tree"``
+    layout stays the oracle.
     """
+    if grads_layout not in ("tree", "flat"):
+        raise ValueError(f"unknown grads_layout {grads_layout!r}")
+    if grads_layout == "flat":
+        # layering: flat_pipeline imports this module; import lazily
+        from apex_tpu.amp.flat_pipeline import FlatGradPipeline
+        if plan is not None and not hasattr(plan, "pack_grads"):
+            pipe = FlatGradPipeline(optimizer=plan)   # a fused optimizer
+        else:
+            # plan=None: the pipeline derives a cached plan from the
+            # gradient tree at first pack
+            pipe = FlatGradPipeline(plan=plan, defer_plan=plan is None)
+        out, flat = pipe.scaled_value_and_grad(
+            loss_fn, state, *args, has_aux=has_aux, **kwargs)
+        return out, flat, flat.found_inf
+
     def scaled_fn(*a, **kw):
         out = loss_fn(*a, **kw)
         if has_aux:

@@ -268,6 +268,21 @@ def axis_size(name: str) -> int:
     return dict(zip(m.axis_names, m.devices.shape)).get(name, 1)
 
 
+def bound_axis_size(name: str) -> int:
+    """Size of a BOUND axis from inside traced code, version-compat.
+
+    ``jax.lax.axis_size`` only exists on newer jax releases (0.4.x
+    raises AttributeError — the single bug behind every parallel/
+    pipeline tier-1 failure of the seed).  ``psum`` of the literal 1 is
+    the portable spelling: jax evaluates it statically in the axis env
+    on every release, so the result is a Python int usable in shape
+    math (loop trip counts, buffer sizes) exactly like axis_size."""
+    ax = getattr(jax.lax, "axis_size", None)
+    if ax is not None:
+        return ax(name)
+    return jax.lax.psum(1, name)
+
+
 def data_parallel_size() -> int:
     return axis_size(AXIS_DATA)
 

@@ -34,7 +34,7 @@ def halo_exchange(x, axis_name: str, halo: int = 1, dim: int = 1):
     shard (N, H_local, W, C).  Edge ranks get zero halos (= SAME
     padding).  Replaces peer_memory.PeerHaloExchanger1d.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = comm.bound_axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
     h = x.shape[dim]
     top = jax.lax.slice_in_dim(x, 0, halo, axis=dim)

@@ -83,8 +83,15 @@ class BucketPlan:
                 return None
             if not jnp.issubdtype(w.dtype, jnp.floating):
                 return None
-            if isinstance(w, jax.Array) and len(w.sharding.device_set) > 1:
-                return None
+            if isinstance(w, jax.Array):
+                try:
+                    multi = len(w.sharding.device_set) > 1
+                except AttributeError:
+                    # tracer (cached_plan inside a jit trace): sharding
+                    # unknown — the caller owns that placement decision
+                    multi = False
+                if multi:
+                    return None
             key = (jnp.dtype(w.dtype), jnp.dtype(p.dtype))
             groups.setdefault(key, []).append((i, w))
         buckets = []
@@ -121,6 +128,27 @@ class BucketPlan:
     def pack_model(self, tree: Pytree) -> List[jax.Array]:
         return self.pack(tree, dtypes=[b.model_dtype for b in self.buckets])
 
+    def pack_grads(self, tree: Pytree) -> List[jax.Array]:
+        """THE gradient pack: one concatenate per bucket, grads keep
+        their own (model) dtype — the flat AMP pipeline's single pack
+        point.  Everything downstream (bucketed all-reduce, fused
+        unscale+norm, the flat optimizer kernels) consumes these
+        buffers; nothing re-walks the pytree."""
+        return self.pack(tree)
+
+    def is_packed(self, obj) -> bool:
+        """True iff ``obj`` is a per-bucket flat-buffer list matching
+        this plan: one 1-D buffer per bucket, each exactly bucket-sized.
+        Shape-only (works on tracers); used by step()/clip_grad to
+        accept already-packed gradients without re-packing."""
+        if not isinstance(obj, (list, tuple)) \
+                or len(obj) != len(self.buckets):
+            return False
+        return all(
+            getattr(buf, "ndim", None) == 1
+            and tuple(buf.shape) == (b.size,)
+            for buf, b in zip(obj, self.buckets))
+
     # ---- unpacking -------------------------------------------------------
     def _unpack_leaves(self, bufs: Sequence[jax.Array],
                        dtypes=None) -> List[jax.Array]:
@@ -142,6 +170,13 @@ class BucketPlan:
         return jax.tree_util.tree_unflatten(
             self.treedef,
             self._unpack_leaves(bufs, [b.dtype for b in self.buckets]))
+
+    def unpack_grads(self, bufs: Sequence[jax.Array]) -> Pytree:
+        """Per-bucket flat buffers -> pytree, each leaf keeping its
+        buffer's dtype (the inverse of ``pack_grads``; rare host-facing
+        path — the hot loop never unpacks gradients)."""
+        return jax.tree_util.tree_unflatten(
+            self.treedef, self._unpack_leaves(bufs, dtypes=None))
 
     def unpack_model(self, bufs: Sequence[jax.Array]) -> Pytree:
         """Per-bucket flat buffers -> pytree in the MODEL dtypes."""
@@ -211,3 +246,51 @@ class BucketPlan:
                  "model_dtype": str(np.dtype(b.model_dtype)),
                  "leaves": len(b.leaves), "elements": b.size}
                 for b in self.buckets]
+
+
+# ---- cached standalone plans ----------------------------------------------
+# The fused optimizers own their plan; everything else on the flat
+# gradient pipeline (FlatGradPipeline without an optimizer, the bucketed
+# Reducer, packed clip_grad) needs one too — built ONCE per distinct
+# tree layout, keyed on (treedef, leaf shape/dtype signature), so
+# repeated calls (including from inside a jit trace) reuse the same
+# static offsets instead of recomputing the layout.
+_PLAN_CACHE: dict = {}
+_PLAN_CACHE_MAX = 64
+
+
+def _leaf_multi_device(l):
+    """True/False for concrete arrays, None for tracers (sharding
+    unknown at trace time) — part of the cache key so a plan built for
+    single-device arrays is never reused for same-shaped multi-device
+    ones (from_tree declines those) or vice versa."""
+    try:
+        return len(l.sharding.device_set) > 1
+    except AttributeError:
+        return None
+
+
+def cached_plan(tree: Pytree,
+                model: Optional[Pytree] = None) -> Optional[BucketPlan]:
+    """Memoized ``BucketPlan.from_tree`` (grad-only pack entry point).
+
+    Works on concrete arrays and tracers alike.  Returns None exactly
+    when ``from_tree`` would (non-float or multi-device leaves); the
+    key carries shapes, dtypes AND device placement, so the memo never
+    bypasses from_tree's multi-device guard."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sig = tuple((tuple(getattr(l, "shape", ())),
+                 jnp.dtype(getattr(l, "dtype", jnp.float32)).name,
+                 _leaf_multi_device(l))
+                for l in leaves if hasattr(l, "dtype"))
+    if len(sig) != len(leaves):
+        return None
+    if model is not None:
+        sig += tuple(jnp.dtype(l.dtype).name
+                     for l in jax.tree_util.tree_leaves(model))
+    key = (treedef, sig)
+    if key not in _PLAN_CACHE:
+        if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+            _PLAN_CACHE.clear()
+        _PLAN_CACHE[key] = BucketPlan.from_tree(tree, model)
+    return _PLAN_CACHE[key]

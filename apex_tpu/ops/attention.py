@@ -1026,8 +1026,11 @@ def _ring(q, k, v, causal, scale, axis):
 
 def _ring_fwd_impl(q, k, v, causal, scale, axis):
     sc = scale if scale is not None else _default_scale(q.shape[-1])
-    cp = jax.lax.axis_size(axis)
-    rank = jax.lax.axis_index(axis)
+    cp = comm.bound_axis_size(axis)
+    # only the causal mask consumes the rank; a dead axis_index would
+    # leave an unused partition-id instruction the CPU SPMD partitioner
+    # rejects outright (it only rewrites the patterns it recognizes)
+    rank = jax.lax.axis_index(axis) if causal else jnp.int32(0)
     b, h, s_loc, d = q.shape
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
@@ -1059,8 +1062,8 @@ def _ring_vjp_fwd(q, k, v, causal, scale, axis):
 def _ring_vjp_bwd(causal, scale, axis, res, do):
     q, k, v, o, lse = res
     sc = scale if scale is not None else _default_scale(q.shape[-1])
-    cp = jax.lax.axis_size(axis)
-    rank = jax.lax.axis_index(axis)
+    cp = comm.bound_axis_size(axis)
+    rank = jax.lax.axis_index(axis) if causal else jnp.int32(0)
     b, h, s_loc, d = q.shape
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     lse1 = lse.reshape(b * h, s_loc)
@@ -1137,7 +1140,7 @@ def ring_attention_ref(q, k, v, causal=False, scale=None,
     per-block attention with online stat merging.
     """
     sc = scale if scale is not None else _default_scale(q.shape[-1])
-    cp = jax.lax.axis_size(axis)
+    cp = comm.bound_axis_size(axis)
     rank = jax.lax.axis_index(axis)
     b, h, s_loc, d = q.shape
     perm = [(i, (i + 1) % cp) for i in range(cp)]
@@ -1211,7 +1214,7 @@ def ulysses_attention(q, k, v, causal=False, scale=None,
     Differentiable end to end (all_to_all transposes to all_to_all; the
     kernel brings its custom_vjp).
     """
-    cp = jax.lax.axis_size(axis)
+    cp = comm.bound_axis_size(axis)
     if cp == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale)
     h, hk = q.shape[1], k.shape[1]

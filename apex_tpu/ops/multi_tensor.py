@@ -181,6 +181,67 @@ def flat_axpby_ref(a, x, b, y, out_dtype=None):
 
 
 # ---------------------------------------------------------------------------
+# fused unscale + non-finite check + squared-L2   [reference: amp+clip
+# issue multi_tensor_scale and multi_tensor_l2norm back-to-back — two
+# HBM sweeps; here ONE read feeds all three outputs]
+# ---------------------------------------------------------------------------
+
+def _unscale_norm_kernel(s_ref, x_ref, o_ref, acc_ref, flag_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[0] = jnp.float32(0.0)
+        flag_ref[0] = 0
+
+    y = _f32(x_ref[...]) * s_ref[0]
+    o_ref[...] = y.astype(o_ref.dtype)
+    acc_ref[0] += jnp.sum(y * y)
+    bad = jnp.logical_not(_all_finite(y)).astype(jnp.int32)
+    flag_ref[0] = jnp.maximum(flag_ref[0], bad)
+
+
+def flat_unscale_norm(x: jax.Array, inv_scale, out_dtype=None):
+    """out = x * inv_scale over a flat gradient buffer, PLUS the squared
+    L2 norm of the unscaled values and the non-finite flag, all from one
+    HBM sweep.  Returns (out, norm_sq f32, found_inf i32).
+
+    This is the amp gradient epilogue (unscale_grads + check_finite +
+    clip_grad_norm's reduction) collapsed into a single kernel per
+    bucket: the caller rss-combines the per-bucket ``norm_sq`` into the
+    global norm and max-combines the flags.  The norm is accumulated in
+    f32 from the PRE-rounding unscaled values (what the clip math
+    wants), and zero padding contributes nothing to either reduction.
+    """
+    out_dtype = out_dtype or x.dtype
+    if not op_enabled("multi_tensor"):
+        return flat_unscale_norm_ref(x, inv_scale, out_dtype)
+    x2d, n = _as_tiles(x)
+    s = jnp.asarray([inv_scale], jnp.float32).reshape(1)
+    out, acc, flag = pl.pallas_call(
+        _unscale_norm_kernel,
+        grid=(_grid(x2d.shape[0]),),
+        in_specs=[_smem_spec(), _vec_spec()],
+        out_specs=[_vec_spec(), _scalar_out_spec(), _scalar_out_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2d.shape, out_dtype),
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_unscale_norm",
+    )(s, x2d)
+    return _from_tiles(out, n), acc[0], flag[0]
+
+
+def flat_unscale_norm_ref(x, inv_scale, out_dtype=None):
+    out_dtype = out_dtype or x.dtype
+    y = _f32(x) * jnp.asarray(inv_scale, jnp.float32)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(y))).astype(jnp.int32)
+    return y.astype(out_dtype), jnp.sum(y * y), bad
+
+
+# ---------------------------------------------------------------------------
 # L2 norm   [reference: multi_tensor_l2norm_kernel.cu]
 # ---------------------------------------------------------------------------
 

@@ -117,6 +117,22 @@ def _select(keep, new_tree, old_tree):
     return tree_map(lambda a, b: jnp.where(keep, a, b), new_tree, old_tree)
 
 
+def _fold_clip(grad_scale, clip_coef):
+    """Fold a global-norm clip coefficient into the gradient scale.
+
+    Every flat_* kernel (and the per-leaf math) multiplies grads by
+    ``1/grad_scale``; an effective scale of ``grad_scale/clip_coef``
+    therefore multiplies by ``clip_coef/grad_scale`` — clipping rides
+    the scaling the kernels already do, with no extra gradient pass or
+    copy.  LAMB's global-grad-norm prologue composes correctly: it sees
+    the norm of the gradients AS CLIPPED, which is what its own
+    max_grad_norm logic should be judging."""
+    gs = jnp.asarray(grad_scale, jnp.float32)
+    if clip_coef is None:
+        return gs
+    return gs / jnp.asarray(clip_coef, jnp.float32)
+
+
 class FusedOptimizerBase:
     """Subclasses set ``defaults`` and implement ``_step_math`` (per-leaf
     oracle path) plus ``_flat_bucket_step`` (bucketed flat path)."""
@@ -318,11 +334,13 @@ class FusedOptimizerBase:
 
     def _full_step_flat(self, param_bufs, master_bufs, opt_state, grads,
                         step, grad_scale, hypers, found_inf=None):
-        """Bucketed step body: grads pack (one concatenate per bucket),
-        then ONE flat kernel chain per bucket; params/masters/state go
-        in and come out packed."""
+        """Bucketed step body: grads pack (one concatenate per bucket)
+        — or arrive ALREADY packed from the flat AMP pipeline, in which
+        case zero pack work happens here — then ONE flat kernel chain
+        per bucket; params/masters/state go in and come out packed."""
         work_bufs = master_bufs if master_bufs is not None else param_bufs
-        grad_bufs = self._plan.pack(grads)
+        grad_bufs = (list(grads) if self._plan.is_packed(grads)
+                     else self._plan.pack(grads))
         new_work, new_state = self._flat_step_math(
             work_bufs, grad_bufs, opt_state, step, grad_scale, hypers)
         if found_inf is not None:
@@ -366,7 +384,8 @@ class FusedOptimizerBase:
                     return False
         return True
 
-    def functional_step(self, params, opt_state, grads, step, grad_scale=1.0):
+    def functional_step(self, params, opt_state, grads, step,
+                        grad_scale=1.0, clip_coef=None):
         """Embed-in-your-own-jit entry point (no master handling).
 
         ``params``/``grads`` are pytrees; ``opt_state`` may be either a
@@ -375,26 +394,57 @@ class FusedOptimizerBase:
         then the flat bucket kernels run, the new state comes back
         packed, and the new params come back as a pytree (what a train
         step's model apply needs anyway; the repack/unpack concatenates
-        and slices fuse into the caller's jit)."""
-        gs = jnp.asarray(grad_scale, jnp.float32)
+        and slices fuse into the caller's jit).  With packed state,
+        ``grads`` may also arrive as the plan's per-bucket flat buffers
+        (the flat AMP pipeline's layout) — no pack happens then.
+
+        ``clip_coef``: optional traced global-norm clip coefficient
+        (e.g. ``FlatGrads.clip_coef``); folded into the kernels' grad
+        scaling, so clipping never materializes a gradient copy."""
+        gs = _fold_clip(grad_scale, clip_coef)
         hypers = dict(self.hypers)
         if self._state_is_packed(opt_state):
             work_bufs = self._plan.pack_work(params)
-            grad_bufs = self._plan.pack(grads)
+            grad_bufs = (list(grads) if self._plan.is_packed(grads)
+                         else self._plan.pack(grads))
             new_bufs, new_state = self._flat_step_math(
                 work_bufs, grad_bufs, opt_state, step, gs, hypers)
             return self._plan.unpack(new_bufs), new_state
         return self._step_math(params, grads, opt_state, step, gs, hypers)
 
     # ---- stateful facade -------------------------------------------------
-    def step(self, grads: Pytree, grad_scale=1.0, found_inf=None) -> Pytree:
+    def step(self, grads: Pytree, grad_scale=1.0, found_inf=None,
+             clip_coef=None) -> Pytree:
         """Apply one update; returns (and stores) the new params.
+
+        ``grads`` may be the usual pytree, the plan's per-bucket flat
+        buffers (the flat AMP pipeline's pack-once layout — no re-pack
+        happens), or an ``amp.FlatGrads`` bundle, whose ``found_inf``
+        and ``clip_coef`` are used unless overridden explicitly.
 
         ``found_inf``: optional on-device i32/bool scalar (amp's overflow
         flag from ``scaled_value_and_grad`` or ``flat_scale``).  When
         given and nonzero, params/masters/state keep their old values
         and the step count does not advance — a branch-free skip, never
-        a host sync."""
+        a host sync.
+
+        ``clip_coef``: optional traced global-norm clip coefficient in
+        (0, 1]; folded into the kernels' grad scaling (see
+        ``_fold_clip``) so clipping costs zero extra gradient passes."""
+        if hasattr(grads, "bufs") and hasattr(grads, "found_inf"):
+            # amp.FlatGrads (duck-typed: amp must stay import-light here)
+            if self._plan is None:
+                raise ValueError(
+                    "FlatGrads/packed gradients require the bucketed "
+                    "path — this optimizer runs per-leaf "
+                    "(fuse_buckets=False or the packer declined its "
+                    "tree); pass a gradient pytree instead")
+            if found_inf is None:
+                found_inf = grads.found_inf
+            if clip_coef is None:
+                clip_coef = getattr(grads, "clip_coef", None)
+            grads = grads.bufs
+        grad_scale = _fold_clip(grad_scale, clip_coef)
         self.step_count = self.step_count + 1
         state = self.opt_state
         eager_offload = self.offload_state and not self._fused_offload

@@ -7,6 +7,11 @@ module times both paths over the SAME many-leaf pytree with benchlib's
 amortized on-device loop (one dispatch runs many steps serially, so a
 tunneled session measures the program, not the relay).
 
+``bench_amp_pipeline`` extends the comparison to the FULL amp gradient
+side of a train step (unscale + finite check + global-norm clip +
+optimizer update): per-leaf amp ops vs the flat pipeline's pack-once /
+fused-kernel-per-bucket chain (amp/flat_pipeline.py).
+
 Shared by bench.py (TPU extras), tools/kernel_bench.py (JSON row) and
 the tier-1 smoke test (tiny shapes, CPU: proves the harness, not
 performance).
@@ -74,4 +79,73 @@ def bench_optimizer_bucketing(layers: int = 48, hidden: int = 256,
     if out["optim_step_bucketed_ms"]:
         out["optim_bucketing_speedup"] = round(
             out["optim_step_perleaf_ms"] / out["optim_step_bucketed_ms"], 2)
+    return out
+
+
+def bench_amp_pipeline(layers: int = 48, hidden: int = 256,
+                       iters: int = 10, reps: int = 3,
+                       max_grad_norm: float = 1.0):
+    """Full AMP gradient epilogue, per-leaf vs flat, same grads.
+
+    Per-leaf: ``check_finite`` + ``unscale_grads`` + ``clip_grad_norm``
+    + per-leaf fused-Adam step — 3 full pytree walks plus the ravel
+    clip_grad does, then per-leaf update math.  Flat: ONE pack,
+    ``flat_unscale_norm`` per bucket (unscale + flag + Σg² in one HBM
+    read), clip coefficient folded into the flat Adam kernels' grad
+    scaling.  Grads are precomputed (identical input to both paths) so
+    the number isolates the gradient pipeline, not the backward."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.contrib.clip_grad import clip_grad_norm
+    from apex_tpu.optimizers import FusedAdam
+
+    params = many_leaf_params(jax, jnp, layers, hidden)
+    scaler = amp.LossScaleState.create(2.0 ** 12)
+    scale = float(scaler.loss_scale)
+    grads = jax.tree_util.tree_map(
+        lambda p: (p * 1e-3 + 1e-4) * scale, params)   # "scaled" grads
+
+    out = {
+        "amp_leaves": len(jax.tree_util.tree_leaves(params)),
+        "amp_elements": sum(int(l.size) for l in
+                            jax.tree_util.tree_leaves(params)),
+        "amp_max_grad_norm": max_grad_norm,
+    }
+
+    # --- per-leaf oracle path -------------------------------------------
+    opt_pl = FusedAdam(params, lr=1e-3, fuse_buckets=False)
+
+    def per_leaf_step(work, opt_state, grads, scaler_state, step):
+        found_inf = amp.check_finite(grads)
+        g = amp.unscale_grads(grads, scaler_state)
+        g, _norm = clip_grad_norm(g, max_grad_norm)
+        new_work, new_state = opt_pl.functional_step(
+            work, opt_state, g, step)
+        return new_work, new_state, found_inf
+
+    # --- flat pipeline path ---------------------------------------------
+    opt_fl = FusedAdam(params, lr=1e-3, fuse_buckets=True)
+    pipe = amp.FlatGradPipeline(optimizer=opt_fl,
+                                max_grad_norm=max_grad_norm)
+
+    def flat_step(work, opt_state, grads, scaler_state, step):
+        flat = pipe.unscale_and_norm(pipe.pack(grads), scaler_state)
+        new_work, new_state = opt_fl.functional_step(
+            work, opt_state, flat.bufs, step, clip_coef=flat.clip_coef)
+        return new_work, new_state, flat.found_inf
+
+    for label, fn, opt in (("per_leaf", per_leaf_step, opt_pl),
+                           ("flat", flat_step, opt_fl)):
+        # two programs, two compiles — not a hot-loop retrace
+        # apexlint: disable-next=APX302
+        step_fn = jax.jit(fn)
+        ms = timeit(step_fn, params, opt.opt_state, grads, scaler,
+                    jnp.int32(2), iters=iters, reps=reps)
+        out[f"amp_step_{label}_ms"] = round(ms, 3)
+    if out["amp_step_flat_ms"]:
+        out["amp_pipeline_speedup"] = round(
+            out["amp_step_per_leaf_ms"] / out["amp_step_flat_ms"], 2)
     return out

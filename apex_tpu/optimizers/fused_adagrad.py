@@ -1,5 +1,9 @@
 """FusedAdagrad (reference: apex/optimizers/fused_adagrad.py);
-cf. csrc/multi_tensor_adagrad.cu."""
+cf. csrc/multi_tensor_adagrad.cu.
+
+Flat AMP pipeline: ``step()`` takes already-packed per-bucket gradient
+buffers and a traced ``clip_coef`` folded into ``flat_adagrad``'s
+in-kernel ``inv_scale`` (optimizers/_base._fold_clip)."""
 
 from __future__ import annotations
 

@@ -5,6 +5,12 @@ Adam/AdamW with the whole-pytree update traced into one jitted program
 SURVEY.md §3.3).  ``adam_w_mode=True`` (default, as in the reference)
 gives AdamW decoupled decay; ``capturable`` is accepted for parity and
 ignored (every step is a compiled graph on TPU).
+
+Flat AMP pipeline: ``step()`` accepts the bucket plan's per-bucket flat
+gradient buffers (or an ``amp.FlatGrads`` bundle) plus a traced
+``clip_coef`` — the clip folds into ``flat_adam``'s in-kernel
+``inv_scale`` multiply, so a clipped step reads the gradients exactly
+once (see optimizers/_base._fold_clip).
 """
 
 from __future__ import annotations

@@ -6,6 +6,14 @@ two-stage multi_tensor_lamb with a multi_tensor_l2norm prologue
 (SURVEY.md §2.1).  The global norm here is one fused reduction across the
 pytree; the trust ratio stays per-leaf exactly as the reference keeps it
 per-tensor.
+
+Flat AMP pipeline: ``step()`` takes already-packed per-bucket gradient
+buffers and a traced pipeline ``clip_coef`` folded into the gradient
+scaling (optimizers/_base._fold_clip).  The two clips COMPOSE: the
+max_grad_norm prologue divides its measured norm by the effective
+grad_scale, so it judges the gradients as the pipeline already clipped
+them — prefer ONE owner (pipeline ``max_grad_norm`` or LAMB's, not
+both) unless double clipping is intended.
 """
 
 from __future__ import annotations

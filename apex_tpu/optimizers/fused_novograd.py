@@ -2,6 +2,12 @@
 
 NovoGrad: layer-wise (per-tensor scalar) second moment normalizing the
 gradient before the first-moment EMA; cf. csrc/multi_tensor_novograd.cu.
+
+Flat AMP pipeline: ``step()`` takes already-packed per-bucket gradient
+buffers and a traced ``clip_coef`` folded into the gradient scaling
+(optimizers/_base._fold_clip); the per-tensor second-moment norms are
+then norms of the CLIPPED gradients, matching the per-leaf oracle fed
+pre-clipped grads.
 """
 
 from __future__ import annotations

@@ -2,6 +2,10 @@
 
 torch.optim.SGD semantics (momentum / dampening / nesterov / weight
 decay) as one fused pytree update; cf. csrc/multi_tensor_sgd_kernel.cu.
+
+Flat AMP pipeline: ``step()`` takes already-packed per-bucket gradient
+buffers and a traced ``clip_coef`` folded into ``flat_sgd``'s in-kernel
+``inv_scale`` (optimizers/_base._fold_clip) — no per-leaf clip pass.
 """
 
 from __future__ import annotations

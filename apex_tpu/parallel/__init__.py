@@ -9,6 +9,7 @@ gradient reduction, SyncBatchNorm with cross-device Welford stats, LARC.
 from apex_tpu.parallel.distributed import (
     DistributedDataParallel,
     Reducer,
+    all_reduce_flat_buffers,
     all_reduce_gradients,
     broadcast_params,
     flat_dist_call,
@@ -22,6 +23,7 @@ from apex_tpu.parallel.LARC import LARC
 
 __all__ = [
     "DistributedDataParallel", "Reducer", "all_reduce_gradients",
+    "all_reduce_flat_buffers",
     "broadcast_params", "flat_dist_call",
     "SyncBatchNorm", "convert_syncbn_model", "sync_batch_norm_stats",
     "LARC",

@@ -14,6 +14,14 @@ reference's API shape on top of that reality:
     scheduler owns that decision.
   - ``flat_dist_call`` / ``broadcast_params`` mirror the ctor broadcast.
   - ``Reducer`` is the raw-reduction facade.
+
+Bucket-granular path (flat AMP pipeline): hand ``Reducer`` or
+``DistributedDataParallel`` a :class:`BucketPlan` (or a bucketed fused
+optimizer) and reduction runs over the plan's flat buckets —
+``all_reduce_flat_buffers`` issues ONE psum per dtype bucket instead of
+one per leaf, and packed buffer lists stay packed through the
+collective so the fused unscale/norm kernel consumes the reduced
+buckets directly (amp/flat_pipeline.py wires the whole chain).
 """
 
 from __future__ import annotations
@@ -49,7 +57,7 @@ def all_reduce_gradients(grads: Pytree,
     """
     if axis_name is None or not _in_shard_map(axis_name):
         return grads
-    world = jax.lax.axis_size(axis_name)
+    world = comm.bound_axis_size(axis_name)
     pre = gradient_predivide_factor
     post = world / pre if average else 1.0 / pre
 
@@ -63,6 +71,38 @@ def all_reduce_gradients(grads: Pytree,
         return gf.astype(g.dtype)
 
     return jax.tree_util.tree_map(reduce_leaf, grads)
+
+
+def all_reduce_flat_buffers(bufs, axis_name: str = comm.AXIS_DATA,
+                            average: bool = True,
+                            gradient_predivide_factor: float = 1.0):
+    """Bucket-granular all-reduce: ONE psum per flat bucket buffer.
+
+    The flat AMP pipeline's collective stage — gradients arrive packed
+    in a BucketPlan layout (a handful of large 1-D buffers instead of
+    hundreds of leaves), so DDP-shaped reduction issues one collective
+    per bucket.  Same average/predivide semantics as
+    ``all_reduce_gradients``; f32 accumulation, results cast back to
+    each buffer's dtype.  No-op outside shard_map (pjit/GSPMD already
+    reduced) — identical contract to the per-leaf entry point.
+    """
+    bufs = list(bufs)
+    if axis_name is None or not _in_shard_map(axis_name):
+        return bufs
+    world = comm.bound_axis_size(axis_name)
+    pre = gradient_predivide_factor
+    post = world / pre if average else 1.0 / pre
+
+    def reduce_buf(b):
+        bf = b.astype(jnp.float32)
+        if pre != 1.0:
+            bf = bf / pre
+        bf = jax.lax.psum(bf, axis_name)
+        if post != 1.0:
+            bf = bf / post
+        return bf.astype(b.dtype)
+
+    return [reduce_buf(b) for b in bufs]
 
 
 def broadcast_params(params: Pytree) -> Pytree:
@@ -84,15 +124,56 @@ def flat_dist_call(tensors, op: Callable, args=None):
     return [op(t) for t in tensors]
 
 
+def _resolve_plan(plan):
+    """plan= may be a BucketPlan or a bucketed fused optimizer.  An
+    optimizer WITHOUT a plan (fuse_buckets=False, or the packer
+    declined its tree) is a loud error, not a silent per-leaf
+    fallback — the user asked for bucket-granular collectives and must
+    learn they are not getting them (FlatGradPipeline raises for the
+    same input)."""
+    if plan is None:
+        return None
+    resolved = getattr(plan, "_plan", plan)
+    if resolved is None:
+        raise ValueError(
+            "plan= was given an optimizer without a bucket plan "
+            "(fuse_buckets=False or the packer declined its tree) — "
+            "bucket-granular reduction needs the bucketed path; omit "
+            "plan= for per-leaf reduction")
+    return resolved
+
+
 class Reducer:
     """Raw gradient reducer (reference: apex/parallel/distributed.py::
-    Reducer) — explicitly-invoked reduction, no hooks."""
+    Reducer) — explicitly-invoked reduction, no hooks.
+
+    ``plan``: an optional :class:`BucketPlan` (or a bucketed fused
+    optimizer, whose plan is borrowed).  With a plan, reduction is
+    bucket-granular — pytree grads are packed once and reduced as flat
+    buckets (one psum per bucket, the reference's allreduce_bucket
+    made literal), and already-packed buffer lists are reduced as-is
+    and returned packed, so the flat AMP pipeline keeps grads flat
+    straight through the collective."""
 
     def __init__(self, module_or_grads_list=None,
-                 axis_name: str = comm.AXIS_DATA):
+                 axis_name: str = comm.AXIS_DATA, plan=None):
         self.axis_name = axis_name
+        self.plan = _resolve_plan(plan)
 
     def reduce(self, grads: Pytree, average: bool = True) -> Pytree:
+        if self.plan is not None:
+            if self.plan.is_packed(grads):
+                return all_reduce_flat_buffers(
+                    grads, self.axis_name, average=average)
+            # no-op contexts (axis unbound / GSPMD) must stay free:
+            # don't pay a pack+unpack gradient copy for nothing
+            if self.axis_name is None \
+                    or not _in_shard_map(self.axis_name):
+                return grads
+            bufs = all_reduce_flat_buffers(
+                self.plan.pack_grads(grads), self.axis_name,
+                average=average)
+            return self.plan.unpack_grads(bufs)
         return all_reduce_gradients(grads, self.axis_name, average=average)
 
 
@@ -118,7 +199,8 @@ class DistributedDataParallel:
                  allreduce_always_fp32: bool = False,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
-                 axis_name: str = comm.AXIS_DATA):
+                 axis_name: str = comm.AXIS_DATA,
+                 bucket_plan=None):
         # bucketing/overlap knobs accepted for parity; XLA owns scheduling
         del message_size, delay_allreduce, shared_param
         del allreduce_trigger_params, retain_allreduce_buffers
@@ -127,11 +209,32 @@ class DistributedDataParallel:
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.axis_name = axis_name
+        # bucket_plan: a BucketPlan or bucketed fused optimizer — grads
+        # then reduce as flat buckets (one collective per bucket), the
+        # honest realization of the knobs deleted above
+        self.bucket_plan = _resolve_plan(bucket_plan)
 
     def __call__(self, *args, **kwargs):
         return self.apply_fn(*args, **kwargs)
 
     def reduce_gradients(self, grads: Pytree) -> Pytree:
+        if self.bucket_plan is not None:
+            packed = self.bucket_plan.is_packed(grads)
+            if not packed and (self.axis_name is None
+                               or not _in_shard_map(self.axis_name)):
+                # no-op context: skip the pack+unpack gradient copy
+                # (per-leaf path below returns grads untouched too)
+                return grads
+            bufs = (list(grads) if packed
+                    else self.bucket_plan.pack_grads(grads))
+            if self.allreduce_always_fp32:
+                bufs = [b.astype(jnp.float32) for b in bufs]
+            bufs = all_reduce_flat_buffers(
+                bufs, self.axis_name, average=self.gradient_average,
+                gradient_predivide_factor=self.gradient_predivide_factor)
+            # packed in -> packed out (the flat pipeline consumes the
+            # buckets directly); tree in -> tree out
+            return bufs if packed else self.bucket_plan.unpack_grads(bufs)
         if self.allreduce_always_fp32:
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)

@@ -131,7 +131,7 @@ class ExpertParallelMLP(nn.Module):
     def __call__(self, x, deterministic: bool = False):
         t, h = x.shape
         e = self.num_experts
-        ep = (jax.lax.axis_size(self.axis)
+        ep = (comm.bound_axis_size(self.axis)
               if self.axis is not None and comm.axis_is_bound(self.axis)
               else 1)
         if e % ep != 0:

@@ -298,7 +298,7 @@ def _interleaved_scan(stage_fn: Callable, seed_fn: Callable,
     cotangent (from a loss, or from a downstream output-cotangent
     slice).  Returns (gacc, loss_acc, gub) — gub is the
     d/d microbatches buffer (zeros unless collect_gub)."""
-    L = jax.lax.axis_size(axis)
+    L = comm.bound_axis_size(axis)
     stage = jax.lax.axis_index(axis)
     V = chunk_count(params_chunks)
     M = microbatches.shape[0]

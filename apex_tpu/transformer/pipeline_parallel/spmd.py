@@ -44,7 +44,7 @@ def spmd_pipeline(stage_fn: Callable,
     Returns (M, mb, ...) outputs of the LAST stage (zeros elsewhere are
     masked out and psum-broadcast so every stage holds the result).
     """
-    L = jax.lax.axis_size(axis)
+    L = comm.bound_axis_size(axis)
     stage = jax.lax.axis_index(axis)
     M = microbatches.shape[0]
     T = M + L - 1
@@ -116,7 +116,7 @@ def spmd_pipeline_interleaved(stage_fn: Callable,
     returns (M, mb, ...) last-chunk outputs replicated on the pipe axis,
     like ``spmd_pipeline``.
     """
-    L = jax.lax.axis_size(axis)
+    L = comm.bound_axis_size(axis)
     stage = jax.lax.axis_index(axis)
     from apex_tpu.transformer.pipeline_parallel.interleaved_1f1b import (
         chunk_count)
@@ -217,7 +217,7 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable,
     Not itself differentiable (it IS the backward); use in place of
     jax.grad(spmd_pipeline_loss).
     """
-    L = jax.lax.axis_size(axis)
+    L = comm.bound_axis_size(axis)
     stage = jax.lax.axis_index(axis)
     M = microbatches.shape[0]
     T = M + 2 * (L - 1)
@@ -316,7 +316,7 @@ def _pipeline_1f1b_apply_fwd(stage_fn, axis, params_local, microbatches):
 
 def _pipeline_1f1b_apply_bwd(stage_fn, axis, res, ct):
     params_local, microbatches = res
-    L = jax.lax.axis_size(axis)
+    L = comm.bound_axis_size(axis)
     stage = jax.lax.axis_index(axis)
     M = microbatches.shape[0]
     T = M + 2 * (L - 1)

@@ -40,7 +40,7 @@ def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
     synced = _tp_bound(axis)
 
     if synced:
-        tp = jax.lax.axis_size(axis)
+        tp = comm.bound_axis_size(axis)
         rank = jax.lax.axis_index(axis)
     else:
         tp, rank = 1, 0

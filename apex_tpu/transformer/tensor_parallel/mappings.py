@@ -36,7 +36,7 @@ def _rank(axis):
 
 
 def _world(axis):
-    return jax.lax.axis_size(axis)
+    return comm.bound_axis_size(axis)
 
 
 def _split_along(x, dim, axis):

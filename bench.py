@@ -141,6 +141,13 @@ def _resnet50_one_batch(jax, jnp, on_tpu, batch, size, steps):
             "step_ms": r["step_ms"],
             "steps_per_dispatch": r["steps_per_dispatch"],
             "stem": "space_to_depth" if on_tpu else "conv7x7",
+            # gradient-HANDLING provenance: "flat" = grads packed once
+            # into dtype buckets and stepped by the flat kernels.  These
+            # bf16/static-scale legs have no unscale/clip work, so the
+            # fused unscale+norm+clip epilogue is NOT part of this
+            # number — bench_amp_pipeline measures that separately
+            # (amp_step_{flat,per_leaf}_ms extras).
+            "amp_pipeline": "flat" if opt.fuse_buckets else "per_leaf",
             "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
                         on_tpu)}
 
@@ -195,6 +202,9 @@ def _amp_lamb_train_bench(jax, jnp, model_loss, params0, batch, *,
         (params_bf16, masters0, opt.opt_state, jnp.float32(0)),
         batch, steps=steps, chunk=chunk, want_flops=want_flops)
     float(r["state"][3])  # loss: forces the donated-buffer chain
+    # gradient-handling provenance only (see _resnet50_one_batch): the
+    # fused unscale/clip epilogue is benched by bench_amp_pipeline
+    r["amp_pipeline"] = "flat" if opt.fuse_buckets else "per_leaf"
     return r
 
 
@@ -228,6 +238,7 @@ def _bert_lamb_one_batch(jax, jnp, on_tpu, batch, seq, steps, config):
     return {"step_ms": r["step_ms"], "config": config,
             "batch": batch, "seq": seq,
             "steps_per_dispatch": r["steps_per_dispatch"],
+            "amp_pipeline": r.get("amp_pipeline"),
             "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
                         on_tpu)}
 
@@ -464,6 +475,7 @@ def run_child(backend):
             "steps_per_dispatch")
         out["extra"]["resnet50_batch_sweep"] = r.get("batch_sweep")
         out["extra"]["resnet50_stem"] = r.get("stem")
+        out["extra"]["resnet50_amp_pipeline"] = r.get("amp_pipeline")
         if r.get("mfu") is not None:
             out["extra"]["resnet50_mfu"] = r["mfu"]
     except Exception:
@@ -480,6 +492,7 @@ def run_child(backend):
         out["extra"]["bert_large_fused_lamb_step_ms"] = round(
             b["step_ms"], 2)
         out["extra"]["bert_config"] = b["config"]
+        out["extra"]["bert_amp_pipeline"] = b.get("amp_pipeline")
         if b.get("mfu") is not None:
             out["extra"]["bert_mfu"] = b["mfu"]
     except Exception:
@@ -511,6 +524,17 @@ def run_child(backend):
                                  if k != "optim_buckets"})
         except Exception as e:
             out["extra"]["optim_bucketing_error"] = repr(e)[:200]
+
+        print(_dump(out), flush=True)
+        try:
+            # full AMP gradient epilogue, flat pipeline vs per-leaf amp
+            # ops on the same many-leaf tree (the pack-once +
+            # fused-unscale/norm/clip win this PR exists for)
+            from apex_tpu.optimizers.bucketing_bench import \
+                bench_amp_pipeline
+            out["extra"].update(bench_amp_pipeline())
+        except Exception as e:
+            out["extra"]["amp_pipeline_error"] = repr(e)[:200]
 
         print(_dump(out), flush=True)
         try:

@@ -97,6 +97,7 @@ _OP_FAMILY = {
     "softmax_cross_entropy": "xentropy",
     "flat_adam": "multi_tensor",
     "flat_lamb": "multi_tensor",
+    "flat_unscale_norm": "multi_tensor",
     "welford_mean_var": "welford",
 }
 
@@ -363,6 +364,14 @@ def main():
     g = jax.random.normal(jax.random.key(2), (n,), jnp.float32) * 0.01
     m = jnp.zeros((n,), jnp.float32)
     v = jnp.zeros((n,), jnp.float32)
+    # fused amp gradient epilogue: unscale + non-finite + Σg² in ONE
+    # HBM read, vs the same three answers computed the per-leaf way
+    # (scale pass + isfinite pass + l2norm pass over the same buffer)
+    inv = jnp.float32(1.0 / 65536.0)
+    rows.append(bench_pair(
+        "flat_unscale_norm", f"n={n}", "f32",
+        lambda g_: mt.flat_unscale_norm(g_, inv),
+        lambda g_: mt.flat_unscale_norm_ref(g_, inv), g))
     kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
               weight_decay=0.01, step=3, adam_w_mode=True)
     rows.append(bench_pair(
@@ -388,7 +397,7 @@ def main():
     # the end-to-end number the flat kernels exist for (recorded in the
     # bench round via bench.py extras too)
     from apex_tpu.optimizers.bucketing_bench import \
-        bench_optimizer_bucketing
+        bench_amp_pipeline, bench_optimizer_bucketing
     r = bench_optimizer_bucketing()
     r["backend"] = backend
     print(json.dumps(r), flush=True)
@@ -399,6 +408,19 @@ def main():
         "kernel_ms": r["optim_step_bucketed_ms"],
         "oracle_ms": r["optim_step_perleaf_ms"],
         "speedup": r.get("optim_bucketing_speedup")})
+
+    # full AMP gradient pipeline, flat vs per-leaf (pack-once + fused
+    # unscale/norm/clip vs 3-4 pytree sweeps) on the same many-leaf tree
+    ra = bench_amp_pipeline()
+    ra["backend"] = backend
+    print(json.dumps(ra), flush=True)
+    rows.append({
+        "kernel": "amp_flat_pipeline_step",
+        "shape": f"{ra['amp_leaves']}leaves/{ra['amp_elements']}elem",
+        "dtype": "f32",
+        "kernel_ms": ra["amp_step_flat_ms"],
+        "oracle_ms": ra["amp_step_per_leaf_ms"],
+        "speedup": ra.get("amp_pipeline_speedup")})
 
     for r in rows:
         r["backend"] = backend
