@@ -35,7 +35,9 @@ Beyond-reference TPU tiers (no apex counterpart): apex_tpu.data (device
 prefetcher), apex_tpu.offload (host-memory offload), apex_tpu.checkpoint
 (packed/async checkpoints) + apex_tpu.resilience (crash recovery),
 apex_tpu.quantization (int8 inference), apex_tpu.platform (backend
-override under hosted sitecustomize hooks).
+override under hosted sitecustomize hooks), apex_tpu.telemetry
+(host-sync-free training telemetry: device-side metric ring, span
+timing, retrace counters — docs/observability.md).
 """
 
 from apex_tpu._version import __version__

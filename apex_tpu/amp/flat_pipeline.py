@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from apex_tpu.amp.scaler import LossScaleState, scale_loss
 from apex_tpu.multi_tensor_apply.packer import BucketPlan, cached_plan
 from apex_tpu.ops import multi_tensor as mt
+from apex_tpu.telemetry import _tape
 
 Pytree = Any
 
@@ -156,6 +157,12 @@ class FlatGradPipeline:
         # whether the norm overflowed to inf (clip would be 0) or NaN
         # (comparison False); no 0-or-NaN coefficient ever leaks out
         clip = jnp.where(found_inf > 0, jnp.float32(1.0), clip)
+        # telemetry producers (trace-time no-ops without an active
+        # tape): the signals below already exist on device — reporting
+        # them costs nothing and syncs nothing
+        _tape.emit("amp/grad_norm", norm)
+        _tape.emit("amp/found_inf", found_inf, reduce="max")
+        _tape.emit("amp/clip_coef", clip)
         return FlatGrads(bufs=outs, grad_norm=norm,
                          found_inf=found_inf, clip_coef=clip)
 
@@ -187,6 +194,8 @@ class FlatGradPipeline:
             aux = None
         flat = self.unscale_and_norm(self.reduce(self.pack(grads)), sstate)
         loss = scaled / sstate.loss_scale
+        _tape.emit("amp/loss_scale", sstate.loss_scale)
+        _tape.emit("loss", loss)
         if has_aux:
             return (loss, aux), flat
         return loss, flat

@@ -77,6 +77,16 @@ class AmpState:
                                 max_grad_norm=max_grad_norm,
                                 axis_name=axis_name, **kw)
 
+    def telemetry_values(self) -> dict:
+        """This state's scaler scalars under their standard telemetry
+        names (still on device — no sync), ready for
+        ``Telemetry.record`` in eager train loops; jitted steps get
+        the same names for free via the producers inside
+        ``scaled_value_and_grad``/``update_state``."""
+        return {"amp/loss_scale": self.scaler.loss_scale,
+                "amp/growth_tracker": self.scaler.growth_tracker,
+                "amp/found_inf": self.scaler.found_inf}
+
     # --- apex serialization contract: amp.state_dict() round-trips the
     # loss scaler (scale + unskipped count), frontend.py parity ---
     def state_dict(self):

@@ -20,6 +20,8 @@ from typing import Any, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.telemetry import _tape
+
 Pytree = Any
 
 
@@ -76,6 +78,7 @@ def update_state(state: LossScaleState, found_inf: jax.Array,
                  config: LossScaleConfig = LossScaleConfig()) -> LossScaleState:
     """update_scale_hysteresis semantics, branch-free on device."""
     if not config.dynamic:
+        _tape.emit("amp/found_inf", found_inf, reduce="max")
         return dataclasses.replace(state, found_inf=found_inf)
     overflowed = found_inf > 0
     tracker = jnp.where(overflowed, 0, state.growth_tracker + 1)
@@ -90,6 +93,11 @@ def update_state(state: LossScaleState, found_inf: jax.Array,
                   state.loss_scale),
     )
     tracker = jnp.where(grow, 0, tracker)
+    # telemetry (no-ops without an active tape): a collapsing loss
+    # scale is THE amp failure signature worth watching live
+    _tape.emit("amp/loss_scale", new_scale)
+    _tape.emit("amp/growth_tracker", tracker)
+    _tape.emit("amp/found_inf", found_inf, reduce="max")
     return LossScaleState(
         loss_scale=new_scale,
         growth_tracker=tracker,
@@ -148,6 +156,9 @@ def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
     found_inf = check_finite(grads)
     grads = unscale_grads(grads, state)
     loss = scaled / state.loss_scale
+    _tape.emit("amp/found_inf", found_inf, reduce="max")
+    _tape.emit("amp/loss_scale", state.loss_scale)
+    _tape.emit("loss", loss)
     if has_aux:
         return (loss, aux), grads, found_inf
     return loss, grads, found_inf

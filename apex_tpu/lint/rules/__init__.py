@@ -10,6 +10,7 @@ from typing import List
 
 from apex_tpu.lint.engine import Rule
 from apex_tpu.lint.rules.host_sync import HostSyncRule
+from apex_tpu.lint.rules.telemetry_sync import TelemetrySyncRule
 from apex_tpu.lint.rules.dtype_promotion import (
     Float64Rule, MatmulAccumulationRule, StrongScalarRule)
 from apex_tpu.lint.rules.retrace import (
@@ -21,6 +22,7 @@ from apex_tpu.lint.rules.import_env import ImportTimeEnvRule
 
 _RULE_CLASSES = (
     HostSyncRule,
+    TelemetrySyncRule,
     MatmulAccumulationRule,
     Float64Rule,
     StrongScalarRule,

@@ -27,6 +27,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._dispatch import interpret_mode, op_enabled
+from apex_tpu.telemetry import _tape
 
 LANE = 128
 SUBLANE = 8
@@ -730,12 +731,20 @@ def _lamb_trust_factor(p, update, seg_ids, num_segments, lr, wd,
                        use_nvlamb):
     """Per-element lr*trust buffer from per-segment norms (one gather)."""
     p_norm = jnp.sqrt(flat_segment_sumsq(p, seg_ids, num_segments))
-    u_norm = jnp.sqrt(flat_segment_sumsq(update, seg_ids, num_segments))
+    u_norm_sq = flat_segment_sumsq(update, seg_ids, num_segments)
+    u_norm = jnp.sqrt(u_norm_sq)
     trust = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
     if not use_nvlamb:
         # standard LAMB exempts decay-free tensors from layer adaptation;
         # NVLAMB applies the trust ratio to every layer
         trust = jnp.where(wd == 0.0, jnp.float32(1.0), trust)
+    # telemetry from the reductions that already exist (both the kernel
+    # and ref paths come through here) — per-bucket emissions combine
+    # across buckets: max for the trust ratio, root-sum-square for the
+    # update norm.  No extra HBM sweep: u_norm_sq is (num_segments,).
+    _tape.emit("optim/max_trust_ratio", jnp.max(trust), reduce="max")
+    _tape.emit("optim/update_norm", jnp.sqrt(jnp.sum(u_norm_sq)),
+               reduce="rss")
     return (jnp.asarray(lr, jnp.float32) * trust)[seg_ids]
 
 

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.multi_tensor_apply.packer import BucketPlan
+from apex_tpu.telemetry import _tape
 
 Pytree = Any
 tree_map = jax.tree_util.tree_map
@@ -115,6 +116,22 @@ def _select(keep, new_tree, old_tree):
     amp found_inf skip — mirrors amp.scaler.conditional_step, never a
     host sync)."""
     return tree_map(lambda a, b: jnp.where(keep, a, b), new_tree, old_tree)
+
+
+def _skip_on_overflow(found_inf, new_work, old_work, new_state,
+                      old_state):
+    """The branch-free found_inf skip, shared by every step body:
+    keep the old values when the flag is set, and report the skip.
+    The telemetry emission lands only when the body is traced inside
+    an instrumented jit (functional_step, or a train step embedding
+    ``_full_step_impl``); the stateful ``step()`` facade's internal
+    jit cannot report into an outer ring — the tape correctly drops
+    its tracers (telemetry._tape docstring)."""
+    keep = jnp.asarray(found_inf) == 0
+    _tape.emit("optim/skipped", jnp.asarray(found_inf) > 0,
+               reduce="max")
+    return (_select(keep, new_work, old_work),
+            _select(keep, new_state, old_state))
 
 
 def _fold_clip(grad_scale, clip_coef):
@@ -322,9 +339,8 @@ class FusedOptimizerBase:
         new_work, new_state = self._step_math(
             work, grads, opt_state, step, grad_scale, hypers)
         if found_inf is not None:
-            keep = jnp.asarray(found_inf) == 0
-            new_work = _select(keep, new_work, work)
-            new_state = _select(keep, new_state, opt_state)
+            new_work, new_state = _skip_on_overflow(
+                found_inf, new_work, work, new_state, opt_state)
         if masters is not None:
             new_params = tree_map(lambda p, m: m.astype(p.dtype)
                                   if jnp.issubdtype(p.dtype, jnp.floating)
@@ -344,9 +360,8 @@ class FusedOptimizerBase:
         new_work, new_state = self._flat_step_math(
             work_bufs, grad_bufs, opt_state, step, grad_scale, hypers)
         if found_inf is not None:
-            keep = jnp.asarray(found_inf) == 0
-            new_work = _select(keep, new_work, work_bufs)
-            new_state = _select(keep, new_state, opt_state)
+            new_work, new_state = _skip_on_overflow(
+                found_inf, new_work, work_bufs, new_state, opt_state)
         if master_bufs is not None:
             new_params = [w.astype(b.model_dtype) for w, b in
                           zip(new_work, self._plan.buckets)]
@@ -385,7 +400,7 @@ class FusedOptimizerBase:
         return True
 
     def functional_step(self, params, opt_state, grads, step,
-                        grad_scale=1.0, clip_coef=None):
+                        grad_scale=1.0, clip_coef=None, found_inf=None):
         """Embed-in-your-own-jit entry point (no master handling).
 
         ``params``/``grads`` are pytrees; ``opt_state`` may be either a
@@ -396,21 +411,49 @@ class FusedOptimizerBase:
         step's model apply needs anyway; the repack/unpack concatenates
         and slices fuse into the caller's jit).  With packed state,
         ``grads`` may also arrive as the plan's per-bucket flat buffers
-        (the flat AMP pipeline's layout) — no pack happens then.
+        (the flat AMP pipeline's layout) — no pack happens then — or as
+        an ``amp.FlatGrads`` bundle, whose ``found_inf``/``clip_coef``
+        apply unless overridden explicitly (``step()`` parity).
 
         ``clip_coef``: optional traced global-norm clip coefficient
         (e.g. ``FlatGrads.clip_coef``); folded into the kernels' grad
-        scaling, so clipping never materializes a gradient copy."""
+        scaling, so clipping never materializes a gradient copy.
+
+        ``found_inf``: optional on-device overflow flag; when nonzero,
+        params and state come back unchanged (branch-free skip — the
+        caller owns the step clock and should likewise not advance it
+        on a skipped step, as ``step()`` does)."""
+        packed = self._state_is_packed(opt_state)
+        if hasattr(grads, "bufs") and hasattr(grads, "found_inf"):
+            # amp.FlatGrads (duck-typed, as in step())
+            if not packed:
+                raise ValueError(
+                    "FlatGrads require the bucketed path — this call "
+                    "runs per-leaf state; pass a gradient pytree "
+                    "instead")
+            if found_inf is None:
+                found_inf = grads.found_inf
+            if clip_coef is None:
+                clip_coef = getattr(grads, "clip_coef", None)
+            grads = grads.bufs
         gs = _fold_clip(grad_scale, clip_coef)
         hypers = dict(self.hypers)
-        if self._state_is_packed(opt_state):
+        if packed:
             work_bufs = self._plan.pack_work(params)
             grad_bufs = (list(grads) if self._plan.is_packed(grads)
                          else self._plan.pack(grads))
             new_bufs, new_state = self._flat_step_math(
                 work_bufs, grad_bufs, opt_state, step, gs, hypers)
+            if found_inf is not None:
+                new_bufs, new_state = _skip_on_overflow(
+                    found_inf, new_bufs, work_bufs, new_state, opt_state)
             return self._plan.unpack(new_bufs), new_state
-        return self._step_math(params, grads, opt_state, step, gs, hypers)
+        new_params, new_state = self._step_math(
+            params, grads, opt_state, step, gs, hypers)
+        if found_inf is not None:
+            new_params, new_state = _skip_on_overflow(
+                found_inf, new_params, params, new_state, opt_state)
+        return new_params, new_state
 
     # ---- stateful facade -------------------------------------------------
     def step(self, grads: Pytree, grad_scale=1.0, found_inf=None,

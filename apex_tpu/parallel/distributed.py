@@ -32,8 +32,19 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu import comm
+from apex_tpu.telemetry import _tape
 
 Pytree = Any
+
+
+def _emit_reduce_telemetry(bufs) -> None:
+    """Report collective payload: bytes all-reduced this step (summed
+    over calls) and the number of collectives issued.  Shapes/dtypes
+    are static, so this is host arithmetic at trace time — nothing is
+    added to the compiled program beyond two ring-slot constants."""
+    nbytes = sum(int(b.size) * jnp.dtype(b.dtype).itemsize for b in bufs)
+    _tape.emit("ddp/bytes_allreduced", float(nbytes), reduce="sum")
+    _tape.emit("ddp/buckets", float(len(bufs)), reduce="sum")
 
 
 def _in_shard_map(axis_name: str) -> bool:
@@ -60,6 +71,7 @@ def all_reduce_gradients(grads: Pytree,
     world = comm.bound_axis_size(axis_name)
     pre = gradient_predivide_factor
     post = world / pre if average else 1.0 / pre
+    _emit_reduce_telemetry(jax.tree_util.tree_leaves(grads))
 
     def reduce_leaf(g):
         gf = g.astype(jnp.float32)
@@ -92,6 +104,7 @@ def all_reduce_flat_buffers(bufs, axis_name: str = comm.AXIS_DATA,
     world = comm.bound_axis_size(axis_name)
     pre = gradient_predivide_factor
     post = world / pre if average else 1.0 / pre
+    _emit_reduce_telemetry(bufs)
 
     def reduce_buf(b):
         bf = b.astype(jnp.float32)

@@ -8,9 +8,16 @@ TPU equivalents: `jax.named_scope` annotations + `jax.profiler` trace
 capture (the nvtx half, `apex_tpu.pyprof.nvtx`), and the trace
 distiller that parses the written profile into a top-device-ops table
 (the prof half, `apex_tpu.pyprof.prof`).
+
+Run-time training telemetry (metric rings, span timing, retrace
+counters) is the sibling layer `apex_tpu.telemetry`:
+``telemetry.span(name)`` nests on nvtx's (thread-local) range stack,
+so telemetry spans land in XProf traces exactly like `annotate`d
+functions do.
 """
 
 from apex_tpu.pyprof import nvtx, prof  # noqa: F401
+from apex_tpu.pyprof.nvtx import annotate, profile  # noqa: F401
 
 _enabled = False
 
@@ -26,4 +33,4 @@ def enabled() -> bool:
     return _enabled
 
 
-__all__ = ["init", "enabled", "nvtx", "prof"]
+__all__ = ["init", "enabled", "nvtx", "prof", "annotate", "profile"]

@@ -5,32 +5,57 @@ range_push/range_pop manage a stack of named_scope context managers;
 `range` is the decorator/context form; `profile` wraps
 jax.profiler.trace for XProf capture.  Scopes show up in TPU traces the
 way nvtx ranges show up in nsight.
+
+The push/pop stack is THREAD-LOCAL: a prefetch thread annotating its
+own work must never pop a scope the main thread pushed (the reference
+nvtx API is per-thread for the same reason).  ``range_pop`` is also
+best-effort on teardown — a scope body that raised can leave
+``jax.named_scope``'s own context in a state where ``__exit__``
+raises, and an unwinding caller (``telemetry.span``'s finally, an
+except-branch cleanup) must still get its stack balanced rather than
+a second exception.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 from typing import List
 
 import jax
 
-_stack: List = []
+_tls = threading.local()
+
+
+def _stack() -> List:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
 
 
 def range_push(msg: str) -> int:
     cm = jax.named_scope(msg)
     cm.__enter__()
-    _stack.append(cm)
-    return len(_stack)
+    stack = _stack()
+    stack.append(cm)
+    return len(stack)
 
 
 def range_pop() -> int:
-    if not _stack:
+    stack = _stack()
+    if not stack:
         return 0
-    cm = _stack.pop()
-    cm.__exit__(None, None, None)
-    return len(_stack)
+    cm = stack.pop()
+    try:
+        cm.__exit__(None, None, None)
+    except Exception:
+        # best-effort unwind: the scope bookkeeping may already be
+        # torn (a raising scope body, interpreter shutdown); the
+        # caller's stack must still balance
+        pass
+    return len(stack)
 
 
 @contextlib.contextmanager

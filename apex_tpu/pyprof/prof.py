@@ -37,7 +37,11 @@ def summarize_device_ops(outdir: str, top: int = 12):
         outdir, "plugins", "profile", "*", "*.trace.json.gz"))
     if not paths:
         return []
-    with gzip.open(sorted(paths)[-1]) as f:
+    # NEWEST capture by mtime: profiler run dirs are wall-clock named,
+    # but the format has changed across versions and hosts ("2026_01_02"
+    # vs "localhost_2026...") — lexicographic order would then pick an
+    # arbitrary old capture, silently summarizing a stale run
+    with gzip.open(max(paths, key=os.path.getmtime)) as f:
         d = json.load(f)
     ev = d.get("traceEvents", [])
     device_pids = {e.get("pid") for e in ev
@@ -67,8 +71,18 @@ def main(argv=None) -> int:
         description="op-level table from a jax.profiler trace dir")
     ap.add_argument("trace_dir")
     ap.add_argument("--top", type=int, default=12)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the table as JSON rows (for telemetry "
+                         "reports / CI embedding)")
     args = ap.parse_args(argv)
     rows = summarize_device_ops(args.trace_dir, top=args.top)
+    if args.json:
+        # same exit-code contract as the text path: an empty table is
+        # a failed summarize (host-only trace / wrong dir), but the
+        # output stays machine-parseable either way
+        print(json.dumps([{"op": n, "total_ms": ms, "pct": pct}
+                          for n, ms, pct in rows]))
+        return 0 if rows else 1
     if not rows:
         print("no device op events found (host-only trace, or wrong "
               "directory)")

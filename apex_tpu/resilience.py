@@ -49,6 +49,7 @@ import jax
 
 from apex_tpu import checkpoint as _ckpt
 from apex_tpu.checkpoint import TemplateMismatchError
+from apex_tpu.telemetry.spans import span
 
 Pytree = Any
 
@@ -192,11 +193,14 @@ class CheckpointManager:
             # (raising if it failed), so everything on disk below is
             # known-durable; the checkpoint scheduled here is NOT, and
             # _gc therefore keeps `keep` durable files besides it — a
-            # failed in-flight write can never leave zero checkpoints
-            self._async.save_training_state(
-                self._path(step), params, optimizer=optimizer,
-                amp_state=amp_state, step=step, extra=extra)
-            self._gc(in_flight=step)
+            # failed in-flight write can never leave zero checkpoints.
+            # The span times the SCHEDULING cost paid by the step loop
+            # (join + snapshot + handoff), not the async write itself.
+            with span("checkpoint/save"):
+                self._async.save_training_state(
+                    self._path(step), params, optimizer=optimizer,
+                    amp_state=amp_state, step=step, extra=extra)
+                self._gc(in_flight=step)
         return True
 
     def _gc(self, in_flight: Optional[int] = None) -> None:
@@ -237,6 +241,12 @@ class CheckpointManager:
             snap = (dict(optimizer.state_dict()),
                     getattr(optimizer, "params", None))
         dirty = False
+        with span("checkpoint/restore"):
+            return self._restore_walk(params_like, optimizer, extra_like,
+                                      snap, dirty)
+
+    def _restore_walk(self, params_like, optimizer, extra_like, snap,
+                      dirty):
         for step in self._agreed_steps():
             out, code, tmpl_err = None, self._LOAD_OK, None
             try:

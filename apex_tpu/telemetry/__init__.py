@@ -1,0 +1,45 @@
+"""apex_tpu.telemetry — host-sync-free training telemetry.
+
+The flat AMP pipeline computes every signal a production trainer
+watches — global grad norm, overflow flag, clip coefficient, loss
+scale, LAMB trust ratios — entirely on device; this package surfaces
+them WITHOUT re-introducing the per-step ``device_get`` our own linter
+flags as APX101 (and whose runtime twin is APX102).  Core invariant:
+**zero additional host syncs per step**.
+
+- :class:`MetricRing` (ring.py): a small device-resident
+  ``(window+1, 2+n_metrics)`` f32 buffer jitted code writes by static
+  metric column at a cursor-selected row; the host flushes it with ONE
+  ``device_get`` every ``window`` recorded steps.
+- :mod:`_tape` + :meth:`Telemetry.instrument`: producers through the
+  stack (amp flat pipeline, fused optimizers, bucketed DDP reducer)
+  report traced scalars into the step's tape; the instrument wrapper
+  writes them into the ring inside the step's own jit.
+- emitters (emitters.py): JSONL (schema'd, one record per step),
+  rank-0 rate-limited console, wide CSV — all fed at flush time only.
+- :func:`span` (spans.py): wall-time spans for host-side phases
+  (checkpoint save/restore...), layered on ``pyprof.nvtx`` so they
+  also land in XProf traces.
+- :class:`RetraceCounter` (retrace.py): counts recompiles at run time
+  via ``jax.monitoring`` (plus a per-function wrapper fallback) — the
+  runtime companion to the APX30x static rules.
+- ``python -m apex_tpu.telemetry summarize <run_dir>`` (cli.py):
+  render a run's JSONL as step/span/retrace tables, stdlib-only.
+
+See docs/observability.md for the producer -> metric wiring table and
+the design rationale.
+"""
+
+from apex_tpu.telemetry._tape import emit as emit_metric
+from apex_tpu.telemetry.emitters import (CsvEmitter, Emitter,
+                                         JsonlEmitter, StepLogger)
+from apex_tpu.telemetry.retrace import RetraceCounter
+from apex_tpu.telemetry.ring import MetricRing
+from apex_tpu.telemetry.session import DEFAULT_METRICS, Telemetry
+from apex_tpu.telemetry.spans import span
+
+__all__ = [
+    "MetricRing", "Telemetry", "DEFAULT_METRICS",
+    "Emitter", "JsonlEmitter", "CsvEmitter", "StepLogger",
+    "RetraceCounter", "span", "emit_metric",
+]

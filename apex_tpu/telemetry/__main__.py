@@ -1,0 +1,4 @@
+from apex_tpu.telemetry.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

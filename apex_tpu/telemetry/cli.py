@@ -1,0 +1,149 @@
+"""``python -m apex_tpu.telemetry summarize <run_dir>`` — render a
+training run's JSONL telemetry as a step table plus span/retrace
+summaries, with no dependency beyond the standard library (works on a
+login host with no jax installed)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+JSONL_NAME = "telemetry.jsonl"
+
+
+def load_jsonl(path: str) -> Tuple[Optional[dict], List[dict]]:
+    """(schema record or None, all other records).  Unparseable lines
+    are skipped (a run killed mid-write leaves a torn last line)."""
+    schema, records = None, []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "schema" and schema is None:
+                schema = rec
+            else:
+                records.append(rec)
+    return schema, records
+
+
+def _resolve(path: str) -> Optional[str]:
+    if os.path.isdir(path):
+        path = os.path.join(path, JSONL_NAME)
+    return path if os.path.isfile(path) else None
+
+
+def _fmt_cell(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _render_table(header: List[str], rows: List[List[str]], out) -> None:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    print("  ".join(h.rjust(w) for h, w in zip(header, widths)), file=out)
+    for r in rows:
+        print("  ".join(c.rjust(w) for c, w in zip(r, widths)), file=out)
+
+
+def summarize(path: str, tail: int = 32, as_json: bool = False,
+              out=None) -> int:
+    """Render the run's telemetry; returns a process exit code (1 when
+    there is nothing to render — missing file or zero step records)."""
+    out = out or sys.stdout
+    resolved = _resolve(path)
+    if resolved is None:
+        print(f"no {JSONL_NAME} under {path!r} (run with telemetry on: "
+              "apex_tpu.telemetry.Telemetry(run_dir=...))", file=out)
+        return 1
+    schema, records = load_jsonl(resolved)
+    steps = [r for r in records if r.get("kind", "step") == "step"]
+    # span/retrace records are cumulative snapshots: keep the newest
+    # per name
+    spans, retraces = {}, {}
+    for r in records:
+        if r.get("kind") == "span":
+            spans[r["name"]] = r
+        elif r.get("kind") == "retrace":
+            retraces[r["name"]] = r
+    if not steps:
+        print(f"{resolved}: no step records", file=out)
+        return 1
+    # a step flushed twice (flush() + close()) keeps the newest record
+    by_step = {}
+    for r in steps:
+        by_step[r["step"]] = r
+    steps = [by_step[s] for s in sorted(by_step)]
+
+    metrics = (schema or {}).get("metrics")
+    if not metrics:
+        seen = {k for r in steps for k in r}
+        metrics = sorted(seen - {"step", "kind"})
+    overflows = sum(1 for r in steps if (r.get("amp/found_inf") or 0) > 0)
+
+    if as_json:
+        json.dump({"source": resolved, "steps": steps,
+                   "overflow_steps": overflows,
+                   "spans": sorted(spans.values(),
+                                   key=lambda r: r["name"]),
+                   "retraces": sorted(retraces.values(),
+                                      key=lambda r: r["name"])},
+                  out)
+        out.write("\n")
+        return 0
+
+    print(f"telemetry: {resolved}", file=out)
+    print(f"steps recorded: {len(steps)}   overflow steps: {overflows}",
+          file=out)
+    print("", file=out)
+    show = steps[-tail:] if tail and tail > 0 else steps
+    header = ["step"] + [m.rsplit("/", 1)[-1] if m.count("/") else m
+                         for m in metrics]
+    rows = [[str(r["step"])] + [_fmt_cell(r.get(m)) for m in metrics]
+            for r in show]
+    _render_table(header, rows, out)
+    if spans:
+        print("\nspans (cumulative):", file=out)
+        _render_table(
+            ["name", "count", "total_ms", "max_ms"],
+            [[n, str(s.get("count", "-")), _fmt_cell(s.get("total_ms")),
+              _fmt_cell(s.get("max_ms"))]
+             for n, s in sorted(spans.items())], out)
+    if retraces:
+        print("\ncompilation:", file=out)
+        _render_table(
+            ["name", "traces", "retraces", "compile_s"],
+            [[n, str(r.get("traces", "-")),
+              str(r.get("retraces", "-")),
+              _fmt_cell(r.get("compile_s"))]
+             for n, r in sorted(retraces.items())], out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.telemetry",
+        description="training telemetry tooling")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("summarize",
+                       help="render a run's telemetry.jsonl as tables")
+    s.add_argument("run_dir", help="run directory (or the .jsonl itself)")
+    s.add_argument("--tail", type=int, default=32,
+                   help="show only the newest N steps (0 = all)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    args = ap.parse_args(argv)
+    try:
+        return summarize(args.run_dir, tail=args.tail, as_json=args.json)
+    except BrokenPipeError:
+        return 0          # |head etc. closing the pipe is not an error

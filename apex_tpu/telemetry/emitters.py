@@ -1,0 +1,145 @@
+"""Pluggable sinks for flushed telemetry records.
+
+Emitters run on the HOST at window-flush time only — they never see a
+device value that was not already fetched by the session's single
+``device_get`` — so an emitter can be as slow as a filesystem without
+touching step time.  Three are built in:
+
+- :class:`JsonlEmitter`: one JSON object per line.  Line 1 is a schema
+  header (``kind: "schema"``), then one ``kind: "step"`` record per
+  step with the FULL metric key set (stable schema — consumers never
+  diff keys), plus ``kind: "span"`` / ``kind: "retrace"`` summary
+  records appended at each flush.  This is the file
+  ``python -m apex_tpu.telemetry summarize`` renders.
+- :class:`StepLogger`: rank-0 console line, rate-limited by wall time
+  (a 10k-step/s trainer must not print 10k lines/s; the newest record
+  wins each interval).
+- :class:`CsvEmitter`: wide ``scalars.csv`` (step + one column per
+  metric) for spreadsheet/pandas consumption with no TensorBoard
+  dependency.
+
+Custom emitters implement :meth:`Emitter.emit` (a list of record
+dicts, already schema'd) and optionally :meth:`close`.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+import time
+from typing import List, Optional, Sequence
+
+SCHEMA_VERSION = 1
+
+
+class Emitter:
+    def emit(self, records: List[dict]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlEmitter(Emitter):
+    """JSONL writer (one record per line, schema header first).  The
+    file is TRUNCATED at first emit: one session owns one run's file —
+    appending would silently interleave two runs' step records behind
+    one schema header, and ``summarize`` would present the mixture as
+    a single run.  NaN never reaches the file: the ring decodes
+    non-finite cells to None/null upstream."""
+
+    def __init__(self, path: str, metrics: Sequence[str] = ()):
+        self.path = path
+        self._f = None
+        self._metrics = tuple(metrics)
+
+    def _open(self):
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "w", encoding="utf-8")
+            self._write({"kind": "schema", "version": SCHEMA_VERSION,
+                         "metrics": list(self._metrics)})
+        return self._f
+
+    def _write(self, rec: dict):
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+    def emit(self, records: List[dict]) -> None:
+        f = self._open()
+        for r in records:
+            self._write(r)
+        f.flush()   # a crash mid-run keeps everything flushed so far
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class StepLogger(Emitter):
+    """Rate-limited console reporter (the rank-0 gating lives in the
+    session: non-writer processes get no emitters at all)."""
+
+    def __init__(self, interval_s: float = 5.0, stream=None,
+                 metrics: Sequence[str] = ()):
+        self.interval_s = float(interval_s)
+        self.stream = stream if stream is not None else sys.stderr
+        self._last_print = float("-inf")
+        self._metrics = tuple(metrics)
+
+    def _fmt(self, rec: dict) -> str:
+        parts = [f"step {rec['step']}"]
+        for name in self._metrics or sorted(k for k in rec
+                                            if k not in ("step", "kind")):
+            v = rec.get(name)
+            if v is None:
+                continue
+            short = name.rsplit("/", 1)[-1]
+            parts.append(f"{short} {v:.6g}")
+        return "telemetry: " + "  ".join(parts)
+
+    def emit(self, records: List[dict]) -> None:
+        steps = [r for r in records if r.get("kind", "step") == "step"]
+        if not steps:
+            return
+        now = time.monotonic()
+        if now - self._last_print < self.interval_s:
+            return
+        self._last_print = now
+        print(self._fmt(steps[-1]), file=self.stream, flush=True)
+
+
+class CsvEmitter(Emitter):
+    """Wide scalar dump: header ``step,<metric>,...``, one row per
+    step; absent metrics are empty cells."""
+
+    def __init__(self, path: str, metrics: Sequence[str]):
+        self.path = path
+        self.metrics = tuple(metrics)
+        self._f: Optional[object] = None
+        self._w = None
+
+    def _open(self):
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            # truncate: one session, one run's file (JsonlEmitter note)
+            self._f = open(self.path, "w", newline="", encoding="utf-8")
+            self._w = csv.writer(self._f)
+            self._w.writerow(("step",) + self.metrics)
+        return self._f
+
+    def emit(self, records: List[dict]) -> None:
+        f = self._open()
+        for r in records:
+            if r.get("kind", "step") != "step":
+                continue
+            self._w.writerow([r["step"]] + [
+                "" if r.get(m) is None else r[m] for m in self.metrics])
+        f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

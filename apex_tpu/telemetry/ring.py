@@ -1,0 +1,136 @@
+"""Device-side metric ring: the zero-host-sync training metric store.
+
+A :class:`MetricRing` describes a small ``(window + 1, 2 + n_metrics)``
+f32 buffer that LIVES ON DEVICE.  Jitted code writes one row per step
+— columns 0/1 hold the absolute step number split lo/hi (each half
+stays far below 2^24, so the f32 cells are exact past 10^13 steps;
+a single f32 step cell would silently merge neighboring steps beyond
+16.7M), each metric has a static column assigned at construction — via
+``lax.dynamic_update_slice``, so recording is a handful of fused
+scalar stores inside the step's own program: no callback, no transfer,
+nothing for the host to wait on.  The host reads the ring with ONE
+``jax.device_get`` every ``window`` recorded steps (:meth:`decode`
+turns the fetched array back into per-step records), which is the only
+device->host traffic telemetry ever adds.
+
+The row index is a WRITE CURSOR carried in the buffer's extra last
+row (cell ``[window, 0]``, kept wrapped in ``[0, window)`` so f32
+stays exact forever), NOT ``step % window``: a trainer that records
+only every k-th step must fill the window's rows densely rather than
+collide on ``step``-derived slots.  A repeat ``record`` for the same
+step as the previous write re-uses that row (multiple producers per
+step compose); record steps monotonically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+STEP_COLUMN = "step"
+# step = hi * _STEP_BASE + lo; both halves exact in f32 while
+# step < 2^20 * 2^24
+_STEP_BASE = 1 << 20
+
+
+class MetricRing:
+    """Static schema + pure record/decode over a device ring buffer."""
+
+    def __init__(self, metrics: Sequence[str], window: int = 64):
+        if window < 2:
+            # the current step's row is always still-accumulating (the
+            # session's auto-flush excludes it so a late producer is
+            # never cut off), so a 1-row ring could never emit anything
+            raise ValueError(f"window must be >= 2, got {window}")
+        names = list(dict.fromkeys(metrics))   # de-dup, keep order
+        if STEP_COLUMN in names:
+            raise ValueError(f"{STEP_COLUMN!r} is the reserved step "
+                             "column; pick another metric name")
+        if not names:
+            raise ValueError("need at least one metric name")
+        self.window = int(window)
+        self.metrics = tuple(names)
+        self.slots: Dict[str, int] = {n: i + 2
+                                      for i, n in enumerate(names)}
+        self.n_columns = 2 + len(names)
+
+    # ---- device side -----------------------------------------------------
+    def init(self) -> jax.Array:
+        buf = jnp.full((self.window + 1, self.n_columns), jnp.nan,
+                       jnp.float32)
+        # last row: the write cursor (cell [window, 0]), starting at 0
+        return buf.at[self.window, 0].set(0.0)
+
+    def record(self, buf: jax.Array, values: Mapping[str, jax.Array],
+               step) -> jax.Array:
+        """Write one step's metrics; trace-safe, returns the new buffer.
+
+        ``values`` maps metric name -> scalar (traced or concrete);
+        names outside the schema are ignored (a producer can emit more
+        than a given ring chooses to keep).  A ``record`` for the same
+        step as the PREVIOUS write composes into that row (each call
+        writes only its own columns); a new step advances the cursor.
+        """
+        step = jnp.asarray(step, jnp.int32)
+        lo = jnp.remainder(step, _STEP_BASE).astype(jnp.float32)
+        hi = (step // _STEP_BASE).astype(jnp.float32)
+        cursor = buf[self.window, 0].astype(jnp.int32)
+        prev = jnp.remainder(cursor - 1, self.window)
+        # NaN step cells in the previous row (fresh ring) compare unequal
+        same = (buf[prev, 0] == lo) & (buf[prev, 1] == hi)
+        row = jnp.where(same, prev, cursor)
+        new_cursor = jnp.where(
+            same, cursor, jnp.remainder(cursor + 1, self.window))
+        # a NEW step claiming a (possibly wrapped) row must clear the
+        # evicted occupant's metric cells — otherwise metrics not
+        # written this step would decode as the OLD step's values
+        cur_row = jax.lax.dynamic_slice(buf, (row, 0),
+                                        (1, self.n_columns))
+        base = jnp.where(same, cur_row, jnp.full_like(cur_row, jnp.nan))
+        # assemble the whole row first (static column indices), then
+        # ONE dynamic_update_slice writes it — not one per metric
+        base = base.at[0, 0].set(lo).at[0, 1].set(hi)
+        for name in sorted(values):
+            slot = self.slots.get(name)
+            if slot is None:
+                continue
+            v = jnp.asarray(values[name], jnp.float32).reshape(())
+            base = base.at[0, slot].set(v)
+        buf = jax.lax.dynamic_update_slice(buf, base, (row, 0))
+        return buf.at[self.window, 0].set(new_cursor.astype(jnp.float32))
+
+    # ---- host side -------------------------------------------------------
+    def decode(self, host_buf, after_step: int = -1,
+               upto_step: Optional[int] = None) -> List[dict]:
+        """Fetched buffer -> per-step records, ascending by step.
+
+        Returns one dict per written row with ``after_step < step``
+        (and ``step <= upto_step`` when given): ``{"step": int,
+        <metric>: float|None, ...}`` with the FULL schema key set every
+        record (JSONL consumers never see a moving schema); NaN cells
+        decode to None.
+        """
+        arr = np.asarray(host_buf)
+        if arr.shape != (self.window + 1, self.n_columns):
+            raise ValueError(
+                f"buffer shape {arr.shape} does not match ring "
+                f"({self.window + 1}, {self.n_columns})")
+        out = []
+        for row in arr[:self.window]:     # last row is the cursor
+            if not (np.isfinite(row[0]) and np.isfinite(row[1])):
+                continue
+            step = int(row[0]) + int(row[1]) * _STEP_BASE
+            if step <= after_step:
+                continue
+            if upto_step is not None and step > upto_step:
+                continue
+            rec = {"step": step}
+            for name, slot in self.slots.items():
+                v = row[slot]
+                rec[name] = float(v) if np.isfinite(v) else None
+            out.append(rec)
+        out.sort(key=lambda r: r["step"])
+        return out

@@ -1,0 +1,226 @@
+"""The stateful telemetry session: ring + emitters + spans + retraces.
+
+One :class:`Telemetry` object per training run.  It owns the device
+ring buffer, flushes it to the pluggable emitters every ``window``
+steps with ONE ``device_get``, aggregates host-side span timings, and
+(optionally) installs the :class:`~.retrace.RetraceCounter`.
+
+Two wiring styles, both zero-sync in the hot path:
+
+Jitted step (the production shape) — ``instrument`` wraps the step
+function with the metric tape, so every producer already reporting
+through :mod:`apex_tpu.telemetry._tape` (the flat AMP pipeline, the
+fused optimizers, the bucketed reducer) lands in the ring with no code
+in the user's step::
+
+    tel = telemetry.Telemetry("runs/exp7", window=64)
+    step = jax.jit(tel.instrument(train_step), donate_argnums=(0,))
+    for i in range(steps):
+        tel_buf, out = step(tel.buf, i, ...)
+        tel.update(tel_buf, i)            # host pointer swap + maybe-flush
+
+Eager loop (toys, notebooks) — record the on-device scalars you
+already hold; ``record`` dispatches a tiny donated update program and
+returns immediately (the values are NOT fetched)::
+
+    tel.record({"loss": loss, "amp/grad_norm": flat.grad_norm}, i)
+
+Rank gating: with ``rank0_only=True`` (default) non-zero processes
+build no emitters and skip the flush ``device_get`` entirely — every
+rank records into its local ring (cheap), only rank 0 ever writes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+
+from apex_tpu.telemetry import _tape
+from apex_tpu.telemetry.emitters import (CsvEmitter, Emitter, JsonlEmitter,
+                                         StepLogger)
+from apex_tpu.telemetry.retrace import RetraceCounter
+from apex_tpu.telemetry.ring import MetricRing
+from apex_tpu.telemetry.spans import SpanStats, add_sink, remove_sink
+
+JSONL_NAME = "telemetry.jsonl"
+CSV_NAME = "scalars.csv"
+
+# the standard producer wiring (docs/observability.md has the table);
+# a custom metrics= list may keep any subset plus its own names
+DEFAULT_METRICS = (
+    "loss",
+    "amp/grad_norm",
+    "amp/clip_coef",
+    "amp/found_inf",
+    "amp/loss_scale",
+    "amp/growth_tracker",
+    "optim/update_norm",
+    "optim/max_trust_ratio",
+    "optim/skipped",
+    "ddp/bytes_allreduced",
+    "ddp/buckets",
+)
+
+
+class Telemetry:
+    """Stateful facade over :class:`MetricRing` + emitters (module
+    docstring has the two wiring styles)."""
+
+    def __init__(self, run_dir: Optional[str] = None,
+                 metrics: Sequence[str] = DEFAULT_METRICS,
+                 window: int = 64,
+                 emitters: Optional[List[Emitter]] = None,
+                 console: bool = False,
+                 console_interval_s: float = 5.0,
+                 rank0_only: bool = True,
+                 retrace: bool = True):
+        self.ring = MetricRing(metrics, window=window)
+        self.run_dir = run_dir
+        self._buf = self.ring.init()
+        # donated: the ring updates in place, never two live copies
+        self._commit = jax.jit(self.ring.record, donate_argnums=(0,))
+        self._flushed_upto = -1
+        self._last_step = -1
+        self._recorded_since_flush = 0
+        self._warned_unknown: set = set()
+        self._writer = (not rank0_only) or jax.process_index() == 0
+        self._emitters: List[Emitter] = []
+        if self._writer:
+            if emitters is not None:
+                self._emitters = list(emitters)
+            elif run_dir is not None:
+                os.makedirs(run_dir, exist_ok=True)
+                self._emitters = [
+                    JsonlEmitter(os.path.join(run_dir, JSONL_NAME),
+                                 metrics=self.ring.metrics),
+                    CsvEmitter(os.path.join(run_dir, CSV_NAME),
+                               metrics=self.ring.metrics),
+                ]
+            if console:
+                self._emitters.append(StepLogger(
+                    interval_s=console_interval_s,
+                    metrics=self.ring.metrics))
+        self.spans = SpanStats()
+        add_sink(self.spans.add)
+        self.retrace: Optional[RetraceCounter] = None
+        if retrace:
+            self.retrace = RetraceCounter()
+            self.retrace.install()
+        self._closed = False
+
+    # ---- hot path --------------------------------------------------------
+    @property
+    def buf(self) -> jax.Array:
+        """The current device ring buffer (thread through your step)."""
+        return self._buf
+
+    def instrument(self, step_fn):
+        """Wrap a step function with the metric tape.
+
+        Returns ``wrapped(telemetry_buf, step, *args, **kwargs) ->
+        (new_telemetry_buf, step_fn(*args, **kwargs))`` — pure, so jit
+        it (donating argument 0 keeps the ring in place).  Producers
+        inside ``step_fn`` that emit through the tape are recorded at
+        ``step``; hand the new buffer to :meth:`update`.
+
+        Trace-level rule: instrument at the SAME transform level as
+        the producers.  A step whose body is a ``shard_map`` should
+        instrument the function *inside* the shard_map (and keep the
+        ring replicated), not the outer wrapper — values emitted under
+        an inner transform belong to that trace and cannot be written
+        into an outer ring.  (Static emissions like the DDP payload
+        sizes are plain floats and land from anywhere.)
+        """
+        ring = self.ring
+
+        def instrumented_step(telemetry_buf, step, *args, **kwargs):
+            tape = _tape.push()
+            try:
+                out = step_fn(*args, **kwargs)
+            finally:
+                _tape.pop()
+            return ring.record(telemetry_buf, tape.values, step), out
+
+        return instrumented_step
+
+    def record(self, values: dict, step: int) -> None:
+        """Eager-loop recording: one tiny donated device program, no
+        transfer.  ``step`` must be a host int (it also drives the
+        flush cadence).  Unlike tape producers (which legitimately
+        emit more than a given ring keeps), a name typo'd here would
+        lose a column for the whole run — so unknown names warn once."""
+        unknown = set(values) - set(self.ring.slots) \
+            - self._warned_unknown
+        if unknown:
+            import warnings
+            self._warned_unknown |= unknown
+            warnings.warn(
+                f"telemetry: metric name(s) {sorted(unknown)} are not "
+                f"in this ring's schema {list(self.ring.metrics)} and "
+                "will not be recorded", stacklevel=2)
+        self._buf = self._commit(self._buf, dict(values), step)
+        self._note_step(step)
+
+    def update(self, new_buf: jax.Array, step: int) -> None:
+        """Adopt the ring buffer an instrumented step returned, then
+        flush if ``step`` closes a window.  ``step`` is a host int."""
+        self._buf = new_buf
+        self._note_step(step)
+
+    def _note_step(self, step: int) -> None:
+        """Flush cadence counts DISTINCT recorded steps, not step
+        arithmetic: a trainer recording every k-th step (metrics
+        cadence != step cadence) must still flush before the ring
+        wraps and overwrites unread rows.  The auto-flush excludes the
+        CURRENT step — another producer may still record into it this
+        iteration, and a row flushed early would drop those values."""
+        if step > self._last_step:
+            self._last_step = step
+            self._recorded_since_flush += 1
+        if self._recorded_since_flush >= self.ring.window:
+            self.flush(upto_step=step - 1)
+            self._recorded_since_flush = 1    # current step still pending
+
+    # ---- flush boundary --------------------------------------------------
+    def flush(self, upto_step: Optional[int] = None) -> List[dict]:
+        """THE host sync: one ``device_get`` of the ring, decoded to
+        records and handed to every emitter.  Returns the new step
+        records (non-writer ranks skip the transfer and return []).
+        ``upto_step`` bounds what is emitted (the auto-flush passes the
+        previous step so a still-accumulating step is never cut off);
+        manual/close flushes emit everything."""
+        self._recorded_since_flush = 0
+        if not self._writer:
+            return []
+        # THE intended sync: once per window, outside the step hot path
+        host = jax.device_get(self._buf)   # apexlint: disable=APX101
+        records = self.ring.decode(host, after_step=self._flushed_upto,
+                                   upto_step=upto_step)
+        if records:
+            self._flushed_upto = records[-1]["step"]
+        extras = self.spans.records(step=self._last_step)
+        if self.retrace is not None:
+            extras += self.retrace.records(step=self._last_step)
+        for e in self._emitters:
+            e.emit(records + extras)
+        return records
+
+    def close(self) -> None:
+        """Final flush + release emitters and hooks (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.flush()
+        for e in self._emitters:
+            e.close()
+        remove_sink(self.spans.add)
+        if self.retrace is not None:
+            self.retrace.uninstall()
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,82 @@
+"""Wall-time spans for host-side phases (checkpoint save, data stalls,
+eval) — the timing layer for everything that is NOT device step math.
+
+``span(name)`` wraps a host-side region: it pushes a
+``pyprof.nvtx`` range (so the span also lands in XProf traces next to
+the device ops, the way the reference's nvtx annotations landed in
+nsight) and times the body with ``perf_counter``.  The duration goes
+to every registered sink — the active :class:`~.session.Telemetry`
+session registers one, aggregating into per-name
+count/total/max stats that ride the next window flush as
+``kind: "span"`` records.
+
+Spans are HOST timing by design: they may (and often do) contain
+device syncs of their own (a checkpoint save device_gets the params),
+which is exactly why they live outside the step hot path.  Never open
+a span inside jitted code — the body would be measured at trace time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List
+
+from apex_tpu.pyprof import nvtx
+
+_SINKS: List[Callable[[str, float], None]] = []
+_lock = threading.Lock()
+
+
+def add_sink(fn: Callable[[str, float], None]) -> None:
+    with _lock:
+        _SINKS.append(fn)
+
+
+def remove_sink(fn: Callable[[str, float], None]) -> None:
+    with _lock:
+        if fn in _SINKS:
+            _SINKS.remove(fn)
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Time a host-side region under ``name`` (nestable; exception-safe:
+    the duration is recorded and the nvtx range popped even when the
+    body raises)."""
+    nvtx.range_push(f"apex_tpu.telemetry/{name}")
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        nvtx.range_pop()
+        with _lock:
+            sinks = list(_SINKS)
+        for fn in sinks:
+            fn(name, dt)
+
+
+class SpanStats:
+    """Per-name aggregate a session keeps between flushes."""
+
+    def __init__(self):
+        self._stats: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            st = self._stats.setdefault(name, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += seconds
+            st[2] = max(st[2], seconds)
+
+    def records(self, step=None) -> List[dict]:
+        """Cumulative ``kind: "span"`` records (one per name)."""
+        with self._lock:
+            return [{"kind": "span", "name": name, "count": st[0],
+                     "total_ms": round(st[1] * 1e3, 3),
+                     "max_ms": round(st[2] * 1e3, 3),
+                     **({"step": step} if step is not None else {})}
+                    for name, st in sorted(self._stats.items())]

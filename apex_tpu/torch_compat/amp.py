@@ -487,7 +487,9 @@ def load_state_dict(sd):
     for i, s in enumerate(_amp_state.loss_scalers):
         entry = sd.get(f"loss_scaler{i}")
         if entry:
-            s._scale = float(entry["loss_scale"])
+            # checkpoint dict values are already host floats — no
+            # device value is pulled here, per-scaler loop or not
+            s._scale = float(entry["loss_scale"])   # apexlint: disable=APX102
             s._unskipped = int(entry["unskipped"])
 
 

@@ -148,6 +148,10 @@ def _resnet50_one_batch(jax, jnp, on_tpu, batch, size, steps):
             # number — bench_amp_pipeline measures that separately
             # (amp_step_{flat,per_leaf}_ms extras).
             "amp_pipeline": "flat" if opt.fuse_buckets else "per_leaf",
+            # tracked legs run with the metric ring OFF so the tracked
+            # number stays comparable across rounds; the ring's cost is
+            # quantified separately (telemetry_on/off extras)
+            "telemetry": "off",
             "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
                         on_tpu)}
 
@@ -205,6 +209,7 @@ def _amp_lamb_train_bench(jax, jnp, model_loss, params0, batch, *,
     # gradient-handling provenance only (see _resnet50_one_batch): the
     # fused unscale/clip epilogue is benched by bench_amp_pipeline
     r["amp_pipeline"] = "flat" if opt.fuse_buckets else "per_leaf"
+    r["telemetry"] = "off"     # ring-on cost: telemetry_on/off extras
     return r
 
 
@@ -239,6 +244,7 @@ def _bert_lamb_one_batch(jax, jnp, on_tpu, batch, seq, steps, config):
             "batch": batch, "seq": seq,
             "steps_per_dispatch": r["steps_per_dispatch"],
             "amp_pipeline": r.get("amp_pipeline"),
+            "telemetry": r.get("telemetry", "off"),
             "mfu": _mfu(r["flops_per_step"], r["step_ms"] / 1e3,
                         on_tpu)}
 
@@ -476,6 +482,7 @@ def run_child(backend):
         out["extra"]["resnet50_batch_sweep"] = r.get("batch_sweep")
         out["extra"]["resnet50_stem"] = r.get("stem")
         out["extra"]["resnet50_amp_pipeline"] = r.get("amp_pipeline")
+        out["extra"]["resnet50_telemetry"] = r.get("telemetry")
         if r.get("mfu") is not None:
             out["extra"]["resnet50_mfu"] = r["mfu"]
     except Exception:
@@ -493,6 +500,7 @@ def run_child(backend):
             b["step_ms"], 2)
         out["extra"]["bert_config"] = b["config"]
         out["extra"]["bert_amp_pipeline"] = b.get("amp_pipeline")
+        out["extra"]["bert_telemetry"] = b.get("telemetry")
         if b.get("mfu") is not None:
             out["extra"]["bert_mfu"] = b["mfu"]
     except Exception:
@@ -535,6 +543,16 @@ def run_child(backend):
             out["extra"].update(bench_amp_pipeline())
         except Exception as e:
             out["extra"]["amp_pipeline_error"] = repr(e)[:200]
+
+        print(_dump(out), flush=True)
+        try:
+            # metric ring on vs off over the identical flat-AMP step —
+            # quantifies BENCH_r06's telemetry cost claim (target
+            # telemetry_overhead_pct <= ~2)
+            from apex_tpu.telemetry.bench import bench_telemetry_overhead
+            out["extra"].update(bench_telemetry_overhead())
+        except Exception as e:
+            out["extra"]["telemetry_overhead_error"] = repr(e)[:200]
 
         print(_dump(out), flush=True)
         try:

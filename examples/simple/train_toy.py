@@ -1,15 +1,23 @@
 """Minimal end-to-end training with apex_tpu (reference: examples/simple).
 
 A user-style script: tiny MLP regression, amp O2 (bf16 params + f32
-masters + loss scaling), FusedAdam, FusedLayerNorm — the whole train step
-jitted, scaler-driven skip logic on device.
+masters + loss scaling), FusedAdam stepping the flat AMP gradient
+pipeline (pack-once grads, fused unscale+norm, branch-free overflow
+skip), FusedLayerNorm — and optional run telemetry: pass
+``--telemetry-dir DIR`` (or set APEX_TPU_TELEMETRY_DIR) to record
+loss / grad norm / loss scale / overflow into a device-side metric
+ring, flushed to ``DIR/telemetry.jsonl`` once per window and rendered
+afterwards by ``python -m apex_tpu.telemetry summarize DIR``.
 """
+
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
 
 import apex_tpu
-from apex_tpu import amp
+from apex_tpu import amp, telemetry
 from apex_tpu.normalization import fused_layer_norm
 from apex_tpu.optimizers import FusedAdam
 
@@ -33,7 +41,15 @@ def forward(params, x):
     return h @ params["w2"] + params["b2"]
 
 
-def main():
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    tel_dir = os.environ.get("APEX_TPU_TELEMETRY_DIR")
+    if "--telemetry-dir" in argv:
+        at = argv.index("--telemetry-dir")
+        if at + 1 >= len(argv):
+            raise SystemExit("usage: train_toy.py [--telemetry-dir DIR]")
+        tel_dir = argv[at + 1]
+
     from apex_tpu.platform import select_platform
     select_platform()          # honor APEX_TPU_PLATFORM (e.g. cpu)
     print(f"apex_tpu {apex_tpu.__version__} on {jax.default_backend()}")
@@ -44,6 +60,12 @@ def main():
     params, amp_state = amp.initialize(params, opt_level="O2",
                                        loss_scale="dynamic")
     opt = FusedAdam(params, lr=1e-2, weight_decay=1e-4)
+    # flat gradient pipeline over the optimizer's bucket plan: grads
+    # pack once, unscale+norm fuse per bucket, found_inf drives the
+    # branch-free skip inside opt.step
+    pipe = amp.FlatGradPipeline(optimizer=opt)
+
+    tel = telemetry.Telemetry(tel_dir, window=16) if tel_dir else None
 
     xk, yk = jax.random.split(jax.random.key(1))
     x = jax.random.normal(xk, (256, 64))
@@ -56,16 +78,29 @@ def main():
 
     losses = []
     for step in range(60):
-        loss, grads, found_inf = amp.scaled_value_and_grad(
+        loss, flat = pipe.scaled_value_and_grad(
             loss_fn, amp_state.scaler, opt.params, x, y)
-        if int(found_inf) == 0:
-            opt.step(grads)
-        amp_state = amp.update_scaler(amp_state, found_inf)
+        opt.step(flat)                    # skips itself on overflow
+        amp_state = amp.update_scaler(amp_state, flat.found_inf)
+        if tel is not None:
+            # on-device scalars straight into the ring: the host fetch
+            # happens once per window at the flush, not here
+            tel.record({"loss": loss, "amp/grad_norm": flat.grad_norm,
+                        "amp/clip_coef": flat.clip_coef,
+                        **amp_state.telemetry_values()}, step)
         losses.append(float(loss))
         if step % 10 == 0:
             print(f"step {step:3d} loss {losses[-1]:.4f} "
                   f"scale {float(amp_state.scaler.loss_scale):.0f} "
-                  f"inf {int(found_inf)}")
+                  f"inf {int(flat.found_inf)}")
+
+    if tel is not None:
+        with telemetry.span("toy/final_eval"):
+            final = float(loss_fn(opt.params, x, y))
+        print(f"final eval loss {final:.4f}")
+        tel.close()
+        print(f"telemetry written to {tel_dir} — inspect with: "
+              f"python -m apex_tpu.telemetry summarize {tel_dir}")
 
     assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
     print(f"OK: loss {losses[0]:.3f} -> {losses[-1]:.3f}")

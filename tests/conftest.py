@@ -83,6 +83,8 @@ SLOW_TESTS = {
     # three CLI subprocesses, each paying the jax import; the tier-1
     # lint gate is test_package_self_check, which stays fast-tier
     "test_lint.py::test_cli_exit_codes_and_json",
+    # runs the full toy example (60 amp steps) in-process
+    "test_telemetry.py::test_train_toy_telemetry_end_to_end",
 }
 
 

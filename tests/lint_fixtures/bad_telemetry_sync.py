@@ -1,0 +1,25 @@
+# apexlint fixture: every per-iteration telemetry pull below must trip
+# APX102 (and only APX102 — nothing here is jit-reachable, so APX101
+# stays quiet and the families stay isolated).
+# These files are linted as TEXT, never imported.
+import jax
+
+
+def run_training(step, state, scaler, n):
+    history = []
+    for i in range(n):
+        state, metrics = step(state)
+        history.append(float(metrics["grad_norm"]))      # APX102: float()
+        scale = jax.device_get(scaler.loss_scale)        # APX102: device_get
+        if metrics["found_inf"].item():                  # APX102: .item()
+            print("overflow at", i, scale)
+        metrics["update_norm"].block_until_ready()       # APX102: stall
+    return history
+
+
+def watch(stream):
+    while True:
+        rec = next(stream)
+        trust = float(rec.max_trust_ratio)               # APX102: float()
+        if trust > 10:
+            break

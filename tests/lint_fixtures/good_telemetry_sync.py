@@ -1,0 +1,25 @@
+# apexlint fixture: the negative twin of bad_telemetry_sync — metrics
+# stay on device through the loop (MetricRing slot writes), the host
+# reads ONCE per window at the flush boundary; non-metric host math in
+# a loop is none of APX102's business.
+from apex_tpu import telemetry
+
+
+def run_training(step, state, tel, n):
+    for i in range(n):
+        state, metrics = step(state)
+        tel.record(metrics, i)           # device-side ring write
+    records = tel.flush()                # ONE device_get per window
+    return records
+
+
+def aggregate(rows):
+    total = 0.0
+    for row in rows:
+        total += float(row.count)        # not a metric value: quiet
+    return total
+
+
+def report(last_record):
+    # syncing OUTSIDE the loop is exactly where syncing belongs
+    return float(last_record["amp/grad_norm"] or 0.0)

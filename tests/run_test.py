@@ -61,6 +61,9 @@ SUITES = {
     "run_harness": ["tests/test_platform.py", "tests/test_benchlib.py",
                     "tests/test_kernel_bench_logic.py"],
     "run_lint": ["tests/test_lint.py"],
+    # run-time training telemetry (metric ring, emitters, spans,
+    # retrace counter) + the pyprof nvtx/prof satellites
+    "run_telemetry": ["tests/test_telemetry.py"],
     # AOT Mosaic lowering for the TPU platform — runs in CPU CI
     "run_tpu_lowering": ["tests/test_tpu_lowering.py"],
     # TPU-only: needs APEX_TPU_SMOKE=1 and a real chip (else skips)

@@ -24,6 +24,7 @@ REPO = os.path.dirname(HERE)
 # hide behind another's findings
 BAD_FIXTURES = {
     "bad_host_sync.py": {"APX101"},
+    "bad_telemetry_sync.py": {"APX102"},
     "bad_dtype.py": {"APX201", "APX202", "APX203"},
     "bad_retrace.py": {"APX301", "APX302", "APX303"},
     "bad_donation.py": {"APX401"},
@@ -31,8 +32,9 @@ BAD_FIXTURES = {
     "bad_import_env.py": {"APX601"},
 }
 GOOD_FIXTURES = [
-    "good_host_sync.py", "good_dtype.py", "good_retrace.py",
-    "good_donation.py", "good_pallas.py", "good_import_env.py",
+    "good_host_sync.py", "good_telemetry_sync.py", "good_dtype.py",
+    "good_retrace.py", "good_donation.py", "good_pallas.py",
+    "good_import_env.py",
 ]
 
 
@@ -56,12 +58,13 @@ def test_good_fixture_is_clean(name):
 
 
 def test_every_rule_family_has_fixture_coverage():
-    """The acceptance contract: >=6 families, each with a positive
-    (bad fixture) and a negative (good twin)."""
+    """The acceptance contract: every rule family (6 static + the
+    APX102 runtime-telemetry twin) has a positive (bad fixture) and a
+    negative (good twin)."""
     covered = set().union(*BAD_FIXTURES.values())
     families = {rid[:4] for rid, _, _ in rule_catalog()}
     assert {rid[:4] for rid in covered} == families
-    assert len(BAD_FIXTURES) >= 6 == len(GOOD_FIXTURES)
+    assert len(BAD_FIXTURES) >= 7 == len(GOOD_FIXTURES)
     ids = [r.id for r in all_rules()]
     assert len(ids) == len(set(ids))
 

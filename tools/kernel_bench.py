@@ -422,6 +422,23 @@ def main():
         "oracle_ms": ra["amp_step_per_leaf_ms"],
         "speedup": ra.get("amp_pipeline_speedup")})
 
+    # telemetry overhead: the IDENTICAL flat-AMP train step, metric
+    # ring on vs off ("kernel" = instrumented, "oracle" = plain — a
+    # speedup of ~1.0 IS the pass condition: the ring must be free)
+    from apex_tpu.telemetry.bench import bench_telemetry_overhead
+    rt = bench_telemetry_overhead()
+    rt["backend"] = backend
+    print(json.dumps(rt), flush=True)
+    rows.append({
+        "kernel": "telemetry_overhead",
+        "shape": (f"{rt['telemetry_leaves']}leaves/"
+                  f"w{rt['telemetry_window']}x{rt['telemetry_metrics']}"),
+        "dtype": "f32",
+        "kernel_ms": rt["telemetry_on_ms"],
+        "oracle_ms": rt["telemetry_off_ms"],
+        "speedup": (round(rt["telemetry_off_ms"] / rt["telemetry_on_ms"],
+                          2) if rt["telemetry_on_ms"] else None)})
+
     for r in rows:
         r["backend"] = backend
         print(json.dumps(r), flush=True)
