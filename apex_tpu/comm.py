@@ -254,9 +254,18 @@ def axis_is_bound(name: str) -> bool:
     jax raises exactly NameError for an unbound axis name ("Found an
     unbound axis name: ..."); nothing broader is swallowed, so real
     errors inside traced code propagate.  The ONE probe every module
-    uses (VERDICT r1 weak #7)."""
+    uses (VERDICT r1 weak #7).
+
+    The probe is ``psum`` of the LITERAL 1 — jax folds that statically
+    in the axis env (same portable spelling as ``bound_axis_size``),
+    so probing leaves NO equation in the traced program.  The previous
+    ``axis_index`` probe left a dead collective in every program that
+    asked — the exact orphan-collective shape that tripped the CPU
+    SPMD partitioner on ring attention's non-causal path (apexverify's
+    ``no_orphan_collectives`` invariant now pins this)."""
     try:
-        jax.lax.axis_index(name)
+        # statically folded probe: only "does this raise" matters
+        jax.lax.psum(1, name)   # apexlint: disable=APX703
         return True
     except NameError:
         return False

@@ -58,6 +58,11 @@ class FileContext:
         self.src = src
         self.tree = tree
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        # set by the engine when linting a multi-file run: the
+        # ProjectContext (lint/callgraph.py) that widens jit_reachable
+        # across module boundaries.  Single-source linting (fixtures,
+        # lint_source) leaves it None and keeps the per-file behavior.
+        self.project = None
 
     # ---- name resolution -------------------------------------------------
 
@@ -194,6 +199,68 @@ class FileContext:
                             static.add(params[v.value])
         return static
 
+    @functools.cached_property
+    def donating_jit_bindings(self) -> Dict[str, Dict[str, object]]:
+        """Bindings of jitted-with-donation callables in this file.
+
+        Maps the callable's local spelling — ``step`` for
+        ``step = jax.jit(f, donate_argnums=(0,))``, ``self._step`` for
+        the attribute form, or the function's own name when the jit is
+        a decorator — to ``{"positions": (ints,), "names": (strs,),
+        "site": node}``.  APX402 uses this to know which argument slots
+        of a later call donate (and therefore kill) their buffers.
+        """
+        def _donation(call: ast.Call):
+            positions: List[int] = []
+            names: List[str] = []
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    for v in ast.walk(kw.value):
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, int):
+                            positions.append(v.value)
+                elif kw.arg == "donate_argnames":
+                    for v in ast.walk(kw.value):
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, str):
+                            names.append(v.value)
+            if positions or names:
+                return {"positions": tuple(positions),
+                        "names": tuple(names), "site": call}
+            return None
+
+        out: Dict[str, Dict[str, object]] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = self._jit_callable(node.value)
+            if call is None:
+                continue
+            # a bare `functools.partial(jax.jit, donate_argnums=...)`
+            # bound to a name is a FACTORY: its later calls take
+            # functions to wrap, not donated buffers (the partial form
+            # only donates as a decorator)
+            if self.qualname(call.func) == "functools.partial":
+                continue
+            info = _donation(call)
+            if info is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = info
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name):
+                    out[f"{t.value.id}.{t.attr}"] = info
+        for fn in ast.walk(self.tree):
+            if isinstance(fn, FunctionNode):
+                for dec in fn.decorator_list:
+                    call = self._jit_callable(dec)
+                    if call is not None:
+                        info = _donation(call)
+                        if info is not None:
+                            out[fn.name] = info
+        return out
+
     # ---- Pallas kernel detection ----------------------------------------
 
     @functools.cached_property
@@ -242,7 +309,7 @@ class FileContext:
         return graph
 
     @functools.cached_property
-    def jit_reachable(self) -> Set[str]:
+    def local_jit_reachable(self) -> Set[str]:
         """Functions reachable (intra-file) from a jit root: a jitted
         function, a Pallas kernel body, or a train-step-named def."""
         roots = set(self.jitted_functions) | set(self.kernel_functions)
@@ -257,6 +324,18 @@ class FileContext:
             seen.add(cur)
             stack.extend(self.call_graph.get(cur, ()))
         return seen
+
+    @property
+    def jit_reachable(self) -> Set[str]:
+        """What hot-path rules consume.  Per-file by default; when a
+        ProjectContext is attached (multi-file runs) this widens to
+        functions jit-reachable from ANY linted module — a helper with
+        no local jit root is still hot when a jitted step elsewhere
+        calls it through the import graph."""
+        if self.project is not None:
+            return self.local_jit_reachable \
+                | self.project.jit_reachable_in(self)
+        return self.local_jit_reachable
 
     def functions_in(self, names: Set[str]) -> Iterator[ast.AST]:
         for name in sorted(names):

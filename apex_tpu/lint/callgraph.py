@@ -1,0 +1,171 @@
+"""Project-wide symbol table + call graph for the interprocedural tier.
+
+PR 1's apexlint saw one file at a time, so a host sync hidden behind a
+helper in another module — ``train_step`` (jitted, module A) calls
+``log_metrics`` (module B) which calls ``float(loss)`` — slipped
+through: module B alone has no jit root, module A alone has no sync.
+:class:`ProjectContext` closes that gap.  It is built once per
+``lint_paths`` run over every collected :class:`FileContext` and gives
+rules three things:
+
+* a **symbol table**: dotted module name -> FileContext, plus
+  ``resolve(qualname)`` from a canonical dotted call target (what
+  ``FileContext.qualname`` returns, alias-resolved) to the defining
+  (FileContext, function def) pair anywhere in the run;
+* a **cross-module call graph** over ``(module, function)`` nodes,
+  merging each file's intra-file edges with edges discovered by
+  resolving dotted call targets through the import alias maps;
+* **project jit reachability**: the transitive closure from every jit
+  root in the run (jitted functions, Pallas kernel bodies,
+  train-step-named defs), exposed per file so
+  ``FileContext.jit_reachable`` transparently widens when a project is
+  attached — existing rules (APX101/102) become interprocedural with
+  zero changes to their own code.
+
+Module naming is filesystem-derived: walk up from each file while
+``__init__.py`` exists, so ``apex_tpu/amp/scaler.py`` becomes
+``apex_tpu.amp.scaler`` regardless of the CLI spelling used to reach
+it.  Files outside any package keep their stem as the module name.
+Everything stays a static over/under-approximation: calls resolved by
+dotted name only, last definition wins, no imports of linted code.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from apex_tpu.lint import _ast_util
+
+Node = Tuple[str, str]  # (module name, function name)
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a file, walking up through packages."""
+    path = os.path.abspath(path)
+    parts: List[str] = []
+    base = os.path.basename(path)
+    stem = base[:-3] if base.endswith(".py") else base
+    d = os.path.dirname(path)
+    if stem != "__init__":
+        parts.append(stem)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(reversed(parts)) or stem
+
+
+class ProjectContext:
+    """The run-wide view shared by every rule (docstring above)."""
+
+    def __init__(self, contexts: Iterable[_ast_util.FileContext]):
+        self.contexts: List[_ast_util.FileContext] = list(contexts)
+        # two non-package files with the same stem (a/utils.py and
+        # b/utils.py) would collide here; resolving the name to
+        # WHICHEVER file was inserted last silently points the call
+        # graph at the wrong definition, so ambiguous names are
+        # dropped from cross-module resolution entirely (those files
+        # keep their intra-file analysis — precision over recall)
+        self.modules: Dict[str, _ast_util.FileContext] = {}
+        ambiguous: Set[str] = set()
+        for ctx in self.contexts:
+            name = module_name_for(ctx.path)
+            if name in self.modules:
+                ambiguous.add(name)
+            else:
+                self.modules[name] = ctx
+        for name in ambiguous:
+            del self.modules[name]
+        self._module_of = {id(ctx): name
+                           for name, ctx in self.modules.items()}
+        self._reachable: Optional[Set[Node]] = None
+        self._reachable_by_mod: Dict[str, Set[str]] = {}
+
+    def module_of(self, ctx: _ast_util.FileContext) -> Optional[str]:
+        return self._module_of.get(id(ctx))
+
+    # ---- symbol resolution ----------------------------------------------
+    def resolve(self, qualname: Optional[str]):
+        """Resolve a canonical dotted call target to its definition.
+
+        Returns ``(ctx, function def)`` when ``qualname`` names a
+        function defined in some linted module (``pkg.mod.fn`` or the
+        ``from pkg.mod import fn`` spelling), else None.  Methods are
+        matched by bare name within the module, same last-name-wins
+        over-approximation as the intra-file call graph.
+        """
+        if not qualname or "." not in qualname:
+            return None
+        mod, _, fn_name = qualname.rpartition(".")
+        ctx = self.modules.get(mod)
+        if ctx is not None and fn_name in ctx.functions:
+            return ctx, ctx.functions[fn_name]
+        return None
+
+    # ---- cross-module call graph ----------------------------------------
+    def _edges_from(self, ctx: _ast_util.FileContext) -> Set[Tuple[Node, Node]]:
+        mod = self.module_of(ctx)
+        if mod is None:
+            return set()
+        edges: Set[Tuple[Node, Node]] = set()
+        # intra-file edges (bare-name resolution, already computed)
+        for caller, callees in ctx.call_graph.items():
+            edges.update(((mod, caller), (mod, c)) for c in callees)
+        # cross-module edges: dotted call targets through the alias map
+        for name, fn in ctx.functions.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self.resolve(ctx.qualname(node.func))
+                if hit is None:
+                    continue
+                callee_ctx, callee_fn = hit
+                callee_mod = self.module_of(callee_ctx)
+                if callee_mod is not None and \
+                        (callee_mod, callee_fn.name) != (mod, name):
+                    edges.add(((mod, name), (callee_mod, callee_fn.name)))
+        return edges
+
+    @property
+    def jit_reachable_nodes(self) -> Set[Node]:
+        """(module, function) nodes reachable from any jit root in the
+        run — the project-wide analog of FileContext.jit_reachable."""
+        if self._reachable is not None:
+            return self._reachable
+        graph: Dict[Node, Set[Node]] = {}
+        roots: Set[Node] = set()
+        for ctx in self.contexts:
+            mod = self.module_of(ctx)
+            if mod is None:
+                continue
+            for a, b in self._edges_from(ctx):
+                graph.setdefault(a, set()).add(b)
+            # per-file roots: local_jit_reachable already folds jitted
+            # functions, kernels and train-step-named defs plus their
+            # intra-file closure; seed with all of them so the
+            # cross-module edges extend the closure
+            roots.update((mod, n) for n in ctx.local_jit_reachable)
+        seen: Set[Node] = set()
+        stack = list(roots)
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(graph.get(cur, ()))
+        self._reachable = seen
+        # grouped once so jit_reachable_in is a dict lookup, not an
+        # O(total nodes) rescan per rule per file
+        self._reachable_by_mod = {}
+        for m, fn in seen:
+            self._reachable_by_mod.setdefault(m, set()).add(fn)
+        return seen
+
+    def jit_reachable_in(self, ctx: _ast_util.FileContext) -> Set[str]:
+        """Function names in ``ctx`` jit-reachable from ANY file."""
+        mod = self.module_of(ctx)
+        if mod is None:
+            return ctx.local_jit_reachable
+        self.jit_reachable_nodes   # ensure the closure is computed
+        return self._reachable_by_mod.get(mod, set())

@@ -1,9 +1,16 @@
 """apexlint command line: ``python -m apex_tpu.lint <paths>``.
 
-Exit codes (tools/lint.py and CI rely on these):
-  0  no findings
+Exit codes (tools/lint.py, tools/check.sh and CI rely on these):
+  0  no gating findings (baselined findings never gate)
   1  findings reported
   2  usage error (no such path, empty selection)
+
+``--semantic`` additionally runs apexverify (the semantic tier): every
+registered entry-point invariant spec is traced and checked, and both
+tiers' findings pass through the findings baseline
+(``--baseline``/``--write-baseline``, default
+apex_tpu/lint/semantic/baseline.json) so a new rule family can land
+without blocking while CI gates on the diff.
 """
 
 from __future__ import annotations
@@ -23,7 +30,9 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="python -m apex_tpu.lint",
         description="apexlint: static analysis for JAX/TPU hazards "
                     "(tracer leaks, dtype promotion, recompile "
-                    "triggers, Pallas geometry).")
+                    "triggers, Pallas geometry, collective hygiene) "
+                    "plus apexverify, the jaxpr-level invariant "
+                    "verifier (--semantic).")
     p.add_argument("paths", nargs="*",
                    help="files or directories to lint")
     p.add_argument("--json", action="store_true",
@@ -34,6 +43,25 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule ids to skip")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--semantic", action="store_true",
+                   help="also run apexverify: trace every registered "
+                        "entry-point invariant spec (jaxpr/HLO-level "
+                        "checks) after the AST tier")
+    p.add_argument("--list-specs", action="store_true",
+                   help="print the semantic invariant-spec registry "
+                        "and exit")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="findings baseline JSON (default: the shipped "
+                        "apex_tpu/lint/semantic/baseline.json when "
+                        "--semantic is on); baselined findings are "
+                        "reported but never gate")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write ALL current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--relax-test-bodies", action="store_true",
+                   help="tests/examples profile: APX101/APX102 are "
+                        "exempt inside test_* function bodies of "
+                        "test files")
     return p
 
 
@@ -47,6 +75,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rid, name, desc in rule_catalog():
             print(f"{rid}  {name}\n    {desc}")
         return 0
+    if args.list_specs:
+        from apex_tpu.lint.semantic import all_specs
+        for spec in all_specs():
+            print(f"{spec.name}  [{spec.anchor}]\n    {spec.description}")
+        return 0
     if not args.paths:
         print("usage: python -m apex_tpu.lint <paths> "
               "(try --list-rules)", file=sys.stderr)
@@ -57,6 +90,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     known = {rid.upper() for rid, _, _ in rule_catalog()}
+    known |= {"APX901", "APX902"}   # semantic tier (apexverify)
     for flag, ids in (("--select", _csv(args.select)),
                       ("--ignore", _csv(args.ignore))):
         bad = {i.upper() for i in ids or ()} - known
@@ -71,9 +105,59 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{', '.join(args.paths)}", file=sys.stderr)
         return 2
     findings = lint_paths(files, select=_csv(args.select),
-                          ignore=_csv(args.ignore))
+                          ignore=_csv(args.ignore),
+                          relax_test_bodies=args.relax_test_bodies)
+
+    specs_checked = None
+    if args.semantic:
+        from apex_tpu.lint.semantic import run_semantic
+        sem_findings, specs_checked, _ = run_semantic()
+        # --select/--ignore apply to the semantic tier too (lint_paths
+        # already consumed them for the AST tier)
+        sel, ign = _csv(args.select), _csv(args.ignore)
+        if sel:
+            su = {s.upper() for s in sel}
+            sem_findings = [f for f in sem_findings
+                            if f.rule_id.upper() in su]
+        if ign:
+            iu = {s.upper() for s in ign}
+            sem_findings = [f for f in sem_findings
+                            if f.rule_id.upper() not in iu]
+        findings = sorted(findings + sem_findings,
+                          key=lambda f: (f.path, f.line, f.col,
+                                         f.rule_id))
+
+    baseline_path = args.baseline
+    if baseline_path is None and args.semantic:
+        from apex_tpu.lint.semantic.baseline import DEFAULT_BASELINE
+        baseline_path = DEFAULT_BASELINE
+
+    if args.write_baseline:
+        if baseline_path is None:
+            # never default here: an AST-only run would silently
+            # overwrite the SHIPPED package baseline
+            print("apexlint: --write-baseline requires --baseline FILE "
+                  "(or --semantic, which targets the shipped baseline)",
+                  file=sys.stderr)
+            return 2
+        from apex_tpu.lint.semantic import baseline as bl
+        bl.save(baseline_path, findings)
+        print(f"apexlint: wrote {len(findings)} finding(s) to "
+              f"baseline {baseline_path}")
+        return 0
+
+    baselined: list = []
+    if baseline_path and os.path.exists(baseline_path):
+        from apex_tpu.lint.semantic import baseline as bl
+        findings, baselined, stale = bl.split(findings,
+                                              bl.load(baseline_path))
+        for key in sorted(stale):
+            print(f"apexlint: note: stale baseline entry (already "
+                  f"fixed): {key[0]} {key[1]}", file=sys.stderr)
+
     render = render_json if args.json else render_text
-    print(render(findings, len(files)))
+    print(render(findings, len(files), specs_checked=specs_checked,
+                 baselined=baselined))
     return 1 if findings else 0
 
 

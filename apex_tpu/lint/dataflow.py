@@ -1,0 +1,192 @@
+"""Intra-function dataflow helpers for apexlint rules.
+
+Deliberately line-granular and flow-insensitive-within-a-line: rules
+using these helpers (APX402 use-after-donate, APX801 trace-time shared
+state) want "is this name read again after that call, without an
+intervening rebind?" answered cheaply and with a bias to precision —
+a read inside an earlier branch of the same function must not count,
+so everything is keyed on line numbers, which Python's one-statement-
+per-line idiom makes a faithful program order for real code.  Code
+that multiplexes statements on one line falls back to "no finding",
+never a false positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+
+def assigned_names(target: ast.expr) -> Iterator[str]:
+    """Names bound by an assignment target (tuples unpacked)."""
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            yield node.id
+
+
+def walk_scope(fn: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` restricted to ``fn``'s OWN scope: nested
+    function/lambda/class definitions are not entered — their
+    parameters and locals shadow, so a same-named ``Name`` inside them
+    is a different variable."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def binding_lines(fn: ast.AST, name: str,
+                  own_scope_only: bool = False) -> List[int]:
+    """Lines where ``name`` is (re)bound inside ``fn``: assignment,
+    augmented assignment, for-target, with-as, walrus.  With
+    ``own_scope_only`` nested definitions don't count (shadowing)."""
+    lines: List[int] = []
+    for node in (walk_scope(fn) if own_scope_only else ast.walk(fn)):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            targets = [node.optional_vars]
+        elif isinstance(node, ast.NamedExpr):
+            targets = [node.target]
+        for t in targets:
+            if name in set(assigned_names(t)):
+                lines.append(getattr(node, "lineno",
+                                     getattr(t, "lineno", 0)))
+    return sorted(lines)
+
+
+def reads_of(fn: ast.AST, name: str,
+             own_scope_only: bool = False) -> List[ast.Name]:
+    """Every Load of ``name`` inside ``fn``, in line order.  With
+    ``own_scope_only`` loads inside nested definitions don't count —
+    APX402 wants this (a fresh parameter named ``state`` in a helper
+    def is not the donated ``state``); APX703 keeps the full walk (a
+    closure reading the collective's result IS a use)."""
+    reads = [n for n in (walk_scope(fn) if own_scope_only
+                         else ast.walk(fn))
+             if isinstance(n, ast.Name) and n.id == name
+             and isinstance(n.ctx, ast.Load)]
+    return sorted(reads, key=lambda n: (n.lineno, n.col_offset))
+
+
+def in_disjoint_branches(ctx, a: ast.AST, b: ast.AST) -> bool:
+    """True when ``a`` and ``b`` live in different arms of the same
+    ``if``/``try`` — so no execution reaches both in one pass and a
+    line-order "read after" relation between them is meaningless."""
+    def chain(node):
+        out = [node]
+        out.extend(ctx.ancestors(node))
+        return out
+
+    ca, cb = chain(a), chain(b)
+    set_b = {id(n) for n in cb}
+    for i, anc in enumerate(ca):
+        if id(anc) not in set_b or i == 0:
+            continue
+        if not isinstance(anc, (ast.If, ast.Try)):
+            continue
+        below_a = ca[i - 1]
+        below_b = cb[cb.index(anc) - 1] if anc in cb else None
+        if below_b is None:
+            continue
+        arms = [anc.body, getattr(anc, "orelse", [])]
+        if isinstance(anc, ast.Try):
+            # only handlers are disjoint from the body: `else` runs
+            # exactly when the body SUCCEEDED (one arm with it), and
+            # `finally` runs on every path (disjoint from nothing —
+            # not an arm, so arm_of returns None and we fall through).
+            # A handler's arm is matched by the ExceptHandler node
+            # itself: it is the Try's direct child on the ancestor
+            # chain, not its body statements.
+            arms = [anc.body + anc.orelse,
+                    *[[h] for h in anc.handlers]]
+
+        def arm_of(node):
+            for j, arm in enumerate(arms):
+                if any(s is node for s in arm):
+                    return j
+            return None
+
+        ia, ib = arm_of(below_a), arm_of(below_b)
+        if ia is not None and ib is not None and ia != ib:
+            return True
+    return False
+
+
+# ---- module-level mutable state --------------------------------------------
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = {"list", "dict", "set", "collections.defaultdict",
+                  "collections.OrderedDict", "collections.deque",
+                  "collections.Counter"}
+_MUTATING_METHODS = {"append", "extend", "insert", "add", "update",
+                     "setdefault", "pop", "popleft", "appendleft",
+                     "remove", "discard", "clear", "__setitem__"}
+
+
+def module_level_mutables(ctx) -> Dict[str, int]:
+    """{name: lineno} of module-scope bindings to mutable containers
+    (list/dict/set literals, comprehensions, or bare list()/dict()/...
+    constructor calls).  ``threading.local()`` and arbitrary objects do
+    NOT match — a thread-local holder is the sanctioned fix for shared
+    trace-time state (telemetry._tape), so it must stay clean."""
+    out: Dict[str, int] = {}
+    for stmt in ctx.tree.body:
+        value = None
+        names: List[str] = []
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            for t in stmt.targets:
+                names.extend(assigned_names(t))
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value = stmt.value
+            names.extend(assigned_names(stmt.target))
+        if value is None:
+            continue
+        mutable = isinstance(value, _MUTABLE_LITERALS) or (
+            isinstance(value, ast.Call)
+            and ctx.qualname(value.func) in _MUTABLE_CTORS)
+        if mutable:
+            for n in names:
+                out.setdefault(n, stmt.lineno)
+    return out
+
+
+def mutations_of(fn: ast.AST, names: Set[str]) -> Iterator[Tuple[ast.AST, str, str]]:
+    """Yield ``(site, name, how)`` for each mutation of one of
+    ``names`` inside ``fn``: a mutating method call (``x.append(..)``),
+    subscript store (``x[k] = v``), augmented assignment (``x += ..``),
+    or a rebind following a ``global`` declaration."""
+    globals_declared: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            globals_declared.update(node.names)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in names \
+                and node.func.attr in _MUTATING_METHODS:
+            yield node, node.func.value.id, f".{node.func.attr}()"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in names:
+                    yield node, t.value.id, "[...] assignment"
+                elif isinstance(t, ast.Name) and t.id in names \
+                        and t.id in globals_declared:
+                    yield node, t.id, "global rebind"

@@ -11,28 +11,51 @@ import collections
 import json
 from typing import List, Sequence
 
-from apex_tpu.lint.findings import Finding
+from apex_tpu.lint.findings import Finding, sort_key
 
 
-def render_text(findings: Sequence[Finding],
-                files_checked: int) -> str:
+def render_text(findings: Sequence[Finding], files_checked: int,
+                specs_checked=None,
+                baselined: Sequence[Finding] = ()) -> str:
+    findings = sorted(findings, key=sort_key)
     lines: List[str] = [f.format() for f in findings]
-    by_rule = collections.Counter(f.rule_id for f in findings)
+    # accepted debt stays VISIBLE (docs/lint.md: "reported but never
+    # gate") — tagged so it can't be mistaken for a gating finding
+    lines.extend(f"{f.format()}  [baselined]"
+                 for f in sorted(baselined, key=sort_key))
+    suffix = ""
+    if specs_checked is not None:
+        suffix += f" + {specs_checked} semantic specs"
+    if baselined:
+        n = len(baselined)
+        suffix += f" ({n} baselined finding" \
+                  f"{'s' if n != 1 else ''})"
     if findings:
-        summary = ", ".join(f"{rid}: {n}"
-                            for rid, n in sorted(by_rule.items()))
+        summary = ", ".join(f"{rid}: {n}" for rid, n in sorted(
+            collections.Counter(f.rule_id for f in findings).items()))
         lines.append(f"apexlint: {len(findings)} finding"
                      f"{'s' if len(findings) != 1 else ''} in "
-                     f"{files_checked} files ({summary})")
+                     f"{files_checked} files{suffix} ({summary})")
     else:
-        lines.append(f"apexlint: {files_checked} files clean")
+        lines.append(f"apexlint: {files_checked} files"
+                     f"{suffix} clean")
     return "\n".join(lines)
 
 
-def render_json(findings: Sequence[Finding],
-                files_checked: int) -> str:
-    return json.dumps({
+def render_json(findings: Sequence[Finding], files_checked: int,
+                specs_checked=None,
+                baselined: Sequence[Finding] = ()) -> str:
+    # deterministic order regardless of rule/file scheduling: sorted
+    # by (path, line, col, rule) like the engine's contract
+    findings = sorted(findings, key=sort_key)
+    payload = {
         "files_checked": files_checked,
         "finding_count": len(findings),
         "findings": [f.to_dict() for f in findings],
-    }, indent=2, sort_keys=True)
+        "baselined_count": len(baselined),
+        "baselined": [f.to_dict()
+                      for f in sorted(baselined, key=sort_key)],
+    }
+    if specs_checked is not None:
+        payload["specs_checked"] = specs_checked
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -16,9 +16,13 @@ from apex_tpu.lint.rules.dtype_promotion import (
 from apex_tpu.lint.rules.retrace import (
     JitInHotPathRule, TracedBranchRule, TracedRangeRule)
 from apex_tpu.lint.rules.donation import DonationRule
+from apex_tpu.lint.rules.use_after_donate import UseAfterDonateRule
 from apex_tpu.lint.rules.pallas_geometry import (
     BlockShapeRule, ProgramIdArithmeticRule)
 from apex_tpu.lint.rules.import_env import ImportTimeEnvRule
+from apex_tpu.lint.rules.collectives import (
+    DeadCollectiveRule, MeshAxisMismatchRule, UnboundAxisRule)
+from apex_tpu.lint.rules.trace_state import TraceSharedStateRule
 
 _RULE_CLASSES = (
     HostSyncRule,
@@ -30,9 +34,14 @@ _RULE_CLASSES = (
     JitInHotPathRule,
     TracedRangeRule,
     DonationRule,
+    UseAfterDonateRule,
     BlockShapeRule,
     ProgramIdArithmeticRule,
     ImportTimeEnvRule,
+    UnboundAxisRule,
+    MeshAxisMismatchRule,
+    DeadCollectiveRule,
+    TraceSharedStateRule,
 )
 
 

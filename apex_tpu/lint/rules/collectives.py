@@ -1,0 +1,235 @@
+"""APX7xx collective hygiene.
+
+Three ways a named-axis collective goes wrong statically:
+
+* **APX701 unbound-axis-collective** — ``psum``/``all_gather``/
+  ``axis_index`` over a literal axis name that nothing in the file
+  binds (no ``shard_map`` spec, no ``pmap``/``vmap`` ``axis_name``, no
+  mesh declaration).  At runtime this is jax's "unbound axis name"
+  NameError — from deep inside a trace, pointing nowhere useful.
+* **APX702 mesh-axis-mismatch** — the file declares its mesh axes
+  (``Mesh(..., axis_names=(...))`` / ``PartitionSpec`` literals) and a
+  collective names an axis outside that set: the collective can never
+  bind on the declared topology (typo'd axis, stale rename).
+* **APX703 dead-collective** — a collective whose result is discarded
+  (bare expression statement, or bound to a name never read).
+  Collectives must be issued consistently across ranks; one on a dead
+  or conditional path is how the ring-attention non-causal bug
+  happened (an unused ``axis_index`` tripped the SPMD partitioner —
+  fixed in PR 3 by emitting it only when used).
+
+Axes spelled as variables (``axis_name`` parameters — the library
+idiom) are out of scope by design: the caller owns the binding, and
+precision beats recall.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from apex_tpu.lint import dataflow
+from apex_tpu.lint.engine import Rule
+from apex_tpu.lint.findings import ERROR
+
+# last path component -> which argument slot carries the axis name
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "psum_scatter": 1, "ppermute": 1, "all_to_all": 1, "axis_index": 0,
+    "axis_size": 0, "pbroadcast": 1, "pshuffle": 1,
+}
+_BINDERS_AXIS_KWARG = {"pmap", "vmap", "xmap"}     # axis_name="..."
+_BINDERS_SPEC_STRINGS = {"shard_map", "smap"}      # strings in specs bind
+_MESH_DECLS = {"Mesh", "make_mesh", "create_device_mesh", "AbstractMesh"}
+_SPEC_DECLS = {"PartitionSpec", "P", "NamedSharding"}
+
+
+def _is_collective(ctx, call: ast.Call) -> Optional[str]:
+    """The collective's short name when ``call`` is a jax.lax (or
+    from-imported) collective, else None."""
+    q = ctx.qualname(call.func)
+    if q is None:
+        return None
+    last = q.rsplit(".", 1)[-1]
+    if last in _COLLECTIVES and ("lax" in q or q == last):
+        return last
+    return None
+
+
+def _axis_literals(call: ast.Call, slot: int):
+    """Literal string axis names of a collective call (positional slot
+    or axis_name=/axis= kwarg; tuples of strings yield each element).
+
+    Sources are UNIONED, never overwritten: ``all_gather(x, 'i',
+    axis=0)`` carries the axis name positionally and the integer
+    tiling dimension in ``axis=`` — an int kwarg contributes no string
+    literals and must not mask the positional name."""
+    nodes = []
+    if len(call.args) > slot:
+        nodes.append(call.args[slot])
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            nodes.append(kw.value)
+    out = []
+    for node in nodes:
+        for v in ast.walk(node):
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.append(v.value)
+    return out
+
+
+def _string_constants(node: ast.AST):
+    return {v.value for v in ast.walk(node)
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)}
+
+
+class _AxisEnv:
+    """Per-file axis-name environment shared by the three rules."""
+
+    def __init__(self, ctx):
+        self.bound: Set[str] = set()       # binder-introduced names
+        self.mesh_axes: Set[str] = set()   # declared mesh/spec axes
+        self.has_mesh_decl = False
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            q = ctx.qualname(call.func)
+            last = q.rsplit(".", 1)[-1] if q else None
+            if last in _BINDERS_AXIS_KWARG:
+                for kw in call.keywords:
+                    if kw.arg == "axis_name":
+                        self.bound |= _string_constants(kw.value)
+            elif last in _BINDERS_SPEC_STRINGS:
+                self.bound |= _string_constants(call)
+            elif last in _MESH_DECLS:
+                self.has_mesh_decl = True
+                self.mesh_axes |= _string_constants(call)
+            elif last in _SPEC_DECLS:
+                self.mesh_axes |= _string_constants(call)
+
+    @property
+    def known(self) -> Set[str]:
+        return self.bound | self.mesh_axes
+
+
+def _env(ctx) -> _AxisEnv:
+    # one environment per FileContext, shared across the three rules
+    cache = getattr(ctx, "_apx7_env", None)
+    if cache is None:
+        cache = ctx._apx7_env = _AxisEnv(ctx)
+    return cache
+
+
+class UnboundAxisRule(Rule):
+    id = "APX701"
+    name = "unbound-axis-collective"
+    severity = ERROR
+    description = (
+        "A collective (`psum`/`all_gather`/`axis_index`/...) over a "
+        "literal axis name that no `shard_map` spec, `pmap`/`vmap` "
+        "`axis_name`, or mesh declaration in the file binds: raises "
+        "jax's unbound-axis NameError from inside the trace.  Bind "
+        "the axis or thread it in as a parameter.")
+
+    def check(self, ctx):
+        env = _env(ctx)
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            coll = _is_collective(ctx, call)
+            if coll is None:
+                continue
+            for axis in _axis_literals(call, _COLLECTIVES[coll]):
+                if axis not in env.known:
+                    yield self.finding(
+                        ctx, call,
+                        f"`{coll}` over axis '{axis}' but nothing in "
+                        "this file binds it (no shard_map spec, "
+                        "pmap/vmap axis_name, or mesh declaration "
+                        "names it); a typo'd or unbound axis raises "
+                        "NameError mid-trace")
+
+
+class MeshAxisMismatchRule(Rule):
+    id = "APX702"
+    name = "mesh-axis-mismatch"
+    severity = ERROR
+    description = (
+        "The file declares its mesh axes (`Mesh(..., axis_names=...)`)"
+        " and a collective names an axis outside that set: the "
+        "collective can never bind on the declared topology (typo'd "
+        "axis or stale rename).")
+
+    def check(self, ctx):
+        env = _env(ctx)
+        if not env.has_mesh_decl or not env.mesh_axes:
+            return
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            coll = _is_collective(ctx, call)
+            if coll is None:
+                continue
+            for axis in _axis_literals(call, _COLLECTIVES[coll]):
+                if axis not in env.mesh_axes and axis not in env.bound:
+                    yield self.finding(
+                        ctx, call,
+                        f"`{coll}` over axis '{axis}' but this file's "
+                        f"mesh declares axes "
+                        f"{sorted(env.mesh_axes)} — the collective "
+                        "can never bind on that topology")
+
+
+class DeadCollectiveRule(Rule):
+    id = "APX703"
+    name = "dead-collective"
+    description = (
+        "A collective whose result is discarded (bare statement, or "
+        "bound to a name never read): it still executes on every rank "
+        "and a partitioner may reject or desynchronize the dead path "
+        "(the ring-attention non-causal `axis_index` bug).  Drop the "
+        "call or use its result.")
+
+    def check(self, ctx):
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            coll = _is_collective(ctx, call)
+            if coll is None:
+                continue
+            parent = ctx.parents.get(call)
+            if isinstance(parent, ast.Expr):
+                yield self.finding(
+                    ctx, call,
+                    f"result of `{coll}` is discarded — the "
+                    "collective still runs on every rank; drop it or "
+                    "use the value")
+            elif isinstance(parent, ast.Assign) and \
+                    len(parent.targets) == 1 and \
+                    isinstance(parent.targets[0], ast.Name):
+                name = parent.targets[0].id
+                scope = ctx.enclosing_function(call) or ctx.tree
+                later = [r for r in dataflow.reads_of(scope, name)
+                         if (r.lineno, r.col_offset) >
+                         (parent.lineno, 0)]
+                if not later:
+                    # loop back edge: a read EARLIER in the same
+                    # enclosing loop body is reached on the next
+                    # iteration (the ring idiom `acc += recv; recv =
+                    # ppermute(...)`) — the result is live
+                    loop = next(
+                        (a for a in ctx.ancestors(parent)
+                         if isinstance(a, (ast.For, ast.AsyncFor,
+                                           ast.While))), None)
+                    if loop is not None:
+                        in_loop = {id(n) for n in ast.walk(loop)}
+                        later = [r for r in
+                                 dataflow.reads_of(scope, name)
+                                 if id(r) in in_loop]
+                if not later:
+                    yield self.finding(
+                        ctx, call,
+                        f"`{name}` holds the result of `{coll}` but "
+                        "is never read — a dead collective "
+                        "desynchronizes ranks that disagree about "
+                        "reaching it")

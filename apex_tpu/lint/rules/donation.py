@@ -23,6 +23,35 @@ def _is_step_like(name: str) -> bool:
     return any(m in n for m in _STEP_MARKERS)
 
 
+def _sites_with_defs(ctx):
+    """Every jit site paired with the target function's def.
+
+    Local sites come from ``ctx.jit_sites``.  With a ProjectContext
+    attached (multi-file runs), ``jax.jit(imported_step, ...)`` also
+    resolves: the step lives in another linted module, and hiding it
+    behind an import must not hide the missing donation.
+    """
+    local = set()
+    for name, site, call in ctx.jit_sites:
+        local.add(name)
+        yield name, site, call, ctx.functions.get(name)
+    if ctx.project is None:
+        return
+    import ast
+    from apex_tpu.lint import _ast_util
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and ctx.qualname(node.func) in _ast_util.JIT_WRAPPERS
+                and node.args):
+            continue
+        hit = ctx.project.resolve(ctx.qualname(node.args[0]))
+        if hit is None:
+            continue
+        _, fn = hit
+        if fn.name not in local:
+            yield fn.name, node, node, fn
+
+
 class DonationRule(Rule):
     id = "APX401"
     name = "train-step-without-donation"
@@ -35,10 +64,9 @@ class DonationRule(Rule):
 
     def check(self, ctx):
         seen = set()
-        for name, site, call in ctx.jit_sites:
+        for name, site, call, fn in _sites_with_defs(ctx):
             if not _is_step_like(name):
                 continue
-            fn = ctx.functions.get(name)
             if fn is None:
                 continue
             params = [p.lower() for p in ctx.param_names(fn)

@@ -1,0 +1,53 @@
+"""APX801 trace-time shared state.
+
+A module-level mutable (list/dict/set) written from inside
+jit-reachable code runs its mutation at TRACE time, not run time:
+the write happens once per (re)trace instead of once per step, repeats
+on every retrace, leaks tracers into host state if the stored value is
+traced, and is shared across threads.  This is exactly the bug class
+the telemetry tape defends against with its thread-local stack and
+trace-identity guard (apex_tpu/telemetry/_tape.py) — a plain
+module-level list there would capture tracers from foreign traces and
+replay stale values on retrace.
+
+The rule flags mutations (``.append``/``.update``/``x[k] = v``/
+``global`` rebinds) of module-scope mutable-literal bindings inside
+jit-reachable functions.  ``threading.local()`` holders and class
+instances are NOT matched — a guarded thread-local holder is the
+sanctioned fix, and arbitrary objects are out of static reach.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.lint import dataflow
+from apex_tpu.lint.engine import Rule
+from apex_tpu.lint.findings import ERROR
+
+
+class TraceSharedStateRule(Rule):
+    id = "APX801"
+    name = "trace-time-shared-state"
+    severity = ERROR
+    description = (
+        "A module-level mutable (list/dict/set) mutated inside a "
+        "jit-reachable function: the write happens at trace time "
+        "(once per retrace, not once per step) and can capture "
+        "tracers into host state.  Carry the value functionally, or "
+        "use a thread-local holder with a trace-identity guard "
+        "(telemetry._tape is the pattern).")
+
+    def check(self, ctx):
+        mutables = dataflow.module_level_mutables(ctx)
+        if not mutables:
+            return
+        names = set(mutables)
+        for fn in ctx.functions_in(ctx.jit_reachable):
+            for site, name, how in dataflow.mutations_of(fn, names):
+                yield self.finding(
+                    ctx, site,
+                    f"{how} on module-level mutable `{name}` (defined "
+                    f"line {mutables[name]}) inside jit-reachable "
+                    f"`{fn.name}`: this runs at trace time — once per "
+                    "retrace, not once per step — and can capture "
+                    "tracers; carry the state functionally or guard "
+                    "it like telemetry._tape")

@@ -1,0 +1,127 @@
+"""APX402 use-after-donate.
+
+``donate_argnums`` tells XLA the input buffer may be reused for an
+output — after the call returns, the donated array is DELETED
+(``jax.errors.deleted`` on access, or silently stale data through a
+raw pointer).  PR 2's checkpoint machinery hit exactly this: a
+``state_dict()`` snapshot taken by reference before a donating
+``step()`` pointed at buffers the step then consumed.  The static
+shape of the bug is always the same: a value passed in a donated
+argument position and then read again.
+
+The rule tracks every jitted-with-donation binding in the file
+(``step = jax.jit(f, donate_argnums=(0,))``, the ``self._step``
+attribute form, and jit-as-decorator), then flags any later read of a
+name that was passed in a donated slot without being rebound first.
+Rebinding from the donating call itself (``x, s = step(x, s)`` — the
+carry idiom) is the sanctioned pattern and stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint import dataflow
+from apex_tpu.lint._ast_util import FunctionNode
+from apex_tpu.lint.engine import Rule
+from apex_tpu.lint.findings import ERROR
+
+
+def _callee_spelling(func: ast.expr):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return f"{func.value.id}.{func.attr}"
+    return None
+
+
+class UseAfterDonateRule(Rule):
+    id = "APX402"
+    name = "use-after-donate"
+    severity = ERROR
+    description = (
+        "A value passed in a donated argument position of a jitted "
+        "call (`donate_argnums`/`donate_argnames`) and read again "
+        "afterwards: the donated buffer is deleted by the call.  "
+        "Rebind the name from the call's results (the carry idiom) or "
+        "copy before donating.")
+
+    def check(self, ctx):
+        bindings = ctx.donating_jit_bindings
+        if not bindings:
+            return
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            spelling = _callee_spelling(call.func)
+            info = bindings.get(spelling) if spelling else None
+            if info is None:
+                continue
+            scope = ctx.enclosing_function(call) or ctx.tree
+            # the whole statement carrying the call: rebinds ON it (the
+            # carry idiom `x, s = step(x, s)`) protect later reads
+            stmt = call
+            for a in ctx.ancestors(call):
+                stmt = a
+                if isinstance(a, ast.stmt):
+                    break
+            start = getattr(stmt, "lineno", call.lineno)
+            end = getattr(stmt, "end_lineno", call.lineno)
+
+            donated: list = []
+            for pos in info["positions"]:
+                if isinstance(pos, int) and pos < len(call.args) \
+                        and isinstance(call.args[pos], ast.Name):
+                    donated.append((call.args[pos].id,
+                                    f"position {pos}", call.args[pos]))
+            for kw in call.keywords:
+                if kw.arg in info["names"] \
+                        and isinstance(kw.value, ast.Name):
+                    donated.append((kw.value.id,
+                                    f"argument `{kw.arg}`", kw.value))
+
+            enclosing_loop = next(
+                (a for a in ctx.ancestors(stmt)
+                 if isinstance(a, (ast.For, ast.AsyncFor, ast.While))),
+                None)
+
+            for name, slot, arg_node in donated:
+                # own scope only: a same-named parameter/local in a
+                # nested def (or another function, for module-level
+                # donations) is a different variable, not the donated
+                # buffer
+                binds = dataflow.binding_lines(scope, name,
+                                               own_scope_only=True)
+                if enclosing_loop is not None:
+                    # loop back edge: donating inside a loop without
+                    # rebinding the name anywhere in the loop body
+                    # passes a deleted buffer on iteration 2 — the
+                    # call's OWN argument read is the later read
+                    l_end = getattr(enclosing_loop, "end_lineno", end)
+                    if not any(enclosing_loop.lineno <= b <= l_end
+                               for b in binds):
+                        yield self.finding(
+                            ctx, arg_node,
+                            f"`{name}` is donated ({slot} of "
+                            f"`{spelling}`) inside a loop without "
+                            "being rebound in the loop body — the "
+                            "next iteration passes a buffer this "
+                            "call deleted; rebind it from the call's "
+                            "results (the carry idiom)")
+                        continue
+                for read in dataflow.reads_of(scope, name,
+                                              own_scope_only=True):
+                    if read.lineno <= end:
+                        continue
+                    if any(start <= b <= read.lineno for b in binds):
+                        break   # rebound before (or by) the read
+                    if dataflow.in_disjoint_branches(ctx, stmt, read):
+                        continue   # other arm of the same if/try
+                    yield self.finding(
+                        ctx, read,
+                        f"`{name}` was donated ({slot} of "
+                        f"`{spelling}`, line {call.lineno}) and is "
+                        "read again here — the buffer is deleted by "
+                        "the donating call; rebind it from the call's "
+                        "results or copy before donating")
+                    break

@@ -1,0 +1,33 @@
+"""apexverify — the semantic tier of apexlint.
+
+Where the AST tier reads source, this tier reads PROGRAMS: it traces
+the library's own public jitted entry points (fused optimizer steps,
+the flat AMP pipeline, telemetry-instrumented steps, the bucketed DDP
+all-reduce) with tiny abstract inputs and asserts structural
+invariants on the jaxpr and lowered HLO — zero transfer/callback
+primitives, donation reflected in input-output aliasing, the exact
+expected ``pallas_call`` and bucket-``concatenate`` counts, no
+f32->f64 promotion, no orphan collectives.
+
+Entry points self-register declarative :class:`InvariantSpec`\\ s
+(semantic/specs.py has the built-ins, semantic/registry.py the
+format); ``python -m apex_tpu.lint --semantic`` runs them after the
+AST tier, filtered through a findings baseline (semantic/baseline.py)
+so new invariants can land without blocking while CI gates on the
+diff.  Tests reuse the same walkers (semantic/jaxprs.py) the verifier
+does, so a test assertion can never silently diverge from the gate.
+"""
+
+from apex_tpu.lint.semantic import jaxprs
+from apex_tpu.lint.semantic.registry import (InvariantSpec, SpecResult,
+                                             all_specs, get_spec,
+                                             register_spec, verify_all,
+                                             verify_spec)
+from apex_tpu.lint.semantic.verifier import (results_to_findings,
+                                             run_semantic, spec_names)
+
+__all__ = [
+    "InvariantSpec", "SpecResult", "all_specs", "get_spec", "jaxprs",
+    "register_spec", "results_to_findings", "run_semantic",
+    "spec_names", "verify_all", "verify_spec",
+]

@@ -1,0 +1,70 @@
+"""Findings baseline: land new rules without blocking, gate the diff.
+
+A baseline is a JSON file of accepted findings.  A finding matches a
+baseline entry on ``(path, rule_id, message)`` — deliberately NOT on
+line/col, which drift with every unrelated edit; a baselined finding
+follows its code around the file.  CI flow:
+
+* a new rule family lands with its current findings written to the
+  baseline (``--write-baseline``): nothing breaks, the debt is
+  visible and versioned;
+* the gate (``tools/check.sh``) fails only on findings NOT in the
+  baseline — the diff, not the stock;
+* fixing a finding and forgetting to shrink the baseline is safe
+  (stale entries are reported as such, not errors), fixing the
+  baseline file is one ``--write-baseline`` run.
+
+The shipped default (``apex_tpu/lint/semantic/baseline.json``) is
+EMPTY: every tier is clean at head, so CI gates on everything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Sequence, Set, Tuple
+
+from apex_tpu.lint.findings import Finding
+
+Key = Tuple[str, str, str]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "baseline.json")
+
+
+def _key(f: Finding) -> Key:
+    return (f.path.replace(os.sep, "/"), f.rule_id, f.message)
+
+
+def load(path: str) -> Set[Key]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {(e["path"], e["rule_id"], e["message"])
+            for e in data.get("findings", [])}
+
+
+def save(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted({_key(f) for f in findings})
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"findings": [
+            {"path": p, "rule_id": r, "message": m}
+            for p, r, m in entries]}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def split(findings: Sequence[Finding], baseline: Set[Key]
+          ) -> Tuple[List[Finding], List[Finding], Set[Key]]:
+    """(new, baselined, stale-entries): new findings gate, baselined
+    ones are reported informationally, stale entries point at debt
+    already paid."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    seen: Set[Key] = set()
+    for f in findings:
+        k = _key(f)
+        if k in baseline:
+            old.append(f)
+            seen.add(k)
+        else:
+            new.append(f)
+    return new, old, baseline - seen

@@ -1,0 +1,150 @@
+"""Shared jaxpr/HLO structural analysis for the semantic tier.
+
+PRs 3 and 4 each hand-rolled a recursive jaxpr walk in their tests to
+prove "zero transfer primitives, N pallas_calls, one concatenate per
+bucket" for one entry point.  This module is that walk, once, as a
+library: the invariant verifier (semantic/registry.py) and the tests
+both consume it, so an assertion can never be weaker in one place
+than the other.
+
+Everything operates on a ``ClosedJaxpr`` (or raw ``Jaxpr``) and
+recurses into every sub-jaxpr carried in equation params (cond/scan
+branches, pjit bodies, custom_vjp calls), exactly like the original
+test walkers did.  The HLO-side check (donation) reads the lowered
+StableHLO text — ``tf.aliasing_output`` argument attributes are how
+XLA records input-output aliasing — without compiling anything.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator, List, Set, Tuple
+
+# primitive-name substrings that mean "the host is involved": callbacks
+# (pure_callback/io_callback/debug_callback), infeed/outfeed, explicit
+# host pulls.  Matched as substrings, as the original tests did, so
+# renamed variants (callback_p -> io_callback) keep matching.
+# ``device_put`` is deliberately NOT here: jax emits a benign
+# device=None/ALIAS device_put inside e.g. segment_sum, and the
+# in-jit host-offload placement is an intended overlapped DMA — the
+# hazard this invariant polices is the host BLOCKING on the device.
+HOST_TRANSFER_MARKERS = ("callback", "infeed", "outfeed", "host",
+                         "device_get")
+
+# collective primitives (named-axis); psum shows up as "psum" in 0.4.x
+COLLECTIVE_PRIMS = {"psum", "pmax", "pmin", "pmean", "all_gather",
+                    "all_to_all", "reduce_scatter", "psum_scatter",
+                    "ppermute", "axis_index", "pbroadcast"}
+
+
+def _as_jaxpr(j):
+    return getattr(j, "jaxpr", j)
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Every equation in ``jaxpr`` and (recursively) its sub-jaxprs."""
+    jaxpr = _as_jaxpr(jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for j in (v if isinstance(v, (list, tuple)) else [v]):
+                if hasattr(j, "jaxpr"):
+                    yield from iter_eqns(j.jaxpr)
+                elif hasattr(j, "eqns"):
+                    yield from iter_eqns(j)
+
+
+def walk(jaxpr, visit: Callable) -> None:
+    """Call ``visit(eqn)`` on every equation (the PR 4 test's shape)."""
+    for eqn in iter_eqns(jaxpr):
+        visit(eqn)
+
+
+def primitive_counts(jaxpr) -> collections.Counter:
+    return collections.Counter(e.primitive.name for e in iter_eqns(jaxpr))
+
+
+def concat_out_shapes(jaxpr) -> List[Tuple[int, ...]]:
+    """Output shapes of every ``concatenate`` — the gradient-pack
+    signature: a pack shows up as exactly one bucket-sized concat."""
+    return [tuple(e.outvars[0].aval.shape) for e in iter_eqns(jaxpr)
+            if e.primitive.name == "concatenate"]
+
+
+def host_transfer_prims(jaxpr) -> List[str]:
+    """Primitive names that move data to/from the host."""
+    return sorted({e.primitive.name for e in iter_eqns(jaxpr)
+                   if any(m in e.primitive.name
+                          for m in HOST_TRANSFER_MARKERS)})
+
+
+def f64_values(jaxpr) -> List[str]:
+    """Evidence of float64 entering the program: any
+    ``convert_element_type`` to f64, or any equation output aval in
+    f64 (TPU has no f64 units — silent downcast or slow path)."""
+    import numpy as np
+    bad: List[str] = []
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name == "convert_element_type" \
+                and np.dtype(e.params.get("new_dtype", "f4")) == \
+                np.dtype("float64"):
+            bad.append("convert_element_type->float64")
+        else:
+            for v in e.outvars:
+                aval = getattr(v, "aval", None)
+                if aval is not None and \
+                        getattr(aval, "dtype", None) is not None and \
+                        np.dtype(aval.dtype) == np.dtype("float64"):
+                    bad.append(f"{e.primitive.name}: f64 output")
+                    break
+    return bad
+
+
+def collective_axis_names(jaxpr) -> Set[str]:
+    """Every named axis any collective in the program reduces over."""
+    axes: Set[str] = set()
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name not in COLLECTIVE_PRIMS:
+            continue
+        raw = e.params.get("axes", e.params.get("axis_name", ()))
+        for a in (raw if isinstance(raw, (tuple, list)) else (raw,)):
+            if isinstance(a, str):
+                axes.add(a)
+    return axes
+
+
+def orphan_collectives(jaxpr) -> List[str]:
+    """Collectives whose every output is dead — unread by any later
+    equation and not a jaxpr output.  A dead collective still executes
+    on every rank (and tripped the SPMD partitioner in the
+    ring-attention non-causal path); the program should not carry one.
+    Checked per (sub)jaxpr, conservatively: a value returned upward
+    counts as live."""
+    dead: List[str] = []
+
+    def scan(j):
+        j = _as_jaxpr(j)
+        live = {id(v) for v in j.outvars}
+        for eqn in j.eqns:
+            live.update(id(v) for v in eqn.invars)
+        for eqn in j.eqns:
+            if eqn.primitive.name in COLLECTIVE_PRIMS and \
+                    not any(id(v) in live for v in eqn.outvars):
+                dead.append(eqn.primitive.name)
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                    if hasattr(sub, "jaxpr"):
+                        scan(sub.jaxpr)
+                    elif hasattr(sub, "eqns"):
+                        scan(sub)
+
+    scan(jaxpr)
+    return dead
+
+
+def donated_alias_count(lowered_text: str) -> int:
+    """How many input buffers the lowered module aliases to outputs —
+    ``tf.aliasing_output`` argument attributes in StableHLO are the
+    trace of ``donate_argnums`` actually taking effect (a donation
+    XLA could not honor simply lacks the attribute)."""
+    return lowered_text.count("tf.aliasing_output")

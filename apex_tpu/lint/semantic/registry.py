@@ -1,0 +1,297 @@
+"""Declarative invariant-spec registry for apexverify.
+
+An :class:`InvariantSpec` names one public jitted entry point and the
+structural facts its program must exhibit.  Registration is
+self-service — a module defining a new entry point registers a spec
+with :func:`register_spec` and the semantic tier picks it up with no
+changes to the verifier, the CLI, or the tests::
+
+    @register_spec(
+        "optim.fused_adam.bucketed",
+        anchor="apex_tpu/optimizers/fused_adam.py",
+        description="bucketed FusedAdam step: one flat kernel per "
+                    "bucket, donated state, zero host traffic")
+    def _build():
+        opt = FusedAdam(_tiny_params(), lr=1e-3)
+        ...
+        return {
+            "fn": step_fn, "args": args,
+            "jit_kwargs": {"donate_argnums": (2,)},
+            "expect": {
+                "no_host_transfer": True,
+                "pallas_calls": n_buckets,
+                "donated_aliases_min": n_state_leaves,
+            },
+        }
+
+The builder runs lazily (verification time, never import time) and
+returns a program description:
+
+``fn``/``args``
+    Traced with ``jax.make_jaxpr(fn)(*args)``.  A builder that must
+    trace under special context may instead return a ready ``jaxpr``.
+``jit_kwargs``
+    When present, ``jax.jit(fn, **jit_kwargs).lower(*args)`` supplies
+    the StableHLO text for the donation-aliasing check (lowering only
+    — nothing is compiled or executed).
+``expect``
+    The declarative invariants; every key maps to one checker in
+    ``_CHECKERS`` below.  Unknown keys fail loudly — a typo'd
+    invariant must not silently verify nothing.
+
+Supported invariants:
+
+=====================  =====================================================
+``no_host_transfer``     no callback/infeed/outfeed/device_get primitives
+``no_f64``               no f64 values or converts (TPU has no f64 units)
+``pallas_calls``         exact ``pallas_call`` count
+``pallas_calls_min``     lower bound (dispatch-table tolerant)
+``bucket_concats``       ``{"count": n, "sizes": {(s,), ...}}`` — exactly n
+                         bucket-sized concatenates (the one gradient pack)
+``is_finite_max``        at most n ``is_finite`` eqns (per-bucket, never
+                         per-leaf)
+``donated_aliases_min``  at least n aliased inputs in the lowered HLO
+``donated_aliases``      exact aliased-input count
+``no_orphan_collectives`` every collective's result is live
+``collective_axes``      exact set of named axes collectives reduce over
+``psum_count``           exact number of ``psum`` equations
+``dus_min``              at least n ``dynamic_update_slice`` eqns (ring
+                         writes)
+``counter``              ``{prim_name: exact_count, ...}`` free-form
+=====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from apex_tpu.lint.semantic import jaxprs
+
+
+@dataclasses.dataclass(frozen=True)
+class InvariantSpec:
+    name: str
+    anchor: str            # repo-relative file findings point at
+    builder: Callable[[], Dict[str, Any]]
+    description: str = ""
+
+
+@dataclasses.dataclass
+class SpecResult:
+    name: str
+    anchor: str
+    checked: List[str] = dataclasses.field(default_factory=list)
+    failures: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+_REGISTRY: Dict[str, InvariantSpec] = {}
+
+
+def register_spec(name: str, anchor: str, description: str = ""):
+    """Decorator registering ``builder`` under ``name`` (idempotent
+    re-registration replaces — supports module reloads in tests)."""
+    def deco(builder):
+        _REGISTRY[name] = InvariantSpec(name=name, anchor=anchor,
+                                        builder=builder,
+                                        description=description)
+        return builder
+    return deco
+
+
+def all_specs() -> List[InvariantSpec]:
+    _load_builtin_specs()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_spec(name: str) -> InvariantSpec:
+    _load_builtin_specs()
+    return _REGISTRY[name]
+
+
+def _load_builtin_specs():
+    from apex_tpu.lint.semantic import specs as _specs  # noqa: F401
+
+
+# ---- checkers --------------------------------------------------------------
+
+def _chk_no_host_transfer(env, expected):
+    if not expected:
+        return None
+    bad = jaxprs.host_transfer_prims(env["jaxpr"])
+    if bad:
+        return f"host transfer primitives present: {bad}"
+    return None
+
+
+def _chk_no_f64(env, expected):
+    if not expected:
+        return None
+    bad = jaxprs.f64_values(env["jaxpr"])
+    if bad:
+        return f"float64 in program: {sorted(set(bad))[:4]}"
+    return None
+
+
+def _chk_pallas_calls(env, expected):
+    got = env["counts"].get("pallas_call", 0)
+    if got != expected:
+        return f"expected exactly {expected} pallas_call(s), found {got}"
+    return None
+
+
+def _chk_pallas_calls_min(env, expected):
+    got = env["counts"].get("pallas_call", 0)
+    if got < expected:
+        return f"expected >= {expected} pallas_call(s), found {got}"
+    return None
+
+
+def _chk_bucket_concats(env, expected):
+    sizes = {tuple(s) for s in expected["sizes"]}
+    packs = [s for s in jaxprs.concat_out_shapes(env["jaxpr"])
+             if s in sizes]
+    if len(packs) != expected["count"]:
+        return (f"expected {expected['count']} bucket-sized "
+                f"concatenate(s) {sorted(sizes)}, found {len(packs)}")
+    return None
+
+
+def _chk_is_finite_max(env, expected):
+    got = env["counts"].get("is_finite", 0)
+    if got > expected:
+        return (f"expected <= {expected} is_finite eqn(s) (per-bucket, "
+                f"never per-leaf), found {got}")
+    return None
+
+
+def _chk_donated_aliases_min(env, expected):
+    if env.get("lowered_text") is None:
+        return "spec declares a donation invariant but no jit_kwargs"
+    got = jaxprs.donated_alias_count(env["lowered_text"])
+    if got < expected:
+        return (f"expected >= {expected} donated input-output "
+                f"alias(es) in lowered HLO, found {got} — donation "
+                "not honored")
+    return None
+
+
+def _chk_donated_aliases(env, expected):
+    if env.get("lowered_text") is None:
+        return "spec declares a donation invariant but no jit_kwargs"
+    got = jaxprs.donated_alias_count(env["lowered_text"])
+    if got != expected:
+        return (f"expected exactly {expected} donated input-output "
+                f"alias(es) in lowered HLO, found {got}")
+    return None
+
+
+def _chk_no_orphan_collectives(env, expected):
+    if not expected:
+        return None
+    dead = jaxprs.orphan_collectives(env["jaxpr"])
+    if dead:
+        return f"dead collective(s) in program: {dead}"
+    return None
+
+
+def _chk_collective_axes(env, expected):
+    got = jaxprs.collective_axis_names(env["jaxpr"])
+    if got != set(expected):
+        return (f"collectives reduce over axes {sorted(got)}, "
+                f"expected exactly {sorted(set(expected))}")
+    return None
+
+
+def _chk_psum_count(env, expected):
+    got = env["counts"].get("psum", 0)
+    if got != expected:
+        return f"expected exactly {expected} psum(s), found {got}"
+    return None
+
+
+def _chk_dus_min(env, expected):
+    got = env["counts"].get("dynamic_update_slice", 0)
+    if got < expected:
+        return (f"expected >= {expected} dynamic_update_slice eqn(s) "
+                f"(ring writes), found {got}")
+    return None
+
+
+def _chk_counter(env, expected):
+    bad = []
+    for prim, n in sorted(expected.items()):
+        got = env["counts"].get(prim, 0)
+        if got != n:
+            bad.append(f"{prim}: expected {n}, found {got}")
+    return "; ".join(bad) or None
+
+
+_CHECKERS: Dict[str, Callable] = {
+    "no_host_transfer": _chk_no_host_transfer,
+    "no_f64": _chk_no_f64,
+    "pallas_calls": _chk_pallas_calls,
+    "pallas_calls_min": _chk_pallas_calls_min,
+    "bucket_concats": _chk_bucket_concats,
+    "is_finite_max": _chk_is_finite_max,
+    "donated_aliases_min": _chk_donated_aliases_min,
+    "donated_aliases": _chk_donated_aliases,
+    "no_orphan_collectives": _chk_no_orphan_collectives,
+    "collective_axes": _chk_collective_axes,
+    "psum_count": _chk_psum_count,
+    "dus_min": _chk_dus_min,
+    "counter": _chk_counter,
+}
+
+
+def verify_spec(spec: InvariantSpec) -> SpecResult:
+    """Build, trace and check one spec.  Build/trace errors become a
+    single failure (never an exception out of the verifier): a spec
+    that cannot even trace is itself a broken invariant."""
+    import jax
+
+    result = SpecResult(name=spec.name, anchor=spec.anchor)
+    try:
+        env = dict(spec.builder())
+        if "jaxpr" not in env:
+            env["jaxpr"] = jax.make_jaxpr(env["fn"])(*env["args"])
+        env["counts"] = jaxprs.primitive_counts(env["jaxpr"])
+        if env.get("lowered_text") is None and env.get("jit_kwargs") \
+                is not None:
+            env["lowered_text"] = jax.jit(
+                env["fn"], **env["jit_kwargs"]).lower(
+                *env["args"]).as_text()
+    except Exception as e:  # noqa: BLE001 — report, don't crash the run
+        result.failures.append(
+            f"spec failed to build/trace: {type(e).__name__}: {e}")
+        return result
+
+    expect = env.get("expect", {})
+    unknown = set(expect) - set(_CHECKERS)
+    if unknown:
+        result.failures.append(
+            f"unknown invariant key(s) {sorted(unknown)} — "
+            f"known: {sorted(_CHECKERS)}")
+    for key in sorted(set(expect) & set(_CHECKERS)):
+        result.checked.append(key)
+        try:
+            msg = _CHECKERS[key](env, expect[key])
+        except Exception as e:  # noqa: BLE001
+            msg = f"checker `{key}` crashed: {type(e).__name__}: {e}"
+        if msg:
+            result.failures.append(f"{key}: {msg}")
+    if not expect:
+        result.failures.append("spec declares no invariants")
+    return result
+
+
+def verify_all(names: Optional[List[str]] = None) -> List[SpecResult]:
+    specs = all_specs()
+    if names:
+        wanted = set(names)
+        specs = [s for s in specs if s.name in wanted]
+    return [verify_spec(s) for s in specs]

@@ -1,0 +1,309 @@
+"""Built-in invariant specs: the library's public jitted entry points.
+
+Every spec here traces a REAL public entry point with tiny abstract
+inputs and pins the structural facts earlier PRs proved ad hoc:
+
+* the five fused optimizers, per-leaf AND bucketed — zero host
+  transfer primitives, the exact flat-kernel count per bucket, the
+  single bucket-sized gradient pack, donation reflected as
+  input-output aliasing in the lowered HLO, no f64;
+* the flat AMP pipeline step — 2 Pallas calls per bucket (unscale+norm
+  fused with the optimizer kernel chain), never a per-leaf finite
+  check;
+* ``amp.scaled_value_and_grad`` (per-leaf oracle surface) — no host
+  traffic, no f64;
+* a telemetry-instrumented step — ZERO callback/transfer primitives
+  (the ring write is a plain dynamic_update_slice);
+* ``all_reduce_flat_buffers`` under shard_map — exactly one psum per
+  bucket, every collective bound to the declared axis, none dead.
+
+Expected Pallas counts adapt to the dispatch gate
+(``ops._dispatch.op_enabled``): when the multi_tensor family is
+routed to the XLA reference path (env override, measured prefs) the
+kernel-count invariant is dropped rather than asserting a count the
+dispatcher made false — the transfer/donation/dtype invariants hold
+on either path.
+
+Tiny shapes keep the whole pass cheap (tools/check.sh budgets the
+full AST+semantic run at < 60 s on one CPU core).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from apex_tpu.lint.semantic.registry import register_spec
+
+_PALLAS_PER_BUCKET = {
+    "FusedAdam": 1,       # flat_adam
+    "FusedSGD": 1,        # flat_sgd
+    "FusedAdagrad": 1,    # flat_adagrad
+    "FusedNovoGrad": 1,   # flat_novograd (segment reduce is XLA)
+    "FusedLAMB": 3,       # flat_l2norm prologue + two-stage flat_lamb
+}
+
+
+def _tiny_params():
+    import jax.numpy as jnp
+    return {"a": jnp.ones((8, 8), jnp.float32),
+            "b": jnp.zeros((8,), jnp.float32),
+            "c": jnp.ones((4, 4), jnp.float32) * 0.5}
+
+
+def _mlp_params(layers=3):
+    import jax.numpy as jnp
+    return {f"l{i}": {"w": jnp.ones((8, 8), jnp.float32) * 0.1,
+                      "b": jnp.zeros((8,), jnp.float32)}
+            for i in range(layers)}
+
+
+def _mlp_loss(p, x):
+    import jax.numpy as jnp
+    h = x
+    for k in sorted(p):
+        h = jnp.tanh(h @ p[k]["w"] + p[k]["b"])
+    return jnp.mean(h ** 2)
+
+
+def _traced_hypers(opt):
+    import jax.numpy as jnp
+    return {k: jnp.asarray(v, jnp.float32)
+            for k, v in opt.hypers.items()
+            if isinstance(v, float) and not isinstance(v, bool)}
+
+
+def _optimizer(name, **kw):
+    from apex_tpu import optimizers
+    return getattr(optimizers, name)(_tiny_params(), lr=1e-3, **kw)
+
+
+def _step_args(opt):
+    import jax
+    import jax.numpy as jnp
+    grads = jax.tree_util.tree_map(jnp.ones_like, _tiny_params())
+    work = opt._param_bufs if opt._plan is not None else opt.params
+    masters = opt._master_bufs if opt._plan is not None else None
+    return (work, masters, opt.opt_state, grads, jnp.int32(1),
+            jnp.float32(1.0), _traced_hypers(opt), jnp.int32(0))
+
+
+def _build_bucketed(name, **kw):
+    import jax
+    from apex_tpu.ops._dispatch import op_enabled
+    opt = _optimizer(name, **kw)
+    assert opt._plan is not None, f"{name}: packer declined tiny tree"
+    args = _step_args(opt)
+    nb = len(opt._plan.buckets)
+    n_state = len(jax.tree_util.tree_leaves(opt.opt_state))
+    expect = {
+        "no_host_transfer": True,
+        "no_f64": True,
+        # ONE gradient pack: a bucket-sized concatenate per bucket
+        "bucket_concats": {"count": nb,
+                           "sizes": {(b.size,)
+                                     for b in opt._plan.buckets}},
+        # donation honored: every packed state buffer aliases an output
+        "donated_aliases": n_state,
+        "no_orphan_collectives": True,
+    }
+    if op_enabled("multi_tensor"):
+        expect["pallas_calls"] = _PALLAS_PER_BUCKET[name] * nb
+        expect["is_finite_max"] = 0   # kernels carry the finite flag
+    return {"fn": opt._full_step_impl, "args": args,
+            "jit_kwargs": {"donate_argnums": (2,)}, "expect": expect}
+
+
+def _build_per_leaf(name, **kw):
+    import jax
+    opt = _optimizer(name, fuse_buckets=False, **kw)
+    assert opt._plan is None
+    args = _step_args(opt)
+    n_state = len(jax.tree_util.tree_leaves(opt.opt_state))
+    return {
+        "fn": opt._full_step_impl, "args": args,
+        "jit_kwargs": {"donate_argnums": (2,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "pallas_calls": 0,        # the per-leaf oracle is pure XLA
+            "donated_aliases": n_state,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+_OPT_KW = {"FusedSGD": {"momentum": 0.9}}
+
+for _name in sorted(_PALLAS_PER_BUCKET):
+    _anchor = ("apex_tpu/optimizers/"
+               f"{_name.replace('Fused', 'fused_').lower()}.py")
+    register_spec(
+        f"optim.{_name}.bucketed", anchor=_anchor,
+        description=f"bucketed {_name} step: flat kernels per bucket, "
+                    "one grad pack, donated state, zero host traffic")(
+        functools.partial(_build_bucketed, _name,
+                          **_OPT_KW.get(_name, {})))
+    register_spec(
+        f"optim.{_name}.per_leaf", anchor=_anchor,
+        description=f"per-leaf {_name} oracle step: pure XLA, donated "
+                    "state, zero host traffic")(
+        functools.partial(_build_per_leaf, _name,
+                          **_OPT_KW.get(_name, {})))
+
+
+@register_spec(
+    "amp.flat_pipeline_step",
+    anchor="apex_tpu/amp/flat_pipeline.py",
+    description="flat AMP train step: one grad pack per bucket, "
+                "unscale+norm fused (2 pallas/bucket with FusedAdam), "
+                "no per-leaf finite checks, zero host traffic")
+def _build_flat_pipeline_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers._base import _fold_clip
+    from apex_tpu.ops._dispatch import op_enabled
+
+    params = _mlp_params()
+    x = jax.random.normal(jax.random.key(0), (4, 8))
+    scaler = amp.LossScaleState.create()
+    opt = FusedAdam(params, lr=1e-3)
+    plan = opt._plan
+    pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0)
+    hypers = _traced_hypers(opt)
+    nb = len(plan.buckets)
+
+    def flat_step(param_bufs, opt_state, scaler, x, step):
+        ptree = plan.unpack_model(param_bufs)
+        loss, flat = pipe.scaled_value_and_grad(_mlp_loss, scaler,
+                                                ptree, x)
+        new_bufs, _, new_state = opt._full_step_flat(
+            param_bufs, None, opt_state, flat.bufs, step,
+            _fold_clip(1.0, flat.clip_coef), hypers, flat.found_inf)
+        return loss, new_bufs, new_state
+
+    args = (opt._param_bufs, opt.opt_state, scaler, x, jnp.int32(1))
+    expect = {
+        "no_host_transfer": True,
+        "no_f64": True,
+        "bucket_concats": {"count": nb,
+                           "sizes": {(b.size,) for b in plan.buckets}},
+        # per-BUCKET finite checks at most — never per leaf (even the
+        # XLA fallback oracle is once per bucket)
+        "is_finite_max": nb,
+        "no_orphan_collectives": True,
+    }
+    if op_enabled("multi_tensor"):
+        # exactly unscale_norm + adam per bucket: clipping folds into
+        # the optimizer kernel's grad scaling, nothing else touches
+        # the gradients
+        expect["pallas_calls"] = 2 * nb
+        expect["is_finite_max"] = 0
+    return {"fn": flat_step, "args": args, "expect": expect}
+
+
+@register_spec(
+    "amp.scaled_value_and_grad",
+    anchor="apex_tpu/amp/scaler.py",
+    description="per-leaf amp oracle surface: scaled loss, unscaled "
+                "grads, on-device overflow flag, zero host traffic")
+def _build_scaled_value_and_grad():
+    import jax
+    from apex_tpu import amp
+
+    params = _mlp_params(layers=2)
+    x = jax.random.normal(jax.random.key(1), (4, 8))
+    scaler = amp.LossScaleState.create()
+
+    def fn(params, scaler, x):
+        return amp.scaled_value_and_grad(_mlp_loss, scaler, params, x)
+
+    return {
+        "fn": fn, "args": (params, scaler, x),
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "telemetry.instrumented_step",
+    anchor="apex_tpu/telemetry/session.py",
+    description="telemetry-instrumented flat AMP step: ZERO "
+                "callback/transfer primitives; the ring write is a "
+                "plain dynamic_update_slice riding the step's jit")
+def _build_instrumented_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import amp, telemetry
+    from apex_tpu.optimizers import FusedAdam
+
+    params = _mlp_params()
+    x = jax.random.normal(jax.random.key(2), (4, 8))
+    scaler = amp.LossScaleState.create()
+    opt = FusedAdam(params, lr=1e-3)
+    pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0)
+    tel = telemetry.Telemetry(run_dir=None, window=8, retrace=False)
+    try:
+        def train_step(work_bufs, opt_state, scaler, x, step):
+            ptree = opt._plan.unpack_model(work_bufs)
+            loss, flat = pipe.scaled_value_and_grad(_mlp_loss, scaler,
+                                                    ptree, x)
+            new_bufs, _, new_state = opt._full_step_flat(
+                work_bufs, None, opt_state, flat.bufs, step, 1.0,
+                {}, flat.found_inf)
+            return loss, new_bufs, new_state
+
+        wrapped = tel.instrument(train_step)
+        jaxpr = jax.make_jaxpr(wrapped)(
+            tel.buf, jnp.int32(0), opt._param_bufs, opt.opt_state,
+            scaler, x, jnp.int32(1))
+    finally:
+        tel.close()
+    return {
+        "jaxpr": jaxpr,
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "dus_min": 1,             # the whole-row ring write
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "ddp.all_reduce_flat_buffers",
+    anchor="apex_tpu/parallel/distributed.py",
+    description="bucket-granular DDP all-reduce under shard_map: "
+                "exactly one psum per flat bucket, every collective "
+                "bound to the declared axis, none dead")
+def _build_all_reduce_flat():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu import comm
+    from apex_tpu.parallel.distributed import all_reduce_flat_buffers
+
+    mesh = Mesh(np.array(jax.devices()[:1]), (comm.AXIS_DATA,))
+    bufs = (jnp.ones((256,), jnp.float32),
+            jnp.ones((128,), jnp.float32))
+
+    def reduce(bufs):
+        return tuple(all_reduce_flat_buffers(list(bufs),
+                                             comm.AXIS_DATA))
+
+    fn = comm.shard_map(reduce, mesh, in_specs=(P(),), out_specs=P())
+    return {
+        "fn": fn, "args": (bufs,),
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "psum_count": len(bufs),
+            "collective_axes": {comm.AXIS_DATA},
+            "no_orphan_collectives": True,
+        },
+    }

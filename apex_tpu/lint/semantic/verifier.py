@@ -1,0 +1,58 @@
+"""apexverify: run the invariant-spec registry, report as findings.
+
+The semantic tier's output speaks the same language as the AST tier —
+:class:`~apex_tpu.lint.findings.Finding` records — so reporters, the
+baseline filter, and CI consume one stream.  Two pseudo-rule ids:
+
+* **APX901 semantic-invariant** — a registered entry point's program
+  violates a declared invariant (a transfer primitive appeared, a
+  kernel count drifted, donation stopped aliasing, ...).
+* **APX902 semantic-build-error** — a spec failed to even build or
+  trace; a public entry point that cannot trace is itself the
+  regression.
+
+These are not AST rules (no fixtures, not in ``--list-rules``): they
+anchor at the entry point's defining file, line 1.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from apex_tpu.lint.findings import ERROR, Finding
+from apex_tpu.lint.semantic.registry import (SpecResult, all_specs,
+                                             verify_all)
+
+RULE_VIOLATION = ("APX901", "semantic-invariant")
+RULE_BUILD = ("APX902", "semantic-build-error")
+
+
+def results_to_findings(results: List[SpecResult]) -> List[Finding]:
+    findings: List[Finding] = []
+    for r in results:
+        for failure in r.failures:
+            build = failure.startswith("spec failed to build")
+            rid, rname = RULE_BUILD if build else RULE_VIOLATION
+            findings.append(Finding(
+                path=r.anchor, line=1, col=1, rule_id=rid,
+                rule_name=rname, severity=ERROR,
+                message=f"[{r.name}] {failure}"))
+    return findings
+
+
+def run_semantic(names: Optional[List[str]] = None
+                 ) -> Tuple[List[Finding], int, float]:
+    """Verify every registered spec (or the named subset).
+
+    Returns ``(findings, specs_checked, elapsed_seconds)``.  Importing
+    and tracing happen here, lazily — the AST tier never pays for jax.
+    """
+    t0 = time.perf_counter()
+    results = verify_all(names)
+    return (results_to_findings(results), len(results),
+            time.perf_counter() - t0)
+
+
+def spec_names() -> List[str]:
+    return [s.name for s in all_specs()]

@@ -142,8 +142,9 @@ def main():
     for i in range(args.steps):
         loss, grads, found_inf = step(opt.params, amp_state.scaler,
                                       tokens)
-        if int(found_inf) == 0:
-            opt.step(grads)
+        # branch-free overflow skip: the flag stays on device (the old
+        # `if int(found_inf) == 0` gate synced the host every step)
+        opt.step(grads, found_inf=found_inf)
         amp_state = amp.update_scaler(amp_state, found_inf)
         if i == 0:
             float(loss)

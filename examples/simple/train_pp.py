@@ -62,7 +62,10 @@ def main():
     params = {"in": w_in, "stages": stages, "out": w_out}
     pspec = {"in": P(), "stages": P(comm.AXIS_PIPE), "out": P()}
 
-    opt = FusedAdam(params, lr=3e-3)
+    # per-leaf state: the shard_map specs below shard each leaf on its
+    # own axis (stages on pipe, the rest replicated) — a flat bucket
+    # would mix them, so the bucketed packing must stay off here
+    opt = FusedAdam(params, lr=3e-3, fuse_buckets=False)
 
     def stage_fn(w, x):
         return x + jnp.tanh(x @ w)          # residual MLP stage

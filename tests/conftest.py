@@ -16,7 +16,10 @@ import os
 
 import jax
 
-if os.environ.get("APEX_TPU_SMOKE") == "1":
+# import-time env reads are THE POINT here: the backend must be chosen
+# before the first jax.devices() call (module docstring), so they
+# cannot move into a function called later.
+if os.environ.get("APEX_TPU_SMOKE") == "1":   # apexlint: disable=APX601
     # TPU smoke mode (tests/test_tpu_smoke.py): keep the real backend and
     # persist compiled executables so re-runs skip the slow first compile.
     jax.config.update(
@@ -26,7 +29,7 @@ if os.environ.get("APEX_TPU_SMOKE") == "1":
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 else:
     jax.config.update("jax_platforms", "cpu")
-    _flags = os.environ.get("XLA_FLAGS", "")
+    _flags = os.environ.get("XLA_FLAGS", "")   # apexlint: disable=APX601
     if "--xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (
             _flags + " --xla_force_host_platform_device_count=8").strip()

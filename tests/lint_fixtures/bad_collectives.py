@@ -1,0 +1,23 @@
+"""APX7xx fixture: unbound axis, mesh mismatch, dead collectives."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec
+
+mesh = Mesh(np.array(jax.devices()).reshape(-1), axis_names=("data",))
+
+
+def mean_grads(g):
+    # APX701 + APX702: nothing binds "batch" and the mesh declares
+    # only ("data",) — stale axis name from a rename
+    return jax.lax.pmean(g, "batch")
+
+
+def reduce_loss(x):
+    def body(x):
+        jax.lax.psum(jnp.ones(()), "data")      # APX703: result discarded
+        idx = jax.lax.axis_index("data")        # APX703: never read
+        return jax.lax.psum(x, "data")
+    return shard_map(body, mesh=mesh, in_specs=PartitionSpec("data"),
+                     out_specs=PartitionSpec())(x)

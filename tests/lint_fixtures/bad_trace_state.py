@@ -1,0 +1,14 @@
+"""APX801 fixture: module-level mutables written under trace."""
+import jax
+import jax.numpy as jnp
+
+_SEEN_LOSSES = []
+_STATS = {}
+
+
+@jax.jit
+def accumulate(w, x):
+    loss = jnp.mean((w * x) ** 2)
+    _SEEN_LOSSES.append(loss)      # APX801: trace-time append of a tracer
+    _STATS["last"] = loss          # APX801: subscript store under trace
+    return loss

@@ -1,0 +1,30 @@
+"""APX402 fixture: donated buffers read after the donating call."""
+import jax
+import jax.numpy as jnp
+
+
+def advance(ring, value):
+    return ring.at[0].set(value)
+
+
+commit = jax.jit(advance, donate_argnums=(0,))
+
+
+def reuse_positional():
+    ring = jnp.zeros((8,))
+    out = commit(ring, 1.0)
+    return ring + out          # APX402: ring was donated, not rebound
+
+
+def make_apply(fn):
+    return jax.jit(fn, donate_argnames=("carry",))
+
+
+refresh = jax.jit(advance, donate_argnums=(0,))
+
+
+def reuse_keyword():
+    apply = jax.jit(advance, donate_argnames=("ring",))
+    buf = jnp.ones((4,))
+    apply(value=0.0, ring=buf)
+    return buf.sum()           # APX402: buf donated by name

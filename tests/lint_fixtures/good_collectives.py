@@ -1,0 +1,24 @@
+"""APX7xx negative fixture: bound axes, matched mesh, live results."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec
+
+mesh = Mesh(np.array(jax.devices()).reshape(-1), axis_names=("data",))
+
+
+def reduce_mean(x, axis_name):
+    # variable axis: the caller owns the binding (library idiom)
+    return jax.lax.pmean(x, axis_name)
+
+
+def body(x):
+    idx = jax.lax.axis_index("data")
+    total = jax.lax.psum(x, "data")
+    return total + jnp.asarray(idx, total.dtype)
+
+
+def reduce_loss(x):
+    return shard_map(body, mesh=mesh, in_specs=PartitionSpec("data"),
+                     out_specs=PartitionSpec())(x)

@@ -1,0 +1,25 @@
+"""APX801 negative fixture: functional carry, thread-local holder,
+and host-side (non-jit-reachable) bookkeeping all stay clean."""
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_TLS = threading.local()       # sanctioned holder (telemetry._tape idiom)
+_LIMITS = {"max_norm": 10.0}   # module dict only ever READ under trace
+_HISTORY = []
+
+
+@jax.jit
+def accumulate(w, x, history):
+    loss = jnp.mean((w * x) ** 2)
+    capped = jnp.minimum(loss, _LIMITS["max_norm"])
+    history = history.at[0].set(capped)     # carried functionally
+    return loss, history
+
+
+def record_host(loss_value):
+    # host-side bookkeeping outside the jit-reachable set is fine
+    _HISTORY.append(loss_value)
+    _TLS.last = loss_value
+    return len(_HISTORY)

@@ -1,0 +1,31 @@
+"""APX402 negative fixture: the carry idiom and copies stay clean."""
+import jax
+import jax.numpy as jnp
+
+
+def advance(ring, value):
+    return ring.at[0].set(value)
+
+
+commit = jax.jit(advance, donate_argnums=(0,))
+
+
+def carry_idiom():
+    ring = jnp.zeros((8,))
+    ring = commit(ring, 1.0)   # rebound by the donating call itself
+    return ring + 1.0
+
+
+def copy_before_donate():
+    ring = jnp.zeros((8,))
+    snapshot = jnp.array(ring, copy=True)
+    commit(ring, 2.0)
+    return snapshot.sum()      # the copy, not the donated buffer
+
+
+def fresh_value_each_call():
+    acc = jnp.float32(0.0)
+    for i in range(3):
+        ring = jnp.zeros((8,))
+        acc = acc + commit(ring, float(i)).sum()
+    return acc

@@ -61,6 +61,9 @@ SUITES = {
     "run_harness": ["tests/test_platform.py", "tests/test_benchlib.py",
                     "tests/test_kernel_bench_logic.py"],
     "run_lint": ["tests/test_lint.py"],
+    # apexverify: jaxpr-level invariant specs over the public jitted
+    # entry points + the findings-baseline diff gate (tools/check.sh)
+    "run_lint_semantic": ["tests/test_lint_semantic.py"],
     # run-time training telemetry (metric ring, emitters, spans,
     # retrace counter) + the pyprof nvtx/prof satellites
     "run_telemetry": ["tests/test_telemetry.py"],

@@ -62,8 +62,10 @@ def _train(opt_level, loss_scale=None, seed=0, lr=0.01,
     for i in range(STEPS):
         (loss, bstats), grads, found_inf = jstep(
             opt.params, bstats, amp_state.scaler, x, y)
-        if int(found_inf) == 0:
-            opt.step(grads)
+        # branch-free overflow skip: found_inf stays on device (the
+        # old `if int(found_inf) == 0` concretized the flag — one
+        # host sync per step, apexlint APX102's exact hazard)
+        opt.step(grads, found_inf=found_inf)
         amp_state = amp.update_scaler(amp_state, found_inf)
         losses.append(float(loss))
     if return_opt:

@@ -134,7 +134,7 @@ def test_conditional_step_jits():
 
     params = {"w": jnp.asarray(1.0)}
     scaler = amp.LossScaleState.create(16.0)
-    jitted = jax.jit(train_step)
+    jitted = jax.jit(train_step, donate_argnums=(0,))
     params, scaler, loss = jitted(params, scaler, 2.0)
     assert np.isfinite(float(loss))
 

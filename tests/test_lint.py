@@ -28,13 +28,17 @@ BAD_FIXTURES = {
     "bad_dtype.py": {"APX201", "APX202", "APX203"},
     "bad_retrace.py": {"APX301", "APX302", "APX303"},
     "bad_donation.py": {"APX401"},
+    "bad_use_after_donate.py": {"APX402"},
     "bad_pallas.py": {"APX501", "APX502"},
     "bad_import_env.py": {"APX601"},
+    "bad_collectives.py": {"APX701", "APX702", "APX703"},
+    "bad_trace_state.py": {"APX801"},
 }
 GOOD_FIXTURES = [
     "good_host_sync.py", "good_telemetry_sync.py", "good_dtype.py",
-    "good_retrace.py", "good_donation.py", "good_pallas.py",
-    "good_import_env.py",
+    "good_retrace.py", "good_donation.py", "good_use_after_donate.py",
+    "good_pallas.py", "good_import_env.py", "good_collectives.py",
+    "good_trace_state.py",
 ]
 
 
@@ -58,15 +62,34 @@ def test_good_fixture_is_clean(name):
 
 
 def test_every_rule_family_has_fixture_coverage():
-    """The acceptance contract: every rule family (6 static + the
-    APX102 runtime-telemetry twin) has a positive (bad fixture) and a
-    negative (good twin)."""
+    """The acceptance contract: every rule family has a positive (bad
+    fixture) and a negative (good twin)."""
     covered = set().union(*BAD_FIXTURES.values())
     families = {rid[:4] for rid, _, _ in rule_catalog()}
     assert {rid[:4] for rid in covered} == families
-    assert len(BAD_FIXTURES) >= 7 == len(GOOD_FIXTURES)
+    assert len(BAD_FIXTURES) >= 10 == len(GOOD_FIXTURES)
     ids = [r.id for r in all_rules()]
     assert len(ids) == len(set(ids))
+
+
+def test_fixture_matrix_completeness_auto_discovered():
+    """Meta-test (no hand-kept list): EVERY registered rule id must be
+    triggered by at least one bad_* fixture, and every bad_* fixture
+    must have a good_* twin that lints clean — so a future rule cannot
+    ship untested and a fixture cannot silently lose its negative."""
+    bad = sorted(n for n in os.listdir(FIXTURES) if n.startswith("bad_"))
+    good = {n for n in os.listdir(FIXTURES) if n.startswith("good_")}
+    triggered = set()
+    for name in bad:
+        triggered |= {f.rule_id for f in _lint_fixture(name)}
+        twin = "good_" + name[len("bad_"):]
+        assert twin in good, f"{name} lacks its clean twin {twin}"
+        assert _lint_fixture(twin) == [], twin
+    missing = {r.id for r in all_rules()} - triggered
+    assert not missing, (
+        f"registered rule id(s) with no bad_* fixture coverage: "
+        f"{sorted(missing)} — add a fixture pair before shipping the "
+        "rule (docs/lint.md 'Extending')")
 
 
 # ---- suppression semantics ------------------------------------------------
@@ -152,3 +175,336 @@ def test_in_process_self_check_matches_cli():
     (runs in the fast tier): apex_tpu/ has zero findings."""
     findings = lint_paths([os.path.join(REPO, "apex_tpu")])
     assert findings == [], [f.format() for f in findings]
+
+
+def test_repo_wide_self_check_relaxed_profile():
+    """Satellite gate: tests/, examples/ and tools/ lint clean under
+    the relaxed profile (APX101/102 exempt inside test bodies; the
+    deliberately-hazardous lint_fixtures tree is pruned from
+    directory walks by collect_files)."""
+    paths = [os.path.join(REPO, d) for d in ("tests", "examples",
+                                             "tools")]
+    findings = lint_paths(paths, relax_test_bodies=True)
+    assert findings == [], [f.format() for f in findings]
+
+
+# ---- path hygiene ---------------------------------------------------------
+
+_HAZARD = "import os\nX = os.environ.get('A')\n"
+
+
+def test_duplicate_spellings_lint_once(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(_HAZARD)
+    dotted = os.path.join(str(tmp_path), ".", "m.py")
+    link = tmp_path / "alias"
+    link.symlink_to(tmp_path)
+    via_link = str(link / "m.py")
+    findings = lint_paths([str(mod), str(mod), dotted, via_link,
+                           str(tmp_path)])
+    assert len(findings) == 1, [f.format() for f in findings]
+    # the reported spelling is normalized (no /./ segments)
+    assert "/./" not in findings[0].path
+
+
+def test_collect_files_deterministic_and_deduped(tmp_path):
+    from apex_tpu.lint.engine import collect_files
+    for name in ("b.py", "a.py"):
+        (tmp_path / name).write_text("x = 1\n")
+    files = collect_files([str(tmp_path / "b.py"), str(tmp_path),
+                           str(tmp_path / "a.py")])
+    assert files == sorted(files)
+    assert len(files) == len(set(files)) == 2
+
+
+def test_json_reporter_order_is_deterministic():
+    """JSON output is sorted by (path, line, col, rule) no matter the
+    order findings were produced in."""
+    from apex_tpu.lint.findings import Finding
+    from apex_tpu.lint.reporters import render_json
+    scrambled = [
+        Finding("b.py", 9, 1, "APX601", "x", "m2"),
+        Finding("a.py", 5, 2, "APX301", "x", "m1"),
+        Finding("a.py", 5, 1, "APX101", "x", "m0"),
+    ]
+    payload = json.loads(render_json(scrambled, 2))
+    got = [(f["path"], f["line"], f["col"], f["rule_id"])
+           for f in payload["findings"]]
+    assert got == sorted(got)
+    assert payload["baselined_count"] == 0
+
+
+# ---- interprocedural tier -------------------------------------------------
+
+def test_interprocedural_host_sync_through_helper(tmp_path):
+    """A host sync hidden behind a helper in ANOTHER module: invisible
+    to per-file linting, caught by the project pass."""
+    (tmp_path / "helpers.py").write_text(
+        "def fetch(x):\n    return float(x)\n")
+    (tmp_path / "train.py").write_text(
+        "import jax\nimport helpers\n\n\n@jax.jit\n"
+        "def run(x):\n    return helpers.fetch(x)\n")
+    findings = lint_paths([str(tmp_path)])
+    hits = [f for f in findings if f.rule_id == "APX101"]
+    assert hits and hits[0].path.endswith("helpers.py"), \
+        [f.format() for f in findings]
+    # the helper alone (no jit root in sight) stays clean
+    assert lint_paths([str(tmp_path / "helpers.py")]) == []
+
+
+def test_interprocedural_donation_of_imported_step(tmp_path):
+    """`jax.jit(imported_step)` without donation: the step def lives in
+    another module, the missing donate_argnums is still caught."""
+    (tmp_path / "steps.py").write_text(
+        "def train_step(params, opt_state, grads):\n"
+        "    return params, opt_state\n")
+    (tmp_path / "wire.py").write_text(
+        "import jax\nfrom steps import train_step\n\n"
+        "jstep = jax.jit(train_step)\n")
+    findings = lint_paths([str(tmp_path)])
+    hits = [f for f in findings if f.rule_id == "APX401"]
+    assert hits and hits[0].path.endswith("wire.py"), \
+        [f.format() for f in findings]
+    # donating spelling is clean
+    (tmp_path / "wire.py").write_text(
+        "import jax\nfrom steps import train_step\n\n"
+        "jstep = jax.jit(train_step, donate_argnums=(0, 1))\n")
+    assert [f for f in lint_paths([str(tmp_path)])
+            if f.rule_id == "APX401"] == []
+
+
+def test_use_after_donate_spares_disjoint_branches():
+    """Regression for the false positive the rule's first draft fired
+    on optimizers/_base.step(): the donating call and the 'later
+    read' live in mutually exclusive if/else arms, so no execution
+    order ever reads the donated buffer."""
+    src = (
+        "import jax\n\n\n"
+        "def advance(state, x):\n"
+        "    return state + x\n\n\n"
+        "step = jax.jit(advance, donate_argnums=(0,))\n\n\n"
+        "def run(flag, state, x):\n"
+        "    if flag:\n"
+        "        out = step(state, x)\n"
+        "    else:\n"
+        "        out = state * 2\n"
+        "    return out\n")
+    findings = lint_source(src, "f.py", all_rules())
+    assert [f for f in findings if f.rule_id == "APX402"] == [], \
+        [f.format() for f in findings]
+    # ...while a genuine straight-line reuse still fires
+    bad = src.replace("    return out\n",
+                      "    return out + state\n")
+    hits = [f for f in lint_source(bad, "f.py", all_rules())
+            if f.rule_id == "APX402"]
+    assert len(hits) == 1, hits
+
+
+def test_use_after_donate_spares_shadowing_scopes():
+    """A same-named parameter/local in a NESTED def (or, for a
+    module-level donation, in any later function) is a fresh variable
+    — its reads must not count as uses of the donated buffer."""
+    src = (
+        "import jax\n\n"
+        "step = jax.jit(lambda s, x: (s, x), donate_argnums=(0,))\n\n\n"
+        "def train(state, x):\n"
+        "    out = step(state, x)\n\n"
+        "    def helper(state):\n"
+        "        return state + 1\n\n"
+        "    return helper(out[0])\n")
+    findings = lint_source(src, "f.py", all_rules())
+    assert [f for f in findings if f.rule_id == "APX402"] == [], \
+        [f.format() for f in findings]
+    # module-level donation, same-named local in another function
+    src2 = (
+        "import jax\n\n"
+        "step = jax.jit(lambda s: s, donate_argnums=(0,))\n"
+        "state = [1.0]\n"
+        "new = step(state)\n\n\n"
+        "def other():\n"
+        "    state = 2\n"
+        "    return state\n")
+    findings2 = lint_source(src2, "f.py", all_rules())
+    assert [f for f in findings2 if f.rule_id == "APX402"] == [], \
+        [f.format() for f in findings2]
+
+
+def test_use_after_donate_try_arms():
+    """`else`/`finally` run after a SUCCESSFUL donating body — reads
+    there see a deleted buffer and must fire; an except handler runs
+    only when the body raised, so its reads stay exempt."""
+    tmpl = (
+        "import jax\n\n"
+        "step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))\n\n\n"
+        "def run(state, x):\n"
+        "    try:\n"
+        "        out = step(state, x)\n"
+        "    {arm}\n"
+        "        {read}\n"
+        "    return out\n")
+    for arm, expect in (("else:", 1), ("finally:", 1),
+                        ("except ValueError:", 0)):
+        src = tmpl.format(arm=arm, read="out = state")
+        if arm == "else:":
+            src = src.replace("    else:",
+                              "    except ValueError:\n"
+                              "        out = None\n    else:")
+        hits = [f for f in lint_source(src, "f.py", all_rules())
+                if f.rule_id == "APX402"]
+        assert len(hits) == expect, (arm, [f.format() for f in hits])
+
+
+def test_use_after_donate_loop_back_edge():
+    """Donating inside a loop without rebinding passes a deleted
+    buffer on iteration 2 — must fire; the carry idiom and a fresh
+    per-iteration binding stay clean."""
+    bad = (
+        "import jax\n\n"
+        "step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))\n\n\n"
+        "def run(state, xs):\n"
+        "    outs = []\n"
+        "    for x in xs:\n"
+        "        outs.append(step(state, x))\n"
+        "    return outs\n")
+    hits = [f for f in lint_source(bad, "f.py", all_rules())
+            if f.rule_id == "APX402"]
+    assert len(hits) == 1 and hits[0].line == 9, hits
+    carry = (
+        "import jax\n\n"
+        "step = jax.jit(lambda s, x: (s + x, x), donate_argnums=(0,))\n\n\n"
+        "def run(state, xs):\n"
+        "    for x in xs:\n"
+        "        state, aux = step(state, x)\n"
+        "    return state\n")
+    assert [f for f in lint_source(carry, "f.py", all_rules())
+            if f.rule_id == "APX402"] == []
+    fresh = (
+        "import jax\n\n"
+        "step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))\n\n\n"
+        "def run(xs):\n"
+        "    outs = []\n"
+        "    for x in xs:\n"
+        "        state = [1.0]\n"
+        "        outs.append(step(state, x))\n"
+        "    return outs\n")
+    assert [f for f in lint_source(fresh, "f.py", all_rules())
+            if f.rule_id == "APX402"] == []
+
+
+def test_use_after_donate_partial_factory_is_not_a_donating_call():
+    """`functools.partial(jax.jit, donate_argnums=...)` bound to a
+    name is a FACTORY — its call arguments are functions to wrap, not
+    donated buffers.  Only the decorator spelling of partial donates."""
+    src = (
+        "import functools\n"
+        "import jax\n\n\n"
+        "def train_step(s, x):\n"
+        "    return s + x\n\n\n"
+        "jit_donate = functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "step = jit_donate(train_step)\n"
+        "eval_step = jit_donate(train_step)\n")
+    findings = lint_source(src, "f.py", all_rules())
+    assert [f for f in findings if f.rule_id == "APX402"] == [], \
+        [f.format() for f in findings]
+    # the decorator form of the same partial still registers donation
+    src_dec = (
+        "import functools\n"
+        "import jax\n\n\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(s, x):\n"
+        "    return s + x\n\n\n"
+        "def run(state, x):\n"
+        "    out = step(state, x)\n"
+        "    return state + out\n")
+    hits = [f for f in lint_source(src_dec, "f.py", all_rules())
+            if f.rule_id == "APX402"]
+    assert len(hits) == 1, hits
+
+
+def test_dead_collective_loop_carry_is_live():
+    """The ring idiom — `acc += recv; recv = ppermute(...)` inside a
+    loop — consumes the collective's result on the NEXT iteration;
+    a read earlier in the same loop body keeps it live (no APX703)."""
+    src = (
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n\n\n"
+        "def ring(acc, x, w, recv, n):\n"
+        "    for step in range(n):\n"
+        "        acc = acc + x @ w + recv\n"
+        "        recv = jax.lax.ppermute(x, 'i', perm=[(0, 1)])\n"
+        "    return acc\n\n\n"
+        "f = shard_map(ring, None, in_specs=None, out_specs=None)\n")
+    findings = lint_source(src, "f.py", all_rules())
+    assert [f for f in findings if f.rule_id == "APX703"] == [], \
+        [f.format() for f in findings]
+    # a result never read anywhere — even across iterations — still fires
+    dead = src.replace("acc = acc + x @ w + recv", "acc = acc + x @ w")
+    hits = [f for f in lint_source(dead, "f.py", all_rules())
+            if f.rule_id == "APX703"]
+    assert len(hits) == 1, hits
+
+
+def test_unbound_axis_detected_despite_tiling_axis_kwarg():
+    """`all_gather(x, 'name', axis=0)` carries the axis NAME
+    positionally and the integer tiling dimension in `axis=` — the int
+    kwarg must not mask the name from APX701/702."""
+    src = (
+        "import jax\n\n\n"
+        "def f(x):\n"
+        "    return jax.lax.all_gather(x, 'typo_axis', axis=0)\n")
+    hits = [f for f in lint_source(src, "f.py", all_rules())
+            if f.rule_id in ("APX701", "APX702")]
+    assert hits, "axis=0 kwarg masked the unbound positional axis name"
+
+
+def test_callgraph_same_stem_files_resolve_deterministically(tmp_path):
+    """Two non-package files with the same stem must not cross-resolve
+    to whichever was linted last: ambiguous module names drop out of
+    cross-module resolution, so findings are argument-order
+    independent."""
+    (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir()
+    pa = tmp_path / "a" / "utils.py"
+    pb = tmp_path / "b" / "utils.py"
+    pm = tmp_path / "main.py"
+    pa.write_text("def helper_step_fn(x):\n    return float(x)\n")
+    pb.write_text("def helper_step_fn(x):\n    return x\n")
+    pm.write_text("import jax\nfrom utils import helper_step_fn\n"
+                  "step = jax.jit(helper_step_fn)\n")
+    fwd = [(f.path, f.line, f.rule_id)
+           for f in lint_paths([str(pa), str(pb), str(pm)])]
+    rev = [(f.path, f.line, f.rule_id)
+           for f in lint_paths([str(pb), str(pa), str(pm)])]
+    assert fwd == rev
+
+
+def test_relaxed_profile_exempts_test_bodies_only(tmp_path):
+    """APX101 inside a test_* body is exempt under the relaxed
+    profile; the same hazard in a module-level helper of the same
+    test file still gates — and without the profile both gate."""
+    src = (
+        "import jax\n\n\n"
+        "def hot_helper_step_fn(x):\n"
+        "    return float(x)\n\n\n"
+        "def test_sync():\n"
+        "    def train_step(x):\n"
+        "        return float(x)\n"
+        "    assert train_step(1.0)\n")
+    f = tmp_path / "test_mod.py"
+    f.write_text(src)
+    strict = lint_paths([str(f)])
+    relaxed = lint_paths([str(f)], relax_test_bodies=True)
+    assert {x.line for x in strict if x.rule_id == "APX101"} == {5, 10}
+    assert {x.line for x in relaxed if x.rule_id == "APX101"} == {5}
+    # the exemption keys on test_* exactly — a tester_*/testbed_*
+    # helper in a test file still gates
+    h = tmp_path / "test_helper.py"
+    h.write_text("import jax\n\n\n"
+                 "def tester_step_fn(x):\n"
+                 "    return float(x)\n")
+    assert {x.line for x in lint_paths([str(h)], relax_test_bodies=True)
+            if x.rule_id == "APX101"} == {5}
+    # non-test files are untouched by the profile
+    g = tmp_path / "mod.py"
+    g.write_text(src)
+    assert len(lint_paths([str(g)], relax_test_bodies=True)) == \
+        len(strict)

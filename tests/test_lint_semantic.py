@@ -1,0 +1,325 @@
+"""apexverify (apex_tpu.lint.semantic): spec registry, invariant
+checkers, jaxpr walkers, baseline diff semantics, the CLI contract,
+and the tier-1 acceptance gate — every registered entry-point spec
+passes, inside the wall-clock budget that keeps the gate cheap.
+
+Suite `run_lint_semantic` in tests/run_test.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.lint import semantic
+from apex_tpu.lint.semantic import baseline as bl
+from apex_tpu.lint.semantic import jaxprs, registry
+from apex_tpu.lint.findings import Finding
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# ---------------------------------------------------------------------------
+# the acceptance gate
+# ---------------------------------------------------------------------------
+
+def test_every_registered_spec_passes():
+    """THE tier-1 semantic gate: every public-entry-point invariant
+    spec verifies clean (zero transfer primitives, donation aliased,
+    expected kernel counts, no f64, no orphan collectives)."""
+    results = semantic.verify_all()
+    failures = {r.name: r.failures for r in results if not r.ok}
+    assert not failures, failures
+    assert len(results) >= 14   # 5 optimizers x 2 paths + 4 pipelines
+    # every spec actually checked something substantive
+    for r in results:
+        assert r.checked, r.name
+
+
+def test_registry_covers_the_public_entry_points():
+    names = set(semantic.spec_names())
+    for opt in ("FusedAdam", "FusedSGD", "FusedAdagrad",
+                "FusedNovoGrad", "FusedLAMB"):
+        assert f"optim.{opt}.bucketed" in names
+        assert f"optim.{opt}.per_leaf" in names
+    assert {"amp.flat_pipeline_step", "amp.scaled_value_and_grad",
+            "telemetry.instrumented_step",
+            "ddp.all_reduce_flat_buffers"} <= names
+
+
+def test_spec_anchors_are_real_files():
+    for spec in semantic.all_specs():
+        assert os.path.exists(os.path.join(REPO, spec.anchor)), \
+            (spec.name, spec.anchor)
+        assert spec.description
+
+
+def test_full_gate_wall_clock_budget():
+    """tools/check.sh stays cheap: the ENTIRE lint+verify pass (AST
+    tier over apex_tpu/ + all semantic specs, one fresh process with
+    its jax import) rounds in < 60 s on one CPU core."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.lint", "--semantic",
+         "apex_tpu/"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "semantic specs" in proc.stdout
+    assert elapsed < 60.0, f"lint+verify gate took {elapsed:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# registry / checker mechanics (temporary specs, cleaned up per test)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def scratch_spec():
+    created = []
+
+    def make(name, builder, anchor="apex_tpu/lint/semantic/specs.py"):
+        semantic.register_spec(name, anchor=anchor)(builder)
+        created.append(name)
+        return registry.get_spec(name)
+
+    yield make
+    for name in created:
+        registry._REGISTRY.pop(name, None)
+
+
+def test_violated_invariant_reports_failure(scratch_spec):
+    spec = scratch_spec("tmp.too_many_pallas", lambda: {
+        "fn": lambda x: x + 1.0, "args": (jnp.ones((4,)),),
+        "expect": {"pallas_calls": 99},
+    })
+    res = semantic.verify_spec(spec)
+    assert not res.ok and "pallas" in res.failures[0]
+    findings = semantic.results_to_findings([res])
+    assert [f.rule_id for f in findings] == ["APX901"]
+    assert findings[0].severity == "error"
+    assert "tmp.too_many_pallas" in findings[0].message
+
+
+def test_build_error_reports_apx902(scratch_spec):
+    def broken():
+        raise RuntimeError("entry point gone")
+    spec = scratch_spec("tmp.broken", broken)
+    res = semantic.verify_spec(spec)
+    assert not res.ok
+    findings = semantic.results_to_findings([res])
+    assert [f.rule_id for f in findings] == ["APX902"]
+
+
+def test_unknown_invariant_key_fails_loudly(scratch_spec):
+    spec = scratch_spec("tmp.typo", lambda: {
+        "fn": lambda x: x, "args": (jnp.ones(3),),
+        "expect": {"no_host_transfers": True},   # typo'd key
+    })
+    res = semantic.verify_spec(spec)
+    assert not res.ok and "unknown invariant" in res.failures[0]
+
+
+def test_empty_expect_fails(scratch_spec):
+    spec = scratch_spec("tmp.empty", lambda: {
+        "fn": lambda x: x, "args": (jnp.ones(3),), "expect": {}})
+    res = semantic.verify_spec(spec)
+    assert not res.ok and "declares no invariants" in res.failures[0]
+
+
+def test_donation_invariant_positive_and_negative(scratch_spec):
+    def step(state, x):
+        return state + x, x * 2.0
+    args = (jnp.ones((16,)), jnp.ones((16,)))
+    ok = scratch_spec("tmp.donated", lambda: {
+        "fn": step, "args": args,
+        "jit_kwargs": {"donate_argnums": (0,)},
+        "expect": {"donated_aliases": 1}})
+    assert semantic.verify_spec(ok).ok
+    missing = scratch_spec("tmp.undonated", lambda: {
+        "fn": step, "args": args, "jit_kwargs": {},
+        "expect": {"donated_aliases_min": 1}})
+    res = semantic.verify_spec(missing)
+    assert not res.ok and "donation not honored" in res.failures[0]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walkers
+# ---------------------------------------------------------------------------
+
+def test_host_transfer_detection_on_callback():
+    def noisy(x):
+        jax.debug.callback(lambda v: None, x)
+        return x * 2
+
+    jaxpr = jax.make_jaxpr(noisy)(jnp.ones(4))
+    bad = jaxprs.host_transfer_prims(jaxpr)
+    assert bad and any("callback" in p for p in bad)
+    assert jaxprs.host_transfer_prims(
+        jax.make_jaxpr(lambda x: x * 2)(jnp.ones(4))) == []
+
+
+def test_concat_shapes_and_counts_recurse_into_subjaxprs():
+    def f(a, b):
+        def body(_, c):
+            return jnp.concatenate([c, c])[: c.shape[0]]
+        return jax.lax.fori_loop(0, 3, body, jnp.concatenate([a, b]))
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones(4), jnp.ones(4))
+    shapes = jaxprs.concat_out_shapes(jaxpr)
+    assert (8,) in shapes and (16,) in shapes   # outer + loop body
+    assert jaxprs.primitive_counts(jaxpr)["concatenate"] == 2
+
+
+def test_orphan_collective_detection():
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu import comm
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    def dead(x):
+        # deliberately dead: this test PROVES the walker catches it
+        jax.lax.psum(jnp.ones(()), "data")   # apexlint: disable=APX703
+        return x * 2
+
+    def live(x):
+        return jax.lax.psum(x, "data")
+
+    dead_j = jax.make_jaxpr(comm.shard_map(
+        dead, mesh, in_specs=P(), out_specs=P()))(jnp.ones(8))
+    live_j = jax.make_jaxpr(comm.shard_map(
+        live, mesh, in_specs=P(), out_specs=P()))(jnp.ones(8))
+    assert "psum" in jaxprs.orphan_collectives(dead_j)
+    assert jaxprs.orphan_collectives(live_j) == []
+    assert jaxprs.collective_axis_names(live_j) == {"data"}
+
+
+def test_axis_is_bound_probe_leaves_no_collective():
+    """Regression for the real finding apexverify surfaced: the old
+    `axis_index` probe left a dead collective in every program that
+    called comm.axis_is_bound (the ring-attention partitioner-bug
+    shape); the statically-folded psum(1) probe leaves NOTHING."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu import comm
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    def probing(x):
+        assert comm.axis_is_bound("data")
+        assert not comm.axis_is_bound("nope")
+        return x * 2
+
+    jaxpr = jax.make_jaxpr(comm.shard_map(
+        probing, mesh, in_specs=P(), out_specs=P()))(jnp.ones(8))
+    assert jaxprs.orphan_collectives(jaxpr) == []
+    assert jaxprs.collective_axis_names(jaxpr) == set()
+
+
+def test_donated_alias_count_reads_lowered_text():
+    lowered = jax.jit(lambda a, b: (a + b, b),
+                      donate_argnums=(0,)).lower(jnp.ones(4),
+                                                 jnp.ones(4))
+    assert jaxprs.donated_alias_count(lowered.as_text()) == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+
+def _finding(path="a.py", rule="APX901", msg="m", line=1):
+    return Finding(path=path, line=line, col=1, rule_id=rule,
+                   rule_name="x", message=msg)
+
+
+def test_baseline_roundtrip_and_split(tmp_path):
+    f1 = _finding(msg="one")
+    f2 = _finding(msg="two", line=9)
+    path = str(tmp_path / "baseline.json")
+    bl.save(path, [f1])
+    base = bl.load(path)
+    new, old, stale = bl.split([f1, f2], base)
+    assert [f.message for f in new] == ["two"]
+    assert [f.message for f in old] == ["one"]
+    assert stale == set()
+    # line drift does NOT un-baseline a finding (keys ignore line/col)
+    moved = _finding(msg="one", line=55)
+    new2, old2, _ = bl.split([moved], base)
+    assert new2 == [] and old2 == [moved]
+    # fixed finding -> stale entry reported, nothing gates
+    new3, old3, stale3 = bl.split([], base)
+    assert new3 == [] and old3 == [] and len(stale3) == 1
+
+
+def test_shipped_baseline_is_empty():
+    """Head is clean: the shipped baseline carries zero accepted
+    findings, so CI gates on everything."""
+    assert bl.load(bl.DEFAULT_BASELINE) == set()
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (subprocesses pay the jax import: slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_semantic_baseline_flow(tmp_path):
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "apex_tpu.lint", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+
+    # --list-specs names every optimizer spec
+    proc = run("--list-specs")
+    assert proc.returncode == 0
+    assert "optim.FusedAdam.bucketed" in proc.stdout
+
+    # a hazard gates normally, is silenced by a written baseline,
+    # and gates again when a NEW finding appears
+    mod = tmp_path / "m.py"
+    mod.write_text("import os\nX = os.environ.get('A')\n")
+    base = str(tmp_path / "base.json")
+    assert run(str(mod)).returncode == 1
+    proc = run("--baseline", base, "--write-baseline", str(mod))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = run("--baseline", base, str(mod))
+    assert proc.returncode == 0
+    assert "1 baselined finding" in proc.stdout
+    mod.write_text("import os\nimport jax\nX = os.environ.get('A')\n"
+                   "\n\n@jax.jit\ndef f(x):\n    if x:\n"
+                   "        return x\n    return x + 1\n")
+    proc = run("--baseline", base, str(mod))
+    assert proc.returncode == 1
+    payload = run("--json", "--baseline", base, str(mod))
+    data = json.loads(payload.stdout)
+    assert data["finding_count"] == 1 and data["baselined_count"] == 1
+    assert data["findings"][0]["rule_id"] == "APX301"
+
+    # --write-baseline without --baseline/--semantic must refuse (it
+    # would otherwise overwrite the SHIPPED package baseline)
+    proc = run("--write-baseline", str(mod))
+    assert proc.returncode == 2
+    assert "--baseline" in proc.stderr
+
+    # baselined findings stay VISIBLE (tagged), per the documented
+    # "reported but never gate" contract — text and JSON
+    mod.write_text("import os\nX = os.environ.get('A')\n")
+    assert run("--baseline", base, "--write-baseline",
+               str(mod)).returncode == 0
+    proc = run("--baseline", base, str(mod))
+    assert proc.returncode == 0
+    assert "[baselined]" in proc.stdout and "APX601" in proc.stdout
+    data = json.loads(run("--json", "--baseline", base,
+                          str(mod)).stdout)
+    assert data["baselined_count"] == 1
+    assert data["baselined"][0]["rule_id"] == "APX601"
+
+    # --ignore/--select cover the semantic tier's ids too
+    proc = run("--ignore", "APX902", str(mod))
+    assert proc.returncode == 1          # APX601 still gates
+    proc = run("--ignore", "APX601,APX902", str(mod))
+    assert proc.returncode == 0, proc.stdout + proc.stderr

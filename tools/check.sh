@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# The one-command correctness gate: AST tier + semantic tier (apexverify)
+# + baseline diff over the package, then the relaxed profile over
+# tests/, examples/ and tools/ (APX101/102 exempt inside test bodies —
+# a test syncing to assert a device value is the point of the test).
+#
+#   tools/check.sh            # everything (CI / pre-merge)
+#
+# Exit: non-zero on any non-baselined finding.  The full pass is
+# budgeted at < 60 s on one CPU core
+# (tests/test_lint_semantic.py::test_full_gate_wall_clock_budget
+# enforces it), so the gate stays cheap enough to run on every push.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== apexlint + apexverify: apex_tpu/ (baseline-gated)"
+python -m apex_tpu.lint --semantic apex_tpu/
+
+echo "== apexlint relaxed profile: tests/ examples/ tools/"
+python -m apex_tpu.lint --relax-test-bodies tests/ examples/ tools/
+
+echo "check.sh: all gates clean"
