@@ -247,6 +247,39 @@ class BucketPlan:
                  "leaves": len(b.leaves), "elements": b.size}
                 for b in self.buckets]
 
+    # ---- layout (de)serialization ----------------------------------------
+    def leaf_paths(self) -> List[str]:
+        """``jax.tree_util.keystr`` path per leaf, in leaf-index order —
+        the human-readable identity the checkpoint v2 header records so
+        a restore onto a DIFFERENT tree fails with a named leaf, not a
+        positional index."""
+        dummy = jax.tree_util.tree_unflatten(
+            self.treedef, list(range(self.n_leaves)))
+        flat, _ = jax.tree_util.tree_flatten_with_path(dummy)
+        paths: List[Optional[str]] = [None] * self.n_leaves
+        for path, idx in flat:
+            paths[idx] = jax.tree_util.keystr(path)
+        return paths  # type: ignore[return-value]
+
+    def layout(self) -> dict:
+        """JSON-able static layout: leaf paths plus every bucket's
+        dtypes, element count and per-leaf shape/offset table.  This is
+        the checkpoint v2 header's ``plan`` record: enough to (a) slice
+        a flat bucket buffer back into per-leaf arrays on the host with
+        no device traffic, and (b) decide whether a restoring
+        optimizer's own plan matches bit-for-bit (same doc ==> packed
+        buffers can be adopted directly)."""
+        return {
+            "paths": self.leaf_paths(),
+            "buckets": [
+                {"dtype": np.dtype(b.dtype).name,
+                 "model_dtype": np.dtype(b.model_dtype).name,
+                 "size": b.size,
+                 "leaves": [{"index": s.index, "shape": list(s.shape),
+                             "offset": s.offset} for s in b.leaves]}
+                for b in self.buckets],
+        }
+
 
 # ---- cached standalone plans ----------------------------------------------
 # The fused optimizers own their plan; everything else on the flat

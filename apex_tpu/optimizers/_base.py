@@ -97,6 +97,14 @@ def place_on_device(tree: Pytree) -> Pytree:
         if isinstance(x, jax.Array) else x, tree)
 
 
+def _device_copy(buf: jax.Array) -> jax.Array:
+    """Async device-side copy of one flat buffer (dispatch returns
+    immediately).  The bucket-native checkpoint path routes every copy
+    through this seam so tests can assert structurally that a packed
+    snapshot is exactly one copy per buffer and nothing else."""
+    return buf.copy()
+
+
 def unzip_tree(like: Pytree, tree_of_tuples: Pytree, n: int):
     """pytree-of-n-tuples -> n-tuple of pytrees (robust to tuples INSIDE
     the params pytree, unlike is_leaf=isinstance(tuple))."""
@@ -522,6 +530,80 @@ class FusedOptimizerBase:
 
     def zero_grad(self):
         """No-op for parity: JAX grads are freshly computed, never stored."""
+
+    # ---- bucket-native checkpoint capture --------------------------------
+    def packed_snapshot(self):
+        """Checkpoint capture that NEVER unpacks: one async device-side
+        copy per packed buffer (params, masters, every optimizer-state
+        field), plus host scalars — the bucket-native checkpoint v2
+        input (``checkpoint.save_training_state`` routes here when the
+        optimizer runs bucketed).
+
+        The copies are the double-buffer: the caller's next ``step()``
+        donates ``opt_state`` (and rebinds the param buffers), so an
+        in-flight device->host transfer must read from buffers the step
+        cannot delete.  ``plan.unpack`` is never called — the whole
+        point of the format (ISSUE 6 acceptance: zero per-leaf work).
+
+        Returns ``{"step", "hypers", "plan", "param_bufs",
+        "master_bufs", "state"}`` with jax-array buffer lists.  Raises
+        ``ValueError`` on a per-leaf optimizer — callers fall back to
+        ``state_dict()`` / the v1 format there."""
+        if self._plan is None:
+            raise ValueError(
+                "packed_snapshot requires the bucketed path "
+                "(fuse_buckets=False or the packer declined this tree);"
+                " use state_dict() / the v1 checkpoint format instead")
+        # offloaded state copies IN PLACE on the host (buf.copy()
+        # preserves placement; the "d2h" later is a plain host memcpy)
+        # — pulling it into HBM first would allocate the very
+        # state-size the offload exists to avoid
+        state = self.opt_state
+        return {
+            "step": int(self.step_count),
+            "hypers": dict(self.hypers),
+            "plan": self._plan,
+            "param_bufs": [_device_copy(b) for b in self._param_bufs],
+            "master_bufs": ([_device_copy(b) for b in self._master_bufs]
+                            if self._master_bufs is not None else None),
+            "state": {k: [_device_copy(b) for b in v]
+                      for k, v in state.items()},
+        }
+
+    def load_packed_snapshot(self, step, hypers, param_bufs, master_bufs,
+                             state):
+        """Inverse of :meth:`packed_snapshot` — adopt packed buffers
+        directly (one host->device put per bucket, zero per-leaf
+        traffic).  Buffers may be numpy (fresh from a checkpoint read)
+        or jax arrays; the caller has already validated the layout
+        against this optimizer's plan (checkpoint.py compares the v2
+        header's plan doc with ``self._plan.layout()``)."""
+        if self._plan is None:
+            raise ValueError(
+                "load_packed_snapshot requires the bucketed path")
+        self.step_count = jnp.int32(step)
+        self.hypers.update(hypers)
+        self._param_bufs = [jnp.asarray(b) for b in param_bufs]
+        if master_bufs is not None:
+            self._master_bufs = [jnp.asarray(b) for b in master_bufs]
+        else:
+            self._master_bufs = None
+        self._params_cache = None
+        self._masters_cache = None
+        if self.offload_state:
+            # adopt each buffer straight onto the existing (host)
+            # placement — asarray-then-place_on_host would stage the
+            # whole state in HBM, the state-size spike offloading
+            # exists to avoid (the load_state_dict mirror of the
+            # packed_snapshot in-place rule)
+            old = self.opt_state
+            self.opt_state = {
+                k: [jax.device_put(b, o.sharding)
+                    for b, o in zip(v, old[k])]
+                for k, v in state.items()}
+        else:
+            self.opt_state = {k: [jnp.asarray(b) for b in v]
+                              for k, v in state.items()}
 
     # ---- serialization (torch Optimizer.state_dict shape) ---------------
     def state_dict(self):

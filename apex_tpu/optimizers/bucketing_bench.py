@@ -149,3 +149,73 @@ def bench_amp_pipeline(layers: int = 48, hidden: int = 256,
         out["amp_pipeline_speedup"] = round(
             out["amp_step_per_leaf_ms"] / out["amp_step_flat_ms"], 2)
     return out
+
+
+def mixed_dtype_params(jax, jnp, layers: int = 48, hidden: int = 256):
+    """The many-leaf tree in amp-O2 clothing: bf16 matmul weights plus
+    f32 norm vectors per layer — two dtype buckets, masters for the
+    bf16 leaves, the state mix a real checkpoint carries."""
+    base = many_leaf_params(jax, jnp, layers, hidden)
+    return {
+        name: {"w": leaves["w"].astype(jnp.bfloat16), "b": leaves["b"],
+               "scale": leaves["scale"], "shift": leaves["shift"]}
+        for name, leaves in base.items()
+    }
+
+
+def bench_checkpoint_snapshot(layers: int = 48, hidden: int = 256,
+                              reps: int = 5):
+    """Training-state snapshot+serialize time, bucket-native (v2) vs
+    per-leaf (v1), over the same realistic mixed-dtype tree.
+
+    Each rep is one full ``save_training_state`` to a scratch file:
+    snapshot (device copies / per-leaf state_dict walk), device->host
+    transfer, checksum, header and the sequential write.  This is a
+    HOST path — disk and PCIe, not a jittable device program — so it
+    is timed by wall-clock median over reps (the telemetry_flush_ms
+    idiom), not benchlib's on-device loop; the file lands in a tmpdir
+    so the numbers include real filesystem work."""
+    import os
+    import shutil
+    import statistics
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu.optimizers import FusedAdam
+
+    params = mixed_dtype_params(jax, jnp, layers, hidden)
+    grads = jax.tree_util.tree_map(
+        lambda p: (p * 1e-3 + 1e-4).astype(p.dtype), params)
+
+    tmpdir = tempfile.mkdtemp(prefix="apex_ckpt_bench_")
+    out = {
+        "ckpt_leaves": len(jax.tree_util.tree_leaves(params)),
+        "ckpt_elements": sum(int(l.size) for l in
+                             jax.tree_util.tree_leaves(params)),
+    }
+    try:
+        for fuse, fmt, label in ((True, "v2", "bucketed"),
+                                 (False, "v1", "perleaf")):
+            opt = FusedAdam(params, lr=1e-3, fuse_buckets=fuse)
+            opt.step(grads)            # realistic non-zero opt state
+            path = os.path.join(tmpdir, f"snap_{label}.ckpt")
+            ms = []
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                ckpt.save_training_state(path, optimizer=opt,
+                                         step=1, format=fmt)
+                ms.append((time.perf_counter() - t0) * 1e3)
+            out[f"ckpt_snapshot_{label}_ms"] = round(
+                statistics.median(ms), 3)
+            out[f"ckpt_bytes_{label}"] = os.path.getsize(path)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if out["ckpt_snapshot_bucketed_ms"]:
+        out["ckpt_snapshot_speedup"] = round(
+            out["ckpt_snapshot_perleaf_ms"]
+            / out["ckpt_snapshot_bucketed_ms"], 2)
+    return out

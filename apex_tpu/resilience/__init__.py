@@ -1,0 +1,29 @@
+"""Elastic resilience: crash-safe checkpoint rotation, preemption-safe
+restart, an in-job supervisor loop, and a deterministic fault-injection
+harness (SURVEY.md §5: the failure story the reference lacks).
+
+- :mod:`~apex_tpu.resilience.manager` — :class:`CheckpointManager`
+  (rotating async checkpoints, bucket-native v2 when the optimizer runs
+  bucketed, multi-host lockstep ``restore_latest``);
+- :mod:`~apex_tpu.resilience.preemption` — :class:`PreemptionGuard`
+  (SIGTERM / ``--preempt-at-step`` -> save-now-then-clean-exit at the
+  next step boundary);
+- :mod:`~apex_tpu.resilience.elastic` — :func:`run_elastic`, the
+  supervisor loop tying restore + cadence saves + bounded
+  retry-with-backoff + preemption together;
+- :mod:`~apex_tpu.resilience.faults` — :class:`FaultInjector`
+  (seeded schedules of torn writes, fsync errors, slow disks,
+  preemption signals and crash-before-publish, injected through the
+  :class:`apex_tpu.checkpoint.CheckpointIO` seam).
+"""
+
+from apex_tpu.resilience.elastic import ElasticResult, run_elastic
+from apex_tpu.resilience.manager import CheckpointManager
+from apex_tpu.resilience.preemption import PreemptionGuard
+
+__all__ = [
+    "CheckpointManager",
+    "ElasticResult",
+    "PreemptionGuard",
+    "run_elastic",
+]

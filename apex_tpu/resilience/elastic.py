@@ -1,0 +1,236 @@
+"""``run_elastic`` — the in-job supervisor loop that makes a train
+loop survive what preemptible fleets actually do to it.
+
+The reference has no failure story (SURVEY.md §5: a crashed rank kills
+the job).  ``run_elastic`` wraps a user step function with the full
+recovery contract:
+
+- **resume**: restore the newest valid checkpoint before the first
+  step (reusing ``CheckpointManager.restore_latest`` — including its
+  multi-host lockstep agreement, so every host resumes from the SAME
+  step or none does);
+- **cadence saves** through the manager (bucket-native v2 when the
+  optimizer runs bucketed);
+- **transient-failure recovery**: a step or save raising a retryable
+  error (``OSError`` by default — flaky disk, NFS hiccup) triggers
+  bounded retry-with-backoff: restore the newest valid checkpoint and
+  resume from it (training replay is deterministic from a checkpoint,
+  so the result is bit-identical to an uninterrupted run);
+- **preemption**: a :class:`~.preemption.PreemptionGuard` notice
+  (SIGTERM / ``--preempt-at-step``) converts into one final FORCED
+  save at the current step boundary, a durability wait, and a clean
+  return with ``preempted=True``.
+
+The user's step function owns the optimizer and any AMP state (a
+closure); ``save_extras``/``on_restore`` thread the non-optimizer
+state (amp scaler dict, BN batch_stats) through the checkpoint bundle:
+
+>>> def step_fn(step):                      # 1-based steps
+...     loss, flat = pipe.scaled_value_and_grad(...)
+...     opt.step(flat)
+...     box["amp"] = amp.update_scaler(box["amp"], flat.found_inf)
+>>> res = run_elastic(
+...     step_fn, mgr, opt, total_steps=1000,
+...     guard=PreemptionGuard(),
+...     save_extras=lambda: {"amp_state": box["amp"].state_dict()},
+...     on_restore=lambda amp_sd, extra, step:
+...         box.update(amp=box["amp"].load_state_dict(amp_sd)))
+>>> if res.preempted: sys.exit(0)           # checkpoint is durable
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import time
+import warnings
+from typing import Any, Callable, Optional, Tuple, Type
+
+import jax
+
+from apex_tpu.resilience import faults as _faults
+from apex_tpu.resilience.manager import CheckpointManager
+from apex_tpu.resilience.preemption import PreemptionGuard
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class ElasticResult:
+    """What the supervisor loop did."""
+    step: int                       # last COMPLETED step
+    preempted: bool                 # True: exited on a notice, final
+    #                                 checkpoint durable at .step
+    restarts: int                   # in-job recoveries performed
+    restored_from: Optional[int]    # initial resume step (None: fresh)
+
+
+def run_elastic(step_fn: Callable[[int], Any],
+                manager: CheckpointManager,
+                optimizer=None, *,
+                total_steps: int,
+                params_like: Optional[Pytree] = None,
+                extra_like: Optional[Pytree] = None,
+                guard: Optional[PreemptionGuard] = None,
+                save_extras: Optional[Callable[[], dict]] = None,
+                on_restore: Optional[Callable] = None,
+                retryable: Tuple[Type[BaseException], ...] = (OSError,),
+                max_restarts: int = 3,
+                backoff_s: float = 0.05,
+                sleep: Callable[[float], None] = time.sleep
+                ) -> ElasticResult:
+    """Drive ``step_fn(step)`` for steps ``1..total_steps`` (1-based,
+    matching the manager's save cadence) under the recovery contract in
+    the module docstring.
+
+    ``params_like``: restore template (shapes/dtypes suffice —
+    ``jax.ShapeDtypeStruct`` leaves are fine); defaults to the shape
+    structure of ``optimizer.params``.  ``save_extras() -> dict`` may
+    return ``amp_state=`` and/or ``extra=`` for the checkpoint bundle
+    — and, with ``optimizer=None``, the ``params=`` pytree the
+    per-leaf save requires;
+    ``on_restore(amp_sd, extra, step)`` — or the 4-arg form
+    ``on_restore(amp_sd, extra, step, params)``, opted into by naming
+    the 4th parameter ``params`` (it is passed by keyword) — is
+    called after every restore (``amp_sd``/``extra`` as saved) so the
+    caller can rebind its own state.  With ``optimizer=None`` the
+    4-arg form is REQUIRED: the restored params can only reach the
+    caller's closure through it.  ``retryable`` failures of a step OR save trigger
+    restore-newest-valid-and-resume, at most ``max_restarts`` times
+    with exponential backoff; anything else propagates (a real crash
+    — the external scheduler restarts the job, and the next
+    ``run_elastic`` resumes)."""
+    if optimizer is None and params_like is None:
+        raise ValueError("need an optimizer or params_like to restore")
+    if params_like is None:
+        # only the SHAPES are the template; holding the unpacked
+        # pytree itself would pin a params-sized HBM copy all run
+        params_like = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+            optimizer.params)
+    wants_params = False
+    if on_restore is not None:
+        # opt-in by NAME, not arity: a defaulted 4th flag parameter
+        # must not silently receive the params pytree
+        sig = inspect.signature(on_restore)
+        wants_params = ("params" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()))
+    if optimizer is None and not wants_params:
+        raise ValueError(
+            "run_elastic(optimizer=None) restores params only through "
+            "on_restore(amp_sd, extra, step, params) — name its 4th "
+            "parameter 'params' (or accept **kwargs); without it a "
+            "resumed run would silently keep its freshly-initialized "
+            "weights")
+    own_guard = guard is not None and not guard._installed
+    if own_guard:
+        guard.install()
+    restarts = 0
+    try:
+        def _extras() -> dict:
+            return save_extras() if save_extras is not None else {}
+
+        def _restore() -> Optional[int]:
+            out = manager.restore_latest(params_like, optimizer,
+                                         extra_like=extra_like)
+            if out is None:
+                return None
+            if on_restore is not None:
+                args = (out[1],
+                        out[3] if extra_like is not None else None,
+                        out[2])
+                if wants_params:
+                    on_restore(*args, params=out[0])
+                else:
+                    on_restore(*args)
+            return out[2]
+
+        def _forced_save(step: int) -> None:
+            """Save NOW, surviving transient IO errors (bounded)."""
+            for attempt in range(max_restarts + 1):
+                try:
+                    manager.save(step, optimizer=optimizer, **_extras())
+                    manager.wait()
+                    return
+                except retryable as e:
+                    if attempt == max_restarts:
+                        raise
+                    warnings.warn(
+                        f"run_elastic: final save at step {step} "
+                        f"failed ({type(e).__name__}: {e}); retrying")
+                    sleep(backoff_s * (2 ** attempt))
+
+        restored_from = _restore()
+        last_done = restored_from if restored_from is not None else 0
+        step = last_done + 1
+        while step <= total_steps:
+            _faults.notify_step(step)     # chaos hook; no-op normally
+            saved_now = False
+            try:
+                step_fn(step)
+                last_done = step
+                # evaluate extras ONLY on cadence steps: state_dict()
+                # callbacks device_get (loss scale etc.), and a
+                # per-step host sync is the hazard class this whole
+                # stack avoids (APX102)
+                due = manager.due(step)
+                saved_now = manager.maybe_save(
+                    step, optimizer=optimizer,
+                    **(_extras() if due else {}))
+            except retryable as e:
+                restarts += 1
+                if restarts > max_restarts:
+                    raise
+                warnings.warn(
+                    f"run_elastic: step {step} failed "
+                    f"({type(e).__name__}: {e}); restoring newest "
+                    f"valid checkpoint (restart {restarts}/"
+                    f"{max_restarts})")
+                sleep(backoff_s * (2 ** (restarts - 1)))
+                resumed = _restore()
+                if resumed is None:
+                    # nothing valid to restore onto — the optimizer may
+                    # hold post-failure state; restarting "fresh" here
+                    # would silently train from a dirty midpoint
+                    raise
+                last_done = resumed
+                step = resumed + 1
+                continue
+            if guard is not None and guard.check(step):
+                # preemption notice -> durable-now-then-clean-exit at
+                # this step boundary.  A cadence save just scheduled
+                # for THIS step only needs its durability wait — a
+                # second full write would double time-to-durable
+                # inside the eviction grace window
+                if saved_now:
+                    try:
+                        manager.wait()
+                    except retryable as e:
+                        warnings.warn(
+                            f"run_elastic: final save at step {step} "
+                            f"failed ({type(e).__name__}: {e}); "
+                            "rewriting")
+                        _forced_save(step)
+                else:
+                    _forced_save(step)
+                return ElasticResult(step=step, preempted=True,
+                                     restarts=restarts,
+                                     restored_from=restored_from)
+            step += 1
+        try:
+            manager.wait()                # final cadence save durable
+        except retryable as e:
+            # the LAST async save's deferred failure surfaces here,
+            # past the loop's retry handling — re-write the newest
+            # state under the same bounded-retry contract
+            warnings.warn(
+                f"run_elastic: final save failed "
+                f"({type(e).__name__}: {e}); retrying")
+            _forced_save(last_done)
+        return ElasticResult(step=last_done, preempted=False,
+                             restarts=restarts,
+                             restored_from=restored_from)
+    finally:
+        if own_guard:
+            guard.uninstall()

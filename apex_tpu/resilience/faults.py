@@ -1,0 +1,198 @@
+"""Deterministic fault injection for the checkpoint/restart stack —
+the chaos harness that PROVES recovery works instead of asserting it.
+
+Real failure modes, injected at the exact layer they occur in
+production, on a deterministic (optionally seeded) schedule:
+
+===================  ======================================================
+kind                 what happens
+===================  ======================================================
+``truncate``         the payload write stops mid-buffer and the writer
+                     dies (``InjectedCrash``) — the mid-write-crash
+                     artifact: a torn ``.tmp`` that must never publish
+``fsync_error``      ``fsync`` raises ``OSError(EIO)`` once — the
+                     transient-disk case ``run_elastic`` retries with
+                     backoff
+``slow_disk``        the ``.tmp`` open stalls ``delay_s`` seconds —
+                     surfaces as ``ckpt/blocked_ms`` backpressure, must
+                     corrupt nothing
+``preempt``          a real ``SIGTERM`` is delivered to this process at
+                     step ``at_step`` (via the ``notify_step`` hook
+                     ``run_elastic`` calls each iteration) — drives the
+                     actual :class:`~.preemption.PreemptionGuard` path
+``crash_before_publish``  write + fsync complete, the process dies
+                     between the durable ``.tmp`` and the atomic
+                     ``os.replace`` — the unpublished-checkpoint case
+===================  ======================================================
+
+The injector subclasses :class:`apex_tpu.checkpoint.CheckpointIO` and
+installs itself with :func:`apex_tpu.checkpoint.set_io`, so every
+checkpoint writer (v1 and v2, sync and async) runs through it without
+test-only branches in library code.  Each fault fires once (tracked in
+``fired``), keyed by the 0-based ordinal of the checkpoint write it
+targets (``at_save``) or the training step (``at_step`` for
+``preempt``).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+import threading
+import time
+from typing import List, NamedTuple, Optional, Sequence
+
+from apex_tpu import checkpoint as _ckpt
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated process death mid-save.  Deliberately NOT an OSError:
+    ``run_elastic`` retries transient IO errors but a crash kills the
+    job — the chaos tests catch this, then restart training the way an
+    external supervisor would."""
+
+
+class FaultSpec(NamedTuple):
+    kind: str                       # one of FaultInjector.KINDS
+    at_save: Optional[int] = None   # 0-based checkpoint-write ordinal
+    at_step: Optional[int] = None   # training step (preempt only)
+    delay_s: float = 0.0            # slow_disk stall
+
+
+# module-level active injector: run_elastic's per-step chaos hook
+# (notify_step) must find it without the supervisor importing test code
+_ACTIVE: Optional["FaultInjector"] = None
+
+
+def notify_step(step: int) -> None:
+    """Per-step chaos hook (called by ``run_elastic``; a no-op unless a
+    FaultInjector is installed — production pays one global read)."""
+    if _ACTIVE is not None:
+        _ACTIVE.on_step(step)
+
+
+class FaultInjector(_ckpt.CheckpointIO):
+    """Checkpoint-IO implementation that injects the scheduled faults.
+
+    >>> faults = [FaultSpec("truncate", at_save=1)]
+    >>> with FaultInjector(faults):
+    ...     train()        # the 2nd checkpoint write dies mid-payload
+    """
+
+    KINDS = ("truncate", "fsync_error", "slow_disk", "preempt",
+             "crash_before_publish")
+
+    def __init__(self, faults: Sequence[FaultSpec]):
+        for f in faults:
+            if f.kind not in self.KINDS:
+                raise ValueError(f"unknown fault kind {f.kind!r}; "
+                                 f"known: {self.KINDS}")
+            if f.kind == "preempt" and f.at_step is None:
+                raise ValueError("preempt faults need at_step")
+            if f.kind != "preempt" and f.at_save is None:
+                raise ValueError(f"{f.kind} faults need at_save")
+        self.faults = list(faults)
+        self.fired: List[FaultSpec] = []
+        self.saves = -1            # ordinal of the CURRENT write
+        self._lock = threading.Lock()
+        self._prev: Optional[_ckpt.CheckpointIO] = None
+
+    @classmethod
+    def seeded(cls, seed: int, n_saves: int = 8,
+               kinds: Optional[Sequence[str]] = None,
+               delay_s: float = 0.05) -> "FaultInjector":
+        """A deterministic pseudo-random schedule: same seed, same
+        faults, forever — the property a chaos suite needs to be
+        debuggable.  Picks one fault kind per save ordinal with ~50%
+        probability (preempt excluded: it is step-keyed, not
+        save-keyed; schedule it explicitly)."""
+        import random
+        rng = random.Random(seed)
+        kinds = tuple(kinds or ("truncate", "fsync_error", "slow_disk",
+                                "crash_before_publish"))
+        faults = [FaultSpec(rng.choice(kinds), at_save=i,
+                            delay_s=delay_s)
+                  for i in range(n_saves) if rng.random() < 0.5]
+        return cls(faults)
+
+    # ---- lifecycle -------------------------------------------------------
+    def install(self) -> "FaultInjector":
+        global _ACTIVE
+        self._prev = _ckpt.set_io(self)
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if self._prev is not None:
+            _ckpt.set_io(self._prev)
+            self._prev = None
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "FaultInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # ---- schedule --------------------------------------------------------
+    def _take(self, kind: str) -> Optional[FaultSpec]:
+        """Pop-and-fire the first unfired fault of ``kind`` scheduled
+        for the current save ordinal."""
+        with self._lock:
+            for f in self.faults:
+                if f.kind == kind and f.at_save == self.saves \
+                        and f not in self.fired:
+                    self.fired.append(f)
+                    return f
+        return None
+
+    def on_step(self, step: int) -> None:
+        """Step-keyed faults (called from ``notify_step``): deliver a
+        REAL SIGTERM so the whole PreemptionGuard signal path is what
+        gets tested, not a shortcut flag."""
+        with self._lock:
+            due = [f for f in self.faults
+                   if f.kind == "preempt" and f not in self.fired
+                   and f.at_step is not None and step >= f.at_step]
+            self.fired.extend(due)
+        if due:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # ---- CheckpointIO overrides -----------------------------------------
+    def open(self, path: str, mode: str = "wb"):
+        if path.endswith(".tmp") and "w" in mode:
+            with self._lock:
+                self.saves += 1
+            f = self._take("slow_disk")
+            if f is not None:
+                time.sleep(f.delay_s)
+        return super().open(path, mode)
+
+    def write_array(self, f, arr) -> None:
+        fault = self._take("truncate")
+        if fault is not None:
+            # torn write: half the bytes land, then the "process" dies
+            half = arr.view("uint8").ravel()[:max(1, arr.nbytes // 2)]
+            super().write_array(f, half)
+            f.flush()
+            raise InjectedCrash(
+                f"injected mid-write truncation (save #{self.saves})")
+        super().write_array(f, arr)
+
+    def fsync(self, f) -> None:
+        fault = self._take("fsync_error")
+        if fault is not None:
+            raise OSError(errno.EIO,
+                          f"injected fsync failure (save #{self.saves})")
+        super().fsync(f)
+
+    def replace(self, tmp: str, path: str) -> None:
+        fault = self._take("crash_before_publish")
+        if fault is not None:
+            raise InjectedCrash(
+                f"injected crash between write and publish "
+                f"(save #{self.saves})")
+        super().replace(tmp, path)

@@ -49,9 +49,35 @@ import jax
 
 from apex_tpu import checkpoint as _ckpt
 from apex_tpu.checkpoint import TemplateMismatchError
+from apex_tpu.telemetry import hostmetrics as _hostmetrics
 from apex_tpu.telemetry.spans import span
 
 Pytree = Any
+
+
+def _rollback_snapshot(optimizer):
+    """Capture the optimizer as it came in, so a restore walk that a
+    peer rejects (or that ends fresh-start after a local success) can
+    undo its mutation.  Bucket granularity when packed — one device
+    copy per flat buffer; the packed fast path's safety net must not
+    pay the per-leaf unpack the format exists to avoid."""
+    if optimizer is None:
+        return None
+    if getattr(optimizer, "_plan", None) is not None:
+        return ("packed", optimizer.packed_snapshot())
+    return ("per_leaf", dict(optimizer.state_dict()),
+            getattr(optimizer, "params", None))
+
+
+def _rollback(optimizer, snap) -> None:
+    if snap[0] == "packed":
+        s = snap[1]
+        optimizer.load_packed_snapshot(s["step"], s["hypers"],
+                                       s["param_bufs"],
+                                       s["master_bufs"], s["state"])
+    else:
+        optimizer.load_state_dict(snap[1])
+        optimizer.params = snap[2]
 
 
 class CheckpointManager:
@@ -60,19 +86,27 @@ class CheckpointManager:
     >>> mgr = CheckpointManager(dir, keep=3, every=100)
     >>> for step in range(start, total):
     ...     ...train...
-    ...     mgr.maybe_save(step, opt.params, opt, amp_state=amp_sd)
+    ...     mgr.maybe_save(step, optimizer=opt, amp_state=amp_sd)
     >>> mgr.close()
+
+    ``format="auto"`` (default) writes the bucket-native v2 format
+    whenever the optimizer runs bucketed (one device copy + one d2h
+    per bucket, zero per-leaf unpack — checkpoint.py docstring);
+    ``"v1"`` forces the per-leaf format for interop with old readers.
     """
 
     def __init__(self, directory: str, keep: int = 3, every: int = 100,
-                 all_hosts: bool = False):
+                 all_hosts: bool = False, format: str = "auto"):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         if every < 1:
             raise ValueError(f"every must be >= 1, got {every}")
+        if format not in ("auto", "v1", "v2"):
+            raise ValueError(f"unknown checkpoint format {format!r}")
         self.directory = directory
         self.keep = keep
         self.every = every
+        self.format = format
         self._writer = (jax.process_index() == 0) or all_hosts
         # per-host file names under all_hosts: hosts on a SHARED
         # filesystem must never race on one path
@@ -181,12 +215,26 @@ class CheckpointManager:
                 out.append(int(m.group(1)))
         return sorted(out)
 
-    def maybe_save(self, step: int, params: Pytree, optimizer=None,
-                   amp_state=None, extra: Optional[Pytree] = None) -> bool:
-        """Save iff ``step`` is on the cadence; returns True if a save
-        was scheduled.  Non-writer hosts no-op (all hosts return the
-        same value, so loops stay in step)."""
-        if step % self.every != 0:
+    def due(self, step: int) -> bool:
+        """True iff ``step`` is on the save cadence — THE predicate
+        ``maybe_save`` applies.  Exposed so step loops can gate
+        expensive checkpoint-argument capture on it (``state_dict()``
+        callbacks device_get; evaluating them on the 99% of steps
+        whose result ``maybe_save`` discards is a per-step host
+        sync)."""
+        return step % self.every == 0
+
+    def maybe_save(self, step: int, params: Pytree = None, optimizer=None,
+                   amp_state=None, extra: Optional[Pytree] = None,
+                   force: bool = False) -> bool:
+        """Save iff ``step`` is on the cadence (or ``force``); returns
+        True if a save was scheduled.  Non-writer hosts no-op (all
+        hosts return the same value, so loops stay in step).
+
+        ``params`` may be None with a bucketed optimizer — the v2 path
+        snapshots the packed buffers directly and never touches the
+        lazily-unpacked ``optimizer.params`` view."""
+        if not self.due(step) and not force:
             return False
         if self._writer:
             # save_training_state first JOINS the previous async save
@@ -199,9 +247,20 @@ class CheckpointManager:
             with span("checkpoint/save"):
                 self._async.save_training_state(
                     self._path(step), params, optimizer=optimizer,
-                    amp_state=amp_state, step=step, extra=extra)
+                    amp_state=amp_state, step=step, extra=extra,
+                    format=self.format)
                 self._gc(in_flight=step)
         return True
+
+    def save(self, step: int, params: Pytree = None, optimizer=None,
+             amp_state=None, extra: Optional[Pytree] = None) -> bool:
+        """Save NOW regardless of cadence — the preemption-notice path
+        (PreemptionGuard/run_elastic call this for the final
+        save-before-exit) and the supervisor's retry-after-failure
+        path."""
+        return self.maybe_save(step, params, optimizer=optimizer,
+                               amp_state=amp_state, extra=extra,
+                               force=True)
 
     def _gc(self, in_flight: Optional[int] = None) -> None:
         """Trim to the newest ``keep`` checkpoints, never counting (or
@@ -215,8 +274,8 @@ class CheckpointManager:
                 pass
 
     def restore_latest(self, params_like: Pytree, optimizer=None,
-                       extra_like: Optional[Pytree] = None
-                       ) -> Optional[Tuple]:
+                       extra_like: Optional[Pytree] = None,
+                       sharding=None) -> Optional[Tuple]:
         """Resume from the newest VALID checkpoint, or None if none.
 
         Corrupt/truncated files (the artifact of dying mid-write) are
@@ -236,23 +295,23 @@ class CheckpointManager:
         # a load that succeeds locally but is rejected by a peer has
         # already mutated the optimizer; snapshot so a walk that ends
         # fresh-start leaves the optimizer as it came in
-        snap = None
-        if optimizer is not None:
-            snap = (dict(optimizer.state_dict()),
-                    getattr(optimizer, "params", None))
+        snap = _rollback_snapshot(optimizer)
         dirty = False
         with span("checkpoint/restore"):
-            return self._restore_walk(params_like, optimizer, extra_like,
-                                      snap, dirty)
+            out = self._restore_walk(params_like, optimizer, extra_like,
+                                     snap, dirty, sharding)
+        if out is not None:
+            _hostmetrics.emit("ckpt/restore_step", out[2])
+        return out
 
     def _restore_walk(self, params_like, optimizer, extra_like, snap,
-                      dirty):
+                      dirty, sharding=None):
         for step in self._agreed_steps():
             out, code, tmpl_err = None, self._LOAD_OK, None
             try:
                 out = _ckpt.load_training_state(
                     self._path(step), params_like, optimizer=optimizer,
-                    extra_like=extra_like)
+                    extra_like=extra_like, sharding=sharding)
             except TemplateMismatchError as e:
                 # caller bug (intact file, wrong tree) — but raising
                 # HERE on one host would strand its peers in the next
@@ -275,8 +334,7 @@ class CheckpointManager:
                     # back to fresh training must not inherit a
                     # half-restored optimizer while its peers are
                     # pristine
-                    optimizer.load_state_dict(snap[0])
-                    optimizer.params = snap[1]
+                    _rollback(optimizer, snap)
                 if tmpl_err is not None:
                     raise tmpl_err
                 raise TemplateMismatchError(
@@ -294,8 +352,7 @@ class CheckpointManager:
                     f"restore_latest: step {step} loaded here but "
                     "failed on another host; falling back together")
         if dirty and snap is not None:
-            optimizer.load_state_dict(snap[0])
-            optimizer.params = snap[1]
+            _rollback(optimizer, snap)
         return None
 
     def wait(self) -> None:

@@ -68,12 +68,14 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
         return 1
     schema, records = load_jsonl(resolved)
     steps = [r for r in records if r.get("kind", "step") == "step"]
-    # span/retrace records are cumulative snapshots: keep the newest
-    # per name
-    spans, retraces = {}, {}
+    # span/counter/retrace records are cumulative snapshots: keep the
+    # newest per name
+    spans, counters, retraces = {}, {}, {}
     for r in records:
         if r.get("kind") == "span":
             spans[r["name"]] = r
+        elif r.get("kind") == "counter":
+            counters[r["name"]] = r
         elif r.get("kind") == "retrace":
             retraces[r["name"]] = r
     if not steps:
@@ -96,6 +98,8 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
                    "overflow_steps": overflows,
                    "spans": sorted(spans.values(),
                                    key=lambda r: r["name"]),
+                   "counters": sorted(counters.values(),
+                                      key=lambda r: r["name"]),
                    "retraces": sorted(retraces.values(),
                                       key=lambda r: r["name"])},
                   out)
@@ -119,6 +123,15 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
             [[n, str(s.get("count", "-")), _fmt_cell(s.get("total_ms")),
               _fmt_cell(s.get("max_ms"))]
              for n, s in sorted(spans.items())], out)
+    if counters:
+        # host counters (ckpt/save_ms, ckpt/bytes_written, ...):
+        # count/total/max/last, cumulative like the span table
+        print("\ncounters (cumulative):", file=out)
+        _render_table(
+            ["name", "count", "total", "max", "last"],
+            [[n, str(c.get("count", "-")), _fmt_cell(c.get("total")),
+              _fmt_cell(c.get("max")), _fmt_cell(c.get("last"))]
+             for n, c in sorted(counters.items())], out)
     if retraces:
         print("\ncompilation:", file=out)
         _render_table(

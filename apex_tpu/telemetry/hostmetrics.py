@@ -1,0 +1,62 @@
+"""Host-side value counters — the span layer's sibling for metrics
+that are a NUMBER, not a duration-of-a-with-block.
+
+The checkpoint path is the motivating producer: ``ckpt/save_ms`` (wall
+time of one packed write, measured on the async worker), ``ckpt/
+bytes_written``, ``ckpt/blocked_ms`` (time ``save()`` spent waiting on
+a previous in-flight write) and ``ckpt/restore_step``.  These are host
+floats produced OUTSIDE the jitted step — often on another thread —
+so the device metric ring is the wrong transport; like spans, they
+aggregate host-side and ride the session's next window flush as
+``kind: "counter"`` records, rendered by ``python -m apex_tpu.telemetry
+summarize`` next to the span tables.
+
+Producers call :func:`emit`; a :class:`~.session.Telemetry` session
+registers a :class:`CounterStats` sink.  With no session active,
+``emit`` is a list-truthiness no-op (the ``_tape`` discipline: library
+code never pays for telemetry that is off).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from apex_tpu.telemetry._sinks import SinkRegistry
+
+_registry = SinkRegistry()
+add_sink = _registry.add
+remove_sink = _registry.remove
+
+
+def emit(name: str, value: float) -> None:
+    """Report one host scalar to every registered sink (thread-safe;
+    no-op without sinks)."""
+    _registry.emit(name, float(value))
+
+
+class CounterStats:
+    """Per-name aggregate a session keeps between flushes: count,
+    total, max and the LAST value (``ckpt/restore_step`` is a
+    last-wins gauge; ``ckpt/bytes_written`` reads as its total)."""
+
+    def __init__(self):
+        self._stats: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, value: float) -> None:
+        with self._lock:
+            st = self._stats.setdefault(name, [0, 0.0, float("-inf"), 0.0])
+            st[0] += 1
+            st[1] += value
+            st[2] = max(st[2], value)
+            st[3] = value
+
+    def records(self, step=None) -> List[dict]:
+        """Cumulative ``kind: "counter"`` records (one per name)."""
+        with self._lock:
+            return [{"kind": "counter", "name": name, "count": int(st[0]),
+                     "total": round(st[1], 3), "max": round(st[2], 3),
+                     "last": round(st[3], 3),
+                     **({"step": step} if step is not None else {})}
+                    for name, st in sorted(self._stats.items())]

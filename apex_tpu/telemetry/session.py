@@ -38,6 +38,7 @@ from typing import List, Optional, Sequence
 import jax
 
 from apex_tpu.telemetry import _tape
+from apex_tpu.telemetry import hostmetrics as _hostmetrics
 from apex_tpu.telemetry.emitters import (CsvEmitter, Emitter, JsonlEmitter,
                                          StepLogger)
 from apex_tpu.telemetry.retrace import RetraceCounter
@@ -104,6 +105,11 @@ class Telemetry:
                     metrics=self.ring.metrics))
         self.spans = SpanStats()
         add_sink(self.spans.add)
+        # host counters (ckpt/save_ms, ckpt/bytes_written, ...): like
+        # spans they aggregate host-side — possibly on another thread,
+        # e.g. the AsyncCheckpointer worker — and ride the next flush
+        self.counters = _hostmetrics.CounterStats()
+        _hostmetrics.add_sink(self.counters.add)
         self.retrace: Optional[RetraceCounter] = None
         if retrace:
             self.retrace = RetraceCounter()
@@ -201,6 +207,7 @@ class Telemetry:
         if records:
             self._flushed_upto = records[-1]["step"]
         extras = self.spans.records(step=self._last_step)
+        extras += self.counters.records(step=self._last_step)
         if self.retrace is not None:
             extras += self.retrace.records(step=self._last_step)
         for e in self._emitters:
@@ -216,6 +223,7 @@ class Telemetry:
         for e in self._emitters:
             e.close()
         remove_sink(self.spans.add)
+        _hostmetrics.remove_sink(self.counters.add)
         if self.retrace is not None:
             self.retrace.uninstall()
 

@@ -21,23 +21,14 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Callable, Dict, List
+from typing import Dict, List
 
 from apex_tpu.pyprof import nvtx
+from apex_tpu.telemetry._sinks import SinkRegistry
 
-_SINKS: List[Callable[[str, float], None]] = []
-_lock = threading.Lock()
-
-
-def add_sink(fn: Callable[[str, float], None]) -> None:
-    with _lock:
-        _SINKS.append(fn)
-
-
-def remove_sink(fn: Callable[[str, float], None]) -> None:
-    with _lock:
-        if fn in _SINKS:
-            _SINKS.remove(fn)
+_registry = SinkRegistry()
+add_sink = _registry.add
+remove_sink = _registry.remove
 
 
 @contextlib.contextmanager
@@ -52,10 +43,7 @@ def span(name: str):
     finally:
         dt = time.perf_counter() - t0
         nvtx.range_pop()
-        with _lock:
-            sinks = list(_SINKS)
-        for fn in sinks:
-            fn(name, dt)
+        _registry.emit(name, dt)
 
 
 class SpanStats:

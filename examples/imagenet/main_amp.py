@@ -48,7 +48,21 @@ def parse_args():
                    help="convert BatchNorm to SyncBatchNorm over the "
                         "'data' mesh axis (reference: --sync_bn + "
                         "apex.parallel.convert_syncbn_model)")
-    p.add_argument("--checkpoint", default="")
+    p.add_argument("--checkpoint", default="",
+                   help="single-file checkpoint bundle (load + final "
+                        "save; the legacy path)")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="rotating crash-safe checkpoints via "
+                        "resilience.CheckpointManager (bucket-native "
+                        "v2, resume-from-newest-valid; overrides "
+                        "--checkpoint)")
+    p.add_argument("--save-every", type=int, default=10,
+                   help="checkpoint cadence in steps "
+                        "(--checkpoint-dir)")
+    p.add_argument("--preempt-at-step", type=int, default=None,
+                   help="simulate a preemption notice at step N: "
+                        "forced final checkpoint, clean exit "
+                        "(--checkpoint-dir; SIGTERM does the same)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (hosted-TPU images "
                         "override JAX_PLATFORMS; see apex_tpu.platform)")
@@ -129,7 +143,25 @@ def main():
         jstep = jax.jit(train_step)
 
     step0 = 0
-    if args.checkpoint:
+    mgr = guard = None
+    if args.checkpoint_dir:
+        # the resilient save path: rotating bucket-native checkpoints,
+        # resume-from-newest-valid, SIGTERM -> final-save-then-exit
+        from apex_tpu.resilience import (CheckpointManager,
+                                         PreemptionGuard)
+        mgr = CheckpointManager(args.checkpoint_dir, keep=3,
+                                every=args.save_every)
+        guard = PreemptionGuard(
+            preempt_at_step=args.preempt_at_step).install()
+        out = mgr.restore_latest(opt.params, opt,
+                                 extra_like=batch_stats)
+        if out is not None:
+            _, amp_sd, step0, batch_stats = out
+            if amp_sd:
+                amp_state = amp_state.load_state_dict(amp_sd)
+            print(f"resumed at step {step0} "
+                  f"scale {float(amp_state.scaler.loss_scale):.0f}")
+    elif args.checkpoint:
         import os
         if os.path.exists(args.checkpoint):
             p_, amp_sd, step0, batch_stats = \
@@ -151,16 +183,20 @@ def main():
     # pre-generate a few host batches and cycle them: keeps the H2D
     # pipeline honest without making single-threaded numpy RNG the
     # bottleneck at TPU batch sizes
+    remaining = max(0, args.steps - step0)   # --steps is the TOTAL:
+    #                                          a resumed run finishes
+    #                                          it, not steps more
     pool = [(nrng.standard_normal(
                  (batch, size, size, 3), dtype=np.float32),
              nrng.integers(0, 1000, (batch,)).astype(np.int32))
-            for _ in range(min(4, args.steps))]
+            for _ in range(min(4, remaining))]
 
     prefetcher = DevicePrefetcher(
-        (pool[i % len(pool)] for i in range(args.steps)), depth=2,
+        (pool[i % len(pool)] for i in range(remaining)), depth=2,
         sharding=comm.sharding("data") if args.ddp else None)
 
     t0 = None
+    done = step0                      # completed steps (1-based count)
     for step, (x, y) in enumerate(prefetcher, start=step0):
         loss, grads, batch_stats, found_inf = jstep(
             opt.params, batch_stats, amp_state.scaler, x, y)
@@ -168,6 +204,27 @@ def main():
         # `if int(found_inf) == 0` gate synced the host every step)
         opt.step(grads, found_inf=found_inf)
         amp_state = amp.update_scaler(amp_state, found_inf)
+        done = step + 1
+        if mgr is not None:
+            # capture amp state only on cadence steps: state_dict()
+            # device_gets the loss scale, and a per-step host sync is
+            # the hazard this loop's branch-free skip exists to avoid
+            saved_now = mgr.due(done) and mgr.maybe_save(
+                done, optimizer=opt, amp_state=amp_state.state_dict(),
+                extra=batch_stats)
+            if guard.check(done):
+                # preemption notice: make this step durable, clean
+                # exit — rerun to resume.  A cadence save just
+                # scheduled for this step only needs the wait, not a
+                # second full write inside the grace window
+                if not saved_now:
+                    mgr.save(done, optimizer=opt,
+                             amp_state=amp_state.state_dict(),
+                             extra=batch_stats)
+                mgr.wait()
+                print(f"preempted: final checkpoint durable at "
+                      f"step {done} — rerun to resume")
+                break
         if step == step0:
             jax.block_until_ready(loss)
             t0 = time.time()          # skip compile in throughput
@@ -176,11 +233,23 @@ def main():
             print(f"step {step:4d} loss {float(loss):.4f} "   # apexlint: disable=APX102
                   f"scale {float(amp_state.scaler.loss_scale):.0f}")   # apexlint: disable=APX102
     jax.block_until_ready(opt.params)
-    n_timed = args.steps - 1
-    if t0 and n_timed > 0:
+    preempted = guard is not None and guard.preempted
+    n_timed = done - step0 - 1       # t0 starts after the first
+    #                                  (compile) step of THIS run
+    if t0 and n_timed > 0 and not preempted:
         imgs = batch * n_timed / (time.time() - t0)
         print(f"throughput {imgs:.1f} imgs/sec")
-    if args.checkpoint:
+    if mgr is not None:
+        if not preempted:
+            mgr.save(done, optimizer=opt,
+                     amp_state=amp_state.state_dict(),
+                     extra=batch_stats)
+            mgr.wait()
+            print(f"checkpointed to {args.checkpoint_dir} "
+                  f"(step {done})")
+        guard.uninstall()
+        mgr.close()
+    elif args.checkpoint:
         checkpoint.save_training_state(
             args.checkpoint, opt.params, opt,
             amp_state=amp_state.state_dict(),

@@ -8,10 +8,19 @@ skip), FusedLayerNorm — and optional run telemetry: pass
 loss / grad norm / loss scale / overflow into a device-side metric
 ring, flushed to ``DIR/telemetry.jsonl`` once per window and rendered
 afterwards by ``python -m apex_tpu.telemetry summarize DIR``.
+
+Elastic resilience (the acceptance flow a preemptible-fleet user
+copies): ``--checkpoint-dir DIR`` drives the loop through
+``resilience.run_elastic`` — rotating bucket-native (v2) checkpoints
+every ``--save-every`` steps, resume-from-newest-valid on restart, and
+a :class:`~apex_tpu.resilience.PreemptionGuard` that converts SIGTERM
+(or the deterministic ``--preempt-at-step N``) into one final forced
+checkpoint and a clean exit.  Kill it, rerun it, and it continues
+bit-exactly where it left off.
 """
 
+import argparse
 import os
-import sys
 
 import jax
 import jax.numpy as jnp
@@ -41,14 +50,26 @@ def forward(params, x):
     return h @ params["w2"] + params["b2"]
 
 
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--telemetry-dir",
+                   default=os.environ.get("APEX_TPU_TELEMETRY_DIR")
+                   or None,
+                   help="record run telemetry under this directory")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="rotating resilient checkpoints (run_elastic); "
+                        "rerun with the same dir to resume")
+    p.add_argument("--save-every", type=int, default=10,
+                   help="checkpoint cadence in steps")
+    p.add_argument("--preempt-at-step", type=int, default=None,
+                   help="simulate a preemption notice at step N "
+                        "(save-now-then-clean-exit)")
+    return p.parse_args(argv)
+
+
 def main(argv=None):
-    argv = sys.argv[1:] if argv is None else argv
-    tel_dir = os.environ.get("APEX_TPU_TELEMETRY_DIR")
-    if "--telemetry-dir" in argv:
-        at = argv.index("--telemetry-dir")
-        if at + 1 >= len(argv):
-            raise SystemExit("usage: train_toy.py [--telemetry-dir DIR]")
-        tel_dir = argv[at + 1]
+    args = parse_args(argv)
 
     from apex_tpu.platform import select_platform
     select_platform()          # honor APEX_TPU_PLATFORM (e.g. cpu)
@@ -65,7 +86,8 @@ def main(argv=None):
     # branch-free skip inside opt.step
     pipe = amp.FlatGradPipeline(optimizer=opt)
 
-    tel = telemetry.Telemetry(tel_dir, window=16) if tel_dir else None
+    tel = telemetry.Telemetry(args.telemetry_dir, window=16) \
+        if args.telemetry_dir else None
 
     xk, yk = jax.random.split(jax.random.key(1))
     x = jax.random.normal(xk, (256, 64))
@@ -76,36 +98,74 @@ def main(argv=None):
         pred = forward(p, x.astype(jnp.bfloat16))
         return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
 
+    box = {"amp": amp_state}
     losses = []
-    for step in range(60):
+
+    def train_one(step):
         loss, flat = pipe.scaled_value_and_grad(
-            loss_fn, amp_state.scaler, opt.params, x, y)
+            loss_fn, box["amp"].scaler, opt.params, x, y)
         opt.step(flat)                    # skips itself on overflow
-        amp_state = amp.update_scaler(amp_state, flat.found_inf)
+        box["amp"] = amp.update_scaler(box["amp"], flat.found_inf)
         if tel is not None:
             # on-device scalars straight into the ring: the host fetch
             # happens once per window at the flush, not here
             tel.record({"loss": loss, "amp/grad_norm": flat.grad_norm,
                         "amp/clip_coef": flat.clip_coef,
-                        **amp_state.telemetry_values()}, step)
+                        **box["amp"].telemetry_values()}, step)
         losses.append(float(loss))
         if step % 10 == 0:
             # 1-in-10-steps console echo; the per-step record above
             # already lands these in the ring without a sync
             print(f"step {step:3d} loss {losses[-1]:.4f} "
-                  f"scale {float(amp_state.scaler.loss_scale):.0f} "   # apexlint: disable=APX102
+                  f"scale {float(box['amp'].scaler.loss_scale):.0f} "   # apexlint: disable=APX102
                   f"inf {int(flat.found_inf)}")   # apexlint: disable=APX102
 
+    preempted = False
+    resumed = False
+    if args.checkpoint_dir:
+        from apex_tpu.resilience import (CheckpointManager,
+                                         PreemptionGuard, run_elastic)
+        with CheckpointManager(args.checkpoint_dir, keep=3,
+                               every=args.save_every) as mgr:
+            res = run_elastic(
+                train_one, mgr, opt, total_steps=args.steps,
+                guard=PreemptionGuard(
+                    preempt_at_step=args.preempt_at_step),
+                save_extras=lambda: {
+                    "amp_state": box["amp"].state_dict()},
+                on_restore=lambda amp_sd, extra, step: box.update(
+                    amp=box["amp"].load_state_dict(amp_sd))
+                if amp_sd else None)
+        if res.restored_from is not None:
+            resumed = True
+            print(f"resumed at step {res.restored_from}")
+        preempted = res.preempted
+        if preempted:
+            print(f"preempted: final checkpoint durable at step "
+                  f"{res.step} — rerun to resume")
+    else:
+        for step in range(1, args.steps + 1):
+            train_one(step)
+
+    final_loss = None
     if tel is not None:
         with telemetry.span("toy/final_eval"):
-            final = float(loss_fn(opt.params, x, y))
-        print(f"final eval loss {final:.4f}")
+            final_loss = float(loss_fn(opt.params, x, y))
+        print(f"final eval loss {final_loss:.4f}")
         tel.close()
-        print(f"telemetry written to {tel_dir} — inspect with: "
-              f"python -m apex_tpu.telemetry summarize {tel_dir}")
+        print(f"telemetry written to {args.telemetry_dir} — inspect "
+              f"with: python -m apex_tpu.telemetry summarize "
+              f"{args.telemetry_dir}")
 
-    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
-    print(f"OK: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    if preempted:
+        return                       # partial run: no convergence bar
+    if final_loss is None:
+        final_loss = float(loss_fn(opt.params, x, y))
+    if not resumed:                  # fresh run saw the early loss
+        assert final_loss < losses[0] * 0.2, (losses[0], final_loss)
+        print(f"OK: loss {losses[0]:.3f} -> {final_loss:.3f}")
+    else:                            # resumed mid-descent
+        print(f"OK: resumed, final loss {final_loss:.3f}")
 
 
 if __name__ == "__main__":

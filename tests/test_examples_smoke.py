@@ -45,6 +45,50 @@ def test_train_toy_runs_and_converges(capsys):
     assert "OK: loss" in capsys.readouterr().out
 
 
+def test_train_toy_preempt_and_resume(tmp_path, capsys):
+    """Kill-and-resume the toy run — the acceptance flow a
+    preemptible-fleet user copies: a preemption notice produces one
+    final durable checkpoint and a clean exit; rerunning with the same
+    --checkpoint-dir resumes from that step and finishes; and the
+    checkpoint telemetry (ckpt/* counters, checkpoint/* spans) renders
+    on the summarize surface."""
+    ckpt = str(tmp_path / "ckpt")
+    tel = str(tmp_path / "telemetry")
+    _run("examples/simple/train_toy.py",
+         ["--steps", "24", "--save-every", "6",
+          "--checkpoint-dir", ckpt, "--preempt-at-step", "10"])
+    out = capsys.readouterr().out
+    assert "preempted: final checkpoint durable at step 10" in out
+    assert "OK" not in out                  # partial run: no bar
+    _run("examples/simple/train_toy.py",
+         ["--steps", "24", "--save-every", "6",
+          "--checkpoint-dir", ckpt, "--telemetry-dir", tel])
+    out = capsys.readouterr().out
+    assert "resumed at step 10" in out and "OK: resumed" in out
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["summarize", tel]) == 0
+    out = capsys.readouterr().out
+    assert "ckpt/save_ms" in out and "checkpoint/save" in out
+
+
+def test_imagenet_preempt_and_resume(tmp_path, capsys):
+    """The imagenet example's save path rides the same resilience
+    manager: --checkpoint-dir rotates bucket-native checkpoints and a
+    preemption notice leaves a resumable final one."""
+    ckpt = str(tmp_path / "ckpt")
+    common = ["--cpu", "--batch-size", "2", "--image-size", "32",
+              "--arch", "resnet18", "--save-every", "3",
+              "--checkpoint-dir", ckpt]
+    _run("examples/imagenet/main_amp.py",
+         common + ["--steps", "6", "--preempt-at-step", "4"])
+    out = capsys.readouterr().out
+    assert "preempted: final checkpoint durable at step 4" in out
+    _run("examples/imagenet/main_amp.py", common + ["--steps", "6"])
+    out = capsys.readouterr().out
+    # --steps is the TOTAL: the resumed run finishes at 6, not 4+6
+    assert "resumed at step 4" in out and "(step 6)" in out
+
+
 def test_imagenet_tiny_cpu(capsys):
     _run("examples/imagenet/main_amp.py",
          ["--cpu", "--steps", "2", "--batch-size", "2",

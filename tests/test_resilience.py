@@ -290,3 +290,921 @@ def test_multihost_fatal_abort_rolls_back_local_optimizer(tmp_path):
         mgr.restore_latest({"w": jnp.zeros((64,))}, opt)
     np.testing.assert_array_equal(np.asarray(opt.params["w"]), before)
     mgr.close()
+
+
+# =====================================================================
+# ISSUE 6: bucket-native v2 checkpoints, preemption-safe restart, and
+# the fault-injection chaos matrix.
+# =====================================================================
+
+import signal
+import threading
+import time
+
+from apex_tpu import checkpoint as ckpt_mod
+from apex_tpu.amp import LossScaler
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.resilience import ElasticResult, PreemptionGuard, run_elastic
+from apex_tpu.resilience.faults import (FaultInjector, FaultSpec,
+                                        InjectedCrash)
+
+
+def _mixed_tree():
+    """Small mixed-dtype tree: bf16 matmul weights + f32 vectors — two
+    dtype buckets, auto-created f32 masters (the amp-O2 state mix)."""
+    return {
+        "w1": jnp.linspace(-1.0, 1.0, 256).astype(jnp.bfloat16
+                                                  ).reshape(16, 16),
+        "b1": jnp.linspace(0.0, 1.0, 16).astype(jnp.float32),
+        "w2": jnp.linspace(0.5, -0.5, 64).astype(jnp.bfloat16
+                                                 ).reshape(8, 8),
+        "s": jnp.ones((3,), jnp.float32),
+    }
+
+
+def _grads_for(tree):
+    return jax.tree_util.tree_map(
+        lambda p: (p.astype(jnp.float32) * 1e-2 + 1e-3).astype(p.dtype),
+        tree)
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _opt_states_equal(o1, o2):
+    s1, s2 = o1.state_dict(), o2.state_dict()
+    assert int(s1["step"]) == int(s2["step"])
+    _assert_tree_equal(s1["state"], s2["state"])
+    _assert_tree_equal(o1.params, o2.params)
+    if s1.get("masters") is not None or s2.get("masters") is not None:
+        _assert_tree_equal(s1["masters"], s2["masters"])
+
+
+# ---------------------------------------------------------------------
+# Format v2: bucket-native packed checkpoints
+# ---------------------------------------------------------------------
+
+def test_v2_roundtrip_packed_fast_path(tmp_path):
+    """v2 save from a bucketed optimizer restores onto an identically
+    planned optimizer via direct buffer adoption — and the file really
+    is the v2 format."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    for _ in range(3):
+        opt.step(g)
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=3,
+                                 amp_state={"loss_scale": 8.0})
+    header = ckpt_mod.read_checkpoint_header(p)
+    assert header["magic"] == "APEX_TPU_CKPT_V2"
+    assert header["plan"]["paths"]          # leaf identities recorded
+
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+    params, amp_sd, step = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+    assert step == 3 and amp_sd == {"loss_scale": 8.0}
+    _opt_states_equal(opt, opt2)
+    _assert_tree_equal(params, opt.params)
+
+
+def test_v2_save_does_zero_per_leaf_unpack(tmp_path, monkeypatch):
+    """Structural acceptance: the bucket-native save is exactly one
+    device copy + one d2h per packed buffer — plan.unpack* is never
+    called and no per-leaf traffic happens."""
+    from apex_tpu.multi_tensor_apply.packer import BucketPlan
+    from apex_tpu.optimizers import _base as base_mod
+
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+
+    def _boom(*a, **k):
+        raise AssertionError("per-leaf unpack on the v2 save path")
+    monkeypatch.setattr(BucketPlan, "unpack", _boom)
+    monkeypatch.setattr(BucketPlan, "unpack_model", _boom)
+    monkeypatch.setattr(BucketPlan, "unpack_state_field", _boom,
+                        raising=False)
+
+    copies, transfers = [], []
+    real_copy, real_d2h = base_mod._device_copy, ckpt_mod._d2h
+    monkeypatch.setattr(base_mod, "_device_copy",
+                        lambda b: copies.append(1) or real_copy(b))
+    monkeypatch.setattr(ckpt_mod, "_d2h",
+                        lambda b: transfers.append(1) or real_d2h(b))
+
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+
+    n_bufs = (len(opt._param_bufs)
+              + (len(opt._master_bufs) if opt._master_bufs else 0)
+              + sum(len(v) for v in opt.opt_state.values()))
+    assert len(copies) == n_bufs        # ONE device copy per buffer
+    assert len(transfers) == n_bufs     # ONE d2h per buffer
+    assert ckpt_mod.read_checkpoint_header(p)["magic"] == \
+        "APEX_TPU_CKPT_V2"
+
+
+def test_v1_file_loads_into_bucketed_and_v2_into_perleaf(tmp_path):
+    """Format interop both ways: v1 -> bucketed optimizer, and v2 ->
+    fuse_buckets=False optimizer (the per-leaf fallback flow)."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    for _ in range(2):
+        opt.step(g)
+
+    p1 = str(tmp_path / "v1.ckpt")
+    ckpt_mod.save_training_state(p1, optimizer=opt, step=2, format="v1")
+    assert ckpt_mod.read_checkpoint_header(p1)["magic"] == \
+        "APEX_TPU_CKPT_V1"
+    opt_b = FusedAdam(_mixed_tree(), lr=1e-2)
+    ckpt_mod.load_training_state(
+        p1, jax.tree_util.tree_map(jnp.zeros_like, tree), opt_b)
+    _opt_states_equal(opt, opt_b)
+
+    p2 = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p2, optimizer=opt, step=2, format="v2")
+    opt_pl = FusedAdam(_mixed_tree(), lr=1e-2, fuse_buckets=False)
+    assert opt_pl._plan is None
+    params, _, step = ckpt_mod.load_training_state(
+        p2, jax.tree_util.tree_map(jnp.zeros_like, tree), opt_pl)
+    assert step == 2
+    _opt_states_equal(opt, opt_pl)
+
+
+def test_v2_requires_bucketed_optimizer(tmp_path):
+    opt = FusedAdam(_mixed_tree(), lr=1e-2, fuse_buckets=False)
+    with pytest.raises(ValueError, match="bucketed"):
+        ckpt_mod.save_training_state(str(tmp_path / "x.ckpt"),
+                                     optimizer=opt, format="v2")
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 8])
+def test_v2_reshard_restore_onto_different_device_count(tmp_path, ndev):
+    """A v2 checkpoint restores onto a different mesh size via
+    ``sharding=`` (conftest forces 8 faked CPU devices)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+
+    devs = jax.devices()[:ndev]
+    sharding = NamedSharding(Mesh(np.array(devs), ("x",)),
+                             PartitionSpec())    # replicated over ndev
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+    params, _, step = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2,
+        sharding=sharding)
+    assert step == 1
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert len(leaf.sharding.device_set) == ndev
+    _assert_tree_equal(params, opt.params)
+    _opt_states_equal(opt, opt2)
+
+
+def test_v2_extra_section_roundtrip(tmp_path):
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    extra = {"bn": {"mean": jnp.arange(4.0), "var": jnp.ones((4,))}}
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1, extra=extra)
+    out = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree),
+        FusedAdam(_mixed_tree(), lr=1e-2),
+        extra_like=jax.tree_util.tree_map(jnp.zeros_like, extra))
+    _assert_tree_equal(out[3], extra)
+
+
+def test_v2_reshard_places_optimizer_state_on_sharding(tmp_path):
+    """Flow (iii) reshards the WHOLE training state: optimizer moments
+    must land on the requested sharding alongside params/masters (a
+    model that only fits sharded would otherwise OOM device 0)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    ndev = min(8, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices")
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+
+    sharding = NamedSharding(Mesh(np.array(jax.devices()[:ndev]), ("x",)),
+                             PartitionSpec())    # replicated over ndev
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2, fuse_buckets=False)
+    ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2,
+        sharding=sharding)
+    for field, leaves in opt2.opt_state.items():
+        for leaf in jax.tree_util.tree_leaves(leaves):
+            assert len(leaf.sharding.device_set) == ndev, field
+    _opt_states_equal(opt, opt2)
+
+
+def test_explicit_params_are_honored_not_dropped(tmp_path):
+    """``format='auto'`` with a caller-supplied params pytree (EMA /
+    averaged weights distinct from the training weights) must save
+    THOSE weights via per-leaf v1 — not silently snapshot the
+    optimizer's packed training params; ``format='v2'`` rejects the
+    combination loudly."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    ema = jax.tree_util.tree_map(
+        lambda p: (p.astype(jnp.float32) * 0.5).astype(p.dtype), tree)
+    p = str(tmp_path / "ema.ckpt")
+    ckpt_mod.save_training_state(p, ema, opt, step=1)
+    out = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree),
+        FusedAdam(_mixed_tree(), lr=1e-2))
+    _assert_tree_equal(out[0], ema)
+    with pytest.raises(ValueError):
+        ckpt_mod.save_training_state(p, ema, opt, step=1, format="v2")
+
+
+def test_v2_extra_python_scalar_leaves_roundtrip(tmp_path):
+    """Python int/float extra leaves must round-trip: the header dtype
+    has to match the bytes numpy actually writes (a float32 default
+    would shift every later extra leaf's payload offset)."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    extra = {"epoch": 3, "best_loss": 0.125,
+             "bn_mean": jnp.arange(4.0)}
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1, extra=extra)
+    out = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree),
+        FusedAdam(_mixed_tree(), lr=1e-2),
+        extra_like={"epoch": 0, "best_loss": 0.0,
+                    "bn_mean": jnp.zeros((4,))})
+    got = out[3]
+    assert int(got["epoch"]) == 3
+    assert float(got["best_loss"]) == 0.125
+    np.testing.assert_array_equal(np.asarray(got["bn_mean"]),
+                                  np.arange(4.0))
+
+
+def test_v2_masters_presence_mismatch_raises_not_partial_load(tmp_path):
+    """A checkpoint without master weights must NOT load into an
+    optimizer that keeps them (or vice versa): load_state_dict would
+    keep the freshly-initialized masters while params restore —
+    silent divergence on the next step.  Fail loudly instead."""
+    from apex_tpu.checkpoint import TemplateMismatchError
+    tree = _mixed_tree()
+    opt_nomaster = FusedAdam(tree, lr=1e-2, master_weights=False)
+    opt_nomaster.step(_grads_for(tree))
+    p = str(tmp_path / "nm.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt_nomaster, step=1)
+    with pytest.raises(TemplateMismatchError, match="master"):
+        ckpt_mod.load_training_state(
+            p, jax.tree_util.tree_map(jnp.zeros_like, tree),
+            FusedAdam(_mixed_tree(), lr=1e-2))    # auto-masters
+    with pytest.raises(TemplateMismatchError, match="master"):
+        ckpt_mod.load_training_state(
+            p, jax.tree_util.tree_map(jnp.zeros_like, tree),
+            FusedAdam(_mixed_tree(), lr=1e-2, fuse_buckets=False))
+
+
+def test_v2_template_mismatch_raises(tmp_path):
+    from apex_tpu.checkpoint import TemplateMismatchError
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+    bad = dict(tree)
+    bad["b1"] = jnp.zeros((99,), jnp.float32)     # wrong shape
+    with pytest.raises(TemplateMismatchError):
+        ckpt_mod.load_training_state(p, bad)
+
+
+def test_v2_async_double_buffer_survives_next_step(tmp_path):
+    """The async packed save must capture the state as of schedule
+    time: stepping the optimizer right after scheduling (donating the
+    old opt_state buffers) must not corrupt the in-flight write."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    opt.step(g)
+    want = {k: [np.asarray(b) for b in v]
+            for k, v in opt.opt_state.items()}
+    want_params = [np.asarray(b) for b in opt._param_bufs]
+    p = str(tmp_path / "v2.ckpt")
+    with ckpt_mod.AsyncCheckpointer() as ac:
+        ac.save_training_state(p, optimizer=opt, step=1)
+        for _ in range(3):                 # donates old opt_state
+            opt.step(g)
+        ac.wait_until_finished()
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+    ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+    for k, v in opt2.opt_state.items():
+        for got, exp in zip(v, want[k]):
+            np.testing.assert_array_equal(np.asarray(got), exp)
+    for got, exp in zip(opt2._param_bufs, want_params):
+        np.testing.assert_array_equal(np.asarray(got), exp)
+
+
+def test_manager_v2_auto_and_packed_restore(tmp_path):
+    """CheckpointManager writes v2 for a bucketed optimizer with
+    params=None (no lazy unpack touched) and restores it packed."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    with CheckpointManager(str(tmp_path), keep=2, every=2) as mgr:
+        for step in range(1, 5):
+            opt.step(g)
+            mgr.maybe_save(step, optimizer=opt)
+        mgr.wait()
+        newest = max(mgr.steps_on_disk())
+        assert ckpt_mod.read_checkpoint_header(
+            mgr._path(newest))["magic"] == "APEX_TPU_CKPT_V2"
+        opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+        out = mgr.restore_latest(
+            jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+        assert out is not None and out[2] == 4
+        _opt_states_equal(opt, opt2)
+
+
+# ---------------------------------------------------------------------
+# AsyncCheckpointer._join failure context (satellite)
+# ---------------------------------------------------------------------
+
+def test_async_join_attaches_failed_save_identity(tmp_path):
+    """A worker failure surfaces at the NEXT call — the re-raised
+    exception must carry the FAILED write's path and step so the
+    traceback is attributable."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    bad = str(tmp_path / "bad.ckpt")
+    good = str(tmp_path / "good.ckpt")
+    with FaultInjector([FaultSpec("fsync_error", at_save=0)]):
+        ac = ckpt_mod.AsyncCheckpointer()
+        ac.save_training_state(bad, optimizer=opt, step=7)
+        with pytest.raises(OSError) as ei:
+            ac.save_training_state(good, optimizer=opt, step=8)
+        assert ei.value.checkpoint_path == bad
+        assert ei.value.checkpoint_step == 7
+        text = "".join(getattr(ei.value, "__notes__", [])) \
+            or " ".join(str(a) for a in ei.value.args)
+        assert "bad.ckpt" in text and "step 7" in text
+        ac.close()
+
+
+def test_packed_snapshot_of_offloaded_state_stays_on_host(tmp_path):
+    """``offload_state=True`` exists because the moments don't fit in
+    HBM — the bucket-native snapshot must copy them IN PLACE on the
+    host, never stage them through device memory."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2, offload_state=True)
+    opt.step(_grads_for(tree))
+    snap = opt.packed_snapshot()
+    for k, bufs in snap["state"].items():
+        for b in bufs:
+            assert b.sharding.memory_kind in (
+                "pinned_host", "unpinned_host"), k
+    # and the snapshot still round-trips through the v2 file
+    p = str(tmp_path / "off.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2, offload_state=True)
+    ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+    _opt_states_equal(opt, opt2)
+
+
+def test_run_elastic_retries_deferred_final_save_failure(tmp_path):
+    """A transient failure of the LAST cadence save surfaces at the
+    supervisor's final durability wait — it must be retried under the
+    same bounded contract, not propagated after all work completed."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    mgr = CheckpointManager(str(tmp_path), keep=3, every=5)
+    # save ordinals: steps 5 and 10 -> the final (2nd) write fails once
+    with FaultInjector([FaultSpec("fsync_error", at_save=1)]):
+        res = run_elastic(lambda step: opt.step(g), mgr, opt,
+                          total_steps=10, backoff_s=0.0)
+    assert not res.preempted and res.step == 10
+    assert 10 in mgr.steps_on_disk()     # retried write is durable
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+    out = mgr.restore_latest(
+        jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+    assert out is not None and out[2] == 10
+    _opt_states_equal(opt, opt2)
+    mgr.close()
+
+
+def test_preemption_on_cadence_step_writes_once(tmp_path):
+    """A preemption notice landing on a cadence-aligned step must wait
+    on the just-scheduled save, not write the identical checkpoint a
+    second time — 2x write time inside the eviction grace window."""
+    from apex_tpu.telemetry import hostmetrics
+
+    writes = []
+    sink = lambda name, v: name == "ckpt/save_ms" and writes.append(v)
+    hostmetrics.add_sink(sink)
+    try:
+        tree = _mixed_tree()
+        opt = FusedAdam(tree, lr=1e-2)
+        g = _grads_for(tree)
+        mgr = CheckpointManager(str(tmp_path), keep=3, every=2)
+        res = run_elastic(lambda step: opt.step(g), mgr, opt,
+                          total_steps=10,
+                          guard=PreemptionGuard(preempt_at_step=4))
+        mgr.close()
+        assert res.preempted and res.step == 4
+        assert mgr.steps_on_disk() == [2, 4]
+        assert len(writes) == 2          # steps 2 and 4, each ONCE
+    finally:
+        hostmetrics.remove_sink(sink)
+
+
+def test_reshard_with_params_shaped_sharding_pytree(tmp_path):
+    """A PYTREE of per-param shardings must align with the params
+    subtree in both formats — never be zipped across the optimizer
+    state or the extra section (whose trees it does not match)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    ndev = min(8, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("x",))
+    repl = NamedSharding(mesh, PartitionSpec())
+    tree = _mixed_tree()
+    shardings = jax.tree_util.tree_map(lambda _: repl, tree)
+    extra = {"bn": jnp.arange(4.0)}
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    for fmt in ("v2", "v1"):
+        p = str(tmp_path / f"{fmt}.ckpt")
+        ckpt_mod.save_training_state(
+            p, None if fmt == "v2" else opt.params, opt, step=1,
+            extra=extra, format=fmt)
+        opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+        out = ckpt_mod.load_training_state(
+            p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2,
+            extra_like={"bn": jnp.zeros((4,))}, sharding=shardings)
+        for leaf in jax.tree_util.tree_leaves(out[0]):
+            assert len(leaf.sharding.device_set) == ndev, fmt
+        np.testing.assert_array_equal(np.asarray(out[3]["bn"]),
+                                      np.arange(4.0))
+        _opt_states_equal(opt, opt2)
+
+
+def test_run_elastic_optimizer_free_mode_restores_params(tmp_path):
+    """``optimizer=None``: params live in the caller's closure — saves
+    flow through ``save_extras()['params']`` and restores come back
+    through the 4-arg ``on_restore``, without which run_elastic must
+    refuse to start (a resume would silently keep fresh weights)."""
+    like = {"w": jax.ShapeDtypeStruct((8,), jnp.float32)}
+
+    def job(ckpt_dir, total):
+        box = {"w": jnp.zeros((8,))}
+        mgr = CheckpointManager(ckpt_dir, keep=3, every=2)
+        res = run_elastic(
+            lambda step: box.update(w=box["w"] + 1.0), mgr, None,
+            total_steps=total, params_like=like,
+            save_extras=lambda: {"params": dict(box)},
+            on_restore=lambda amp_sd, extra, step, params:
+                box.update(params))
+        mgr.close()
+        return res, box
+
+    with pytest.raises(ValueError):    # 3-arg on_restore can't work
+        run_elastic(lambda s: None,
+                    CheckpointManager(str(tmp_path), keep=1, every=2),
+                    None, total_steps=1, params_like=like,
+                    on_restore=lambda amp_sd, extra, step: None)
+
+    res, _ = job(str(tmp_path), 4)
+    assert res.step == 4 and res.restored_from is None
+    res2, box2 = job(str(tmp_path), 6)
+    assert res2.restored_from == 4 and res2.step == 6
+    np.testing.assert_array_equal(np.asarray(box2["w"]),
+                                  np.full((8,), 6.0))
+
+
+def test_blocked_ms_only_on_save_backpressure(tmp_path):
+    """``ckpt/blocked_ms`` is the SAVE-path backpressure signal: a
+    deliberate durability wait (``wait_until_finished``/``close``) must
+    not emit it, or every run's summarize shows phantom stalls."""
+    from apex_tpu.telemetry import hostmetrics
+
+    class SlowIO(ckpt_mod.CheckpointIO):
+        def write_array(self, f, arr):
+            time.sleep(0.05)
+            super().write_array(f, arr)
+
+    got = []
+    sink = lambda name, value: got.append(name)
+    hostmetrics.add_sink(sink)
+    prev = ckpt_mod.set_io(SlowIO())
+    try:
+        tree = _mixed_tree()
+        opt = FusedAdam(tree, lr=1e-2)
+        opt.step(_grads_for(tree))
+        with ckpt_mod.AsyncCheckpointer() as ac:
+            ac.save_training_state(str(tmp_path / "a.ckpt"),
+                                   optimizer=opt, step=1)
+            ac.wait_until_finished()       # durability wait: NOT blocked
+        assert "ckpt/blocked_ms" not in got
+        with ckpt_mod.AsyncCheckpointer() as ac:
+            ac.save_training_state(str(tmp_path / "b.ckpt"),
+                                   optimizer=opt, step=2)
+            ac.save_training_state(str(tmp_path / "c.ckpt"),
+                                   optimizer=opt, step=3)   # backpressure
+        assert "ckpt/blocked_ms" in got
+    finally:
+        ckpt_mod.set_io(prev)
+        hostmetrics.remove_sink(sink)
+
+
+# ---------------------------------------------------------------------
+# PreemptionGuard
+# ---------------------------------------------------------------------
+
+def test_preemption_guard_sigterm_surfaces_at_step_boundary():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert not guard.check(1)
+        os.kill(os.getpid(), signal.SIGTERM)
+        # handler sets the flag; check at the next boundary sees it
+        deadline = time.time() + 5
+        while not guard.preempted and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.check(2)
+    # the EXACT previous handler restored after uninstall (`is not
+    # guard._on_signal` would be vacuous: attribute access mints a
+    # fresh bound-method object every time)
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_preemption_guard_partial_install_rolls_back():
+    """One invalid entry in a custom signal set must not leave the
+    guard's handler installed on the valid ones — uninstall() would
+    never touch a guard that reports not-installed."""
+    before = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard(signals=(signal.SIGTERM, -1))
+    with pytest.raises(ValueError):
+        guard.install()
+    assert signal.getsignal(signal.SIGTERM) == before
+    assert not guard._installed and not guard._old
+
+
+def test_preemption_guard_at_step_deterministic():
+    guard = PreemptionGuard(preempt_at_step=5)
+    assert not guard.check(4)
+    assert guard.check(5) and guard.check(6)
+
+
+def test_preemption_guard_programmatic_notice():
+    guard = PreemptionGuard()
+    guard.notice()
+    assert guard.check(1)
+
+
+# ---------------------------------------------------------------------
+# run_elastic + the chaos matrix: every fault kind x {single-host,
+# faked multi-host} must resume from the newest valid step with
+# params/optimizer/AMP state bit-identical to an uninterrupted run.
+# ---------------------------------------------------------------------
+
+_TOTAL, _EVERY = 12, 3
+
+
+def _mirror_peer(mgr):
+    """Fake a 2-host cluster whose peer always mirrors this host
+    (shared filesystem): drives the full lockstep agreement code."""
+    def allgather(arr):
+        arr = np.asarray(arr)
+        return np.stack([arr, arr])
+    mgr._allgather = allgather
+    mgr._process_count = lambda: 2
+
+
+class _Job:
+    """One 'process lifetime': freshly built optimizer + scaler + loop
+    state, the way a real restart reconstructs everything."""
+
+    def __init__(self, ckpt_dir, multihost):
+        tree = _mixed_tree()
+        self.opt = FusedAdam(tree, lr=1e-2)
+        self.scaler = LossScaler(loss_scale="dynamic",
+                                 init_scale=2.0 ** 4, scale_window=4)
+        self.g = _grads_for(tree)
+        self.mgr = CheckpointManager(ckpt_dir, keep=3, every=_EVERY)
+        if multihost:
+            _mirror_peer(self.mgr)
+        self.template = jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+    def step_fn(self, step):
+        self.opt.step(self.g)
+        self.scaler.update_scale(0)
+
+    def run(self, guard=None):
+        return run_elastic(
+            self.step_fn, self.mgr, self.opt, total_steps=_TOTAL,
+            params_like=self.template, guard=guard,
+            save_extras=lambda: {"amp_state": self.scaler.state_dict()},
+            on_restore=lambda amp_sd, extra, step:
+                self.scaler.load_state_dict(amp_sd) if amp_sd else None,
+            backoff_s=0.0)
+
+
+def _drive_to_completion(ckpt_dir, multihost):
+    """External-supervisor loop: rebuild the whole job after any crash
+    or preemption (a restarted process has no in-memory state) until
+    run_elastic completes all steps."""
+    for _ in range(6):
+        job = _Job(ckpt_dir, multihost)
+        guard = PreemptionGuard()
+        try:
+            res = job.run(guard=guard)
+        except InjectedCrash:
+            job.mgr.close()
+            continue                     # "process died"; restart
+        if res.preempted:
+            job.mgr.close()              # evicted; scheduler restarts
+            continue
+        job.mgr.close()
+        assert res.step == _TOTAL
+        return job
+    raise AssertionError("chaos run never completed")
+
+
+@pytest.fixture(scope="module")
+def _uninterrupted(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ref")
+    return _drive_to_completion(str(d), multihost=False)
+
+
+_CHAOS = {
+    "truncate": [FaultSpec("truncate", at_save=1)],
+    "fsync_error": [FaultSpec("fsync_error", at_save=1)],
+    "slow_disk": [FaultSpec("slow_disk", at_save=1, delay_s=0.05)],
+    "crash_before_publish": [FaultSpec("crash_before_publish",
+                                       at_save=1)],
+    "preempt": [FaultSpec("preempt", at_step=5)],
+}
+
+
+@pytest.mark.parametrize("multihost", [False, True],
+                         ids=["singlehost", "multihost"])
+@pytest.mark.parametrize("kind", sorted(_CHAOS))
+def test_chaos_resumes_bit_exact(tmp_path, kind, multihost,
+                                 _uninterrupted):
+    with FaultInjector(_CHAOS[kind]) as inj:
+        job = _drive_to_completion(str(tmp_path), multihost)
+        assert inj.fired, "the scheduled fault never fired"
+    ref = _uninterrupted
+    _assert_tree_equal(job.opt.params, ref.opt.params)
+    _opt_states_equal(job.opt, ref.opt)
+    assert job.scaler.state_dict() == ref.scaler.state_dict()
+
+
+def test_preemption_notice_produces_valid_final_checkpoint(tmp_path):
+    """Acceptance: a preemption notice ends the run with a durable,
+    loadable checkpoint at the preempted step."""
+    job = _Job(str(tmp_path), multihost=False)
+    guard = PreemptionGuard(preempt_at_step=5)
+    res = job.run(guard=guard)
+    assert res.preempted and res.step == 5
+    job.mgr.close()
+    # the final checkpoint is valid and newest
+    mgr = CheckpointManager(str(tmp_path), keep=3, every=_EVERY)
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+    out = mgr.restore_latest(job.template, opt2)
+    assert out is not None and out[2] == 5
+    _opt_states_equal(job.opt, opt2)
+    mgr.close()
+
+
+def test_run_elastic_fresh_and_resumed_runs_match(tmp_path):
+    """Kill (preempt) + restart resumes from the preempt step, and the
+    final state matches a run that was never interrupted."""
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    ref = _drive_to_completion(d2, multihost=False)
+
+    job = _Job(d1, multihost=False)
+    res = job.run(guard=PreemptionGuard(preempt_at_step=7))
+    assert res.preempted
+    job.mgr.close()
+    job2 = _Job(d1, multihost=False)
+    res2 = job2.run()
+    assert res2.restored_from == 7 and res2.step == _TOTAL
+    job2.mgr.close()
+    _assert_tree_equal(job2.opt.params, ref.opt.params)
+    assert job2.scaler.state_dict() == ref.scaler.state_dict()
+
+
+def test_run_elastic_exhausts_restarts_and_raises(tmp_path):
+    """More transient failures than max_restarts must propagate, not
+    loop forever."""
+    seed = _Job(str(tmp_path), multihost=False)
+    seed.opt.step(seed.g)
+    seed.mgr.save(3, optimizer=seed.opt)   # something valid to restore
+    seed.mgr.wait()
+    seed.mgr.close()
+
+    job = _Job(str(tmp_path), multihost=False)
+    calls = []
+
+    def bad_step(step):
+        calls.append(step)
+        raise OSError("flaky disk, forever")
+
+    with pytest.raises(OSError):
+        run_elastic(bad_step, job.mgr, job.opt, total_steps=_TOTAL,
+                    params_like=job.template, max_restarts=2,
+                    backoff_s=0.0)
+    # initial attempt + max_restarts recoveries, then give up
+    assert len(calls) == 3
+    job.mgr.close()
+
+
+def test_run_elastic_nothing_to_restore_after_failure_raises(tmp_path):
+    """A retryable failure with NO valid checkpoint to restore onto
+    must raise (restarting 'fresh' would train from a dirty
+    midpoint)."""
+    job = _Job(str(tmp_path), multihost=False)
+    calls = []
+
+    def bad_step(step):
+        calls.append(step)
+        raise OSError("flaky")
+
+    with pytest.raises(OSError):
+        run_elastic(bad_step, job.mgr, job.opt, total_steps=_TOTAL,
+                    params_like=job.template, max_restarts=2,
+                    backoff_s=0.0)
+    assert len(calls) == 1
+    job.mgr.close()
+
+
+def test_run_elastic_nonretryable_propagates(tmp_path):
+    job = _Job(str(tmp_path), multihost=False)
+
+    def bad_step(step):
+        raise RuntimeError("a real bug")
+
+    with pytest.raises(RuntimeError, match="a real bug"):
+        run_elastic(bad_step, job.mgr, job.opt, total_steps=_TOTAL,
+                    params_like=job.template, backoff_s=0.0)
+    job.mgr.close()
+
+
+def test_run_elastic_injob_recovery_counts_restarts(tmp_path):
+    """A transient OSError mid-run is recovered IN-JOB (restore newest
+    valid + resume) and reported in ElasticResult.restarts."""
+    job = _Job(str(tmp_path), multihost=False)
+    failed = []
+
+    real_step = job.step_fn
+
+    def flaky_step(step):
+        if step == 8 and not failed:
+            failed.append(step)
+            raise OSError("transient")
+        real_step(step)
+
+    res = run_elastic(
+        flaky_step, job.mgr, job.opt, total_steps=_TOTAL,
+        params_like=job.template,
+        save_extras=lambda: {"amp_state": job.scaler.state_dict()},
+        on_restore=lambda amp_sd, extra, step:
+            job.scaler.load_state_dict(amp_sd) if amp_sd else None,
+        backoff_s=0.0)
+    assert res.restarts == 1 and res.step == _TOTAL
+    job.mgr.close()
+    ref = _Job(str(tmp_path / "ref"), multihost=False)
+    ref_res = ref.run()
+    assert ref_res.step == _TOTAL
+    ref.mgr.close()
+    _assert_tree_equal(job.opt.params, ref.opt.params)
+
+
+# ---------------------------------------------------------------------
+# checkpoint_snapshot bench smoke (tier-1: proves the harness)
+# ---------------------------------------------------------------------
+
+def test_checkpoint_snapshot_bench_smoke():
+    from apex_tpu.optimizers.bucketing_bench import \
+        bench_checkpoint_snapshot
+    r = bench_checkpoint_snapshot(layers=2, hidden=16, reps=1)
+    assert r["ckpt_snapshot_bucketed_ms"] > 0
+    assert r["ckpt_snapshot_perleaf_ms"] > 0
+    assert r["ckpt_bytes_bucketed"] > 0 and r["ckpt_bytes_perleaf"] > 0
+
+
+# ---------------------------------------------------------------------
+# review-hardening regressions (round 6)
+# ---------------------------------------------------------------------
+
+def test_manager_due_is_the_maybe_save_cadence(tmp_path):
+    """``due(step)`` is THE cadence predicate — callers gate expensive
+    state_dict() capture on it, so it must agree with maybe_save."""
+    mgr = CheckpointManager(str(tmp_path), every=4)
+    assert [s for s in range(1, 13) if mgr.due(s)] == [4, 8, 12]
+    # off-cadence maybe_save returns False without requiring any
+    # checkpoint arguments at all
+    assert not mgr.maybe_save(3)
+    mgr.close()
+
+
+def test_v1_reshard_places_optimizer_state_on_sharding(tmp_path):
+    """The v1 (per-leaf) restore honors a params-shaped sharding
+    pytree across the WHOLE bundle: optimizer moments land on the
+    requested mesh straight from host (staging the bundle on the
+    default device first would OOM exactly the model that only fits
+    sharded); per-tensor scalar state replicates."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    ndev = min(8, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices")
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2, fuse_buckets=False)
+    opt.step(_grads_for(tree))
+    p = str(tmp_path / "v1.ckpt")
+    ckpt_mod.save_training_state(p, opt.params, opt, step=1,
+                                 format="v1")
+
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("x",))
+    repl = NamedSharding(mesh, PartitionSpec())
+    shardings = jax.tree_util.tree_map(lambda _: repl, tree)
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2, fuse_buckets=False)
+    params, _, step = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2,
+        sharding=shardings)
+    assert step == 1
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert len(leaf.sharding.device_set) == ndev
+    for field, leaves in opt2.opt_state.items():
+        for leaf in jax.tree_util.tree_leaves(leaves):
+            assert len(leaf.sharding.device_set) == ndev, field
+    _opt_states_equal(opt, opt2)
+
+
+def test_load_packed_snapshot_offload_adopts_on_host(tmp_path,
+                                                     monkeypatch):
+    """Restoring v2 into an ``offload_state=True`` optimizer commits
+    each state buffer straight onto the host placement — no
+    asarray-to-HBM staging and no place_on_host fixup pass (the
+    state-size spike offloading exists to avoid)."""
+    import apex_tpu.optimizers._base as base_mod
+
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2, offload_state=True)
+    opt.step(_grads_for(tree))
+    p = str(tmp_path / "off.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2, offload_state=True)
+
+    def _trap(_tree):
+        raise AssertionError(
+            "place_on_host fixup on the packed restore path")
+
+    monkeypatch.setattr(base_mod, "place_on_host", _trap)
+    ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+    monkeypatch.undo()
+    for field, bufs in opt2.opt_state.items():
+        for b in bufs:
+            assert b.sharding.memory_kind in (
+                "pinned_host", "unpinned_host"), field
+    _opt_states_equal(opt, opt2)
+
+
+def test_v2_extra_restores_with_shapedtypestruct_template(tmp_path):
+    """``extra_like`` may be ShapeDtypeStructs — the template style
+    run_elastic itself builds for params_like; the extra-section
+    check must read shape/dtype attributes like every other template
+    check (np.asarray on a struct template raised a spurious
+    TemplateMismatchError, and on a device-array template paid a d2h
+    per leaf just to compare dtypes)."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    opt.step(_grads_for(tree))
+    extra = {"bn": {"mean": jnp.arange(4.0), "var": jnp.ones((4,))}}
+    p = str(tmp_path / "v2.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1, extra=extra)
+    like = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), extra)
+    out = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree),
+        FusedAdam(_mixed_tree(), lr=1e-2), extra_like=like)
+    _assert_tree_equal(out[3], extra)

@@ -424,6 +424,57 @@ def test_checkpoint_manager_reports_spans(tmp_path):
     tel.close()
 
 
+def test_checkpoint_counters_flow_to_jsonl_and_summarize(tmp_path,
+                                                         capsys):
+    """ckpt/save_ms, ckpt/bytes_written, ckpt/blocked_ms and
+    ckpt/restore_step ride the session flush as counter records and
+    render in the summarize counter table (ISSUE 6 satellite)."""
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.resilience import CheckpointManager
+
+    d = str(tmp_path / "run")
+    ckdir = str(tmp_path / "ckpts")
+    with telemetry.Telemetry(d, window=4) as tel:
+        params = {"w": jnp.ones((32,))}
+        opt = FusedAdam(params, lr=0.1)
+        g = {"w": jnp.full((32,), 0.01)}
+        with CheckpointManager(ckdir, keep=2, every=1) as mgr:
+            for step in range(1, 4):
+                opt.step(g)
+                tel.record({"loss": 1.0 / step}, step)
+                mgr.maybe_save(step, optimizer=opt)
+            mgr.wait()
+            assert mgr.restore_latest({"w": jnp.zeros((32,))},
+                                      opt) is not None
+        recs = {r["name"]: r for r in tel.counters.records()}
+        assert recs["ckpt/save_ms"]["count"] == 3
+        assert recs["ckpt/bytes_written"]["total"] > 0
+        assert recs["ckpt/restore_step"]["last"] == 3.0
+    # counter records landed in the jsonl...
+    with open(os.path.join(d, "telemetry.jsonl")) as f:
+        kinds = [json.loads(l).get("kind") for l in f if l.strip()]
+    assert "counter" in kinds
+    # ...and summarize renders them next to the span tables
+    assert telemetry_cli(["summarize", d]) == 0
+    out = capsys.readouterr().out
+    assert "counters (cumulative):" in out
+    assert "ckpt/save_ms" in out and "ckpt/bytes_written" in out
+    assert telemetry_cli(["summarize", d, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert any(c["name"] == "ckpt/save_ms" for c in payload["counters"])
+
+
+def test_counter_sink_removed_after_close():
+    from apex_tpu.telemetry import hostmetrics
+    tel = telemetry.Telemetry(run_dir=None, metrics=("loss",),
+                              retrace=False)
+    hostmetrics.emit("ckpt/save_ms", 1.0)
+    assert tel.counters.records()
+    tel.close()
+    hostmetrics.emit("ckpt/save_ms", 99.0)
+    assert tel.counters.records()[0]["count"] == 1   # no longer sunk
+
+
 # ---------------------------------------------------------------------------
 # retrace counter
 # ---------------------------------------------------------------------------

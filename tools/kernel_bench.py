@@ -422,6 +422,22 @@ def main():
         "oracle_ms": ra["amp_step_per_leaf_ms"],
         "speedup": ra.get("amp_pipeline_speedup")})
 
+    # training-state snapshot+serialize, bucket-native (v2: one device
+    # copy + one d2h per bucket) vs per-leaf (v1: state_dict walk) on a
+    # mixed-dtype many-leaf tree — the checkpoint cost a step loop pays
+    from apex_tpu.optimizers.bucketing_bench import \
+        bench_checkpoint_snapshot
+    rc = bench_checkpoint_snapshot()
+    rc["backend"] = backend
+    print(json.dumps(rc), flush=True)
+    rows.append({
+        "kernel": "checkpoint_snapshot",
+        "shape": f"{rc['ckpt_leaves']}leaves/{rc['ckpt_elements']}elem",
+        "dtype": "bf16+f32",
+        "kernel_ms": rc["ckpt_snapshot_bucketed_ms"],
+        "oracle_ms": rc["ckpt_snapshot_perleaf_ms"],
+        "speedup": rc.get("ckpt_snapshot_speedup")})
+
     # telemetry overhead: the IDENTICAL flat-AMP train step, metric
     # ring on vs off ("kernel" = instrumented, "oracle" = plain — a
     # speedup of ~1.0 IS the pass condition: the ring must be free)
