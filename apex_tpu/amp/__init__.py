@@ -22,6 +22,7 @@ from apex_tpu.amp.scaler import (
     LossScaleState,
     check_finite,
     conditional_step,
+    re_anchor,
     scale_loss,
     scaled_value_and_grad,
     unscale_grads,
@@ -42,7 +43,7 @@ from apex_tpu.amp import lists
 __all__ = [
     "Policy", "Properties", "opt_level_properties",
     "LossScaler", "LossScaleConfig", "LossScaleState",
-    "check_finite", "conditional_step", "scale_loss",
+    "check_finite", "conditional_step", "re_anchor", "scale_loss",
     "scaled_value_and_grad", "unscale_grads", "update_state",
     "AmpState", "initialize", "master_params_to_model_params",
     "update_scaler", "state_dict", "load_state_dict",

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from apex_tpu.amp.policies import (Policy, Properties, opt_level_properties)
 from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
-                                 update_state)
+                                 re_anchor, update_state)
 from apex_tpu.amp.wrap import auto_cast, cast_inputs
 
 Pytree = Any
@@ -76,6 +76,14 @@ class AmpState:
         return FlatGradPipeline(optimizer=optimizer, plan=plan,
                                 max_grad_norm=max_grad_norm,
                                 axis_name=axis_name, **kw)
+
+    def re_anchor(self, scale=None) -> "AmpState":
+        """This state with its scaler reset to a known-safe operating
+        point (:func:`apex_tpu.amp.scaler.re_anchor`) — the watchdog's
+        quarantine action after a NaN storm or scale collapse."""
+        return dataclasses.replace(
+            self, scaler=re_anchor(self.scaler, self.scaler_config,
+                                   scale))
 
     def telemetry_values(self) -> dict:
         """This state's scaler scalars under their standard telemetry
@@ -169,11 +177,15 @@ def master_params_to_model_params(model_params: Pytree,
         lambda mp, m: m.astype(mp.dtype), model_params, master_params)
 
 
-def update_scaler(state: AmpState, found_inf) -> AmpState:
+def update_scaler(state: AmpState, found_inf, skipped=None) -> AmpState:
+    """``skipped``: the step was skipped externally (watchdog
+    quarantine) — the growth tracker holds instead of counting the
+    window as clean (:func:`apex_tpu.amp.scaler.update_state`)."""
     return dataclasses.replace(
         state, scaler=update_state(state.scaler,
                                    jnp.asarray(found_inf, jnp.int32),
-                                   state.scaler_config))
+                                   state.scaler_config,
+                                   skipped=skipped))
 
 
 def state_dict(*states: AmpState) -> dict:

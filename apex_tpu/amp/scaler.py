@@ -75,8 +75,19 @@ def check_finite(grads: Pytree) -> jax.Array:
 
 
 def update_state(state: LossScaleState, found_inf: jax.Array,
-                 config: LossScaleConfig = LossScaleConfig()) -> LossScaleState:
-    """update_scale_hysteresis semantics, branch-free on device."""
+                 config: LossScaleConfig = LossScaleConfig(),
+                 skipped=None) -> LossScaleState:
+    """update_scale_hysteresis semantics, branch-free on device.
+
+    ``skipped`` (optional i32/bool, traced or concrete): the step was
+    skipped EXTERNALLY — a watchdog quarantine, a pipeline bubble —
+    rather than by the scaler's own overflow logic.  Such a step is
+    neither a clean step nor an overflow: the growth tracker must not
+    advance toward the growth interval (it did not observe a clean
+    optimizer update) and the scale must not move.  Without the flag a
+    quarantined window would count toward ``growth_interval`` and the
+    scale could grow across a window where nothing was learned.
+    """
     if not config.dynamic:
         _tape.emit("amp/found_inf", found_inf, reduce="max")
         return dataclasses.replace(state, found_inf=found_inf)
@@ -93,6 +104,10 @@ def update_state(state: LossScaleState, found_inf: jax.Array,
                   state.loss_scale),
     )
     tracker = jnp.where(grow, 0, tracker)
+    if skipped is not None:
+        ext = jnp.asarray(skipped, jnp.int32) > 0
+        new_scale = jnp.where(ext, state.loss_scale, new_scale)
+        tracker = jnp.where(ext, state.growth_tracker, tracker)
     # telemetry (no-ops without an active tape): a collapsing loss
     # scale is THE amp failure signature worth watching live
     _tape.emit("amp/loss_scale", new_scale)
@@ -102,6 +117,29 @@ def update_state(state: LossScaleState, found_inf: jax.Array,
         loss_scale=new_scale,
         growth_tracker=tracker,
         found_inf=found_inf,
+    )
+
+
+def re_anchor(state: LossScaleState,
+              config: LossScaleConfig = LossScaleConfig(),
+              scale=None) -> LossScaleState:
+    """Reset the scaler to a known-safe operating point — the
+    watchdog's quarantine action.
+
+    After a detected training anomaly (NaN storm that outlasted the
+    backoff, loss-scale collapse) the scaler's carried state is part of
+    the damage: the scale may be pinned at the floor and the growth
+    tracker mid-count.  ``re_anchor`` returns a fresh state at
+    ``scale`` (default: the config's init scale), tracker zeroed,
+    overflow flag cleared — so recovery restarts from the configured
+    operating point instead of crawling back up by growth intervals.
+    """
+    if scale is None:
+        scale = config.init_scale
+    return LossScaleState(
+        loss_scale=jnp.float32(scale),
+        growth_tracker=jnp.int32(0),
+        found_inf=jnp.int32(0),
     )
 
 

@@ -13,7 +13,9 @@ inputs and pins the structural facts earlier PRs proved ad hoc:
 * ``amp.scaled_value_and_grad`` (per-leaf oracle surface) — no host
   traffic, no f64;
 * a telemetry-instrumented step — ZERO callback/transfer primitives
-  (the ring write is a plain dynamic_update_slice);
+  (the ring write is a plain dynamic_update_slice) — and the same
+  step with a resilience Watchdog attached (detectors are host-side,
+  window-cadence only: self-healing adds no per-step syncs);
 * ``all_reduce_flat_buffers`` under shard_map — exactly one psum per
   bucket, every collective bound to the declared axis, none dead.
 
@@ -229,13 +231,11 @@ def _build_scaled_value_and_grad():
     }
 
 
-@register_spec(
-    "telemetry.instrumented_step",
-    anchor="apex_tpu/telemetry/session.py",
-    description="telemetry-instrumented flat AMP step: ZERO "
-                "callback/transfer primitives; the ring write is a "
-                "plain dynamic_update_slice riding the step's jit")
-def _build_instrumented_step():
+def _instrumented_step_jaxpr(with_watchdog: bool):
+    """The telemetry-instrumented flat-AMP step's jaxpr, optionally
+    with a resilience watchdog attached to the session — the watchdog
+    is host-side, window-cadence only, so the traced program must be
+    byte-for-byte free of callbacks/transfers either way."""
     import jax
     import jax.numpy as jnp
     from apex_tpu import amp, telemetry
@@ -247,7 +247,12 @@ def _build_instrumented_step():
     opt = FusedAdam(params, lr=1e-3)
     pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0)
     tel = telemetry.Telemetry(run_dir=None, window=8, retrace=False)
+    wd = None
     try:
+        if with_watchdog:
+            from apex_tpu.resilience.watchdog import Watchdog
+            wd = Watchdog(telemetry=tel)
+
         def train_step(work_bufs, opt_state, scaler, x, step):
             ptree = opt._plan.unpack_model(work_bufs)
             loss, flat = pipe.scaled_value_and_grad(_mlp_loss, scaler,
@@ -262,13 +267,45 @@ def _build_instrumented_step():
             tel.buf, jnp.int32(0), opt._param_bufs, opt.opt_state,
             scaler, x, jnp.int32(1))
     finally:
+        if wd is not None:
+            wd.close()
         tel.close()
+    return jaxpr
+
+
+@register_spec(
+    "telemetry.instrumented_step",
+    anchor="apex_tpu/telemetry/session.py",
+    description="telemetry-instrumented flat AMP step: ZERO "
+                "callback/transfer primitives; the ring write is a "
+                "plain dynamic_update_slice riding the step's jit")
+def _build_instrumented_step():
     return {
-        "jaxpr": jaxpr,
+        "jaxpr": _instrumented_step_jaxpr(with_watchdog=False),
         "expect": {
             "no_host_transfer": True,
             "no_f64": True,
             "dus_min": 1,             # the whole-row ring write
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "watchdog.instrumented_step",
+    anchor="apex_tpu/resilience/watchdog.py",
+    description="watchdog-attached instrumented flat AMP step: the "
+                "anomaly detectors are host-side and window-cadence "
+                "only, so the traced step still contains ZERO "
+                "callback/transfer primitives — self-healing adds no "
+                "per-step device syncs")
+def _build_watchdog_instrumented_step():
+    return {
+        "jaxpr": _instrumented_step_jaxpr(with_watchdog=True),
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "dus_min": 1,             # the ring write, nothing more
             "no_orphan_collectives": True,
         },
     }

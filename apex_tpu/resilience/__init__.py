@@ -11,19 +11,35 @@ harness (SURVEY.md §5: the failure story the reference lacks).
 - :mod:`~apex_tpu.resilience.elastic` — :func:`run_elastic`, the
   supervisor loop tying restore + cadence saves + bounded
   retry-with-backoff + preemption together;
+- :mod:`~apex_tpu.resilience.watchdog` — :class:`Watchdog`
+  (anomaly detectors over the telemetry ring's window flushes, the
+  escalation policy quarantine -> rollback-to-last-known-good ->
+  abort-with-diagnostics, executed through ``run_elastic``);
+- :mod:`~apex_tpu.resilience.retry` — :class:`RetryPolicy`
+  (bounded widening backoff, shared by ``run_elastic``'s transient
+  retries and the watchdog's rollback budget);
 - :mod:`~apex_tpu.resilience.faults` — :class:`FaultInjector`
   (seeded schedules of torn writes, fsync errors, slow disks,
-  preemption signals and crash-before-publish, injected through the
-  :class:`apex_tpu.checkpoint.CheckpointIO` seam).
+  preemption signals, crash-before-publish, and the training-state
+  faults — NaN grads, loss spikes, scale collapse, straggler stalls —
+  that prove every detector->action path).
 """
 
 from apex_tpu.resilience.elastic import ElasticResult, run_elastic
 from apex_tpu.resilience.manager import CheckpointManager
 from apex_tpu.resilience.preemption import PreemptionGuard
+from apex_tpu.resilience.retry import RetryPolicy
+from apex_tpu.resilience.watchdog import (Anomaly, Watchdog,
+                                          WatchdogAbort, WatchdogPolicy)
 
 __all__ = [
+    "Anomaly",
     "CheckpointManager",
     "ElasticResult",
     "PreemptionGuard",
+    "RetryPolicy",
+    "Watchdog",
+    "WatchdogAbort",
+    "WatchdogPolicy",
     "run_elastic",
 ]

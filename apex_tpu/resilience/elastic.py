@@ -19,7 +19,16 @@ recovery contract:
 - **preemption**: a :class:`~.preemption.PreemptionGuard` notice
   (SIGTERM / ``--preempt-at-step``) converts into one final FORCED
   save at the current step boundary, a durability wait, and a clean
-  return with ``preempted=True``.
+  return with ``preempted=True``;
+- **self-healing** (``watchdog=``): a
+  :class:`~.watchdog.Watchdog` polled at every step boundary turns
+  detected training anomalies into the escalation ladder — quarantine
+  (the ``on_quarantine`` hook re-anchors the loss scale), bounded
+  rollback-and-replay onto the last-known-good checkpoint, or
+  abort-with-diagnostics (:class:`~.watchdog.WatchdogAbort` after the
+  post-mortem bundle is written).  Cadence saves age toward
+  last-known-good through the watchdog's clean-window rule, pinned
+  against rotation while they age.
 
 The user's step function owns the optimizer and any AMP state (a
 closure); ``save_extras``/``on_restore`` thread the non-optimizer
@@ -49,8 +58,10 @@ from typing import Any, Callable, Optional, Tuple, Type
 import jax
 
 from apex_tpu.resilience import faults as _faults
+from apex_tpu.resilience import watchdog as _watchdog
 from apex_tpu.resilience.manager import CheckpointManager
 from apex_tpu.resilience.preemption import PreemptionGuard
+from apex_tpu.resilience.retry import RetryPolicy
 
 Pytree = Any
 
@@ -63,6 +74,7 @@ class ElasticResult:
     #                                 checkpoint durable at .step
     restarts: int                   # in-job recoveries performed
     restored_from: Optional[int]    # initial resume step (None: fresh)
+    rollbacks: int = 0              # watchdog rollback-and-replays
 
 
 def run_elastic(step_fn: Callable[[int], Any],
@@ -72,9 +84,12 @@ def run_elastic(step_fn: Callable[[int], Any],
                 params_like: Optional[Pytree] = None,
                 extra_like: Optional[Pytree] = None,
                 guard: Optional[PreemptionGuard] = None,
+                watchdog=None,
+                on_quarantine: Optional[Callable] = None,
                 save_extras: Optional[Callable[[], dict]] = None,
                 on_restore: Optional[Callable] = None,
                 retryable: Tuple[Type[BaseException], ...] = (OSError,),
+                retry: Optional[RetryPolicy] = None,
                 max_restarts: int = 3,
                 backoff_s: float = 0.05,
                 sleep: Callable[[float], None] = time.sleep
@@ -96,12 +111,27 @@ def run_elastic(step_fn: Callable[[int], Any],
     caller can rebind its own state.  With ``optimizer=None`` the
     4-arg form is REQUIRED: the restored params can only reach the
     caller's closure through it.  ``retryable`` failures of a step OR save trigger
-    restore-newest-valid-and-resume, at most ``max_restarts`` times
-    with exponential backoff; anything else propagates (a real crash
-    — the external scheduler restarts the job, and the next
-    ``run_elastic`` resumes)."""
+    restore-newest-valid-and-resume under ``retry`` (a
+    :class:`~apex_tpu.resilience.retry.RetryPolicy`; defaults to one
+    built from the legacy ``max_restarts``/``backoff_s`` knobs);
+    anything else propagates (a real crash — the external scheduler
+    restarts the job, and the next ``run_elastic`` resumes).
+
+    ``watchdog``: a :class:`~apex_tpu.resilience.watchdog.Watchdog`
+    polled once per step boundary; its verdicts execute here —
+    quarantine calls ``on_quarantine(anomaly)`` (re-anchor the loss
+    scale, drop the window), rollback restores the last-known-good
+    checkpoint through the manager (multi-host lockstep agreement
+    included) and replays under the watchdog's own
+    ``policy.rollback`` budget + widening backoff, abort writes the
+    post-mortem bundle then raises ``WatchdogAbort``.  Cadence saves
+    are reported to the watchdog and pinned until the clean-window
+    rule resolves them (good -> ``manager.mark_good``)."""
     if optimizer is None and params_like is None:
         raise ValueError("need an optimizer or params_like to restore")
+    if retry is None:
+        retry = RetryPolicy(max_retries=max_restarts,
+                            base_delay_s=backoff_s)
     if params_like is None:
         # only the SHAPES are the template; holding the unpacked
         # pytree itself would pin a params-sized HBM copy all run
@@ -127,13 +157,14 @@ def run_elastic(step_fn: Callable[[int], Any],
     if own_guard:
         guard.install()
     restarts = 0
+    rollbacks = 0
     try:
         def _extras() -> dict:
             return save_extras() if save_extras is not None else {}
 
-        def _restore() -> Optional[int]:
-            out = manager.restore_latest(params_like, optimizer,
-                                         extra_like=extra_like)
+        def _restore(restore_fn=None) -> Optional[int]:
+            out = (restore_fn or manager.restore_latest)(
+                params_like, optimizer, extra_like=extra_like)
             if out is None:
                 return None
             if on_restore is not None:
@@ -148,18 +179,18 @@ def run_elastic(step_fn: Callable[[int], Any],
 
         def _forced_save(step: int) -> None:
             """Save NOW, surviving transient IO errors (bounded)."""
-            for attempt in range(max_restarts + 1):
+            for attempt in range(retry.max_retries + 1):
                 try:
                     manager.save(step, optimizer=optimizer, **_extras())
                     manager.wait()
                     return
                 except retryable as e:
-                    if attempt == max_restarts:
+                    if attempt == retry.max_retries:
                         raise
                     warnings.warn(
                         f"run_elastic: final save at step {step} "
                         f"failed ({type(e).__name__}: {e}); retrying")
-                    sleep(backoff_s * (2 ** attempt))
+                    sleep(retry.delay_s(attempt + 1))
 
         restored_from = _restore()
         last_done = restored_from if restored_from is not None else 0
@@ -180,14 +211,14 @@ def run_elastic(step_fn: Callable[[int], Any],
                     **(_extras() if due else {}))
             except retryable as e:
                 restarts += 1
-                if restarts > max_restarts:
+                if retry.exhausted(restarts):
                     raise
                 warnings.warn(
                     f"run_elastic: step {step} failed "
                     f"({type(e).__name__}: {e}); restoring newest "
                     f"valid checkpoint (restart {restarts}/"
-                    f"{max_restarts})")
-                sleep(backoff_s * (2 ** (restarts - 1)))
+                    f"{retry.max_retries})")
+                sleep(retry.delay_s(restarts))
                 resumed = _restore()
                 if resumed is None:
                     # nothing valid to restore onto — the optimizer may
@@ -197,6 +228,66 @@ def run_elastic(step_fn: Callable[[int], Any],
                 last_done = resumed
                 step = resumed + 1
                 continue
+            if watchdog is not None:
+                if saved_now:
+                    # the save starts aging toward last-known-good;
+                    # pinned so rotation cannot delete a candidate
+                    manager.pin(step)
+                    watchdog.note_save(step)
+                verdict = watchdog.check(step)
+                for s, good in watchdog.resolved_saves():
+                    if good:
+                        manager.mark_good(s)     # unpins; LKG pinned
+                    else:
+                        manager.unpin(s)
+                if verdict.action == _watchdog.ACTION_QUARANTINE:
+                    warnings.warn(
+                        f"run_elastic: watchdog quarantined step "
+                        f"{step} ({verdict.anomaly.kind}: "
+                        f"{dict(verdict.anomaly.evidence)})")
+                    watchdog.note_quarantine(step, verdict.anomaly)
+                    if on_quarantine is not None:
+                        on_quarantine(verdict.anomaly)
+                elif verdict.action == _watchdog.ACTION_ROLLBACK:
+                    warnings.warn(
+                        f"run_elastic: watchdog rollback at step "
+                        f"{step} ({verdict.anomaly.kind}); restoring "
+                        f"last-known-good (rollback "
+                        f"{watchdog.rollbacks}/"
+                        f"{watchdog.policy.rollback.max_retries})")
+                    sleep(watchdog.policy.rollback.delay_s(
+                        watchdog.rollbacks))
+                    resumed = _restore(manager.restore_good)
+                    if resumed is None:
+                        # nothing proven-good to roll onto: recovery
+                        # is impossible, not merely over budget
+                        pm = watchdog.write_postmortem(
+                            step, verdict.anomaly,
+                            directory=watchdog.postmortem_dir
+                            or manager.directory)
+                        raise _watchdog.WatchdogAbort(
+                            f"watchdog rollback at step {step} "
+                            f"({verdict.anomaly.kind}) found no valid "
+                            f"checkpoint to roll back to; post-mortem: "
+                            f"{pm}", pm)
+                    rollbacks += 1
+                    watchdog.note_rollback(resumed, step,
+                                           verdict.anomaly)
+                    last_done = resumed
+                    step = resumed + 1
+                    continue
+                elif verdict.action == _watchdog.ACTION_ABORT:
+                    pm = watchdog.write_postmortem(
+                        step, verdict.anomaly,
+                        directory=watchdog.postmortem_dir
+                        or manager.directory)
+                    raise _watchdog.WatchdogAbort(
+                        f"watchdog abort at step {step}"
+                        + (f" ({verdict.anomaly.kind})"
+                           if verdict.anomaly else "")
+                        + f"; recovery exhausted after "
+                        f"{watchdog.rollbacks} rollback(s); "
+                        f"post-mortem: {pm}", pm)
             if guard is not None and guard.check(step):
                 # preemption notice -> durable-now-then-clean-exit at
                 # this step boundary.  A cadence save just scheduled
@@ -216,7 +307,8 @@ def run_elastic(step_fn: Callable[[int], Any],
                     _forced_save(step)
                 return ElasticResult(step=step, preempted=True,
                                      restarts=restarts,
-                                     restored_from=restored_from)
+                                     restored_from=restored_from,
+                                     rollbacks=rollbacks)
             step += 1
         try:
             manager.wait()                # final cadence save durable
@@ -230,7 +322,8 @@ def run_elastic(step_fn: Callable[[int], Any],
             _forced_save(last_done)
         return ElasticResult(step=last_done, preempted=False,
                              restarts=restarts,
-                             restored_from=restored_from)
+                             restored_from=restored_from,
+                             rollbacks=rollbacks)
     finally:
         if own_guard:
             guard.uninstall()

@@ -23,6 +23,17 @@ kind                 what happens
 ``crash_before_publish``  write + fsync complete, the process dies
                      between the durable ``.tmp`` and the atomic
                      ``os.replace`` — the unpublished-checkpoint case
+``nan_grads``        advisory: the training loop should poison this
+                     step's gradients with NaN (the hardware-flake /
+                     bad-batch NaN storm the watchdog detects)
+``loss_spike``       advisory: the training loop should spike this
+                     step's loss (corrupt batch / divergence onset)
+``scale_collapse``   advisory: the training loop should feed the
+                     scaler intermittent overflows so the loss scale
+                     pins at its floor without a contiguous NaN streak
+``straggler``        ``notify_step`` stalls ``delay_s`` seconds — a
+                     simulated slow host, visible as a step-time
+                     regression to the watchdog's straggler detector
 ===================  ======================================================
 
 The injector subclasses :class:`apex_tpu.checkpoint.CheckpointIO` and
@@ -30,8 +41,20 @@ installs itself with :func:`apex_tpu.checkpoint.set_io`, so every
 checkpoint writer (v1 and v2, sync and async) runs through it without
 test-only branches in library code.  Each fault fires once (tracked in
 ``fired``), keyed by the 0-based ordinal of the checkpoint write it
-targets (``at_save``) or the training step (``at_step`` for
-``preempt``).
+targets (``at_save``) or the training step (``at_step`` for the
+step-keyed kinds).
+
+Training-state faults (``nan_grads`` / ``loss_spike`` /
+``scale_collapse``) are ADVISORY: fault injection cannot reach into a
+user step function's gradients from outside, so the training loop asks
+:func:`training_fault` once per step and applies the returned kind
+itself (``examples/simple/train_toy.py --inject-nan-at`` and the chaos
+suite are the reference consumers; production pays one module-global
+read).  Their activation is BUDGETED, not step-ranged: a fault with
+``n_steps=4`` poisons the first 4 steps at/after ``at_step`` it is
+asked about and then stays spent — so a rollback that replays those
+step numbers replays them CLEAN, which is exactly the
+recovery-then-bit-exact-replay contract the chaos matrix asserts.
 """
 
 from __future__ import annotations
@@ -56,8 +79,9 @@ class InjectedCrash(RuntimeError):
 class FaultSpec(NamedTuple):
     kind: str                       # one of FaultInjector.KINDS
     at_save: Optional[int] = None   # 0-based checkpoint-write ordinal
-    at_step: Optional[int] = None   # training step (preempt only)
-    delay_s: float = 0.0            # slow_disk stall
+    at_step: Optional[int] = None   # training step (step-keyed kinds)
+    delay_s: float = 0.0            # slow_disk / straggler stall
+    n_steps: int = 1                # training-fault application budget
 
 
 # module-level active injector: run_elastic's per-step chaos hook
@@ -72,6 +96,16 @@ def notify_step(step: int) -> None:
         _ACTIVE.on_step(step)
 
 
+def training_fault(step: int) -> Optional[FaultSpec]:
+    """The training-state fault a loop should apply at ``step``, if any
+    (a no-op None unless a FaultInjector is installed).  Consumes one
+    unit of the fault's ``n_steps`` budget per call — ask exactly once
+    per step."""
+    if _ACTIVE is not None:
+        return _ACTIVE.training_fault(step)
+    return None
+
+
 class FaultInjector(_ckpt.CheckpointIO):
     """Checkpoint-IO implementation that injects the scheduled faults.
 
@@ -81,22 +115,39 @@ class FaultInjector(_ckpt.CheckpointIO):
     """
 
     KINDS = ("truncate", "fsync_error", "slow_disk", "preempt",
-             "crash_before_publish")
+             "crash_before_publish",
+             "nan_grads", "loss_spike", "scale_collapse", "straggler")
+    # step-keyed kinds delivered through notify_step/training_fault
+    STEP_KINDS = ("preempt", "nan_grads", "loss_spike",
+                  "scale_collapse", "straggler")
+    # advisory kinds the TRAINING LOOP applies (training_fault)
+    TRAINING_KINDS = ("nan_grads", "loss_spike", "scale_collapse")
 
     def __init__(self, faults: Sequence[FaultSpec]):
         for f in faults:
             if f.kind not in self.KINDS:
                 raise ValueError(f"unknown fault kind {f.kind!r}; "
                                  f"known: {self.KINDS}")
-            if f.kind == "preempt" and f.at_step is None:
-                raise ValueError("preempt faults need at_step")
-            if f.kind != "preempt" and f.at_save is None:
+            if f.kind in self.STEP_KINDS and f.at_step is None:
+                raise ValueError(f"{f.kind} faults need at_step")
+            if f.kind not in self.STEP_KINDS and f.at_save is None:
                 raise ValueError(f"{f.kind} faults need at_save")
         self.faults = list(faults)
         self.fired: List[FaultSpec] = []
         self.saves = -1            # ordinal of the CURRENT write
+        # all bookkeeping is INDEX-keyed: specs are not unique (two
+        # identical nan storms may be scheduled), so NamedTuple
+        # equality would alias them — fired mirrors _fired_idx
+        self._fired_idx: set = set()
+        self._spent = [0] * len(self.faults)
         self._lock = threading.Lock()
         self._prev: Optional[_ckpt.CheckpointIO] = None
+
+    def _mark_fired(self, idx: int) -> None:
+        """Record fault ``idx`` as fired (caller holds the lock)."""
+        if idx not in self._fired_idx:
+            self._fired_idx.add(idx)
+            self.fired.append(self.faults[idx])
 
     @classmethod
     def seeded(cls, seed: int, n_saves: int = 8,
@@ -105,8 +156,9 @@ class FaultInjector(_ckpt.CheckpointIO):
         """A deterministic pseudo-random schedule: same seed, same
         faults, forever — the property a chaos suite needs to be
         debuggable.  Picks one fault kind per save ordinal with ~50%
-        probability (preempt excluded: it is step-keyed, not
-        save-keyed; schedule it explicitly)."""
+        probability (the step-keyed kinds — preempt and the
+        training-state faults — are excluded: schedule those
+        explicitly with at_step)."""
         import random
         rng = random.Random(seed)
         kinds = tuple(kinds or ("truncate", "fsync_error", "slow_disk",
@@ -142,24 +194,47 @@ class FaultInjector(_ckpt.CheckpointIO):
         """Pop-and-fire the first unfired fault of ``kind`` scheduled
         for the current save ordinal."""
         with self._lock:
-            for f in self.faults:
+            for i, f in enumerate(self.faults):
                 if f.kind == kind and f.at_save == self.saves \
-                        and f not in self.fired:
-                    self.fired.append(f)
+                        and i not in self._fired_idx:
+                    self._mark_fired(i)
+                    return f
+        return None
+
+    def _draw_step_fault(self, step: int, kinds) -> Optional[FaultSpec]:
+        """Pop one unit of budget from the first due step-keyed fault
+        of ``kinds`` (record in ``fired`` on first application)."""
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if f.kind in kinds and f.at_step is not None \
+                        and step >= f.at_step \
+                        and self._spent[i] < max(1, f.n_steps):
+                    self._spent[i] += 1
+                    self._mark_fired(i)
                     return f
         return None
 
     def on_step(self, step: int) -> None:
         """Step-keyed faults (called from ``notify_step``): deliver a
         REAL SIGTERM so the whole PreemptionGuard signal path is what
-        gets tested, not a shortcut flag."""
+        gets tested, not a shortcut flag; a ``straggler`` fault stalls
+        the step boundary itself — a slow host, not slow disk."""
+        lag = self._draw_step_fault(step, ("straggler",))
+        if lag is not None:
+            time.sleep(lag.delay_s)
         with self._lock:
-            due = [f for f in self.faults
-                   if f.kind == "preempt" and f not in self.fired
+            due = [i for i, f in enumerate(self.faults)
+                   if f.kind == "preempt" and i not in self._fired_idx
                    and f.at_step is not None and step >= f.at_step]
-            self.fired.extend(due)
+            for i in due:
+                self._mark_fired(i)
         if due:
             os.kill(os.getpid(), signal.SIGTERM)
+
+    def training_fault(self, step: int) -> Optional[FaultSpec]:
+        """The advisory training-state fault to apply at ``step`` (one
+        budget unit consumed per call — module docstring)."""
+        return self._draw_step_fault(step, self.TRAINING_KINDS)
 
     # ---- CheckpointIO overrides -----------------------------------------
     def open(self, path: str, mode: str = "wb"):

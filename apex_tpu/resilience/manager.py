@@ -40,10 +40,11 @@ A host can never silently diverge from host 0's resume step
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import warnings
-from typing import Any, Optional, Tuple
+from typing import Any, Optional, Set, Tuple
 
 import jax
 
@@ -114,6 +115,10 @@ class CheckpointManager:
                         else ".ckpt")
         self._step_re = re.compile(
             r"^step-(\d+)" + re.escape(self._suffix) + "$")
+        # watchdog integration: steps pinned against rotation while
+        # they age toward last-known-good, plus the LKG step itself
+        self._pins: Set[int] = set()
+        self._lkg: Optional[int] = self._read_lkg()
         self._async = _ckpt.AsyncCheckpointer()
         if self._writer:
             os.makedirs(directory, exist_ok=True)
@@ -215,6 +220,72 @@ class CheckpointManager:
                 out.append(int(m.group(1)))
         return sorted(out)
 
+    # ---- last-known-good tagging (watchdog integration) ------------------
+    # The watchdog stamps a checkpoint "good" only after a FULL clean
+    # telemetry window has aged past it with no anomaly.  Rotation must
+    # never delete the LKG (it is the rollback target) nor a still-aging
+    # candidate (it may BECOME the LKG); the marker survives restarts so
+    # a rollback after a crash still lands on a proven-clean state.
+
+    def _lkg_path(self) -> str:
+        return os.path.join(self.directory, f"lkg{self._suffix}.json")
+
+    def _read_lkg(self) -> Optional[int]:
+        try:
+            with open(self._lkg_path(), encoding="utf-8") as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def pin(self, step: int) -> None:
+        """Exempt ``step`` from rotation while it ages toward
+        last-known-good (unpin when the verdict lands)."""
+        self._pins.add(int(step))
+
+    def unpin(self, step: int) -> None:
+        self._pins.discard(int(step))
+
+    def mark_good(self, step: int) -> None:
+        """Stamp ``step`` as the last-known-good checkpoint: rotation
+        keeps it (beyond ``keep``) until a newer step is stamped, and
+        ``restore_good`` rolls back to it.  Persisted next to the
+        checkpoints so a restarted job inherits the stamp."""
+        step = int(step)
+        self._lkg = step
+        self._pins.discard(step)       # the LKG pin supersedes
+        if self._writer:
+            tmp = self._lkg_path() + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"step": step}, f)
+                os.replace(tmp, self._lkg_path())
+            except OSError:
+                # the stamp is an optimization (rollback falls back to
+                # newest-valid); a transient marker-write failure must
+                # not kill training
+                warnings.warn(f"mark_good: could not persist LKG marker "
+                              f"for step {step}")
+        # a superseded LKG loses its exemption at the next _gc
+
+    def lkg_step(self) -> Optional[int]:
+        """The last-known-good step (None before the first stamp)."""
+        return self._lkg
+
+    def restore_good(self, params_like: Pytree, optimizer=None,
+                     extra_like: Optional[Pytree] = None,
+                     sharding=None) -> Optional[Tuple]:
+        """Rollback restore: resume from the newest valid checkpoint
+        NO NEWER than the last-known-good step — checkpoints taken
+        after the LKG may hold the very state the watchdog flagged.
+        Without a stamp yet this degrades to ``restore_latest`` (and
+        the watchdog's bounded rollback budget still ends a recovery
+        loop that keeps restoring poisoned state).  Collective on
+        multi-host runs exactly like ``restore_latest``."""
+        return self.restore_latest(params_like, optimizer,
+                                   extra_like=extra_like,
+                                   sharding=sharding,
+                                   max_step=self._lkg)
+
     def due(self, step: int) -> bool:
         """True iff ``step`` is on the save cadence — THE predicate
         ``maybe_save`` applies.  Exposed so step loops can gate
@@ -265,8 +336,15 @@ class CheckpointManager:
     def _gc(self, in_flight: Optional[int] = None) -> None:
         """Trim to the newest ``keep`` checkpoints, never counting (or
         deleting) the not-yet-durable in-flight one — so a failed
-        in-flight write can never reduce the durable window."""
-        steps = [s for s in self.steps_on_disk() if s != in_flight]
+        in-flight write can never reduce the durable window.  The LKG
+        step and watchdog-pinned (still-aging) steps are exempt and do
+        not count toward ``keep``: retention pinning means rotation can
+        never delete the rollback target out from under a recovery."""
+        exempt = set(self._pins)
+        if self._lkg is not None:
+            exempt.add(self._lkg)
+        steps = [s for s in self.steps_on_disk()
+                 if s != in_flight and s not in exempt]
         for s in steps[:max(0, len(steps) - self.keep)]:
             try:
                 os.remove(self._path(s))
@@ -275,7 +353,8 @@ class CheckpointManager:
 
     def restore_latest(self, params_like: Pytree, optimizer=None,
                        extra_like: Optional[Pytree] = None,
-                       sharding=None) -> Optional[Tuple]:
+                       sharding=None,
+                       max_step: Optional[int] = None) -> Optional[Tuple]:
         """Resume from the newest VALID checkpoint, or None if none.
 
         Corrupt/truncated files (the artifact of dying mid-write) are
@@ -284,6 +363,13 @@ class CheckpointManager:
         tree/shape/dtype) is a caller bug and re-raises instead of
         silently restarting from scratch.  Returns
         load_training_state's tuple.
+
+        ``max_step`` bounds the walk: only checkpoints at or below it
+        are considered (the watchdog's rollback-to-LKG path —
+        checkpoints newer than the last-known-good may hold the bad
+        state being rolled away from).  The bound must be the SAME on
+        every host: it filters the agreed step set before the lockstep
+        walk, so agreement semantics are unchanged.
 
         COLLECTIVE on multi-host runs (every process must call it, in
         the same program order): the candidate steps are the
@@ -299,14 +385,17 @@ class CheckpointManager:
         dirty = False
         with span("checkpoint/restore"):
             out = self._restore_walk(params_like, optimizer, extra_like,
-                                     snap, dirty, sharding)
+                                     snap, dirty, sharding, max_step)
         if out is not None:
             _hostmetrics.emit("ckpt/restore_step", out[2])
         return out
 
     def _restore_walk(self, params_like, optimizer, extra_like, snap,
-                      dirty, sharding=None):
-        for step in self._agreed_steps():
+                      dirty, sharding=None, max_step=None):
+        steps = self._agreed_steps()
+        if max_step is not None:
+            steps = [s for s in steps if s <= max_step]
+        for step in steps:
             out, code, tmpl_err = None, self._LOAD_OK, None
             try:
                 out = _ckpt.load_training_state(

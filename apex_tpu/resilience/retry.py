@@ -1,0 +1,66 @@
+"""Bounded retry with widening backoff — the one policy object every
+recovery loop in the resilience stack shares.
+
+``run_elastic`` retries transient step/save failures under it, and the
+watchdog's rollback-and-replay budget reuses it verbatim: both are
+"try again, a bounded number of times, waiting longer each time" —
+hard-coding the constants separately in each loop is how one of them
+ends up retrying forever.
+
+The policy is pure arithmetic over an attempt number; the caller owns
+the clock (``sleep=`` injection keeps every test fake-clocked).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Widening (exponential) backoff, bounded in count and delay.
+
+    ``max_retries``: recoveries attempted AFTER the first failure;
+    attempt ``max_retries + 1`` is never made (``exhausted``).
+    ``base_delay_s`` doubles per attempt up to ``max_delay_s``.
+    ``jitter``: fraction in ``[0, 1)`` of the delay added uniformly at
+    random — decorrelates a fleet of hosts hammering the same flaky
+    filesystem.  Deterministic tests pass an explicit ``rng``
+    (``random.Random(seed)``) or leave jitter at 0; multi-host
+    lockstep recoveries MUST keep jitter at 0 (hosts sleeping
+    different times before a collective restore still agree — the
+    restore walk is the barrier — but the grace window shrinks by the
+    skew).
+    """
+    max_retries: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 5.0
+    jitter: float = 0.0
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, "
+                             f"got {self.max_retries}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be >= 0")
+        if not (0.0 <= self.jitter < 1.0):
+            raise ValueError(f"jitter must be in [0, 1), "
+                             f"got {self.jitter}")
+
+    def delay_s(self, attempt: int,
+                rng: Optional[random.Random] = None) -> float:
+        """Backoff before retry ``attempt`` (1-based: the delay taken
+        after the ``attempt``-th failure)."""
+        if attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {attempt}")
+        d = min(self.base_delay_s * (2.0 ** (attempt - 1)),
+                self.max_delay_s)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (rng or random).random()
+        return d
+
+    def exhausted(self, attempts: int) -> bool:
+        """True once ``attempts`` recoveries exceed the budget."""
+        return attempts > self.max_retries

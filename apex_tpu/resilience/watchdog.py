@@ -1,0 +1,740 @@
+"""Self-healing training: the anomaly watchdog and its escalation
+policy — the closed loop between the telemetry sensors and the
+elastic-recovery actuators.
+
+The reference apex's only self-healing behavior is dynamic loss
+scaling: skip the step and shrink the scale on overflow
+(``apex/amp/scaler.py``).  At fleet scale that is nowhere near enough —
+loss spikes, NaN storms that outlive the scaler's backoff, optimizer
+divergence and straggling hosts all kill multi-day runs with no
+automated response.  This module wires PR 4's device-side MetricRing
+(the sensor) to PR 6's bucket-native checkpoints + ``run_elastic``
+supervisor (the actuator):
+
+- **Detectors** consume the telemetry session's WINDOW FLUSHES on the
+  host — the one ``device_get`` per window the ring already pays — so
+  detection adds **zero per-step device syncs** (the apexverify spec
+  ``watchdog.instrumented_step`` proves the traced step is unchanged).
+  Built in: ``found_inf`` streaks that outlast the scaler
+  (:class:`NanStreakDetector`), windowed z-score loss-spike and
+  grad-norm-explosion detection (:class:`LossSpikeDetector`,
+  :class:`GradNormDetector`), loss-scale collapse storms
+  (:class:`ScaleCollapseDetector`), and step-time straggler regression
+  from host step-boundary wall times (:class:`StepTimeDetector`).
+  Each yields a typed :class:`Anomaly` with severity and evidence.
+
+- The **escalation ladder** (:class:`WatchdogPolicy`) turns anomalies
+  into actions executed through ``run_elastic``:
+
+  1. *warn* — emit the anomaly event, change nothing;
+  2. *quarantine* — the offending window is written off: the caller's
+     ``on_quarantine`` hook re-anchors the loss scale
+     (``amp.re_anchor`` / ``AmpState.re_anchor``) and may skip its own
+     update (``amp.update_state(..., skipped=...)`` keeps such steps
+     out of the growth interval).  Repeated quarantines of the same
+     kind escalate to rollback;
+  3. *rollback-and-replay* — restore the **last-known-good**
+     checkpoint (``CheckpointManager.restore_good``; "good" is stamped
+     only after a full clean window ages past a save, and retention
+     pinning means rotation never deletes it) and replay.  The budget
+     and widening backoff come from a shared
+     :class:`~apex_tpu.resilience.retry.RetryPolicy`, so a persistent
+     bug can never loop forever;
+  4. *abort-with-diagnostics* — write a post-mortem bundle (ring dump,
+     anomaly timeline, config/env, retrace counters) and raise
+     :class:`WatchdogAbort` so the job exits non-zero with the
+     evidence on disk.
+
+Multi-host: the detectors are deterministic functions of the ring
+contents, which are computed from replicated on-device values — every
+host reaches the SAME verdict at the same step boundary, and the
+rollback itself goes through ``restore_latest``'s lockstep agreement,
+so all hosts act in the same step boundary or none does.  (Attach a
+watchdog on every rank; the telemetry session fetches its local ring
+for observers even on non-writer ranks.)
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import math
+import os
+import sys
+import time
+from typing import (Any, Callable, Deque, Dict, List, Mapping, NamedTuple,
+                    Optional, Sequence, Tuple)
+
+from apex_tpu.resilience.retry import RetryPolicy
+
+SEVERITY_WARN = "warn"
+SEVERITY_CRITICAL = "critical"
+
+# the escalation ladder, least to most drastic
+ACTION_NONE = "none"
+ACTION_WARN = "warn"
+ACTION_QUARANTINE = "quarantine"
+ACTION_ROLLBACK = "rollback"
+ACTION_ABORT = "abort"
+_LADDER = (ACTION_NONE, ACTION_WARN, ACTION_QUARANTINE,
+           ACTION_ROLLBACK, ACTION_ABORT)
+
+DEFAULT_ACTIONS: Mapping[str, str] = {
+    "nan_streak": ACTION_ROLLBACK,
+    "scale_collapse": ACTION_ROLLBACK,
+    "loss_spike": ACTION_QUARANTINE,
+    "grad_norm_explosion": ACTION_QUARANTINE,
+    "straggler": ACTION_WARN,
+}
+
+
+class WatchdogAbort(RuntimeError):
+    """The escalation policy reached abort: recovery is out of budget
+    or impossible.  ``.postmortem`` holds the diagnostics bundle path
+    (None if writing it failed); the job should exit non-zero."""
+
+    def __init__(self, message: str, postmortem: Optional[str] = None):
+        super().__init__(message)
+        self.postmortem = postmortem
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    """One detected training anomaly (typed, JSON-able evidence)."""
+    kind: str                   # "nan_streak" | "loss_spike" | ...
+    severity: str               # SEVERITY_WARN | SEVERITY_CRITICAL
+    step: int                   # newest step of the evidence
+    first_step: int             # oldest step of the evidence
+    detector: str               # detector instance name
+    evidence: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def record(self) -> dict:
+        """The typed telemetry event (``kind: "anomaly"``) emitters
+        write and ``telemetry summarize`` renders as a timeline row."""
+        return {"kind": "anomaly", "anomaly": self.kind,
+                "severity": self.severity, "step": self.step,
+                "first_step": self.first_step,
+                "detector": self.detector,
+                "evidence": dict(self.evidence)}
+
+
+class Verdict(NamedTuple):
+    """What the escalation policy decided at a step boundary."""
+    action: str                     # one of the ACTION_* ladder
+    anomaly: Optional[Anomaly]      # the driving anomaly (None: clean)
+
+
+# ---------------------------------------------------------------------
+# Detectors: pure host-side consumers of flushed step records.
+# ---------------------------------------------------------------------
+
+class Detector:
+    """One anomaly detector over flushed telemetry step records.
+
+    ``observe(records)`` is called once per window flush with the
+    decoded step records (ascending by step; missing/non-finite metric
+    cells are None) and returns any anomalies found.  Detectors carry
+    their own trailing state and must ``reset()`` cleanly after a
+    rollback — replayed step numbers would otherwise re-trigger
+    against stale history.
+    """
+    name = "detector"
+
+    def observe(self, records: Sequence[dict]) -> List[Anomaly]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def config(self) -> dict:
+        """JSON-able construction parameters (post-mortem bundle)."""
+        return {}
+
+
+class NanStreakDetector(Detector):
+    """``found_inf`` streaks that OUTLAST the scaler's own backoff.
+
+    The scaler handles isolated overflows by design: skip + halve the
+    scale.  From ``init_scale`` 2^16 that self-heals within ~16
+    overflow steps — so a streak longer than ``streak`` consecutive
+    overflowed steps means backoff is NOT converging (NaN params, a
+    poisoned batch pipeline, broken kernels) and the state itself
+    needs treatment."""
+
+    def __init__(self, streak: int = 8, metric: str = "amp/found_inf"):
+        if streak < 1:
+            raise ValueError(f"streak must be >= 1, got {streak}")
+        self.name = "nan_streak"
+        self.streak = int(streak)
+        self.metric = metric
+        self.reset()
+
+    def reset(self) -> None:
+        self._run = 0
+        self._first: Optional[int] = None
+        self._fired = False
+
+    def config(self) -> dict:
+        return {"streak": self.streak, "metric": self.metric}
+
+    def observe(self, records: Sequence[dict]) -> List[Anomaly]:
+        out: List[Anomaly] = []
+        for r in records:
+            v = r.get(self.metric)
+            if v is None:
+                continue                  # metric not recorded this step
+            if v > 0:
+                if self._run == 0:
+                    self._first = r["step"]
+                self._run += 1
+                if self._run >= self.streak and not self._fired:
+                    self._fired = True    # once per streak, not per step
+                    out.append(Anomaly(
+                        kind="nan_streak", severity=SEVERITY_CRITICAL,
+                        step=r["step"], first_step=self._first,
+                        detector=self.name,
+                        evidence={"consecutive_overflows": self._run}))
+            else:
+                self.reset()
+        return out
+
+
+class ZScoreDetector(Detector):
+    """Windowed z-score spike detection over one metric's trailing
+    history.  Anomalous values are EXCLUDED from the history so a
+    spike cannot poison its own baseline; non-finite cells are the NaN
+    detector's business and are skipped here."""
+
+    kind = "zscore"
+    severity = SEVERITY_WARN
+
+    def __init__(self, metric: str, zscore: float = 8.0,
+                 min_history: int = 12, history: int = 256,
+                 min_rel_std: float = 0.01):
+        if min_history < 2:
+            raise ValueError("min_history must be >= 2")
+        self.name = self.kind
+        self.metric = metric
+        self.zscore = float(zscore)
+        self.min_history = int(min_history)
+        self.min_rel_std = float(min_rel_std)
+        self._hist: Deque[float] = collections.deque(maxlen=int(history))
+
+    def reset(self) -> None:
+        self._hist.clear()
+
+    def config(self) -> dict:
+        return {"metric": self.metric, "zscore": self.zscore,
+                "min_history": self.min_history,
+                "history": self._hist.maxlen,
+                "min_rel_std": self.min_rel_std}
+
+    def observe(self, records: Sequence[dict]) -> List[Anomaly]:
+        out: List[Anomaly] = []
+        for r in records:
+            v = r.get(self.metric)
+            if v is None or not math.isfinite(v):
+                continue
+            if len(self._hist) >= self.min_history:
+                mean = sum(self._hist) / len(self._hist)
+                var = (sum((x - mean) ** 2 for x in self._hist)
+                       / (len(self._hist) - 1))
+                # a (near-)flat-lined metric has no noise to measure
+                # spikes against: floor the std at min_rel_std of the
+                # mean's magnitude, so a noiseless baseline still
+                # catches a genuine spike without firing on rounding
+                std = max(math.sqrt(var),
+                          self.min_rel_std * max(abs(mean), 1e-12))
+                if (v - mean) / std >= self.zscore:
+                    out.append(Anomaly(
+                        kind=self.kind, severity=self.severity,
+                        step=r["step"], first_step=r["step"],
+                        detector=self.name,
+                        evidence={"value": v, "mean": mean, "std": std,
+                                  "zscore": (v - mean) / std}))
+                    continue              # keep the baseline clean
+            self._hist.append(float(v))
+        return out
+
+
+class LossSpikeDetector(ZScoreDetector):
+    """Loss suddenly far above its trailing distribution — a corrupt
+    batch or the onset of divergence."""
+    kind = "loss_spike"
+
+    def __init__(self, metric: str = "loss", zscore: float = 8.0,
+                 min_history: int = 12, history: int = 256):
+        super().__init__(metric, zscore=zscore, min_history=min_history,
+                         history=history)
+
+
+class GradNormDetector(ZScoreDetector):
+    """Gradient-norm explosion relative to its trailing distribution
+    (pre-clip norm: clipping caps the update, not the signal)."""
+    kind = "grad_norm_explosion"
+
+    def __init__(self, metric: str = "amp/grad_norm",
+                 zscore: float = 8.0, min_history: int = 12,
+                 history: int = 256):
+        super().__init__(metric, zscore=zscore, min_history=min_history,
+                         history=history)
+
+
+class ScaleCollapseDetector(Detector):
+    """Loss scale pinned at its floor for ``windows`` consecutive
+    flushes — the storm signature: intermittent overflows keep beating
+    the scale back down faster than growth can recover it, without
+    ever forming the contiguous streak :class:`NanStreakDetector`
+    requires."""
+
+    def __init__(self, floor: float = 1.0, windows: int = 2,
+                 metric: str = "amp/loss_scale"):
+        if windows < 1:
+            raise ValueError(f"windows must be >= 1, got {windows}")
+        self.name = "scale_collapse"
+        self.floor = float(floor)
+        self.windows = int(windows)
+        self.metric = metric
+        self.reset()
+
+    def reset(self) -> None:
+        self._consec = 0
+        self._first: Optional[int] = None
+        self._fired = False
+
+    def config(self) -> dict:
+        return {"floor": self.floor, "windows": self.windows,
+                "metric": self.metric}
+
+    def observe(self, records: Sequence[dict]) -> List[Anomaly]:
+        scales = [(r["step"], r[self.metric]) for r in records
+                  if r.get(self.metric) is not None]
+        if not scales:
+            return []                     # no information this window
+        if max(v for _, v in scales) <= self.floor:
+            if self._consec == 0:
+                self._first = scales[0][0]
+            self._consec += 1
+            if self._consec >= self.windows and not self._fired:
+                self._fired = True
+                return [Anomaly(
+                    kind="scale_collapse", severity=SEVERITY_CRITICAL,
+                    step=scales[-1][0], first_step=self._first,
+                    detector=self.name,
+                    evidence={"floor": self.floor,
+                              "windows_at_floor": self._consec})]
+        else:
+            self.reset()
+        return []
+
+
+class StepTimeDetector(Detector):
+    """Straggler / throughput regression from HOST step-boundary wall
+    times.  The watchdog clocks ``check(step)`` calls itself (span-
+    style host telemetry — no device traffic) and feeds the deltas
+    here; a step slower than ``factor`` x the trailing median fires.
+    Outliers are excluded from the history, so a stall does not drag
+    the baseline up."""
+
+    def __init__(self, factor: float = 3.0, min_history: int = 12,
+                 history: int = 256):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        self.name = "straggler"
+        self.factor = float(factor)
+        self.min_history = int(min_history)
+        self._hist: Deque[float] = collections.deque(maxlen=int(history))
+        self._fired = False
+
+    def reset(self) -> None:
+        self._hist.clear()
+        self._fired = False
+
+    def config(self) -> dict:
+        return {"factor": self.factor, "min_history": self.min_history,
+                "history": self._hist.maxlen}
+
+    def observe(self, records: Sequence[dict]) -> List[Anomaly]:
+        return []                         # fed through observe_time
+
+    def observe_time(self, step: int, dt_s: float) -> Optional[Anomaly]:
+        if len(self._hist) >= self.min_history:
+            med = sorted(self._hist)[len(self._hist) // 2]
+            if med > 0 and dt_s >= self.factor * med:
+                # once per slowness EPISODE, not per slow step: a
+                # sustained slowdown (or a cadence of naturally-slower
+                # save/flush steps) must not flood the timeline
+                if self._fired:
+                    return None
+                self._fired = True
+                return Anomaly(
+                    kind="straggler", severity=SEVERITY_WARN,
+                    step=step, first_step=step, detector=self.name,
+                    evidence={"step_time_s": round(dt_s, 6),
+                              "median_s": round(med, 6),
+                              "slowdown": round(dt_s / med, 2)})
+            self._fired = False           # normal step re-arms
+        self._hist.append(float(dt_s))
+        return None
+
+
+def default_detectors(scale_floor: float = 1.0) -> List[Detector]:
+    """The standard detector suite (``scale_floor`` should match the
+    scaler config's ``min_loss_scale``)."""
+    return [NanStreakDetector(),
+            LossSpikeDetector(),
+            GradNormDetector(),
+            ScaleCollapseDetector(floor=scale_floor),
+            StepTimeDetector()]
+
+
+# ---------------------------------------------------------------------
+# Escalation policy
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WatchdogPolicy:
+    """Anomaly kind -> action mapping plus the escalation budgets.
+
+    ``actions``: base action per anomaly kind (unknown kinds warn).
+    ``quarantine_budget``: same-kind quarantines tolerated per
+    INCIDENT before escalating that kind to rollback; the counts
+    clear after a full clean window (or a rollback), so isolated
+    spikes days apart never accumulate into a spurious rollback.
+    ``rollback``: the rollback budget and widening backoff — a shared
+    :class:`RetryPolicy`; once ``rollback.max_retries`` rollbacks have
+    been spent, the next rollback-grade anomaly aborts.
+    """
+    actions: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_ACTIONS))
+    quarantine_budget: int = 2
+    rollback: RetryPolicy = dataclasses.field(
+        default_factory=lambda: RetryPolicy(max_retries=2,
+                                            base_delay_s=0.05,
+                                            max_delay_s=2.0))
+
+    def __post_init__(self):
+        for kind, act in self.actions.items():
+            if act not in _LADDER:
+                raise ValueError(f"unknown action {act!r} for anomaly "
+                                 f"kind {kind!r}; known: {_LADDER}")
+        if self.quarantine_budget < 0:
+            raise ValueError("quarantine_budget must be >= 0")
+
+    def action_for(self, anomaly: Anomaly) -> str:
+        return self.actions.get(anomaly.kind, ACTION_WARN)
+
+
+# ---------------------------------------------------------------------
+# The watchdog
+# ---------------------------------------------------------------------
+
+class Watchdog:
+    """Anomaly watchdog over a telemetry session's window flushes.
+
+    >>> tel = telemetry.Telemetry(run_dir, window=32)
+    >>> wd = Watchdog(telemetry=tel)          # observer auto-attached
+    >>> res = run_elastic(step_fn, mgr, opt, total_steps=...,
+    ...                   watchdog=wd,
+    ...                   on_quarantine=lambda a:
+    ...                       box.update(amp=box["amp"].re_anchor()))
+
+    Detection runs inside the session's flush (host side, window
+    cadence); decisions surface at step boundaries through
+    ``check(step)``, which ``run_elastic`` calls for you.  Without a
+    session, call ``observe(records)`` with decoded ring records
+    directly (the chaos suite drives it this way).
+
+    LKG stamping: ``run_elastic`` reports cadence saves via
+    ``note_save`` and drains ``resolved_saves()`` — a save is stamped
+    good only once ``clean_window`` further steps were observed with
+    no quarantine-or-worse anomaly; any such anomaly voids every
+    still-aging candidate.
+    """
+
+    def __init__(self, detectors: Optional[Sequence[Detector]] = None,
+                 policy: Optional[WatchdogPolicy] = None,
+                 telemetry=None,
+                 clean_window: Optional[int] = None,
+                 postmortem_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.detectors: List[Detector] = (
+            list(detectors) if detectors is not None
+            else default_detectors())
+        self.policy = policy or WatchdogPolicy()
+        self.telemetry = telemetry
+        if clean_window is None:
+            clean_window = (telemetry.ring.window
+                            if telemetry is not None else 32)
+        if clean_window < 1:
+            raise ValueError("clean_window must be >= 1")
+        self.clean_window = int(clean_window)
+        self.postmortem_dir = postmortem_dir or (
+            getattr(telemetry, "run_dir", None))
+        self._clock = clock
+        self._time_det: Optional[StepTimeDetector] = next(
+            (d for d in self.detectors
+             if isinstance(d, StepTimeDetector)), None)
+        self.timeline: List[Anomaly] = []     # full history, in order
+        self.events: List[dict] = []          # full action-event history
+        self._pending: List[Anomaly] = []     # awaiting a verdict
+        self._event_records: List[dict] = []  # queued for the next flush
+        self._recent: Deque[dict] = collections.deque(maxlen=1024)
+        self._pending_saves: List[int] = []
+        self._resolved: List[Tuple[int, bool]] = []
+        self._quarantines: Dict[str, int] = {}
+        self._last_anomaly_step: Optional[int] = None
+        self._rollbacks = 0
+        self._last_step_t: Optional[float] = None
+        self._attached = False
+        if telemetry is not None:
+            telemetry.add_observer(self._on_flush)
+            self._attached = True
+
+    # ---- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        if self._attached and self.telemetry is not None:
+            self.telemetry.remove_observer(self._on_flush)
+            self._attached = False
+
+    def __enter__(self) -> "Watchdog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def rollbacks(self) -> int:
+        """Rollbacks spent from the policy's budget so far."""
+        return self._rollbacks
+
+    # ---- observation (window-flush cadence, host side) -------------------
+    def _on_flush(self, records: Sequence[dict]) -> List[dict]:
+        """Telemetry flush observer: detect, then hand the anomaly +
+        action event records back for the emitters to write."""
+        events = [a.record() for a in self.observe(records)]
+        events += self._event_records
+        self._event_records = []
+        return events
+
+    def observe(self, records: Sequence[dict]) -> List[Anomaly]:
+        """Run every detector over one window's decoded step records;
+        returns (and queues for ``check``) the anomalies found."""
+        step_records = [r for r in records
+                        if r.get("kind", "step") == "step"]
+        if not step_records:
+            return []
+        self._recent.extend(step_records)
+        found: List[Anomaly] = []
+        for det in self.detectors:
+            found.extend(det.observe(step_records))
+        self._ingest(found)
+        newest = step_records[-1]["step"]
+        # LKG aging: saves survive once a full clean window passed them
+        # (any quarantine-grade anomaly above already voided them all)
+        while self._pending_saves and \
+                newest >= self._pending_saves[0] + self.clean_window:
+            self._resolved.append((self._pending_saves.pop(0), True))
+        # incident closure: a full clean window since the last
+        # quarantine-or-worse anomaly forgives the quarantine counts
+        # (policy docstring); _ingest keeps the watermark fresh while
+        # an incident is live, so the age test suffices
+        if self._last_anomaly_step is not None and \
+                newest >= self._last_anomaly_step + self.clean_window:
+            self._quarantines.clear()
+            self._last_anomaly_step = None
+        return found
+
+    def _ingest(self, found: Sequence[Anomaly]) -> None:
+        if not found:
+            return
+        self.timeline.extend(found)
+        self._pending.extend(found)
+        # incident state keys on quarantine-or-worse anomalies only: a
+        # warn-grade straggler must neither void LKG candidates nor
+        # hold the quarantine-forgiveness window open
+        serious = [a for a in found
+                   if _LADDER.index(self.policy.action_for(a))
+                   >= _LADDER.index(ACTION_QUARANTINE)]
+        if serious:
+            self._last_anomaly_step = max(
+                [a.step for a in serious]
+                + ([self._last_anomaly_step]
+                   if self._last_anomaly_step is not None else []))
+            # the open incident voids every still-aging save
+            # candidate: none of them has proven a clean window
+            for s in self._pending_saves:
+                self._resolved.append((s, False))
+            self._pending_saves.clear()
+
+    # ---- supervisor surface (step-boundary cadence) ----------------------
+    def note_save(self, step: int) -> None:
+        """A cadence checkpoint was scheduled at ``step``; it starts
+        aging toward last-known-good (pin it in the manager).
+
+        A save taken inside an OPEN incident — anomalies awaiting a
+        verdict at this very boundary, or within ``clean_window``
+        steps of the last quarantine-or-worse anomaly — is rejected
+        immediately: it snapshots state that went through the
+        anomalous window (the quarantine re-anchor has not even run
+        yet), and letting it age into LKG would hand a later rollback
+        the very state being rolled away from."""
+        step = int(step)
+        if self._pending or (
+                self._last_anomaly_step is not None
+                and step <= self._last_anomaly_step + self.clean_window):
+            self._resolved.append((step, False))
+            return
+        self._pending_saves.append(step)
+        self._pending_saves.sort()
+
+    def resolved_saves(self) -> List[Tuple[int, bool]]:
+        """Drain (step, became_good) verdicts for previously noted
+        saves — ``run_elastic`` marks good / unpins accordingly."""
+        out, self._resolved = self._resolved, []
+        return out
+
+    def check(self, step: int) -> Verdict:
+        """THE step-boundary poll (``run_elastic`` calls it once per
+        step): clock the step for the straggler detector, then fold
+        every pending anomaly through the escalation policy into one
+        verdict.  Pure host logic — no device traffic."""
+        now = self._clock()
+        if self._last_step_t is not None and self._time_det is not None:
+            a = self._time_det.observe_time(step, now - self._last_step_t)
+            if a is not None:
+                self._ingest([a])
+                self._event_records.append(a.record())
+        self._last_step_t = now
+        if not self._pending:
+            return Verdict(ACTION_NONE, None)
+        worst, worst_anomaly = ACTION_NONE, None
+        for a in self._pending:
+            act = self.policy.action_for(a)
+            if act == ACTION_QUARANTINE:
+                n = self._quarantines.get(a.kind, 0) + 1
+                self._quarantines[a.kind] = n
+                if n > self.policy.quarantine_budget:
+                    act = ACTION_ROLLBACK    # ladder: repeat offender
+            if _LADDER.index(act) > _LADDER.index(worst):
+                worst, worst_anomaly = act, a
+        self._pending = []
+        if worst == ACTION_ROLLBACK:
+            if self.policy.rollback.exhausted(self._rollbacks + 1):
+                worst = ACTION_ABORT         # budget spent
+            else:
+                # counted only when the rollback will actually run, so
+                # `rollbacks` always reads as rollbacks EXECUTED
+                self._rollbacks += 1
+        return Verdict(worst, worst_anomaly)
+
+    # ---- actions (called by run_elastic) ---------------------------------
+    def _event(self, rec: dict) -> None:
+        self.events.append(rec)
+        self._event_records.append(rec)
+
+    def note_quarantine(self, step: int, anomaly: Optional[Anomaly]
+                        ) -> None:
+        self._event({
+            "kind": "watchdog", "action": ACTION_QUARANTINE,
+            "step": int(step),
+            "anomaly": anomaly.kind if anomaly else None})
+
+    def note_rollback(self, restored_step: int, step: int,
+                      anomaly: Optional[Anomaly]) -> None:
+        """A rollback restored ``restored_step``: rewind telemetry so
+        the replayed steps re-record, reset every detector (replayed
+        step numbers must not re-trigger on stale history), void the
+        aging save candidates, and log the event."""
+        self._event({
+            "kind": "watchdog", "action": ACTION_ROLLBACK,
+            "step": int(step), "to_step": int(restored_step),
+            "anomaly": anomaly.kind if anomaly else None,
+            "rollbacks": self._rollbacks})
+        if self.telemetry is not None:
+            self.telemetry.rewind(restored_step)
+        for det in self.detectors:
+            det.reset()
+        self._pending = []
+        for s in self._pending_saves:
+            self._resolved.append((s, False))
+        self._pending_saves.clear()
+        self._quarantines.clear()
+        # the restored state predates the incident: replayed saves are
+        # trustworthy candidates again
+        self._last_anomaly_step = None
+        self._last_step_t = None             # restore time is not a step
+
+    # ---- abort diagnostics -----------------------------------------------
+    def write_postmortem(self, step: int,
+                         anomaly: Optional[Anomaly] = None,
+                         directory: Optional[str] = None
+                         ) -> Optional[str]:
+        """Write the post-mortem bundle; returns its path (None when
+        even that failed — aborting must never be blocked on disk).
+
+        Layout: ``postmortem-step<N>/`` with ``anomalies.jsonl`` (the
+        full anomaly timeline + action events), ``ring_dump.jsonl``
+        (the recent decoded step records), ``config.json`` (policy,
+        detector configs, environment, process topology) and
+        ``retraces.json`` (compilation counters, when a telemetry
+        session carries them)."""
+        base = directory or self.postmortem_dir or "."
+        path = os.path.join(base, f"postmortem-step{int(step)}")
+        try:
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "anomalies.jsonl"), "w",
+                      encoding="utf-8") as f:
+                for a in self.timeline:
+                    f.write(json.dumps(a.record(), sort_keys=True) + "\n")
+                for e in self.events:
+                    f.write(json.dumps(e, sort_keys=True) + "\n")
+                if anomaly is not None:
+                    f.write(json.dumps(
+                        {"kind": "watchdog", "action": ACTION_ABORT,
+                         "step": int(step), "anomaly": anomaly.kind},
+                        sort_keys=True) + "\n")
+            with open(os.path.join(path, "ring_dump.jsonl"), "w",
+                      encoding="utf-8") as f:
+                for r in self._recent:
+                    f.write(json.dumps(r, sort_keys=True) + "\n")
+            with open(os.path.join(path, "config.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(self._config_snapshot(step), f, indent=1,
+                          sort_keys=True, default=str)
+            retrace = getattr(self.telemetry, "retrace", None)
+            if retrace is not None:
+                with open(os.path.join(path, "retraces.json"), "w",
+                          encoding="utf-8") as f:
+                    json.dump(retrace.records(step=int(step)), f,
+                              indent=1, sort_keys=True)
+            return path
+        except OSError:
+            return None
+
+    def _config_snapshot(self, step: int) -> dict:
+        env = {k: v for k, v in sorted(os.environ.items())
+               if k.startswith(("APEX_TPU_", "JAX_", "XLA_"))}
+        topo: Dict[str, Any] = {}
+        try:
+            import jax
+            topo = {"backend": jax.default_backend(),
+                    "process_index": jax.process_index(),
+                    "process_count": jax.process_count(),
+                    "device_count": jax.device_count()}
+        except Exception:                    # diagnostics must not raise
+            pass
+        return {
+            "step": int(step),
+            "argv": list(sys.argv),
+            "policy": {"actions": dict(self.policy.actions),
+                       "quarantine_budget": self.policy.quarantine_budget,
+                       "rollback": dataclasses.asdict(
+                           self.policy.rollback)},
+            "detectors": {d.name: d.config() for d in self.detectors},
+            "clean_window": self.clean_window,
+            "rollbacks_spent": self._rollbacks,
+            "env": env,
+            "topology": topo,
+        }

@@ -48,6 +48,26 @@ def _fmt_cell(v) -> str:
     return str(v)
 
 
+def _anomaly_row(r: dict) -> List[str]:
+    """One anomaly-timeline row from a ``kind:"anomaly"`` detection or
+    a ``kind:"watchdog"`` action event."""
+    step = str(r.get("step", "-"))
+    if r.get("kind") == "watchdog":
+        action = r.get("action", "-")
+        detail = []
+        if r.get("anomaly"):
+            detail.append(f"anomaly={r['anomaly']}")
+        if r.get("to_step") is not None:
+            detail.append(f"to_step={r['to_step']}")
+        if r.get("rollbacks") is not None:
+            detail.append(f"rollbacks={r['rollbacks']}")
+        return [step, "action", action, " ".join(detail) or "-"]
+    detail = " ".join(f"{k}={_fmt_cell(v)}" for k, v in
+                      sorted((r.get("evidence") or {}).items()))
+    return [step, r.get("anomaly", "-"), r.get("severity", "-"),
+            detail or "-"]
+
+
 def _render_table(header: List[str], rows: List[List[str]], out) -> None:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(header)]
@@ -69,8 +89,9 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
     schema, records = load_jsonl(resolved)
     steps = [r for r in records if r.get("kind", "step") == "step"]
     # span/counter/retrace records are cumulative snapshots: keep the
-    # newest per name
-    spans, counters, retraces = {}, {}, {}
+    # newest per name; anomaly/watchdog records are EVENTS — every one
+    # is a timeline row
+    spans, counters, retraces, anomalies = {}, {}, {}, []
     for r in records:
         if r.get("kind") == "span":
             spans[r["name"]] = r
@@ -78,6 +99,8 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
             counters[r["name"]] = r
         elif r.get("kind") == "retrace":
             retraces[r["name"]] = r
+        elif r.get("kind") in ("anomaly", "watchdog"):
+            anomalies.append(r)
     if not steps:
         print(f"{resolved}: no step records", file=out)
         return 1
@@ -96,6 +119,7 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
     if as_json:
         json.dump({"source": resolved, "steps": steps,
                    "overflow_steps": overflows,
+                   "anomalies": anomalies,
                    "spans": sorted(spans.values(),
                                    key=lambda r: r["name"]),
                    "counters": sorted(counters.values(),
@@ -116,6 +140,16 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
     rows = [[str(r["step"])] + [_fmt_cell(r.get(m)) for m in metrics]
             for r in show]
     _render_table(header, rows, out)
+    if anomalies:
+        # the watchdog's anomaly timeline: detections (kind:"anomaly")
+        # interleaved with the actions taken (kind:"watchdog") in
+        # event order, stably sorted by step
+        print("\nanomaly timeline:", file=out)
+        _render_table(
+            ["step", "event", "severity/action", "detail"],
+            [_anomaly_row(r)
+             for r in sorted(anomalies,
+                             key=lambda r: r.get("step", 0))], out)
     if spans:
         print("\nspans (cumulative):", file=out)
         _render_table(

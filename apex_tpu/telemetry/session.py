@@ -114,6 +114,10 @@ class Telemetry:
         if retrace:
             self.retrace = RetraceCounter()
             self.retrace.install()
+        # flush observers (the resilience watchdog's detector hook):
+        # called with each flush's decoded step records; whatever
+        # records they return ride the same emit
+        self._observers: List = []
         self._closed = False
 
     # ---- hot path --------------------------------------------------------
@@ -190,15 +194,33 @@ class Telemetry:
             self._recorded_since_flush = 1    # current step still pending
 
     # ---- flush boundary --------------------------------------------------
+    def add_observer(self, fn) -> None:
+        """Register a flush observer: ``fn(records) -> extra records
+        or None``, called with each flush's decoded step records; any
+        records it returns are emitted alongside (how the resilience
+        watchdog's detectors see the window and how its anomaly events
+        reach the JSONL).  Observers run on EVERY rank — with
+        ``rank0_only`` sessions the flush ``device_get`` is performed
+        for them even on non-writer ranks (multi-host watchdogs must
+        all reach the same verdict), while emitters stay rank-0."""
+        self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        try:
+            self._observers.remove(fn)
+        except ValueError:
+            pass
+
     def flush(self, upto_step: Optional[int] = None) -> List[dict]:
         """THE host sync: one ``device_get`` of the ring, decoded to
         records and handed to every emitter.  Returns the new step
-        records (non-writer ranks skip the transfer and return []).
-        ``upto_step`` bounds what is emitted (the auto-flush passes the
-        previous step so a still-accumulating step is never cut off);
-        manual/close flushes emit everything."""
+        records (non-writer ranks skip the transfer — unless an
+        observer needs it — and return []).  ``upto_step`` bounds what
+        is emitted (the auto-flush passes the previous step so a
+        still-accumulating step is never cut off); manual/close
+        flushes emit everything."""
         self._recorded_since_flush = 0
-        if not self._writer:
+        if not self._writer and not self._observers:
             return []
         # THE intended sync: once per window, outside the step hot path
         host = jax.device_get(self._buf)   # apexlint: disable=APX101
@@ -206,13 +228,35 @@ class Telemetry:
                                    upto_step=upto_step)
         if records:
             self._flushed_upto = records[-1]["step"]
+        events: List[dict] = []
+        for obs in list(self._observers):
+            more = obs(records)
+            if more:
+                events.extend(more)
+        if not self._writer:
+            return []
         extras = self.spans.records(step=self._last_step)
         extras += self.counters.records(step=self._last_step)
         if self.retrace is not None:
             extras += self.retrace.records(step=self._last_step)
         for e in self._emitters:
-            e.emit(records + extras)
+            e.emit(records + extras + events)
         return records
+
+    def rewind(self, upto_step: int) -> None:
+        """Roll the session back to ``upto_step`` — the watchdog's
+        rollback-and-replay support.  Steps after ``upto_step`` are
+        about to be REPLAYED: flush what has accumulated (the bad
+        window stays on the record), then reset the ring and the
+        emitted-step watermark so the replayed steps record and emit
+        again.  ``summarize`` keeps the newest record per step, so the
+        replay overwrites the rolled-back values on the rendered
+        surface while the raw JSONL keeps both."""
+        self.flush()
+        self._buf = self.ring.init()
+        self._flushed_upto = int(upto_step)
+        self._last_step = int(upto_step)
+        self._recorded_since_flush = 0
 
     def close(self) -> None:
         """Final flush + release emitters and hooks (idempotent)."""

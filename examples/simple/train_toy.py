@@ -17,6 +17,15 @@ a :class:`~apex_tpu.resilience.PreemptionGuard` that converts SIGTERM
 (or the deterministic ``--preempt-at-step N``) into one final forced
 checkpoint and a clean exit.  Kill it, rerun it, and it continues
 bit-exactly where it left off.
+
+Self-healing (``--watchdog``, needs both dirs above): a
+:class:`~apex_tpu.resilience.Watchdog` watches the telemetry window
+flushes for NaN storms, loss spikes and loss-scale collapse, and
+escalates quarantine (loss-scale re-anchor) -> rollback to the
+last-known-good checkpoint -> abort-with-diagnostics.  Prove it with
+``--inject-nan-at N``: a NaN fault poisons a few steps, the watchdog
+rolls back and replays, and the anomaly shows up in
+``python -m apex_tpu.telemetry summarize DIR``.
 """
 
 import argparse
@@ -65,6 +74,16 @@ def parse_args(argv=None):
     p.add_argument("--preempt-at-step", type=int, default=None,
                    help="simulate a preemption notice at step N "
                         "(save-now-then-clean-exit)")
+    p.add_argument("--watchdog", action="store_true",
+                   help="self-healing: anomaly watchdog over the "
+                        "telemetry flushes (needs --telemetry-dir and "
+                        "--checkpoint-dir)")
+    p.add_argument("--inject-nan-at", type=int, default=None,
+                   help="chaos: poison gradients with NaN for a few "
+                        "steps starting at N (the watchdog detects, "
+                        "rolls back to last-known-good and replays)")
+    p.add_argument("--inject-nan-steps", type=int, default=6,
+                   help="how many steps the NaN fault poisons")
     return p.parse_args(argv)
 
 
@@ -98,12 +117,24 @@ def main(argv=None):
         pred = forward(p, x.astype(jnp.bfloat16))
         return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
 
+    injector = None
+    if args.inject_nan_at is not None:
+        from apex_tpu.resilience.faults import FaultInjector, FaultSpec
+        injector = FaultInjector([FaultSpec(
+            "nan_grads", at_step=args.inject_nan_at,
+            n_steps=args.inject_nan_steps)]).install()
+    from apex_tpu.resilience.faults import training_fault
+
     box = {"amp": amp_state}
     losses = []
 
     def train_one(step):
+        batch = x
+        fault = training_fault(step)   # no-op None without --inject-*
+        if fault is not None and fault.kind == "nan_grads":
+            batch = x * jnp.nan        # poisoned batch -> NaN grads
         loss, flat = pipe.scaled_value_and_grad(
-            loss_fn, box["amp"].scaler, opt.params, x, y)
+            loss_fn, box["amp"].scaler, opt.params, batch, y)
         opt.step(flat)                    # skips itself on overflow
         box["amp"] = amp.update_scaler(box["amp"], flat.found_inf)
         if tel is not None:
@@ -120,6 +151,26 @@ def main(argv=None):
                   f"scale {float(box['amp'].scaler.loss_scale):.0f} "   # apexlint: disable=APX102
                   f"inf {int(flat.found_inf)}")   # apexlint: disable=APX102
 
+    wd = None
+    if args.watchdog:
+        if tel is None or not args.checkpoint_dir:
+            raise SystemExit("--watchdog needs --telemetry-dir and "
+                             "--checkpoint-dir (the sensor and the "
+                             "actuator of the self-healing loop)")
+        from apex_tpu.resilience.watchdog import (GradNormDetector,
+                                                  LossSpikeDetector,
+                                                  NanStreakDetector,
+                                                  ScaleCollapseDetector,
+                                                  Watchdog)
+        # toy-scaled thresholds: a short run needs a short streak and
+        # a clean window that ages within a few save cadences
+        wd = Watchdog(
+            detectors=[NanStreakDetector(streak=4),
+                       LossSpikeDetector(),
+                       GradNormDetector(),
+                       ScaleCollapseDetector()],
+            telemetry=tel, clean_window=8)
+
     preempted = False
     resumed = False
     if args.checkpoint_dir:
@@ -131,6 +182,9 @@ def main(argv=None):
                 train_one, mgr, opt, total_steps=args.steps,
                 guard=PreemptionGuard(
                     preempt_at_step=args.preempt_at_step),
+                watchdog=wd,
+                on_quarantine=lambda anomaly: box.update(
+                    amp=box["amp"].re_anchor()),
                 save_extras=lambda: {
                     "amp_state": box["amp"].state_dict()},
                 on_restore=lambda amp_sd, extra, step: box.update(
@@ -139,6 +193,9 @@ def main(argv=None):
         if res.restored_from is not None:
             resumed = True
             print(f"resumed at step {res.restored_from}")
+        if res.rollbacks:
+            print(f"watchdog: rolled back and replayed "
+                  f"{res.rollbacks}x — run self-healed")
         preempted = res.preempted
         if preempted:
             print(f"preempted: final checkpoint durable at step "
@@ -146,6 +203,10 @@ def main(argv=None):
     else:
         for step in range(1, args.steps + 1):
             train_one(step)
+    if wd is not None:
+        wd.close()
+    if injector is not None:
+        injector.uninstall()
 
     final_loss = None
     if tel is not None:

@@ -65,6 +65,69 @@ def test_scaler_min_clamp():
     assert float(s.loss_scale) == 1.0
 
 
+def test_scaler_external_skip_does_not_advance_growth_interval():
+    """ISSUE 7 regression: a watchdog/quarantine skip is neither a
+    clean step nor an overflow — the growth tracker must HOLD, not
+    count the non-stepped window toward the growth interval (and the
+    scale must not move)."""
+    cfg = amp.LossScaleConfig(init_scale=8.0, growth_interval=3)
+    s = amp.LossScaleState.create(8.0)
+    s = amp.update_state(s, jnp.int32(0), cfg)
+    assert int(s.growth_tracker) == 1
+    # forced skips: tracker and scale frozen, however many
+    for _ in range(5):
+        s = amp.update_state(s, jnp.int32(0), cfg, skipped=jnp.int32(1))
+    assert int(s.growth_tracker) == 1
+    assert float(s.loss_scale) == 8.0
+    # resuming clean steps completes the ORIGINAL interval
+    s = amp.update_state(s, jnp.int32(0), cfg)
+    s = amp.update_state(s, jnp.int32(0), cfg)
+    assert float(s.loss_scale) == 16.0
+    # skipped=0 behaves exactly like the plain update
+    s2 = amp.update_state(s, jnp.int32(0), cfg, skipped=jnp.int32(0))
+    assert int(s2.growth_tracker) == 1
+
+
+def test_scaler_external_skip_traced_under_jit():
+    cfg = amp.LossScaleConfig(init_scale=8.0, growth_interval=2)
+    step = jax.jit(lambda s, fi, sk: amp.update_state(s, fi, cfg,
+                                                      skipped=sk))
+    s = amp.LossScaleState.create(8.0)
+    s = step(s, jnp.int32(0), jnp.int32(1))       # skipped: hold
+    assert int(s.growth_tracker) == 0
+    s = step(s, jnp.int32(0), jnp.int32(0))
+    s = step(s, jnp.int32(0), jnp.int32(0))       # 2 clean: grow
+    assert float(s.loss_scale) == 16.0
+
+
+def test_re_anchor_resets_to_operating_point():
+    cfg = amp.LossScaleConfig(init_scale=2.0 ** 10, growth_interval=4)
+    s = amp.LossScaleState.create(2.0 ** 10)
+    for _ in range(6):                            # collapse to floor
+        s = amp.update_state(s, jnp.int32(1), cfg)
+    s = amp.update_state(s, jnp.int32(0), cfg)
+    assert float(s.loss_scale) < 2.0 ** 10
+    r = amp.re_anchor(s, cfg)
+    assert float(r.loss_scale) == 2.0 ** 10
+    assert int(r.growth_tracker) == 0 and int(r.found_inf) == 0
+    r2 = amp.re_anchor(s, cfg, scale=64.0)        # explicit override
+    assert float(r2.loss_scale) == 64.0
+
+
+def test_amp_state_re_anchor_and_update_scaler_skipped():
+    params = {"w": jnp.ones((2,))}
+    _, state = amp.initialize(params, opt_level="O2",
+                              loss_scale="dynamic")
+    state = amp.update_scaler(state, jnp.int32(1))     # backoff
+    assert float(state.scaler.loss_scale) == 2.0 ** 15
+    held = amp.update_scaler(state, jnp.int32(0),
+                             skipped=jnp.int32(1))     # external skip
+    assert int(held.scaler.growth_tracker) == 0
+    assert float(held.scaler.loss_scale) == 2.0 ** 15
+    anchored = state.re_anchor()
+    assert float(anchored.scaler.loss_scale) == 2.0 ** 16
+
+
 def test_state_dict_roundtrip():
     params = {"w": jnp.ones((2,))}
     _, state = amp.initialize(params, opt_level="O2",

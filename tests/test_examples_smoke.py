@@ -71,6 +71,32 @@ def test_train_toy_preempt_and_resume(tmp_path, capsys):
     assert "ckpt/save_ms" in out and "checkpoint/save" in out
 
 
+def test_train_toy_watchdog_self_heals_nan_fault(tmp_path, capsys):
+    """The self-healing acceptance flow: an injected NaN fault storms
+    past the scaler's backoff, the watchdog detects the streak at a
+    window flush, rolls back to the last-known-good checkpoint,
+    replays to completion — and the anomaly timeline (detection +
+    rollback action) renders on the summarize surface."""
+    import warnings as _warnings
+
+    ckpt = str(tmp_path / "ckpt")
+    tel = str(tmp_path / "telemetry")
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")      # the rollback warns: fine
+        _run("examples/simple/train_toy.py",
+             ["--steps", "48", "--save-every", "6",
+              "--checkpoint-dir", ckpt, "--telemetry-dir", tel,
+              "--watchdog", "--inject-nan-at", "20"])
+    out = capsys.readouterr().out
+    assert "run self-healed" in out
+    assert "OK:" in out                       # replay converged
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["summarize", tel]) == 0
+    out = capsys.readouterr().out
+    assert "anomaly timeline:" in out
+    assert "nan_streak" in out and "rollback" in out
+
+
 def test_imagenet_preempt_and_resume(tmp_path, capsys):
     """The imagenet example's save path rides the same resilience
     manager: --checkpoint-dir rotates bucket-native checkpoints and a

@@ -1208,3 +1208,739 @@ def test_v2_extra_restores_with_shapedtypestruct_template(tmp_path):
         p, jax.tree_util.tree_map(jnp.zeros_like, tree),
         FusedAdam(_mixed_tree(), lr=1e-2), extra_like=like)
     _assert_tree_equal(out[3], extra)
+
+
+# =====================================================================
+# ISSUE 7: self-healing — anomaly watchdog, LKG rollback-and-replay,
+# RetryPolicy, training-state chaos.
+# =====================================================================
+
+from apex_tpu import telemetry as telemetry_mod
+from apex_tpu.resilience import (RetryPolicy, Watchdog, WatchdogAbort,
+                                 WatchdogPolicy)
+from apex_tpu.resilience import watchdog as wd_mod
+from apex_tpu.resilience.watchdog import (GradNormDetector,
+                                          LossSpikeDetector,
+                                          NanStreakDetector,
+                                          ScaleCollapseDetector,
+                                          StepTimeDetector)
+
+
+# ---------------------------------------------------------------------
+# RetryPolicy (satellite: configurable run_elastic backoff)
+# ---------------------------------------------------------------------
+
+def test_retry_policy_delays_widen_and_cap():
+    p = RetryPolicy(max_retries=5, base_delay_s=0.1, max_delay_s=0.5)
+    assert [p.delay_s(i) for i in (1, 2, 3, 4)] == \
+        [0.1, 0.2, 0.4, 0.5]                       # doubles, then caps
+    assert not p.exhausted(5) and p.exhausted(6)
+
+
+def test_retry_policy_jitter_deterministic_with_rng():
+    import random
+    p = RetryPolicy(base_delay_s=1.0, jitter=0.5)
+    a = p.delay_s(1, rng=random.Random(7))
+    b = p.delay_s(1, rng=random.Random(7))
+    assert a == b and 1.0 <= a < 1.5
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.0)
+    with pytest.raises(ValueError):
+        RetryPolicy(base_delay_s=-0.1)
+    with pytest.raises(ValueError):
+        RetryPolicy().delay_s(0)                   # attempts are 1-based
+
+
+def test_run_elastic_honors_retry_policy_fake_clock(tmp_path):
+    """run_elastic's transient-failure backoff comes from the policy:
+    a fake clock records the exact widening-then-capped delays."""
+    job = _Job(str(tmp_path), multihost=False)
+    job.opt.step(job.g)
+    job.mgr.save(3, optimizer=job.opt)             # valid restore target
+    job.mgr.wait()
+
+    fails = []
+
+    def flaky(step):
+        if len(fails) < 3:
+            fails.append(step)
+            raise OSError("transient")
+        job.step_fn(step)
+
+    slept = []
+    res = run_elastic(
+        flaky, job.mgr, job.opt, total_steps=6,
+        params_like=job.template,
+        retry=RetryPolicy(max_retries=3, base_delay_s=1.0,
+                          max_delay_s=2.5),
+        sleep=slept.append)
+    assert res.restarts == 3 and res.step == 6
+    assert slept == [1.0, 2.0, 2.5]                # widened, then capped
+    job.mgr.close()
+
+
+# ---------------------------------------------------------------------
+# CheckpointManager: LKG tagging + retention pinning
+# ---------------------------------------------------------------------
+
+def test_lkg_survives_rotation_and_manager_restart(tmp_path):
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    with CheckpointManager(str(tmp_path), keep=2, every=2) as mgr:
+        for step in range(1, 5):
+            opt.step(g)
+            mgr.maybe_save(step, optimizer=opt)
+        mgr.wait()
+        mgr.mark_good(2)
+        for step in range(5, 11):
+            opt.step(g)
+            mgr.maybe_save(step, optimizer=opt)
+        mgr.wait()
+        # keep=2 newest + the pinned LKG
+        assert 2 in mgr.steps_on_disk()
+        assert mgr.steps_on_disk()[-2:] == [8, 10]
+        assert mgr.lkg_step() == 2
+    # a restarted manager inherits the persisted stamp
+    mgr2 = CheckpointManager(str(tmp_path), keep=2, every=2)
+    assert mgr2.lkg_step() == 2
+    mgr2.close()
+
+
+def test_pin_exempts_from_rotation_until_unpinned(tmp_path):
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    with CheckpointManager(str(tmp_path), keep=1, every=1) as mgr:
+        opt.step(g)
+        mgr.maybe_save(1, optimizer=opt)
+        mgr.wait()
+        mgr.pin(1)
+        for step in (2, 3, 4):
+            opt.step(g)
+            mgr.maybe_save(step, optimizer=opt)
+            mgr.wait()
+        assert 1 in mgr.steps_on_disk()            # pinned: survives
+        mgr.unpin(1)
+        opt.step(g)
+        mgr.maybe_save(5, optimizer=opt)
+        mgr.wait()
+        assert 1 not in mgr.steps_on_disk()        # unpinned: rotated
+
+
+def test_restore_good_walks_from_lkg_not_newest(tmp_path):
+    """Rollback must not land on a checkpoint newer than the LKG —
+    those may hold the very state being rolled away from."""
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    with CheckpointManager(str(tmp_path), keep=5, every=2) as mgr:
+        snapshots = {}
+        for step in range(1, 9):
+            opt.step(g)
+            if mgr.due(step):
+                snapshots[step] = [np.asarray(b)
+                                   for b in opt._param_bufs]
+            mgr.maybe_save(step, optimizer=opt)
+        mgr.wait()
+        mgr.mark_good(4)
+        opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+        out = mgr.restore_good(
+            jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+        assert out is not None and out[2] == 4     # LKG, not 8
+        for got, exp in zip(opt2._param_bufs, snapshots[4]):
+            np.testing.assert_array_equal(np.asarray(got), exp)
+
+
+def test_restore_latest_max_step_filters_and_falls_back(tmp_path):
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    with CheckpointManager(str(tmp_path), keep=5, every=2) as mgr:
+        for step in range(1, 9):
+            opt.step(g)
+            mgr.maybe_save(step, optimizer=opt)
+        mgr.wait()
+        # corrupt step-4 so the bounded walk must fall back to 2
+        p4 = mgr._path(4)
+        open(p4, "wb").write(open(p4, "rb").read()[:30])
+        opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+        with pytest.warns(UserWarning, match="skipping"):
+            out = mgr.restore_latest(
+                jax.tree_util.tree_map(jnp.zeros_like, tree), opt2,
+                max_step=4)
+        assert out is not None and out[2] == 2
+
+
+def test_restore_good_without_stamp_degrades_to_latest(tmp_path):
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    with CheckpointManager(str(tmp_path), keep=2, every=1) as mgr:
+        opt.step(_grads_for(tree))
+        mgr.maybe_save(1, optimizer=opt)
+        mgr.wait()
+        assert mgr.lkg_step() is None
+        out = mgr.restore_good(
+            jax.tree_util.tree_map(jnp.zeros_like, tree),
+            FusedAdam(_mixed_tree(), lr=1e-2))
+        assert out is not None and out[2] == 1
+
+
+# ---------------------------------------------------------------------
+# Detectors
+# ---------------------------------------------------------------------
+
+def _steps(vals, metric, start=0):
+    return [{"step": start + i, metric: v}
+            for i, v in enumerate(vals)]
+
+
+def test_nan_streak_fires_once_per_streak_and_resets():
+    d = NanStreakDetector(streak=3)
+    a = d.observe(_steps([1, 1], "amp/found_inf"))
+    assert a == []                                 # below threshold
+    a = d.observe(_steps([1, 1, 1], "amp/found_inf", start=2))
+    assert len(a) == 1 and a[0].kind == "nan_streak"
+    assert a[0].severity == "critical"
+    # 3rd consecutive overflow is step 2; streak anchored at step 0
+    assert a[0].first_step == 0 and a[0].step == 2
+    assert a[0].evidence["consecutive_overflows"] == 3
+    # continuing the SAME streak does not re-fire ...
+    assert d.observe(_steps([1, 1], "amp/found_inf", start=5)) == []
+    # ... a clean step re-arms, and a fresh streak fires again
+    assert d.observe(_steps([0, 1, 1, 1], "amp/found_inf",
+                            start=7)) != []
+
+
+def test_nan_streak_ignores_unrecorded_steps():
+    d = NanStreakDetector(streak=2)
+    recs = [{"step": 0, "amp/found_inf": 1.0},
+            {"step": 1, "amp/found_inf": None},    # metric not recorded
+            {"step": 2, "amp/found_inf": 1.0}]
+    assert len(d.observe(recs)) == 1               # None is not a reset
+
+
+def test_loss_spike_zscore_and_baseline_not_poisoned():
+    d = LossSpikeDetector(zscore=6.0, min_history=8)
+    base = [1.0 + 0.01 * (i % 5) for i in range(16)]
+    assert d.observe(_steps(base, "loss")) == []
+    a = d.observe(_steps([50.0], "loss", start=16))
+    assert len(a) == 1 and a[0].kind == "loss_spike"
+    assert a[0].evidence["zscore"] >= 6.0
+    # the spike was excluded from the history: the baseline still
+    # fires on the next spike instead of having absorbed the outlier
+    a2 = d.observe(_steps([50.0], "loss", start=17))
+    assert len(a2) == 1
+
+
+def test_loss_spike_flat_baseline_still_detects():
+    """A noiseless baseline (std == 0) must not divide by zero NOR go
+    blind — the relative-std floor keeps genuine spikes detectable."""
+    d = LossSpikeDetector(zscore=8.0, min_history=8)
+    d.observe(_steps([1.0] * 12, "loss"))
+    a = d.observe(_steps([100.0], "loss", start=12))
+    assert len(a) == 1
+
+
+def test_grad_norm_explosion_detector():
+    d = GradNormDetector(zscore=6.0, min_history=8)
+    d.observe(_steps([0.5 + 0.01 * (i % 3) for i in range(12)],
+                     "amp/grad_norm"))
+    a = d.observe(_steps([1e4], "amp/grad_norm", start=12))
+    assert len(a) == 1 and a[0].kind == "grad_norm_explosion"
+
+
+def test_scale_collapse_needs_consecutive_floored_windows():
+    d = ScaleCollapseDetector(floor=1.0, windows=2)
+    assert d.observe(_steps([1.0, 1.0], "amp/loss_scale")) == []
+    a = d.observe(_steps([1.0, 1.0], "amp/loss_scale", start=2))
+    assert len(a) == 1 and a[0].kind == "scale_collapse"
+    assert a[0].evidence["windows_at_floor"] == 2
+    # recovery above the floor re-arms
+    d.observe(_steps([2.0], "amp/loss_scale", start=4))
+    assert d.observe(_steps([1.0, 1.0], "amp/loss_scale",
+                            start=5)) == []
+
+
+def test_step_time_detector_flags_straggler_not_baseline():
+    d = StepTimeDetector(factor=3.0, min_history=4)
+    for i in range(8):
+        assert d.observe_time(i, 0.1) is None
+    a = d.observe_time(8, 0.5)
+    assert a is not None and a.kind == "straggler"
+    assert a.evidence["slowdown"] >= 3.0
+    # the stall was excluded from the history: baseline stays 0.1
+    assert d.observe_time(9, 0.1) is None
+
+
+# ---------------------------------------------------------------------
+# Watchdog escalation policy
+# ---------------------------------------------------------------------
+
+def _loss_window(wd, start, n, loss=1.0, **extra):
+    recs = []
+    for i in range(n):
+        r = {"kind": "step", "step": start + i, "loss": loss,
+             "amp/found_inf": 0.0, "amp/loss_scale": 1024.0}
+        r.update(extra)
+        recs.append(r)
+    wd.observe(recs)
+    return start + n
+
+
+def test_quarantine_budget_escalates_to_rollback():
+    wd = Watchdog(detectors=[LossSpikeDetector(min_history=4)],
+                  policy=WatchdogPolicy(quarantine_budget=1),
+                  clean_window=4)
+    step = _loss_window(wd, 0, 8)
+    # spike 1: quarantine; spike 2 (same kind): over budget -> rollback
+    wd.observe([{"kind": "step", "step": step, "loss": 1e5}])
+    assert wd.check(step).action == "quarantine"
+    wd.observe([{"kind": "step", "step": step + 1, "loss": 1e5}])
+    assert wd.check(step + 1).action == "rollback"
+
+
+def test_rollback_budget_exhaustion_aborts():
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  policy=WatchdogPolicy(rollback=RetryPolicy(
+                      max_retries=1, base_delay_s=0.0)),
+                  clean_window=4)
+    wd.observe(_steps([1, 1], "amp/found_inf"))
+    assert wd.check(2).action == "rollback"
+    wd.note_rollback(0, 2, None)                   # detectors reset
+    wd.observe(_steps([1, 1], "amp/found_inf", start=3))
+    assert wd.check(5).action == "abort"           # budget spent
+
+
+def test_warn_kind_takes_no_action_but_lands_in_timeline():
+    wd = Watchdog(detectors=[StepTimeDetector(factor=2.0,
+                                              min_history=2)],
+                  clean_window=4)
+    t = [0.0]
+    wd._clock = lambda: t[0]
+    for i in range(6):
+        t[0] += 0.1
+        assert wd.check(i).action == "none"
+    t[0] += 5.0                                    # the straggler step
+    assert wd.check(6).action == "warn"
+    assert [a.kind for a in wd.timeline] == ["straggler"]
+
+
+def test_lkg_stamping_requires_full_clean_window():
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  clean_window=8)
+    wd.note_save(3)
+    _loss_window(wd, 0, 8)                         # newest == 7 < 3+8
+    assert wd.resolved_saves() == []
+    _loss_window(wd, 8, 8)                         # newest == 15 >= 11
+    assert wd.resolved_saves() == [(3, True)]
+
+
+def test_anomaly_voids_aging_save_candidates():
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  clean_window=8)
+    wd.note_save(3)
+    wd.note_save(6)
+    recs = _steps([0, 0, 1, 1], "amp/found_inf", start=4)
+    for r in recs:
+        r["kind"] = "step"
+    wd.observe(recs)
+    assert sorted(wd.resolved_saves()) == [(3, False), (6, False)]
+
+
+def test_warn_anomaly_does_not_void_candidates():
+    wd = Watchdog(detectors=[StepTimeDetector(factor=2.0,
+                                              min_history=2)],
+                  clean_window=4)
+    t = [0.0]
+    wd._clock = lambda: t[0]
+    wd.note_save(1)
+    for i in range(4):
+        t[0] += 0.1
+        wd.check(i)
+    t[0] += 5.0
+    assert wd.check(4).action == "warn"
+    _loss_window(wd, 0, 8)                         # ages past 1+4
+    assert wd.resolved_saves() == [(1, True)]
+
+
+def test_postmortem_bundle_contents(tmp_path):
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  clean_window=4, postmortem_dir=str(tmp_path))
+    recs = _steps([1, 1], "amp/found_inf")
+    for r in recs:
+        r["kind"] = "step"
+    wd.observe(recs)
+    v = wd.check(2)
+    pm = wd.write_postmortem(2, v.anomaly)
+    assert pm == str(tmp_path / "postmortem-step2")
+    import json as _json
+    lines = [_json.loads(l) for l in
+             open(os.path.join(pm, "anomalies.jsonl"))]
+    assert any(r.get("anomaly") == "nan_streak" for r in lines)
+    dump = [_json.loads(l) for l in
+            open(os.path.join(pm, "ring_dump.jsonl"))]
+    assert [r["step"] for r in dump] == [0, 1]
+    cfg = _json.load(open(os.path.join(pm, "config.json")))
+    assert cfg["detectors"]["nan_streak"]["streak"] == 2
+    assert "policy" in cfg and "rollback" in cfg["policy"]
+    assert cfg["topology"].get("backend") == "cpu"
+
+
+# ---------------------------------------------------------------------
+# Self-healing chaos matrix: every training-state fault kind x
+# {single-host, faked multi-host} must end in the DOCUMENTED action
+# (quarantine / rollback-to-LKG / warn), training must run to
+# completion, and post-recovery state matches an uninterrupted run
+# bit-exactly where determinism allows (nan storm and loss-spike
+# rollbacks replay clean; a scale collapse rolls back to a mid-storm
+# LKG by design — metrics before detection are not anomalies — so
+# that case asserts recovery, not bit-exactness).
+# ---------------------------------------------------------------------
+
+from apex_tpu.resilience import faults as faults_mod
+
+_WD_TOTAL, _WD_EVERY = 24, 3
+
+
+def _fast_rollback_policy(**kw):
+    return WatchdogPolicy(rollback=RetryPolicy(max_retries=2,
+                                               base_delay_s=0.0), **kw)
+
+
+class _WdJob:
+    """One self-healing 'process lifetime': telemetry session (window
+    4 -> flush every 4 recorded steps) + watchdog + manager, wired the
+    way train_toy wires them."""
+
+    def __init__(self, ckpt_dir, multihost, policy=None,
+                 scale_window=4, straggler_factor=50.0):
+        tree = _mixed_tree()
+        self.opt = FusedAdam(tree, lr=1e-2)
+        self.scaler = LossScaler(loss_scale="dynamic",
+                                 init_scale=2.0 ** 2,
+                                 scale_window=scale_window)
+        self.g = _grads_for(tree)
+        self.mgr = CheckpointManager(ckpt_dir, keep=3, every=_WD_EVERY)
+        if multihost:
+            _mirror_peer(self.mgr)
+        self.template = jax.tree_util.tree_map(jnp.zeros_like, tree)
+        self.tel = telemetry_mod.Telemetry(run_dir=None, window=4,
+                                           retrace=False)
+        self.wd = Watchdog(
+            detectors=[NanStreakDetector(streak=3),
+                       LossSpikeDetector(min_history=6, zscore=6.0),
+                       ScaleCollapseDetector(floor=1.0, windows=2),
+                       StepTimeDetector(factor=straggler_factor,
+                                        min_history=6)],
+            policy=policy or _fast_rollback_policy(),
+            telemetry=self.tel, clean_window=4)
+        self.quarantined = []
+
+    def step_fn(self, step):
+        f = faults_mod.training_fault(step)
+        kind = f.kind if f is not None else None
+        bad = 0
+        loss = 1.0 + 0.001 * step
+        if kind == "nan_grads":
+            bad = 1
+        elif kind == "scale_collapse":
+            bad = 1 if step % 2 == 0 else 0   # intermittent: no streak
+        elif kind == "loss_spike":
+            loss = 1e4
+        if not bad:
+            self.opt.step(self.g)
+        self.scaler.update_scale(bad)
+        # eager host loop: bad/loss_scale are host floats, not tracers
+        self.tel.record(
+            {"loss": loss, "amp/found_inf": float(bad),   # apexlint: disable=APX101
+             "amp/loss_scale": self.scaler.loss_scale()}, step)
+
+    def on_quarantine(self, anomaly):
+        self.quarantined.append(anomaly.kind)
+        self.scaler.state = amp.re_anchor(self.scaler.state,
+                                          self.scaler.config)
+
+    def run(self):
+        return run_elastic(
+            self.step_fn, self.mgr, self.opt, total_steps=_WD_TOTAL,
+            params_like=self.template, watchdog=self.wd,
+            on_quarantine=self.on_quarantine,
+            save_extras=lambda: {"amp_state": self.scaler.state_dict()},
+            on_restore=lambda amp_sd, extra, step:
+                self.scaler.load_state_dict(amp_sd) if amp_sd else None,
+            backoff_s=0.0)
+
+    def close(self):
+        self.wd.close()
+        self.tel.close()
+        self.mgr.close()
+
+
+from apex_tpu import amp  # noqa: E402  (re_anchor in on_quarantine)
+
+
+@pytest.fixture(scope="module")
+def _wd_reference(tmp_path_factory):
+    """The uninterrupted run every healed run must match."""
+    job = _WdJob(str(tmp_path_factory.mktemp("wd_ref")),
+                 multihost=False)
+    res = job.run()
+    assert res.step == _WD_TOTAL and res.rollbacks == 0
+    job.close()
+    return job
+
+
+@pytest.mark.parametrize("multihost", [False, True],
+                         ids=["singlehost", "multihost"])
+def test_nan_storm_rolls_back_to_lkg_and_replays_bit_exact(
+        tmp_path, multihost, _wd_reference):
+    """Acceptance: an injected NaN storm (outlasting the scaler's
+    backoff) triggers detection, multi-host-agreed rollback to the
+    last-known-good checkpoint, and the replayed run completes
+    bit-identical to an uninterrupted one."""
+    with FaultInjector([FaultSpec("nan_grads", at_step=10,
+                                  n_steps=4)]) as inj:
+        job = _WdJob(str(tmp_path), multihost)
+        with pytest.warns(UserWarning, match="watchdog rollback"):
+            res = job.run()
+        assert inj.fired
+    assert res.step == _WD_TOTAL and res.rollbacks == 1
+    assert "nan_streak" in [a.kind for a in job.wd.timeline]
+    rb = [e for e in job.wd.events if e["action"] == "rollback"]
+    assert rb and rb[0]["to_step"] < 10        # LKG is pre-storm
+    assert job.mgr.lkg_step() is not None
+    ref = _wd_reference
+    _assert_tree_equal(job.opt.params, ref.opt.params)
+    _opt_states_equal(job.opt, ref.opt)
+    assert job.scaler.state_dict() == ref.scaler.state_dict()
+    job.close()
+
+
+@pytest.mark.parametrize("multihost", [False, True],
+                         ids=["singlehost", "multihost"])
+def test_single_loss_spike_is_quarantined_not_rolled_back(
+        tmp_path, multihost):
+    """A one-off loss spike stays at the quarantine rung: the
+    on_quarantine hook re-anchors the scaler, training continues, no
+    checkpoint is touched."""
+    with FaultInjector([FaultSpec("loss_spike", at_step=10,
+                                  n_steps=1)]) as inj:
+        job = _WdJob(str(tmp_path), multihost)
+        with pytest.warns(UserWarning, match="watchdog quarantined"):
+            res = job.run()
+        assert inj.fired
+    assert res.step == _WD_TOTAL and res.rollbacks == 0
+    assert job.quarantined == ["loss_spike"]
+    assert [e["action"] for e in job.wd.events] == ["quarantine"]
+    # re-anchor happened: scale back at the configured operating point
+    # at quarantine time (and grows normally afterwards)
+    assert float(job.scaler.loss_scale()) >= 2.0 ** 2
+    job.close()
+
+
+@pytest.mark.parametrize("multihost", [False, True],
+                         ids=["singlehost", "multihost"])
+def test_persistent_loss_spikes_escalate_to_rollback_bit_exact(
+        tmp_path, multihost, _wd_reference):
+    """Acceptance: a persistent loss-spike fault exhausts the
+    quarantine budget, escalates to a multi-host-agreed rollback to
+    LKG, and the replayed run completes bit-identical to an
+    uninterrupted one (the spike only poisoned the METRIC stream; the
+    optimizer path is deterministic, so replay heals exactly)."""
+    with FaultInjector([FaultSpec("loss_spike", at_step=10,
+                                  n_steps=2)]) as inj:
+        job = _WdJob(str(tmp_path), multihost,
+                     policy=_fast_rollback_policy(quarantine_budget=0))
+        with pytest.warns(UserWarning, match="watchdog rollback"):
+            res = job.run()
+        assert inj.fired
+    assert res.step == _WD_TOTAL and res.rollbacks == 1
+    assert "loss_spike" in [a.kind for a in job.wd.timeline]
+    ref = _wd_reference
+    _assert_tree_equal(job.opt.params, ref.opt.params)
+    _opt_states_equal(job.opt, ref.opt)
+    assert job.scaler.state_dict() == ref.scaler.state_dict()
+    job.close()
+
+
+@pytest.mark.parametrize("multihost", [False, True],
+                         ids=["singlehost", "multihost"])
+def test_scale_collapse_storm_rolls_back_and_recovers(
+        tmp_path, multihost):
+    """Intermittent overflows pin the scale at the floor without ever
+    forming a NaN streak; the collapse detector fires after N floored
+    windows and the rollback-and-replay recovers the scale."""
+    with FaultInjector([FaultSpec("scale_collapse", at_step=8,
+                                  n_steps=8)]) as inj:
+        job = _WdJob(str(tmp_path), multihost, scale_window=8)
+        with pytest.warns(UserWarning, match="watchdog rollback"):
+            res = job.run()
+        assert inj.fired
+    assert res.step == _WD_TOTAL and res.rollbacks >= 1
+    assert "scale_collapse" in [a.kind for a in job.wd.timeline]
+    # recovered: the replayed run ends with the scale off the floor
+    assert job.scaler.loss_scale() > 1.0
+    job.close()
+
+
+@pytest.mark.parametrize("multihost", [False, True],
+                         ids=["singlehost", "multihost"])
+def test_straggler_stall_warns_without_state_action(
+        tmp_path, multihost, _wd_reference):
+    """A straggling step is an infrastructure signal, not a state
+    corruption: the watchdog records the anomaly and takes NO
+    state-changing action — and the run still matches the reference
+    bit-exactly (the fault only burned wall time)."""
+    with FaultInjector([FaultSpec("straggler", at_step=12, n_steps=1,
+                                  delay_s=2.0)]) as inj:
+        job = _WdJob(str(tmp_path), multihost, straggler_factor=8.0)
+        res = job.run()
+        assert inj.fired
+    assert res.step == _WD_TOTAL and res.rollbacks == 0
+    assert "straggler" in [a.kind for a in job.wd.timeline]
+    assert all(e["action"] not in ("rollback", "quarantine")
+               for e in job.wd.events)
+    ref = _wd_reference
+    _assert_tree_equal(job.opt.params, ref.opt.params)
+    _opt_states_equal(job.opt, ref.opt)
+    job.close()
+
+
+def test_rollback_exhaustion_aborts_with_postmortem(tmp_path):
+    """A PERSISTENT fault (never spent) exhausts the rollback budget;
+    the abort raises WatchdogAbort after writing the post-mortem
+    bundle — the anomaly timeline and ring dump are on disk."""
+    pm_dir = str(tmp_path / "pm")
+    with FaultInjector([FaultSpec("nan_grads", at_step=6,
+                                  n_steps=10_000)]):
+        job = _WdJob(str(tmp_path / "ckpt"), multihost=False,
+                     policy=_fast_rollback_policy())
+        job.wd.postmortem_dir = pm_dir
+        with pytest.raises(WatchdogAbort) as ei:
+            with pytest.warns(UserWarning, match="watchdog rollback"):
+                job.run()
+    assert ei.value.postmortem and os.path.isdir(ei.value.postmortem)
+    assert os.path.exists(os.path.join(ei.value.postmortem,
+                                       "anomalies.jsonl"))
+    assert os.path.exists(os.path.join(ei.value.postmortem,
+                                       "ring_dump.jsonl"))
+    assert os.path.exists(os.path.join(ei.value.postmortem,
+                                       "config.json"))
+    job.close()
+
+
+def test_watchdog_overhead_bench_smoke():
+    from apex_tpu.telemetry.bench import bench_watchdog_overhead
+    r = bench_watchdog_overhead(layers=2, hidden=16, window=8,
+                                iters=2, reps=1)
+    assert r["watchdog_on_ms"] > 0 and r["watchdog_off_ms"] > 0
+    assert r["watchdog_observe_ms"] >= 0
+    assert r["watchdog_detectors"] >= 4
+
+
+def test_quarantine_counts_forgiven_after_clean_window():
+    """Isolated same-kind spikes separated by a full clean window must
+    each stay at the quarantine rung — escalation is per incident, not
+    per lifetime."""
+    wd = Watchdog(detectors=[LossSpikeDetector(min_history=4)],
+                  policy=WatchdogPolicy(quarantine_budget=1),
+                  clean_window=4)
+    step = _loss_window(wd, 0, 8)
+    wd.observe([{"kind": "step", "step": step, "loss": 1e5}])
+    assert wd.check(step).action == "quarantine"
+    step = _loss_window(wd, step + 1, 6)       # clean window: forgiven
+    wd.observe([{"kind": "step", "step": step, "loss": 1e5}])
+    assert wd.check(step).action == "quarantine"   # not rollback
+
+
+# ---------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------
+
+def test_save_inside_open_incident_never_ages_into_lkg():
+    """A cadence save taken at the same boundary an anomaly is awaiting
+    its verdict (or within a clean window of the last serious anomaly)
+    snapshots state that went through the anomalous window — it must
+    be rejected as an LKG candidate immediately, not aged."""
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  clean_window=4)
+    wd.observe(_steps([1, 1], "amp/found_inf"))    # anomaly pending
+    wd.note_save(2)                                # same boundary
+    assert wd.resolved_saves() == [(2, False)]
+    assert wd.check(2).action == "rollback"
+    # still inside the incident window after the verdict drained
+    wd.note_save(4)
+    assert wd.resolved_saves() == [(4, False)]
+    # after the rollback the restored state predates the incident:
+    # replayed saves are candidates again and age normally
+    wd.note_rollback(0, 4, None)
+    wd.note_save(3)
+    _loss_window(wd, 1, 8)                         # newest 8 >= 3+4
+    assert wd.resolved_saves() == [(3, True)]
+
+
+def test_straggler_fires_once_per_episode():
+    """A sustained slowdown (or naturally slower cadence steps) must
+    not flood the timeline: one anomaly per episode, re-armed by a
+    normal-speed step — and suppressed samples stay out of the
+    baseline."""
+    d = StepTimeDetector(factor=3.0, min_history=4)
+    for i in range(6):
+        d.observe_time(i, 0.1)
+    assert d.observe_time(6, 1.0) is not None
+    assert d.observe_time(7, 1.0) is None          # same episode
+    assert d.observe_time(8, 0.1) is None          # re-arms
+    assert d.observe_time(9, 1.0) is not None      # new episode
+
+
+def test_warn_anomaly_does_not_hold_incident_open():
+    """Straggler warns between quarantines must not block the
+    per-incident forgiveness of quarantine counts."""
+    wd = Watchdog(detectors=[LossSpikeDetector(min_history=4),
+                             StepTimeDetector(factor=2.0,
+                                              min_history=2)],
+                  policy=WatchdogPolicy(quarantine_budget=1),
+                  clean_window=4)
+    t = [0.0]
+    wd._clock = lambda: t[0]
+    step = _loss_window(wd, 0, 8)
+    wd.observe([{"kind": "step", "step": step, "loss": 1e5}])
+    assert wd.check(step).action == "quarantine"
+    # keep the straggler detector firing warns through the clean window
+    for i in range(4):
+        t[0] += 0.1 if i else 10.0                 # one stall, then ok
+        wd.check(step + 1 + i)
+    step = _loss_window(wd, step + 1, 6)           # clean window passes
+    wd.observe([{"kind": "step", "step": step, "loss": 1e5}])
+    assert wd.check(step).action == "quarantine"   # forgiven, not
+    #                                                escalated
+
+
+def test_direct_abort_mapping_reports_zero_rollbacks():
+    """An anomaly kind mapped straight to abort must not claim a
+    negative rollback count — `rollbacks` reads as rollbacks
+    EXECUTED."""
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  policy=WatchdogPolicy(
+                      actions={"nan_streak": wd_mod.ACTION_ABORT}),
+                  clean_window=4)
+    wd.observe(_steps([1, 1], "amp/found_inf"))
+    assert wd.check(2).action == "abort"
+    assert wd.rollbacks == 0
+
+
+def test_identical_duplicate_fault_specs_both_fire():
+    """fired is index-keyed: two IDENTICAL scheduled specs must both
+    appear once applied (NamedTuple equality would alias them)."""
+    spec = FaultSpec("nan_grads", at_step=1, n_steps=1)
+    inj = FaultInjector([spec, spec])
+    assert inj.training_fault(1) is not None       # spends spec #0
+    assert inj.training_fault(2) is not None       # spends spec #1
+    assert inj.training_fault(3) is None           # both budgets spent
+    assert len(inj.fired) == 2

@@ -678,3 +678,116 @@ def test_train_toy_telemetry_end_to_end(tmp_path, capsys):
     table = capsys.readouterr().out
     assert "grad_norm" in table and "loss_scale" in table
     assert "final_eval" in table
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: flush observers, rewind, anomaly timeline (watchdog surface)
+# ---------------------------------------------------------------------------
+
+def test_flush_observer_sees_records_and_injects_events(tmp_path):
+    d = str(tmp_path / "run")
+    seen, tel = [], telemetry.Telemetry(d, metrics=("loss",), window=4,
+                                        retrace=False)
+
+    def obs(records):
+        seen.extend(r["step"] for r in records)
+        if records:
+            return [{"kind": "anomaly", "anomaly": "test_kind",
+                     "severity": "warn", "step": records[-1]["step"],
+                     "first_step": records[0]["step"],
+                     "detector": "t", "evidence": {}}]
+
+    tel.add_observer(obs)
+    for i in range(6):
+        tel.record({"loss": float(i)}, i)
+    tel.close()
+    assert seen == [0, 1, 2, 3, 4, 5]         # every step reached it
+    lines = [json.loads(l) for l in
+             open(os.path.join(d, JSONL_NAME))]
+    assert any(r.get("kind") == "anomaly" and
+               r.get("anomaly") == "test_kind" for r in lines)
+
+
+def test_flush_observer_runs_on_nonwriter_rank(tmp_path, monkeypatch):
+    """Multi-host watchdogs must all reach the same verdict: with an
+    observer attached, a rank0_only session still fetches and decodes
+    its LOCAL ring on non-zero ranks — emitters stay silent."""
+    d = str(tmp_path / "rank1")
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    tel = telemetry.Telemetry(d, metrics=("loss",), window=2,
+                              retrace=False)
+    seen = []
+    tel.add_observer(lambda records:
+                     seen.extend(r["step"] for r in records))
+    tel.record({"loss": 1.0}, 0)
+    tel.record({"loss": 2.0}, 1)
+    assert tel.flush() == []                  # contract: returns []
+    tel.close()
+    assert seen == [0, 1]                     # ...but the observer saw
+    assert not os.path.exists(os.path.join(d, JSONL_NAME))
+
+
+def test_remove_observer_and_no_observer_skips_fetch(monkeypatch):
+    tel = telemetry.Telemetry(run_dir=None, metrics=("loss",),
+                              window=4, retrace=False)
+    calls = []
+    obs = lambda records: calls.append(len(records))
+    tel.add_observer(obs)
+    tel.remove_observer(obs)
+    tel.remove_observer(obs)                  # idempotent
+    tel.record({"loss": 1.0}, 0)
+    tel.flush()
+    tel.close()
+    assert calls == []
+
+
+def test_rewind_replays_steps_and_summarize_keeps_newest(tmp_path,
+                                                         capsys):
+    """After a rollback, replayed step numbers must re-record and
+    re-emit; the raw JSONL keeps both passes, the summarize surface
+    renders the REPLAYED (newest) values."""
+    d = str(tmp_path / "run")
+    with telemetry.Telemetry(d, metrics=("loss",), window=4,
+                             retrace=False) as tel:
+        for i in range(1, 7):
+            tel.record({"loss": 100.0 + i}, i)    # the "bad" pass
+        tel.rewind(2)                             # rollback to step 2
+        for i in range(3, 7):
+            tel.record({"loss": float(i)}, i)     # the replay
+    lines = [json.loads(l) for l in open(os.path.join(d, JSONL_NAME))]
+    steps = [r for r in lines if r.get("kind", "step") == "step"
+             and "step" in r]
+    # both passes of step 4 are on the record
+    assert sorted(r["loss"] for r in steps
+                  if r["step"] == 4) == [4.0, 104.0]
+    assert telemetry_cli(["summarize", d, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    by_step = {r["step"]: r for r in payload["steps"]}
+    assert by_step[4]["loss"] == 4.0              # replay wins
+    assert by_step[1]["loss"] == 101.0            # pre-rollback kept
+
+
+def test_summarize_renders_anomaly_timeline(tmp_path, capsys):
+    d = tmp_path
+    recs = [
+        {"kind": "schema", "version": 1, "metrics": ["loss"]},
+        {"kind": "step", "step": 1, "loss": 1.0},
+        {"kind": "step", "step": 2, "loss": 999.0},
+        {"kind": "anomaly", "anomaly": "loss_spike",
+         "severity": "warn", "step": 2, "first_step": 2,
+         "detector": "loss_spike", "evidence": {"zscore": 12.5}},
+        {"kind": "watchdog", "action": "rollback", "step": 3,
+         "to_step": 1, "anomaly": "loss_spike", "rollbacks": 1},
+        {"kind": "step", "step": 3, "loss": 1.1},
+    ]
+    (d / "telemetry.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in recs) + "\n")
+    assert telemetry_cli(["summarize", str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "anomaly timeline:" in out
+    assert "loss_spike" in out and "zscore=12.5" in out
+    assert "rollback" in out and "to_step=1" in out
+    assert telemetry_cli(["summarize", str(d), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    kinds = [r["kind"] for r in payload["anomalies"]]
+    assert kinds == ["anomaly", "watchdog"]

@@ -455,6 +455,26 @@ def main():
         "speedup": (round(rt["telemetry_off_ms"] / rt["telemetry_on_ms"],
                           2) if rt["telemetry_on_ms"] else None)})
 
+    # watchdog overhead: the same instrumented step with the anomaly
+    # watchdog attached vs the bare step ("kernel" = watchdog-attached,
+    # "oracle" = bare — ~1.0 IS the pass condition: detectors are
+    # host-side, window-cadence only; the host detector cost shows up
+    # separately as watchdog_observe_ms)
+    from apex_tpu.telemetry.bench import bench_watchdog_overhead
+    rwd = bench_watchdog_overhead()
+    rwd["backend"] = backend
+    print(json.dumps(rwd), flush=True)
+    rows.append({
+        "kernel": "watchdog_overhead",
+        "shape": (f"{rwd['watchdog_leaves']}leaves/"
+                  f"w{rwd['watchdog_window']}"
+                  f"x{rwd['watchdog_detectors']}det"),
+        "dtype": "f32",
+        "kernel_ms": rwd["watchdog_on_ms"],
+        "oracle_ms": rwd["watchdog_off_ms"],
+        "speedup": (round(rwd["watchdog_off_ms"] / rwd["watchdog_on_ms"],
+                          2) if rwd["watchdog_on_ms"] else None)})
+
     for r in rows:
         r["backend"] = backend
         print(json.dumps(r), flush=True)
