@@ -312,6 +312,48 @@ def _build_watchdog_instrumented_step():
 
 
 @register_spec(
+    "profiler.annotated_step",
+    anchor="apex_tpu/telemetry/profiler/capture.py",
+    description="profiler-capable (annotate_step-wrapped) flat AMP "
+                "step: capture-off instrumentation is a trace-time "
+                "named scope that lowers to NOTHING — zero "
+                "callback/transfer primitives, no f64, no dead "
+                "collectives")
+def _build_profiler_annotated_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.telemetry.profiler import annotate_step
+
+    params = _mlp_params()
+    x = jax.random.normal(jax.random.key(2), (4, 8))
+    scaler = amp.LossScaleState.create()
+    opt = FusedAdam(params, lr=1e-3)
+    pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0)
+
+    def train_step(work_bufs, opt_state, scaler, x, step):
+        ptree = opt._plan.unpack_model(work_bufs)
+        loss, flat = pipe.scaled_value_and_grad(_mlp_loss, scaler,
+                                                ptree, x)
+        new_bufs, _, new_state = opt._full_step_flat(
+            work_bufs, None, opt_state, flat.bufs, step, 1.0,
+            {}, flat.found_inf)
+        return loss, new_bufs, new_state
+
+    return {
+        "fn": annotate_step(train_step, name="profiled_step"),
+        "args": (opt._param_bufs, opt.opt_state, scaler, x,
+                 jnp.int32(1)),
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
     "ddp.all_reduce_flat_buffers",
     anchor="apex_tpu/parallel/distributed.py",
     description="bucket-granular DDP all-reduce under shard_map: "

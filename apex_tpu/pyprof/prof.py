@@ -2,14 +2,17 @@
 the toolkit that parsed captured profiles into per-kernel tables;
 SURVEY.md §5 tracing).
 
-The TPU capture side is `jax.profiler.trace` (driven by
-tools/profile_step.py or `apex_tpu.pyprof.profile`); THIS module turns
-the written trace directory into the op-level table the reference's
-parsers produced — top device ops by total time, from the
-Chrome-format trace, with no xprof/tensorboard dependency.
+The TPU capture side is `jax.profiler.trace` (driven through
+`apex_tpu.telemetry.profiler.capture` — tools/profile_step.py and the
+observatory share that one code path); THIS module turns the written
+trace directory into the op-level table the reference's parsers
+produced — top ops by total time, from the Chrome-format trace, with
+no xprof/tensorboard dependency.  Typed event parsing itself lives in
+`apex_tpu.telemetry.profiler.events`; this is the thin table layer.
 
     from apex_tpu.pyprof import prof
     rows = prof.summarize_device_ops("/tmp/apex_tpu_trace")
+    rows = prof.summarize_ops("/tmp/apex_tpu_trace")   # + host ranges
 
     python -m apex_tpu.pyprof.prof /tmp/apex_tpu_trace
 """
@@ -17,51 +20,92 @@ Chrome-format trace, with no xprof/tensorboard dependency.
 from __future__ import annotations
 
 import collections
-import glob
-import gzip
 import json
-import os
+from typing import List
 
-__all__ = ["summarize_device_ops", "main"]
+__all__ = ["summarize_device_ops", "summarize_ops", "main"]
 
 
 def summarize_device_ops(outdir: str, top: int = 12):
-    """Top device ops by total time from the Chrome-format trace the
-    profiler writes (device thread named "XLA Ops" under a /device:*
-    process).  Returns [[name, total_ms, pct], ...].
+    """Top device ops by total time.  Returns [[name, total_ms, pct],
+    ...].
 
-    Only the device op thread is aggregated: the round-4 capture held
-    ~1M host python events against 434 device ops — counting hosts
-    would bury the signal this table exists to surface."""
-    paths = glob.glob(os.path.join(
-        outdir, "plugins", "profile", "*", "*.trace.json.gz"))
-    if not paths:
-        return []
-    # NEWEST capture by mtime: profiler run dirs are wall-clock named,
-    # but the format has changed across versions and hosts ("2026_01_02"
-    # vs "localhost_2026...") — lexicographic order would then pick an
-    # arbitrary old capture, silently summarizing a stale run
-    with gzip.open(max(paths, key=os.path.getmtime)) as f:
-        d = json.load(f)
-    ev = d.get("traceEvents", [])
-    device_pids = {e.get("pid") for e in ev
-                   if e.get("ph") == "M"
-                   and e.get("name") == "process_name"
-                   and "/device:" in str(e.get("args", {}).get("name"))}
-    op_tids = {(e.get("pid"), e.get("tid")) for e in ev
-               if e.get("ph") == "M" and e.get("name") == "thread_name"
-               and e.get("pid") in device_pids
-               and e.get("args", {}).get("name") == "XLA Ops"}
+    Only the device op timeline is aggregated (the round-4 capture
+    held ~1M host python events against 434 device ops — counting
+    hosts would bury the signal this table exists to surface); on the
+    CPU fallback the XLA executor threads stand in.  Parsing —
+    including newest-capture-by-mtime selection — delegates to
+    `apex_tpu.telemetry.profiler.events`."""
+    from apex_tpu.telemetry.profiler.events import load_device_events
     agg = collections.Counter()
-    for e in ev:
-        if (e.get("ph") == "X"
-                and (e.get("pid"), e.get("tid")) in op_tids):
-            agg[e["name"]] += e.get("dur", 0)
+    for ev in load_device_events(outdir, prefer="json"):
+        agg[ev.name] += ev.dur_us
     total = sum(agg.values())
     if not total:
         return []
     return [[name, round(dur / 1e3, 3), round(dur / total * 100, 1)]
             for name, dur in agg.most_common(top)]
+
+
+def _host_ranges(doc: dict) -> collections.Counter:
+    """Aggregate nvtx-style host ranges from a parsed Chrome doc:
+    named spans on host-process threads (``PjitFunction(step)``,
+    TraceMe annotations, user range names) — python-tracer stack
+    frames (``$file:line fn``) and the XLA executor threads (the CPU
+    fallback's "device" side, selected by
+    `events.device_events_from_chrome`) are excluded."""
+    ev = doc.get("traceEvents", [])
+    agg: collections.Counter = collections.Counter()
+    host_pids = {e.get("pid") for e in ev
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "/host:" in str(e.get("args", {}).get("name"))}
+    skip_tids = {(e.get("pid"), e.get("tid")) for e in ev
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"
+                 and str(e.get("args", {}).get("name"))
+                 .startswith("tf_XLA")}
+    for e in ev:
+        name = str(e.get("name", ""))
+        if (e.get("ph") != "X" or e.get("pid") not in host_pids
+                or (e.get("pid"), e.get("tid")) in skip_tids
+                or name.startswith(("$", "ThreadpoolListener"))
+                or not e.get("dur")):
+            continue
+        agg[name] += float(e["dur"])
+    return agg
+
+
+def summarize_ops(outdir: str, top: int = 12) -> List[list]:
+    """Device ops MERGED with nvtx host ranges when the capture holds
+    both: [[name, where, total_ms, pct], ...], ``where`` is
+    ``"device"`` or ``"host"``.  Shares (``pct``) are computed within
+    each side — device and host timelines overlap in wall time, so a
+    cross-side percentage would be meaningless.  A device-only trace
+    yields exactly the `summarize_device_ops` rows plus the column.
+    The (multi-MB on real captures) trace file is parsed ONCE; both
+    views derive from the same doc."""
+    from apex_tpu.telemetry.profiler.events import (
+        device_events_from_chrome, find_trace_files, read_chrome_doc)
+    path = find_trace_files(outdir).get("json")
+    if path is None:
+        return []
+    try:
+        doc = read_chrome_doc(path)
+    except Exception:
+        return []
+    agg: collections.Counter = collections.Counter()
+    for d in device_events_from_chrome(doc):
+        agg[d.name] += d.dur_us
+    dev_total = sum(agg.values())
+    rows = [[name, "device", round(dur / 1e3, 3),
+             round(dur / dev_total * 100, 1)]
+            for name, dur in agg.most_common(top)] if dev_total else []
+    host = _host_ranges(doc)
+    host_total = sum(host.values())
+    if host_total:
+        rows += [[name, "host", round(dur / 1e3, 3),
+                  round(dur / host_total * 100, 1)]
+                 for name, dur in host.most_common(top)]
+    return rows
 
 
 def main(argv=None) -> int:
@@ -71,25 +115,33 @@ def main(argv=None) -> int:
         description="op-level table from a jax.profiler trace dir")
     ap.add_argument("trace_dir")
     ap.add_argument("--top", type=int, default=12)
+    ap.add_argument("--device-only", action="store_true",
+                    help="suppress the host-range rows even when the "
+                         "capture holds them")
     ap.add_argument("--json", action="store_true",
                     help="emit the table as JSON rows (for telemetry "
                          "reports / CI embedding)")
     args = ap.parse_args(argv)
-    rows = summarize_device_ops(args.trace_dir, top=args.top)
+    rows = ([[n, "device", ms, pct] for n, ms, pct in
+             summarize_device_ops(args.trace_dir, top=args.top)]
+            if args.device_only
+            else summarize_ops(args.trace_dir, top=args.top))
+    # exit-code contract (both output modes): no DEVICE rows is a
+    # failed summarize (host-only trace / wrong dir) — host ranges
+    # alone cannot stand in for the op breakdown
+    ok = any(r[1] == "device" for r in rows)
     if args.json:
-        # same exit-code contract as the text path: an empty table is
-        # a failed summarize (host-only trace / wrong dir), but the
-        # output stays machine-parseable either way
-        print(json.dumps([{"op": n, "total_ms": ms, "pct": pct}
-                          for n, ms, pct in rows]))
-        return 0 if rows else 1
-    if not rows:
+        print(json.dumps([{"op": n, "where": where, "total_ms": ms,
+                           "pct": pct}
+                          for n, where, ms, pct in rows]))
+        return 0 if ok else 1
+    if not ok:
         print("no device op events found (host-only trace, or wrong "
               "directory)")
         return 1
     w = max(len(r[0]) for r in rows)
-    for name, ms, pct in rows:
-        print(f"{name:<{w}}  {ms:>10.3f} ms  {pct:>5.1f}%")
+    for name, where, ms, pct in rows:
+        print(f"{name:<{w}}  {where:<6}  {ms:>10.3f} ms  {pct:>5.1f}%")
     return 0
 
 

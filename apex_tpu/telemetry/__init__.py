@@ -25,11 +25,16 @@ flags as APX101 (and whose runtime twin is APX102).  Core invariant:
   runtime companion to the APX30x static rules.
 - ``python -m apex_tpu.telemetry summarize <run_dir>`` (cli.py):
   render a run's JSONL as step/span/retrace tables, stdlib-only.
+- :mod:`profiler` (profiler/): the performance observatory — trace
+  capture windows, device-time attribution (compute / collective /
+  transfer / idle + overlap fraction), cost-model MFU, and
+  ``python -m apex_tpu.telemetry profile <trace_dir>``.
 
 See docs/observability.md for the producer -> metric wiring table and
 the design rationale.
 """
 
+from apex_tpu.telemetry import profiler
 from apex_tpu.telemetry._tape import emit as emit_metric
 from apex_tpu.telemetry.emitters import (CsvEmitter, Emitter,
                                          JsonlEmitter, StepLogger)
@@ -41,5 +46,5 @@ from apex_tpu.telemetry.spans import span
 __all__ = [
     "MetricRing", "Telemetry", "DEFAULT_METRICS",
     "Emitter", "JsonlEmitter", "CsvEmitter", "StepLogger",
-    "RetraceCounter", "span", "emit_metric",
+    "RetraceCounter", "span", "emit_metric", "profiler",
 ]

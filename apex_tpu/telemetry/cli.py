@@ -1,7 +1,9 @@
 """``python -m apex_tpu.telemetry summarize <run_dir>`` — render a
 training run's JSONL telemetry as a step table plus span/retrace
-summaries, with no dependency beyond the standard library (works on a
-login host with no jax installed)."""
+summaries — and ``... profile <trace_dir>`` — render a captured
+profiler trace as the observatory report (step breakdown, collective
+overlap, MFU, top ops).  Both with no dependency beyond the standard
+library (works on a login host with no jax installed)."""
 
 from __future__ import annotations
 
@@ -115,11 +117,19 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
         seen = {k for r in steps for k in r}
         metrics = sorted(seen - {"step", "kind"})
     overflows = sum(1 for r in steps if (r.get("amp/found_inf") or 0) > 0)
+    # profiler headline counters (perf/step_ms, perf/mfu,
+    # perf/overlap_pct, ... — emitted by a profile_window capture taken
+    # during the run) get their own section; last value wins, like the
+    # gauges they are
+    perf = {n.split("/", 1)[1]: c.get("last")
+            for n, c in sorted(counters.items())
+            if n.startswith("perf/")}
 
     if as_json:
         json.dump({"source": resolved, "steps": steps,
                    "overflow_steps": overflows,
                    "anomalies": anomalies,
+                   "perf": perf,
                    "spans": sorted(spans.values(),
                                    key=lambda r: r["name"]),
                    "counters": sorted(counters.values(),
@@ -157,6 +167,11 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
             [[n, str(s.get("count", "-")), _fmt_cell(s.get("total_ms")),
               _fmt_cell(s.get("max_ms"))]
              for n, s in sorted(spans.items())], out)
+    if perf:
+        print("\nperf (profiler capture):", file=out)
+        _render_table(
+            ["metric", "value"],
+            [[n, _fmt_cell(v)] for n, v in sorted(perf.items())], out)
     if counters:
         # host counters (ckpt/save_ms, ckpt/bytes_written, ...):
         # count/total/max/last, cumulative like the span table
@@ -177,6 +192,23 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
     return 0
 
 
+def profile(trace_dir: str, *, top: int = 12,
+            steps: Optional[int] = None, as_json: bool = False,
+            out=None) -> int:
+    """Render the observatory report for a captured trace dir; exit 1
+    when the directory holds no device events (host-only trace, wrong
+    directory) — machine-parseable either way under ``--json``."""
+    from apex_tpu.telemetry.profiler import report as _report
+    out = out or sys.stdout
+    rep = _report.build_report(trace_dir, top=top, steps=steps)
+    if as_json:
+        json.dump(rep, out)
+        out.write("\n")
+    else:
+        _report.render_text(rep, out)
+    return 1 if rep.get("error") else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",
@@ -189,8 +221,24 @@ def main(argv=None) -> int:
                    help="show only the newest N steps (0 = all)")
     s.add_argument("--json", action="store_true",
                    help="machine-readable output")
+    p = sub.add_parser(
+        "profile",
+        help="render a captured jax.profiler trace dir as the "
+             "observatory report (breakdown, overlap, MFU, top ops)")
+    p.add_argument("trace_dir",
+                   help="trace directory (profiler.capture outdir)")
+    p.add_argument("--top", type=int, default=12,
+                   help="rows in the top-op table")
+    p.add_argument("--steps", type=int, default=None,
+                   help="step count override (traces without a "
+                        "profile_meta.json sidecar)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
     args = ap.parse_args(argv)
     try:
+        if args.cmd == "profile":
+            return profile(args.trace_dir, top=args.top,
+                           steps=args.steps, as_json=args.json)
         return summarize(args.run_dir, tail=args.tail, as_json=args.json)
     except BrokenPipeError:
         return 0          # |head etc. closing the pipe is not an error

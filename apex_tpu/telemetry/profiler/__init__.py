@@ -1,0 +1,42 @@
+"""apex_tpu.telemetry.profiler — the performance observatory.
+
+Capture → attribute → gate: programmatic ``jax.profiler`` trace
+windows (:mod:`capture`), typed device-timeline parsing from either
+the xplane proto or the stdlib Chrome-JSON path (:mod:`events`),
+device-time attribution into compute / collective / transfer / idle
+with the collective-overlap fraction (:mod:`attribution`), cost-model
+MFU from the compiled step's own cost analysis (:mod:`mfu`), and the
+rendered report + ``perf/*`` host counters (:mod:`report`).
+
+    meta = profiler.profile_window(step, state, batch, steps=20,
+                                   outdir="/tmp/trace")
+    # then, anywhere (no jax needed):
+    #   python -m apex_tpu.telemetry profile /tmp/trace [--json]
+
+The regression half lives in ``tools/perf_gate.py`` (BENCH trajectory
+vs ``tools/perf_budget.json``).  docs/perf.md has the workflow.
+"""
+
+from apex_tpu.telemetry.profiler.attribution import (Breakdown, attribute,
+                                                     classify, top_ops)
+from apex_tpu.telemetry.profiler.capture import (annotate_step,
+                                                 profile_window, trace,
+                                                 trace_options)
+from apex_tpu.telemetry.profiler.events import (DeviceEvent,
+                                                find_trace_files,
+                                                load_device_events,
+                                                load_meta)
+from apex_tpu.telemetry.profiler.mfu import (ChipSpec, chip_spec,
+                                             device_peak_flops, mfu,
+                                             step_flops)
+from apex_tpu.telemetry.profiler.report import (build_report,
+                                                emit_perf_counters,
+                                                render_text)
+
+__all__ = [
+    "Breakdown", "attribute", "classify", "top_ops",
+    "annotate_step", "profile_window", "trace", "trace_options",
+    "DeviceEvent", "find_trace_files", "load_device_events", "load_meta",
+    "ChipSpec", "chip_spec", "device_peak_flops", "mfu", "step_flops",
+    "build_report", "emit_perf_counters", "render_text",
+]

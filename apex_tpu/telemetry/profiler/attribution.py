@@ -1,0 +1,209 @@
+"""Attribute device wall time to compute / collective / transfer /
+idle, and measure how much collective time hides under compute.
+
+The unit of truth is the INTERVAL UNION, not the event-duration sum:
+two overlapping fusions on different cores busy the chip once, and a
+collective running concurrently with compute must not double-count
+the window.  All bucket numbers are union lengths; ``idle`` is the
+capture window minus the union of everything.
+
+Overlap — ROADMAP item 2's invariant ("collectives interleaved, not
+trailing") made measurable: ``hidden`` is the length of
+``intersection(collective ∪, compute ∪)``, ``exposed`` is collective
+time with no concurrent compute (the step-time cost), and
+``overlap_pct = hidden / collective``.  Async collectives lower as
+``*-start.N`` / ``*-done.N`` pairs whose in-flight gap is exactly the
+hideable region, so matching pairs are fused into one spanning
+interval before the set math.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from apex_tpu.telemetry.profiler.events import DeviceEvent
+
+__all__ = ["Breakdown", "attribute", "classify", "top_ops",
+           "COMPUTE", "COLLECTIVE", "TRANSFER"]
+
+COMPUTE = "compute"
+COLLECTIVE = "collective"
+TRANSFER = "transfer"
+
+# HLO collective spellings (classify on the lowercased op name): the
+# bucket all-reduce this repo emits (one psum per flat bucket), plus
+# every cross-replica/cross-partition primitive XLA names
+_COLLECTIVE_PAT = re.compile(
+    r"all-reduce|all-gather|all-to-all|reduce-scatter"
+    r"|collective-permute|collective-broadcast|allreduce|allgather"
+    r"|\bpsum\b|ppermute")
+
+# host<->device traffic: infeed/outfeed, explicit memcpy rows, and the
+# async copy pairs XLA emits for cross-memory-space movement
+_TRANSFER_PAT = re.compile(
+    r"infeed|outfeed|memcpy|h2d|d2h|copy-start|copy-done"
+    r"|device-to-host|host-to-device|\bsend\b|\brecv\b"
+    r"|send-done|recv-done|transfer")
+
+_ASYNC_PAIR = re.compile(r"^(?P<stem>.*)-start(?P<suffix>(\.\d+)?)$")
+
+
+def classify(name: str) -> str:
+    """Bucket for one device op name (``compute`` is the default: on
+    an accelerator everything that is neither communication nor host
+    traffic is the chip doing work)."""
+    low = name.lower()
+    if _COLLECTIVE_PAT.search(low):
+        return COLLECTIVE
+    if _TRANSFER_PAT.search(low):
+        return TRANSFER
+    return COMPUTE
+
+
+# ---- interval set helpers --------------------------------------------------
+
+Interval = Tuple[float, float]
+
+
+def _merge(intervals: Iterable[Interval]) -> List[Interval]:
+    out: List[Interval] = []
+    for s, e in sorted(intervals):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _total(merged: Sequence[Interval]) -> float:
+    return sum(e - s for s, e in merged)
+
+
+def _intersect(a: Sequence[Interval],
+               b: Sequence[Interval]) -> List[Interval]:
+    out, i, j = [], 0, 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            out.append((s, e))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _span_async_pairs(
+        events: Sequence[DeviceEvent]) -> List[Tuple[Interval, str]]:
+    """One spanning ``(interval, bucket)`` per matched ``*-start`` /
+    ``*-done`` pair (same stem + ``.N`` suffix): the in-flight region
+    between launch and completion is where an async collective (or
+    copy) can hide.  Unmatched starts contribute their own slice only
+    (they are already in their bucket as plain events)."""
+    dones: Dict[str, List[DeviceEvent]] = {}
+    for ev in events:
+        low = ev.name.lower()
+        if "-done" in low:
+            key = low.replace("-done", "-start", 1)
+            dones.setdefault(key, []).append(ev)
+    spans: List[Tuple[Interval, str]] = []
+    for ev in events:
+        if not _ASYNC_PAIR.match(ev.name.lower()):
+            continue
+        partner = next((d for d in dones.get(ev.name.lower(), [])
+                        if d.end_us >= ev.start_us), None)
+        if partner is not None:
+            spans.append(((ev.start_us, max(ev.end_us, partner.end_us)),
+                          classify(ev.name)))
+    return spans
+
+
+@dataclasses.dataclass
+class Breakdown:
+    """Union-length attribution of one capture window (all times ms)."""
+
+    window_ms: float
+    compute_ms: float
+    collective_ms: float
+    transfer_ms: float
+    idle_ms: float
+    collective_hidden_ms: float
+    collective_exposed_ms: float
+    overlap_pct: Optional[float]      # None when no collectives ran
+    n_events: int
+    steps: Optional[int] = None
+
+    @property
+    def step_ms(self) -> Optional[float]:
+        if not self.steps:
+            return None
+        return self.window_ms / self.steps
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["step_ms"] = self.step_ms
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in d.items()}
+
+
+def attribute(events: Sequence[DeviceEvent],
+              steps: Optional[int] = None) -> Breakdown:
+    """Fold a capture's device events into a :class:`Breakdown`."""
+    if not events:
+        return Breakdown(0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, None, 0,
+                         steps)
+    window = (min(e.start_us for e in events),
+              max(e.end_us for e in events))
+    by_cat: Dict[str, List[Interval]] = {COMPUTE: [], COLLECTIVE: [],
+                                         TRANSFER: []}
+    for ev in events:
+        by_cat[classify(ev.name)].append((ev.start_us, ev.end_us))
+    # async pairs: the spanning in-flight interval joins the bucket of
+    # the start op (collective for all-reduce-start, transfer for
+    # copy-start)
+    for span, cat in _span_async_pairs(events):
+        by_cat[cat].append(span)
+
+    compute = _merge(by_cat[COMPUTE])
+    collective = _merge(by_cat[COLLECTIVE])
+    transfer = _merge(by_cat[TRANSFER])
+    busy = _merge(compute + collective + transfer)
+    hidden = _total(_intersect(collective, compute))
+    coll_total = _total(collective)
+    overlap_pct = (round(hidden / coll_total * 100.0, 2)
+                   if coll_total > 0 else None)
+    return Breakdown(
+        window_ms=(window[1] - window[0]) / 1e3,
+        compute_ms=_total(compute) / 1e3,
+        collective_ms=coll_total / 1e3,
+        transfer_ms=_total(transfer) / 1e3,
+        idle_ms=max(0.0, (window[1] - window[0]) - _total(busy)) / 1e3,
+        collective_hidden_ms=hidden / 1e3,
+        collective_exposed_ms=(coll_total - hidden) / 1e3,
+        overlap_pct=overlap_pct,
+        n_events=len(events),
+        steps=steps)
+
+
+def top_ops(events: Sequence[DeviceEvent], top: int = 12) -> List[dict]:
+    """Per-op aggregate: total duration, count, share of summed op
+    time, and the bucket each op attributes to.  Duration-sum based
+    (the familiar pyprof table), not union based — overlap questions
+    belong to :func:`attribute`."""
+    agg: Dict[str, List[float]] = {}
+    for ev in events:
+        st = agg.setdefault(ev.name, [0.0, 0.0])
+        st[0] += ev.dur_us
+        st[1] += 1
+    total = sum(st[0] for st in agg.values()) or 1.0
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+    return [{"op": name, "total_ms": round(st[0] / 1e3, 3),
+             "count": int(st[1]),
+             "pct": round(st[0] / total * 100.0, 1),
+             "category": classify(name)}
+            for name, st in rows]

@@ -1,0 +1,263 @@
+"""Typed device-timeline rows from a captured profiler trace.
+
+``jax.profiler.trace`` writes two artifacts per capture under
+``<outdir>/plugins/profile/<run>/``: an ``.xplane.pb`` XSpace proto
+(the full-fidelity XProf source) and a Chrome-format
+``.trace.json.gz``.  This module reads EITHER into the same
+:class:`DeviceEvent` rows so the attribution layer never cares which
+was available:
+
+- the xplane path uses the TensorFlow-bundled proto when importable
+  (``tensorflow.tsl.profiler.protobuf.xplane_pb2`` — a few hundred KB
+  of proto import, no TF runtime touched);
+- the JSON path is pure stdlib (``gzip`` + ``json``) and therefore
+  always works, including on a login host with neither jax nor TF.
+
+Device-thread selection follows pyprof.prof's round-4 lesson: a
+capture holds ~1M host python events against a few hundred device
+ops, so only the device op timeline is surfaced.  On TPU that is the
+"XLA Ops" line under a ``/device:*`` process; under the CPU fallback
+(no ``/device:*`` process at all) the XLA executor pools
+(``tf_XLA*`` threads under ``/host:CPU``) stand in — useful for
+harness tests and host-pipeline inspection, labeled by ``backend``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["DeviceEvent", "device_events_from_chrome",
+           "find_trace_files", "load_device_events", "load_meta",
+           "read_chrome_doc", "META_NAME"]
+
+# capture sidecar written by profiler.capture.profile_window: step
+# count, cost-analysis FLOPs and the chip spec the MFU needs
+META_NAME = "profile_meta.json"
+
+# scheduler/bookkeeping rows that would otherwise read as device work
+# (ThunkExecutor spans WRAP the per-op events on the CPU client's
+# thread — counting them would double-cover every op's interval)
+_INFRA_PREFIXES = (
+    "ThreadpoolListener",
+    "ThunkExecutor::",
+    "BlockUntilReady",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceEvent:
+    """One complete device-timeline slice (Chrome ``ph: "X"`` shape)."""
+
+    name: str
+    start_us: float
+    dur_us: float
+    pid: int = 0
+    tid: int = 0
+    thread: str = ""
+    hlo_op: str = ""
+    hlo_module: str = ""
+
+    @property
+    def end_us(self) -> float:
+        return self.start_us + self.dur_us
+
+
+def _is_infra(name: str) -> bool:
+    return name.startswith(_INFRA_PREFIXES)
+
+
+def find_trace_files(trace_dir: str) -> Dict[str, Optional[str]]:
+    """Newest capture per format under ``trace_dir`` (the profiler's
+    ``plugins/profile/<run>/`` layout, or the files directly).  Newest
+    by mtime, not name: run-dir naming has changed across versions and
+    hosts, and lexicographic order silently picks a stale capture."""
+    out: Dict[str, Optional[str]] = {"json": None, "xplane": None}
+    # uncompressed *.trace.json is accepted too: hand-built fixture
+    # traces stay reviewable in the repo and render directly
+    for key, pats in (("json", ("*.trace.json.gz", "*.trace.json")),
+                      ("xplane", ("*.xplane.pb",))):
+        paths = []
+        for pat in pats:
+            paths += (glob.glob(os.path.join(
+                trace_dir, "plugins", "profile", "*", pat))
+                or glob.glob(os.path.join(trace_dir, pat)))
+        if paths:
+            out[key] = max(paths, key=os.path.getmtime)
+    return out
+
+
+def load_meta(trace_dir: str) -> dict:
+    """The capture sidecar (``profile_meta.json``), or ``{}``.  Looked
+    up next to the trace dir root — capture writes it there so a
+    copied/rsynced trace keeps its provenance."""
+    path = os.path.join(trace_dir, META_NAME)
+    try:
+        with open(path, encoding="utf-8") as f:
+            meta = json.load(f)
+        return meta if isinstance(meta, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def load_device_events(trace_dir: str,
+                       prefer: str = "auto") -> List[DeviceEvent]:
+    """Device-op rows from the newest capture under ``trace_dir``.
+
+    ``prefer``: ``"auto"`` tries the xplane proto first (richer stats)
+    and falls back to the Chrome JSON; ``"json"`` / ``"xplane"`` pin
+    one path (the tests pin each).  Returns ``[]`` when the directory
+    holds no parseable capture."""
+    files = find_trace_files(trace_dir)
+    order = {"auto": ("xplane", "json"), "xplane": ("xplane",),
+             "json": ("json",)}[prefer]
+    for kind in order:
+        path = files.get(kind)
+        if path is None:
+            continue
+        try:
+            events = (_events_from_xplane(path) if kind == "xplane"
+                      else _events_from_trace_json(path))
+        except Exception:
+            # a torn/foreign file must not mask the other format
+            continue
+        if events:
+            return events
+    return []
+
+
+# ---- Chrome trace.json.gz (stdlib) -----------------------------------------
+
+def read_chrome_doc(path: str) -> dict:
+    """The parsed Chrome-trace document (gzipped or plain).  Public so
+    callers that need BOTH device and host views (pyprof's merged
+    table) can parse the multi-MB file once and hand the doc to
+    :func:`device_events_from_chrome`."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _events_from_trace_json(path: str) -> List[DeviceEvent]:
+    return device_events_from_chrome(read_chrome_doc(path))
+
+
+def device_events_from_chrome(doc: dict) -> List[DeviceEvent]:
+    ev = doc.get("traceEvents", [])
+    proc_names = {e.get("pid"): str(e.get("args", {}).get("name"))
+                  for e in ev if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+    thread_names = {(e.get("pid"), e.get("tid")):
+                    str(e.get("args", {}).get("name"))
+                    for e in ev if e.get("ph") == "M"
+                    and e.get("name") == "thread_name"}
+    keep = _select_threads(proc_names, thread_names)
+    out = []
+    for e in ev:
+        key = (e.get("pid"), e.get("tid"))
+        if e.get("ph") != "X" or key not in keep:
+            continue
+        name = str(e.get("name", ""))
+        if _is_infra(name) or not e.get("dur"):
+            continue
+        args = e.get("args") or {}
+        out.append(DeviceEvent(
+            name=name, start_us=float(e.get("ts", 0.0)),
+            dur_us=float(e["dur"]), pid=e.get("pid", 0),
+            tid=e.get("tid", 0), thread=keep[key],
+            hlo_op=str(args.get("hlo_op", "")),
+            hlo_module=str(args.get("hlo_module", ""))))
+    out.sort(key=lambda d: (d.start_us, d.end_us))
+    return out
+
+
+def _select_threads(proc_names: Dict, thread_names: Dict) -> Dict:
+    """(pid, tid) -> thread-name for the timelines that represent
+    device execution.  TPU/GPU: the "XLA Ops" line of every
+    ``/device:*`` process.  CPU fallback (no device process at all):
+    the ``tf_XLA*`` executor pools under the host process."""
+    device_pids = {pid for pid, name in proc_names.items()
+                   if "/device:" in name}
+    keep = {}
+    if device_pids:
+        for (pid, tid), tname in thread_names.items():
+            if pid in device_pids and tname == "XLA Ops":
+                keep[(pid, tid)] = tname
+        return keep
+    host_pids = {pid for pid, name in proc_names.items()
+                 if "/host:" in name}
+    for (pid, tid), tname in thread_names.items():
+        if pid in host_pids and tname.startswith("tf_XLA"):
+            keep[(pid, tid)] = tname
+    return keep
+
+
+# ---- xplane.pb (tensorflow protos, optional) -------------------------------
+
+def _xplane_proto():
+    """The XSpace proto class, from whichever home this environment
+    ships it in, or None (JSON path still works)."""
+    for mod in ("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                "tsl.profiler.protobuf.xplane_pb2",
+                "tensorflow.core.profiler.protobuf.xplane_pb2"):
+        try:
+            import importlib
+            return importlib.import_module(mod)
+        except Exception:
+            continue
+    return None
+
+
+def _events_from_xplane(path: str) -> List[DeviceEvent]:
+    pb2 = _xplane_proto()
+    if pb2 is None:
+        return []
+    space = pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+
+    device_planes = [p for p in space.planes if "/device:" in p.name]
+    if device_planes:
+        selected = [(p, [ln for ln in p.lines
+                         if (ln.display_name or ln.name) == "XLA Ops"])
+                    for p in device_planes]
+    else:
+        hosts = [p for p in space.planes if "/host:" in p.name]
+        selected = [(p, [ln for ln in p.lines
+                         if (ln.display_name or ln.name)
+                         .startswith("tf_XLA")])
+                    for p in hosts]
+    out = []
+    for pid, (plane, lines) in enumerate(selected):
+        stat_md = plane.stat_metadata
+        ev_md = plane.event_metadata
+        for ln in lines:
+            base_us = ln.timestamp_ns / 1e3
+            tname = ln.display_name or ln.name
+            for e in ln.events:
+                name = ev_md[e.metadata_id].name
+                if _is_infra(name) or not e.duration_ps:
+                    continue
+                hlo_op = hlo_module = ""
+                for s in e.stats:
+                    sname = stat_md[s.metadata_id].name
+                    # string stats may be inline (str_value) or a
+                    # reference into the plane's stat_metadata names
+                    sval = s.str_value or (
+                        stat_md[s.ref_value].name if s.ref_value else "")
+                    if sname == "hlo_op":
+                        hlo_op = sval
+                    elif sname == "hlo_module":
+                        hlo_module = sval
+                out.append(DeviceEvent(
+                    name=name,
+                    start_us=base_us + e.offset_ps / 1e6,
+                    dur_us=e.duration_ps / 1e6,
+                    pid=pid, tid=ln.id, thread=tname,
+                    hlo_op=hlo_op, hlo_module=hlo_module))
+    out.sort(key=lambda d: (d.start_us, d.end_us))
+    return out

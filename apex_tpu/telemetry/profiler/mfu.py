@@ -1,0 +1,87 @@
+"""Cost-model MFU: one home for "how many FLOPs did the step do" and
+"what could this chip have done".
+
+Replaces the ad-hoc peak table + formula that lived in ``bench.py``:
+FLOPs come from XLA's own cost analysis of the COMPILED step
+(``jitted.lower().compile().cost_analysis()``, the same program the
+timing ran — via :func:`apex_tpu.benchlib.cost_flops`), and the
+denominator from a small chip-spec table keyed on
+``device_kind`` substrings.  MFU is only reported when both halves
+are real: an unrecognized chip or an unreported cost analysis yields
+``None``, never a guess.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["ChipSpec", "chip_spec", "device_peak_flops", "step_flops",
+           "mfu", "CHIP_SPECS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip peaks (per-chip, not per-host): bf16 matmul FLOP/s and
+    HBM bandwidth — the two roofline axes."""
+
+    name: str
+    bf16_flops: float
+    hbm_bytes_per_s: float
+
+
+# device_kind substring -> spec, FIRST match wins (more specific
+# entries before their prefixes: "v5p" before "v5", "v6e" before "v6").
+# Sources: published TPU system specs (bf16 peak / chip, HBM BW).
+CHIP_SPECS = (
+    ("v6e", ChipSpec("TPU v6e", 918e12, 1640e9)),
+    ("v6", ChipSpec("TPU v6e", 918e12, 1640e9)),
+    ("v5p", ChipSpec("TPU v5p", 459e12, 2765e9)),
+    ("v5 lite", ChipSpec("TPU v5e", 197e12, 819e9)),
+    ("v5litepod", ChipSpec("TPU v5e", 197e12, 819e9)),
+    ("v5e", ChipSpec("TPU v5e", 197e12, 819e9)),
+    ("v4", ChipSpec("TPU v4", 275e12, 1228e9)),
+    ("v3", ChipSpec("TPU v3", 123e12, 900e9)),
+)
+
+
+def chip_spec(device_kind: str) -> Optional[ChipSpec]:
+    """Spec for a ``jax.Device.device_kind`` string, or None when the
+    chip is not in the table (MFU then stays unreported)."""
+    kind = (device_kind or "").lower()
+    for sub, spec in CHIP_SPECS:
+        if sub in kind:
+            return spec
+    return None
+
+
+def device_peak_flops() -> Optional[float]:
+    """bf16 peak of the first addressable device, or None off-TPU /
+    on an unrecognized chip.  Imports jax lazily: the report side of
+    the observatory must stay usable on a jax-less login host."""
+    try:
+        import jax
+        spec = chip_spec(jax.devices()[0].device_kind)
+    except Exception:
+        return None
+    return spec.bf16_flops if spec else None
+
+
+def step_flops(jitted, *args) -> Optional[float]:
+    """FLOPs of one compiled call of ``jitted(*args)`` from XLA's cost
+    analysis (None when the backend doesn't report it).  Delegates to
+    :func:`apex_tpu.benchlib.cost_flops` — the persistent compilation
+    cache dedupes the compile with the later execution."""
+    from apex_tpu.benchlib import cost_flops
+    return cost_flops(jitted, *args)
+
+
+def mfu(flops_per_step: Optional[float], step_s: Optional[float],
+        peak_flops: Optional[float]) -> Optional[float]:
+    """``flops / time / peak``, or None when any input is missing —
+    a partially-known MFU is worse than none."""
+    if not flops_per_step or not step_s or not peak_flops:
+        return None
+    if step_s <= 0 or peak_flops <= 0:
+        return None
+    return round(flops_per_step / step_s / peak_flops, 4)

@@ -1,0 +1,143 @@
+"""Turn a captured trace directory into the observatory report: step
+breakdown {compute, collective, transfer, idle}, collective overlap
+fraction, cost-model MFU, and the per-op top-k table.
+
+Rendered by ``python -m apex_tpu.telemetry profile <trace_dir>``
+(text or ``--json``); the headline numbers also go out as ``perf/*``
+host-metric counters through :mod:`apex_tpu.telemetry.hostmetrics`,
+so a capture taken during a live telemetry session lands in the run's
+JSONL next to the training metrics (and in ``summarize``'s perf
+section).  Stdlib-only on the read path — a trace dir rsynced to a
+login host renders without jax installed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from apex_tpu.telemetry.profiler import attribution, events
+# the submodule by its full path: the package re-exports a `mfu`
+# FUNCTION, which would shadow the module on attribute-style imports
+from apex_tpu.telemetry.profiler.mfu import mfu as _mfu_of
+
+__all__ = ["build_report", "emit_perf_counters", "render_text"]
+
+# the counters a capture publishes into a live session's JSONL
+PERF_HEADLINES = ("step_ms", "mfu", "overlap_pct", "compute_ms",
+                  "collective_ms", "transfer_ms", "idle_ms")
+
+
+def build_report(trace_dir: str, *, top: int = 12,
+                 steps: Optional[int] = None,
+                 prefer: str = "auto") -> dict:
+    """The full report dict, or ``{"trace_dir": ..., "error": ...}``
+    when the directory holds no parseable device events.
+
+    ``steps`` overrides the sidecar's step count (a trace captured by
+    someone else's tooling has no sidecar; pass what you know)."""
+    meta = events.load_meta(trace_dir)
+    rows = events.load_device_events(trace_dir, prefer=prefer)
+    if not rows:
+        return {"trace_dir": trace_dir,
+                "error": "no device op events found (host-only trace, "
+                         "or wrong directory)"}
+    n_steps = steps if steps is not None else meta.get("steps")
+    bd = attribution.attribute(rows, steps=n_steps)
+
+    # MFU over the DEVICE timeline: flops/step from the sidecar's cost
+    # analysis, step time from the captured window / steps — the
+    # number is about what the chip did, not what the host dispatched
+    flops = meta.get("flops_per_step")
+    peak = meta.get("peak_bf16_flops")
+    step_ms = bd.step_ms
+    value = _mfu_of(flops, step_ms / 1e3 if step_ms else None, peak)
+
+    report = {
+        "trace_dir": trace_dir,
+        "backend": meta.get("backend"),
+        "device_kind": meta.get("device_kind"),
+        "steps": n_steps,
+        "step_ms": round(step_ms, 3) if step_ms else None,
+        "breakdown": bd.as_dict(),
+        "overlap_pct": bd.overlap_pct,
+        "mfu": value,
+        "mfu_source": meta.get("mfu_source") if value is not None
+        else None,
+        "flops_per_step": flops,
+        "top_ops": attribution.top_ops(rows, top=top),
+    }
+    return report
+
+
+def emit_perf_counters(report: dict) -> None:
+    """Publish the headline numbers as ``perf/*`` host counters.  A
+    live :class:`~apex_tpu.telemetry.session.Telemetry` session picks
+    them up on its next flush; with no session this is the usual
+    sink-registry no-op."""
+    from apex_tpu.telemetry import hostmetrics
+    flat = dict(report.get("breakdown") or {})
+    flat.update({k: report.get(k) for k in ("step_ms", "mfu",
+                                            "overlap_pct")})
+    for key in PERF_HEADLINES:
+        val = flat.get(key)
+        if val is not None:
+            hostmetrics.emit(f"perf/{key}", float(val))
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render_text(report: dict, out) -> None:
+    """The human-readable report (the ``profile`` subcommand's text
+    mode)."""
+    print(f"trace: {report['trace_dir']}", file=out)
+    if report.get("error"):
+        print(report["error"], file=out)
+        return
+    head = []
+    if report.get("backend"):
+        head.append(f"backend={report['backend']}")
+    if report.get("device_kind"):
+        head.append(f"chip={report['device_kind']}")
+    if report.get("steps"):
+        head.append(f"steps={report['steps']}")
+    if head:
+        print("  ".join(head), file=out)
+
+    bd = report["breakdown"]
+    print("", file=out)
+    if report.get("step_ms") is not None:
+        print(f"device step time: {_fmt(report['step_ms'])} ms", file=out)
+    window = bd.get("window_ms") or 0.0
+    print("step breakdown (interval-union over the device timeline):",
+          file=out)
+    for key in ("compute_ms", "collective_ms", "transfer_ms", "idle_ms"):
+        ms = bd.get(key) or 0.0
+        pct = ms / window * 100.0 if window else 0.0
+        print(f"  {key.removesuffix('_ms'):<10}  {_fmt(ms):>12} ms"
+              f"  {pct:5.1f}%", file=out)
+    if report.get("overlap_pct") is not None:
+        print(f"collective overlap: {report['overlap_pct']:.1f}% hidden "
+              f"under compute ({_fmt(bd.get('collective_hidden_ms'))} ms "
+              f"hidden, {_fmt(bd.get('collective_exposed_ms'))} ms "
+              "exposed/trailing)", file=out)
+    else:
+        print("collective overlap: no collectives in window", file=out)
+    if report.get("mfu") is not None:
+        print(f"MFU: {report['mfu']:.4f}  "
+              f"(source={report.get('mfu_source')}, "
+              f"flops/step={report.get('flops_per_step'):.3e})", file=out)
+
+    rows: List[dict] = report.get("top_ops") or []
+    if rows:
+        print("\ntop device ops:", file=out)
+        w = max(len(r["op"]) for r in rows)
+        for r in rows:
+            print(f"  {r['op']:<{w}}  {r['total_ms']:>10.3f} ms"
+                  f"  {r['pct']:>5.1f}%  x{r['count']:<5d}"
+                  f" {r['category']}", file=out)

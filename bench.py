@@ -39,35 +39,37 @@ import traceback
 
 A100_IMGS_PER_SEC = 2500.0
 
-# per-chip bf16 peak FLOP/s by device_kind substring; MFU is only
-# reported when the running chip is recognized
-_TPU_BF16_PEAK = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v4", 275e12),
-]
-
-
-def _bf16_peak():
-    import jax
-    kind = jax.devices()[0].device_kind.lower()
-    for sub, peak in _TPU_BF16_PEAK:
-        if sub in kind:
-            return peak
-    return None
-
 
 def _mfu(flops, step_s, on_tpu):
+    """Cost-model MFU via the observatory's one chip-spec table
+    (apex_tpu.telemetry.profiler.mfu) — the ad-hoc peak list that
+    lived here moved there.  Reported only when the running chip is
+    recognized; ``flops`` comes from the compiled step's own cost
+    analysis, so wherever this is non-None the matching
+    ``*_mfu_source`` extra reads "cost_analysis"."""
     if not (flops and on_tpu):
         return None
     try:
-        peak = _bf16_peak()
+        from apex_tpu.telemetry.profiler.mfu import (device_peak_flops,
+                                                     mfu as mfu_of)
+        return mfu_of(flops, step_s, device_peak_flops())
     except Exception:
         return None
-    if peak is None:
-        return None
-    return round(flops / step_s / peak, 4)
+
+
+def _err(leg, stage, error):
+    """One structured error entry: BENCH_r05 buried a flash_attention
+    traceback in a string tail — failed legs are now machine-readable
+    ({"leg", "stage", "error"}), and every consumer renders them via
+    :func:`_err_str`."""
+    return {"leg": leg, "stage": stage, "error": str(error)}
+
+
+def _err_str(e):
+    """Render one errors entry (dict or legacy string) for joins."""
+    if isinstance(e, dict):
+        return f"{e.get('leg')}[{e.get('stage')}]: {e.get('error')}"
+    return str(e)
 
 # NOTE: there is deliberately NO tunnel-probe helper here.  A
 # timeout-killed jax.devices() subprocess is the documented tunnel
@@ -353,11 +355,12 @@ def bench_flash_attention(jax, jnp, on_tpu):
     Every (shape, path) leg is guarded INDIVIDUALLY: BENCH_r05 lost all
     attention numbers to one remote-compile 500 on the first leg —
     a failed leg now records `flash_<s>[_oracle]_error` and the rest
-    still measure."""
+    still measure; the same failures also come back structurally under
+    `_errors` (popped by run_child into the report's errors list)."""
     from apex_tpu.benchlib import timeit as time_fn
     from apex_tpu.ops.attention import attention_ref, flash_attention
 
-    out = {}
+    out = {"_errors": []}
     # s=512 exercises the round-5 single-KV-block fast path (the shape
     # where round 4 measured the fwd losing); 2048 the generic online
     # kernel; 8192 the O(S)-memory story (oracle would need 48G)
@@ -387,6 +390,8 @@ def bench_flash_attention(jax, jnp, on_tpu):
                 q, k, v, adaptive=True), 2)
         except Exception as e:
             out[f"flash_{s}_error"] = repr(e)[:200]
+            out["_errors"].append(
+                _err(f"flash_{s}", "fwd_bwd", repr(e)[:400]))
         if run_oracle:
             try:
                 out[f"oracle_{s}_fwdbwd_ms"] = round(time_fn(
@@ -395,6 +400,10 @@ def bench_flash_attention(jax, jnp, on_tpu):
                     q, k, v, adaptive=True), 2)
             except Exception as e:
                 out[f"oracle_{s}_error"] = repr(e)[:200]
+                out["_errors"].append(
+                    _err(f"flash_{s}", "oracle", repr(e)[:400]))
+    if not out["_errors"]:
+        out.pop("_errors")
     return out
 
 
@@ -456,9 +465,11 @@ def run_child(backend):
             out["backend"] = backend = "cpu-fallback"
             out["metric"] = _metric_name(backend)
             on_tpu = False
-            out["errors"].append("requested tpu but jax initialized cpu")
+            out["errors"].append(
+                _err("backend", "init", "requested tpu but jax "
+                                        "initialized cpu"))
     except Exception as e:
-        out["errors"].append(f"jax-init: {e!r}")
+        out["errors"].append(_err("jax-init", "init", repr(e)))
         print(_dump(out))
         return
 
@@ -467,7 +478,8 @@ def run_child(backend):
         out["extra"]["dispatch_overhead_ms"] = round(
             dispatch_overhead_ms(), 3)
     except Exception as e:
-        out["errors"].append(f"dispatch_overhead: {e!r}")
+        out["errors"].append(_err("dispatch_overhead", "measure",
+                                  repr(e)))
 
     try:
         r = bench_resnet50_amp_o2(jax, jnp, on_tpu)
@@ -485,9 +497,13 @@ def run_child(backend):
         out["extra"]["resnet50_telemetry"] = r.get("telemetry")
         if r.get("mfu") is not None:
             out["extra"]["resnet50_mfu"] = r["mfu"]
+            # provenance: flops from the compiled step's cost analysis
+            # over the profiler.mfu chip table (docs/perf.md)
+            out["extra"]["resnet50_mfu_source"] = "cost_analysis"
     except Exception:
-        out["errors"].append(
-            "resnet50: " + traceback.format_exc(limit=3).replace("\n", " | "))
+        out["errors"].append(_err(
+            "resnet50", "train_bench",
+            traceback.format_exc(limit=3).replace("\n", " | ")))
 
     # Flush the primary metric NOW: if the secondary bench hangs and the
     # watchdog kills us, the orchestrator salvages the last parseable
@@ -503,9 +519,11 @@ def run_child(backend):
         out["extra"]["bert_telemetry"] = b.get("telemetry")
         if b.get("mfu") is not None:
             out["extra"]["bert_mfu"] = b["mfu"]
+            out["extra"]["bert_mfu_source"] = "cost_analysis"
     except Exception:
-        out["errors"].append(
-            "bert_lamb: " + traceback.format_exc(limit=3).replace("\n", " | "))
+        out["errors"].append(_err(
+            "bert_lamb", "train_bench",
+            traceback.format_exc(limit=3).replace("\n", " | ")))
 
     # extras AFTER both tracked metrics are flushed: a hang + watchdog
     # kill in here truncates only the extras.  flash (a VERDICT
@@ -514,11 +532,16 @@ def run_child(backend):
     if on_tpu:
         print(_dump(out), flush=True)
         try:
-            out["extra"].update(bench_flash_attention(jax, jnp, on_tpu))
+            fa = bench_flash_attention(jax, jnp, on_tpu)
+            # per-leg failures come back structurally (satellite of
+            # the observatory PR): keep the flash_*_error extras for
+            # continuity AND surface the legs in errors
+            out["errors"].extend(fa.pop("_errors", []))
+            out["extra"].update(fa)
         except Exception:
-            out["errors"].append(
-                "flash_attention: "
-                + traceback.format_exc(limit=3).replace("\n", " | "))
+            out["errors"].append(_err(
+                "flash_attention", "bench",
+                traceback.format_exc(limit=3).replace("\n", " | ")))
 
         print(_dump(out), flush=True)
         try:
@@ -579,6 +602,51 @@ def run_child(backend):
         except Exception as e:
             out["extra"]["bert_varlen_error"] = repr(e)[:200]
 
+        print(_dump(out), flush=True)
+        try:
+            # observatory capture: a short device-only trace of the
+            # north-star step, attributed into compute / collective /
+            # transfer / idle — lands the collective-overlap fraction
+            # (ROADMAP item 2's target gauge) next to the throughput
+            # it explains.  Reuses the persistent-cache-warm step, so
+            # the cost is ~10 traced steps, not a fresh compile.
+            import shutil
+            import tempfile
+
+            from apex_tpu.telemetry.profiler import build_report, capture
+            tdir = tempfile.mkdtemp(prefix="apex_tpu_bench_trace_")
+            try:
+                # warmup OUTSIDE the window (capture.py's rule): this
+                # identical un-traced leg populates the persistent
+                # compilation cache, so the traced call below pays a
+                # cache-hit compile (ms), not the cold multi-minute
+                # XLA build the window would otherwise record as idle
+                _resnet50_one_batch(jax, jnp, on_tpu, 128, 224, 10)
+                with capture.trace(tdir):
+                    _resnet50_one_batch(jax, jnp, on_tpu, 128, 224, 10)
+                # chunked_train_bench dispatches a warmup chunk (10
+                # steps) before the timed chunk INSIDE this window, so
+                # the device timeline holds 20 executed steps (plus
+                # one init pass) — the breakdown/overlap fractions
+                # are the product here, but the per-step divisor must
+                # match what ran
+                rep = build_report(tdir, steps=20)
+                if not rep.get("error"):
+                    bd = rep["breakdown"]
+                    out["extra"]["resnet50_overlap_pct"] = rep.get(
+                        "overlap_pct")
+                    out["extra"]["resnet50_breakdown"] = {
+                        k: bd.get(k)
+                        for k in ("compute_ms", "collective_ms",
+                                  "transfer_ms", "idle_ms")}
+            finally:
+                # the attribution above is the product; the raw trace
+                # is waste once read (tools/profile_step.py is the
+                # keep-the-trace capture path)
+                shutil.rmtree(tdir, ignore_errors=True)
+        except Exception as e:
+            out["extra"]["resnet50_profile_error"] = repr(e)[:200]
+
     print(_dump(out), flush=True)
 
 
@@ -600,7 +668,9 @@ def _cached_tpu_result(path=None):
         # the capture session's own errors describe THAT session (and
         # can carry multi-KB ANSI tracebacks); keep a prefixed stub so
         # a reader cannot mistake them for THIS report's failures
-        cached["errors"] = ["captured: " + e[:150]
+        # (entries may be structured {leg, stage, error} dicts or
+        # legacy strings — stringify both)
+        cached["errors"] = ["captured: " + _err_str(e)[:150]
                             for e in cached.get("errors", [])]
         # capture time: the validator embeds measured_at at write time;
         # mtime is only a fallback (it is checkout time on a fresh
@@ -656,7 +726,8 @@ def _run_bench_child(backend, timeout_s):
     out = _last_json_line(stdout)
     if out is not None:
         if note is not None:
-            out.setdefault("errors", []).append(f"child: {note}")
+            out.setdefault("errors", []).append(
+                _err("child", "watchdog", note))
         return out, None
     tail = (stderr or "").strip()[-300:]
     return None, (f"child: {note or 'exited'}, no JSON on stdout, "
@@ -711,12 +782,14 @@ def main():
         # down at report time.
         if out is not None:
             err = "; ".join(["tpu child did not measure hardware"]
-                            + out.get("errors", []))
+                            + [_err_str(e)
+                               for e in out.get("errors", [])])
         cached = _cached_tpu_result()
         if cached is not None:
-            cached.setdefault("errors", []).append(
+            cached.setdefault("errors", []).append(_err(
+                "orchestrator", "fallback",
                 f"live tpu attempt failed ({err}); value is the "
-                f"round's recorded hardware window")
+                f"round's recorded hardware window"))
             print(json.dumps(cached))
             return
         # no cached hardware number: a CPU-proxy liveness line.  The
@@ -734,7 +807,8 @@ def main():
             cpu_out, err2 = _run_bench_child("cpu-fallback",
                                              child_timeout)
         if cpu_out is not None:
-            cpu_out.setdefault("errors", []).append(f"tpu attempt: {err}")
+            cpu_out.setdefault("errors", []).append(
+                _err("orchestrator", "tpu_attempt", err))
             if out is not None and cpu_out is not out \
                     and cpu_out.get("extra") is not out.get("extra"):
                 # Keep any metric the TPU child DID measure (e.g. BERT
@@ -747,7 +821,7 @@ def main():
         err = f"{err}; cpu-retry: {err2}"
 
     out = _empty_result(backend)
-    out["errors"].append(err)
+    out["errors"].append(_err("orchestrator", "run", err))
     print(json.dumps(out))
 
 

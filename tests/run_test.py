@@ -67,6 +67,9 @@ SUITES = {
     # run-time training telemetry (metric ring, emitters, spans,
     # retrace counter) + the pyprof nvtx/prof satellites
     "run_telemetry": ["tests/test_telemetry.py"],
+    # the performance observatory: trace parsing, attribution/overlap,
+    # cost-model MFU, report CLI, and the perf regression gate
+    "run_profiler": ["tests/test_profiler.py"],
     # AOT Mosaic lowering for the TPU platform — runs in CPU CI
     "run_tpu_lowering": ["tests/test_tpu_lowering.py"],
     # TPU-only: needs APEX_TPU_SMOKE=1 and a real chip (else skips)

@@ -645,8 +645,10 @@ def test_prof_json_output_and_empty_exit_code(tmp_path, capsys):
     _write_trace(tmp_path, "run", [("fusion.9", 3000), ("conv", 1000)])
     assert prof.main([str(tmp_path), "--json"]) == 0
     rows = json.loads(capsys.readouterr().out)
-    assert rows == [{"op": "fusion.9", "total_ms": 3.0, "pct": 75.0},
-                    {"op": "conv", "total_ms": 1.0, "pct": 25.0}]
+    assert rows == [{"op": "fusion.9", "where": "device",
+                     "total_ms": 3.0, "pct": 75.0},
+                    {"op": "conv", "where": "device",
+                     "total_ms": 1.0, "pct": 25.0}]
     # empty-trace path: exit 1, and --json stays parseable
     empty = tmp_path / "empty"
     empty.mkdir()

@@ -455,6 +455,25 @@ def main():
         "speedup": (round(rt["telemetry_off_ms"] / rt["telemetry_on_ms"],
                           2) if rt["telemetry_on_ms"] else None)})
 
+    # profiler overhead: the identical step, annotate_step-wrapped vs
+    # plain with NO capture running ("kernel" = profile-capable,
+    # "oracle" = plain — ~1.0 IS the pass condition: a profiled-capable
+    # step must cost nothing until a trace window opens; the
+    # profiler.annotated_step apexverify spec proves the same fact
+    # structurally)
+    from apex_tpu.telemetry.bench import bench_profiler_overhead
+    rp = bench_profiler_overhead()
+    rp["backend"] = backend
+    print(json.dumps(rp), flush=True)
+    rows.append({
+        "kernel": "profiler_overhead",
+        "shape": f"{rp['profiler_leaves']}leaves",
+        "dtype": "f32",
+        "kernel_ms": rp["profiler_on_ms"],
+        "oracle_ms": rp["profiler_off_ms"],
+        "speedup": (round(rp["profiler_off_ms"] / rp["profiler_on_ms"],
+                          2) if rp["profiler_on_ms"] else None)})
+
     # watchdog overhead: the same instrumented step with the anomaly
     # watchdog attached vs the bare step ("kernel" = watchdog-attached,
     # "oracle" = bare — ~1.0 IS the pass condition: detectors are

@@ -1,0 +1,233 @@
+"""Performance regression gate over the BENCH_r*.json trajectory.
+
+The observatory's verdict half: ``bench.py`` measures, the rounds
+accumulate as ``BENCH_r0N.json``, and THIS turns the trajectory into
+an exit code — the same sensor→verdict discipline the telemetry ring
+(PR 4) and the watchdog (PR 7) apply to training health, applied to
+performance.  No jax import, stdlib only: the gate must run on any CI
+box in milliseconds.
+
+    python tools/perf_gate.py             # gate: exit 1 on regression
+    python tools/perf_gate.py --report    # report-only: always exit 0
+    python tools/perf_gate.py --json      # machine-readable verdicts
+
+Budget: ``tools/perf_budget.json`` maps a dotted metric path (into
+the round's parsed bench line, e.g. ``extra.resnet50_mfu``) to a
+floor (or ceiling, for lower-is-better metrics) plus a per-metric
+noise band.  The noise bands encode benchlib's amortized-timing
+methodology: tracked train metrics repeat within a few percent
+between windows, so only an ABOVE-NOISE drop is a regression —
+within-band wobble reports as ``ok (within noise)``.
+
+Two checks per metric, both noise-banded:
+
+- **budget**: the newest hardware measurement vs its committed
+  floor/ceiling — the "never ship slower than this" line, restamped
+  from each accepted hardware window;
+- **trajectory**: the newest measurement vs the best previous
+  hardware round — catches a slide the budget's slack would hide.
+
+A metric the NEWEST hardware round stopped reporting grades
+``stale`` and fails the gate: a perf loss that manifests as a crashed
+bench leg (the BENCH_r05 flash shape) must not read as green by
+comparing an older round's value against the floor.
+
+Only real hardware rounds count (``backend`` "tpu" or "tpu-cached",
+positive value): the CPU-fallback liveness lines prove the harness,
+not performance, and a cached round re-served across windows compares
+equal to itself (no false regression while the tunnel is down).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET_PATH = os.path.join(_ROOT, "tools", "perf_budget.json")
+
+_HW_BACKENDS = {"tpu", "tpu-cached"}
+
+
+def load_rounds(root: str = _ROOT) -> List[Tuple[int, dict]]:
+    """[(round_number, parsed bench line), ...] sorted by round, for
+    every round whose artifact holds a parseable bench line."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            out.append((int(m.group(1)), parsed))
+    out.sort()
+    return out
+
+
+def _numeric(v) -> float:
+    """Best-effort float; malformed values read as 0 (a hand-edited
+    artifact must degrade to "not a hardware round", not a traceback
+    aborting the whole check run)."""
+    try:
+        return float(v or 0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def hardware_rounds(rounds: List[Tuple[int, dict]]) -> List[Tuple[int, dict]]:
+    return [(n, p) for n, p in rounds
+            if p.get("backend") in _HW_BACKENDS
+            and _numeric(p.get("value")) > 0]
+
+
+def metric_value(parsed: dict, dotted: str) -> Optional[float]:
+    """Resolve ``"extra.resnet50_mfu"``-style paths; None when any
+    segment is missing or the leaf is not a number."""
+    node = parsed
+    for seg in dotted.split("."):
+        if not isinstance(node, dict) or seg not in node:
+            return None
+        node = node[seg]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def _check(name: str, spec: dict,
+           rounds: List[Tuple[int, dict]]) -> dict:
+    """One metric's verdict dict (status: ok | regression | no-data)."""
+    direction = spec.get("direction", "higher")
+    noise_pct = float(spec.get("noise_pct", 5.0))
+    limit = spec.get("floor" if direction == "higher" else "ceiling")
+    series = [(n, metric_value(p, name)) for n, p in rounds]
+    series = [(n, v) for n, v in series if v is not None]
+    verdict = {"metric": name, "direction": direction,
+               "noise_pct": noise_pct, "limit": limit,
+               "rounds": [n for n, _ in series]}
+    if not series:
+        verdict.update(status="no-data",
+                       detail="no hardware round reports this metric")
+        return verdict
+    newest_round, newest = series[-1]
+    verdict.update(newest=newest, newest_round=newest_round)
+    if rounds and newest_round != rounds[-1][0]:
+        # the newest hardware round stopped reporting this metric — a
+        # perf loss that manifests as a crashed leg must not read as
+        # green; grading r(N-1)'s value against the floor would mask it
+        verdict.update(
+            status="stale",
+            detail=f"newest hardware round r{rounds[-1][0]:02d} does "
+                   f"not report this metric (last seen "
+                   f"r{newest_round:02d}) — a crashed bench leg "
+                   "cannot pass the gate")
+        return verdict
+    worse = ((lambda a, b: a < b) if direction == "higher"
+             else (lambda a, b: a > b))
+    band = 1.0 - noise_pct / 100.0
+    failures = []
+
+    if limit is not None:
+        # budget check: newest vs floor/ceiling, noise-banded
+        lim = float(limit)
+        threshold = lim * band if direction == "higher" else lim / band
+        if worse(newest, threshold):
+            failures.append(
+                f"newest {newest:g} (r{newest_round:02d}) breaches "
+                f"{'floor' if direction == 'higher' else 'ceiling'} "
+                f"{lim:g} beyond the {noise_pct:g}% noise band")
+
+    prev = [v for _, v in series[:-1]]
+    if prev:
+        best_prev = max(prev) if direction == "higher" else min(prev)
+        threshold = (best_prev * band if direction == "higher"
+                     else best_prev / band)
+        verdict["best_prev"] = best_prev
+        if worse(newest, threshold):
+            failures.append(
+                f"newest {newest:g} (r{newest_round:02d}) regressed "
+                f"beyond {noise_pct:g}% noise vs best prior {best_prev:g}")
+
+    verdict["status"] = "regression" if failures else "ok"
+    if failures:
+        verdict["detail"] = "; ".join(failures)
+    return verdict
+
+
+def evaluate(budget: dict,
+             rounds: List[Tuple[int, dict]]) -> List[dict]:
+    hw = hardware_rounds(rounds)
+    return [_check(name, spec, hw)
+            for name, spec in sorted(budget.get("metrics", {}).items())]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH trajectory regression gate "
+                    "(tools/perf_budget.json)")
+    ap.add_argument("--budget", default=BUDGET_PATH)
+    ap.add_argument("--root", default=_ROOT,
+                    help="directory holding BENCH_r*.json")
+    ap.add_argument("--report", action="store_true",
+                    help="report-only: print verdicts, always exit 0 "
+                         "(tools/check.sh mode until fresh TPU numbers "
+                         "exist)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.budget, encoding="utf-8") as f:
+            budget = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot read budget {args.budget}: {e}",
+              file=sys.stderr)
+        return 2
+    rounds = load_rounds(args.root)
+    verdicts = evaluate(budget, rounds)
+    # stale (metric vanished from the newest hardware round) gates
+    # like a regression: a crashed leg must not pass
+    regressions = [v for v in verdicts
+                   if v["status"] in ("regression", "stale")]
+
+    if args.json:
+        print(json.dumps({"verdicts": verdicts,
+                          "hardware_rounds":
+                          [n for n, _ in hardware_rounds(rounds)],
+                          "regressions": len(regressions),
+                          "gating": not args.report}))
+    else:
+        hw = hardware_rounds(rounds)
+        print(f"perf_gate: {len(hw)} hardware round(s) "
+              f"{[n for n, _ in hw]} of {len(rounds)} total")
+        for v in verdicts:
+            line = f"  {v['status']:<10} {v['metric']}"
+            if v.get("newest") is not None:
+                line += (f"  newest={v['newest']:g} "
+                         f"(r{v['newest_round']:02d})")
+            if v.get("limit") is not None:
+                kind = ("floor" if v["direction"] == "higher"
+                        else "ceiling")
+                line += f"  {kind}={v['limit']:g}"
+            if v.get("detail"):
+                line += f"  [{v['detail']}]"
+            print(line)
+        if regressions:
+            print(f"perf_gate: {len(regressions)} above-noise "
+                  "regression(s)"
+                  + (" (report-only, not gating)" if args.report else ""))
+        else:
+            print("perf_gate: trajectory clean")
+    return 0 if (args.report or not regressions) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,30 +31,21 @@ def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
     reported number may come from a different batch; compare this
     summary's step_ms against the matching batch_sweep entry) and
     return the summary dict.  Shared by the standalone CLI below and
-    the one-session validator."""
+    the one-session validator.
+
+    The capture body is apex_tpu.telemetry.profiler.capture — ONE
+    code path for device-only tracing (host/python tracers off: the
+    round-4 window's default-options capture drowned in ~1M host
+    python events against 434 device ops) shared with profile_window
+    and the observatory, so there is no second tunnel-client rule to
+    remember here."""
     import jax.numpy as jnp
 
     import bench
-
-    # Device-only trace: the round-4 window's capture drowned in ~1M
-    # host python events (the device "XLA Ops" thread recorded 37 ms
-    # of a 46 s wall — useless for an op breakdown).  Host/python
-    # tracers off; trace ONE batch leg at the tracked b128 config with
-    # a short step count so device events stay within buffer.
-    opts = None
-    try:
-        opts = jax.profiler.ProfileOptions()
-        opts.host_tracer_level = 0
-        opts.python_tracer_level = 0
-    except Exception:
-        pass  # older jax: fall back to a default-options trace
+    from apex_tpu.telemetry.profiler import build_report, capture
 
     t0 = time.perf_counter()
-    # only pass the kwarg when options exist: a jax old enough to lack
-    # ProfileOptions also lacks the profiler_options parameter
-    tr = (jax.profiler.trace(outdir, profiler_options=opts)
-          if opts is not None else jax.profiler.trace(outdir))
-    with tr:
+    with capture.trace(outdir):
         r = bench._resnet50_one_batch(
             jax, jnp, on_tpu, 128 if on_tpu else 8,
             224 if on_tpu else 64, 20 if on_tpu else 2)
@@ -69,6 +60,15 @@ def capture_trace(outdir: str, jax, on_tpu: bool) -> dict:
         out["top_device_ops"] = summarize_device_ops(outdir)
     except Exception as e:  # summary is best-effort, trace is the point
         out["top_device_ops_error"] = repr(e)[:120]
+    try:
+        # the observatory's attribution over the same capture: step
+        # breakdown + collective overlap (docs/perf.md); best-effort
+        rep = build_report(outdir)
+        if not rep.get("error"):
+            out["breakdown"] = rep["breakdown"]
+            out["overlap_pct"] = rep.get("overlap_pct")
+    except Exception as e:
+        out["breakdown_error"] = repr(e)[:120]
     return out
 
 
