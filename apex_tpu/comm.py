@@ -192,6 +192,48 @@ def initialize_distributed(
     return initialize(**mesh_axes)
 
 
+def shrink_mesh(survivors: Sequence[int],
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Re-initialize the global mesh over the devices of the surviving
+    hosts — the mesh half of shrink-to-healthy-mesh recovery
+    (``resilience.fleet`` / ``run_elastic(fleet=...)``).
+
+    Keeps the current non-data axis sizes where the surviving device
+    count still supports them (pipe/ctx/model are topology choices the
+    model code depends on); the DATA axis absorbs the shrink, exactly
+    like the reference's data-parallel size = world // (tp * pp).
+    When the survivor count no longer divides by the minor axes, falls
+    back to all-data-parallel — a restore through the ``sharding=``
+    reshard flow is valid on any mesh, so correctness never depends on
+    preserving the old layout.
+
+    Faked multi-host note: when every device reports the same
+    ``process_index`` (single-process CPU tests), the filter keeps all
+    devices — the shrink is then exercised at the protocol layer
+    (agreement, restore, counters) with the mesh rebuilt in place.
+    """
+    alive = set(int(h) for h in survivors)
+    if devices is None:
+        devices = [d for d in jax.devices()
+                   if getattr(d, "process_index", 0) in alive]
+        if not devices:
+            # faked multi-host (or a survivor set naming no local
+            # process): never hand initialize() an empty device list
+            devices = list(jax.devices())
+    cfg = _CONFIG
+    pipe, ctx, model = ((cfg.pipe, cfg.ctx, cfg.model) if cfg is not None
+                        else (1, 1, 1))
+    if len(devices) % max(1, pipe * ctx * model) != 0:
+        import warnings
+        warnings.warn(
+            f"shrink_mesh: {len(devices)} surviving devices not "
+            f"divisible by pipe*ctx*model={pipe * ctx * model}; "
+            "rebuilding all-data-parallel")
+        pipe = ctx = model = 1
+    return initialize(data=-1, pipe=pipe, ctx=ctx, model=model,
+                      devices=devices)
+
+
 def process_index() -> int:
     """This host's rank (reference: torch.distributed.get_rank() over
     the world group)."""

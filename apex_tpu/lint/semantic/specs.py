@@ -231,11 +231,13 @@ def _build_scaled_value_and_grad():
     }
 
 
-def _instrumented_step_jaxpr(with_watchdog: bool):
+def _instrumented_step_jaxpr(with_watchdog: bool = False,
+                             with_fleet: bool = False):
     """The telemetry-instrumented flat-AMP step's jaxpr, optionally
-    with a resilience watchdog attached to the session — the watchdog
-    is host-side, window-cadence only, so the traced program must be
-    byte-for-byte free of callbacks/transfers either way."""
+    with a resilience watchdog and/or a fleet monitor attached to the
+    session — both are host-side (window-cadence detectors; out-of-band
+    beacons), so the traced program must be byte-for-byte free of
+    callbacks/transfers either way."""
     import jax
     import jax.numpy as jnp
     from apex_tpu import amp, telemetry
@@ -248,10 +250,18 @@ def _instrumented_step_jaxpr(with_watchdog: bool):
     pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0)
     tel = telemetry.Telemetry(run_dir=None, window=8, retrace=False)
     wd = None
+    mon = None
     try:
         if with_watchdog:
             from apex_tpu.resilience.watchdog import Watchdog
             wd = Watchdog(telemetry=tel)
+        if with_fleet:
+            from apex_tpu.resilience import fleet as fleet_mod
+            mon = fleet_mod.FleetMonitor(
+                channel=fleet_mod.LocalChannel(), host=0, n_hosts=2,
+                slow_after_steps=4, dead_after_steps=8,
+                slow_after_s=None, dead_after_s=None, telemetry=tel)
+            mon.beat(0)           # beacons are published host-side
 
         def train_step(work_bufs, opt_state, scaler, x, step):
             ptree = opt._plan.unpack_model(work_bufs)
@@ -267,6 +277,8 @@ def _instrumented_step_jaxpr(with_watchdog: bool):
             tel.buf, jnp.int32(0), opt._param_bufs, opt.opt_state,
             scaler, x, jnp.int32(1))
     finally:
+        if mon is not None:
+            mon.close()
         if wd is not None:
             wd.close()
         tel.close()
@@ -302,6 +314,27 @@ def _build_instrumented_step():
 def _build_watchdog_instrumented_step():
     return {
         "jaxpr": _instrumented_step_jaxpr(with_watchdog=True),
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "dus_min": 1,             # the ring write, nothing more
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "fleet.instrumented_step",
+    anchor="apex_tpu/resilience/fleet.py",
+    description="fleet-monitored instrumented flat AMP step: the "
+                "liveness beacon is published host-side through an "
+                "out-of-band channel at step boundaries, so the "
+                "traced step still contains ZERO callback/transfer "
+                "primitives — peer-failure detection adds no "
+                "per-step device syncs")
+def _build_fleet_instrumented_step():
+    return {
+        "jaxpr": _instrumented_step_jaxpr(with_fleet=True),
         "expect": {
             "no_host_transfer": True,
             "no_f64": True,

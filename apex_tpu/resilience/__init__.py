@@ -18,14 +18,23 @@ harness (SURVEY.md §5: the failure story the reference lacks).
 - :mod:`~apex_tpu.resilience.retry` — :class:`RetryPolicy`
   (bounded widening backoff, shared by ``run_elastic``'s transient
   retries and the watchdog's rollback budget);
+- :mod:`~apex_tpu.resilience.fleet` — :class:`FleetMonitor`
+  (out-of-band host liveness beacons classified live/slow/dead,
+  typed :class:`HostFailure` events, the barrier-free survivor
+  agreement round, and the deadline-armed step machinery —
+  :class:`StepDeadlineExceeded` — behind ``run_elastic``'s
+  shrink-to-healthy-mesh recovery);
 - :mod:`~apex_tpu.resilience.faults` — :class:`FaultInjector`
-  (seeded schedules of torn writes, fsync errors, slow disks,
-  preemption signals, crash-before-publish, and the training-state
+  (seeded schedules of torn writes, fsync errors, slow disks, full
+  disks, preemption signals, crash-before-publish, the training-state
   faults — NaN grads, loss spikes, scale collapse, straggler stalls —
-  that prove every detector->action path).
+  and the fleet faults — peer death, peer hang, slow network — that
+  prove every detector->action path).
 """
 
 from apex_tpu.resilience.elastic import ElasticResult, run_elastic
+from apex_tpu.resilience.fleet import (FleetMonitor, FleetRecoveryFailed,
+                                       HostFailure, StepDeadlineExceeded)
 from apex_tpu.resilience.manager import CheckpointManager
 from apex_tpu.resilience.preemption import PreemptionGuard
 from apex_tpu.resilience.retry import RetryPolicy
@@ -36,8 +45,12 @@ __all__ = [
     "Anomaly",
     "CheckpointManager",
     "ElasticResult",
+    "FleetMonitor",
+    "FleetRecoveryFailed",
+    "HostFailure",
     "PreemptionGuard",
     "RetryPolicy",
+    "StepDeadlineExceeded",
     "Watchdog",
     "WatchdogAbort",
     "WatchdogPolicy",

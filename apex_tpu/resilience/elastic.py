@@ -28,7 +28,18 @@ recovery contract:
   abort-with-diagnostics (:class:`~.watchdog.WatchdogAbort` after the
   post-mortem bundle is written).  Cadence saves age toward
   last-known-good through the watchdog's clean-window rule, pinned
-  against rotation while they age.
+  against rotation while they age;
+- **multi-host failure domains** (``fleet=`` + ``step_deadline=``): a
+  :class:`~.fleet.FleetMonitor` beaten at every step boundary
+  publishes this host's liveness beacon and classifies peers; a peer
+  agreed DEAD — or a deadline-armed step/save converting a hung
+  collective into :class:`~.fleet.StepDeadlineExceeded` — triggers
+  shrink-to-healthy-mesh recovery: barrier-free survivor agreement,
+  mesh re-initialization over the survivors (``comm.shrink_mesh`` or
+  the caller's ``on_shrink`` hook), restore of the last-known-good
+  checkpoint through the ``sharding=`` reshard flow, and resume —
+  bounded by the same ``RetryPolicy`` budget and reported as
+  ``ElasticResult.mesh_shrinks``.  A slow peer only warns.
 
 The user's step function owns the optimizer and any AMP state (a
 closure); ``save_extras``/``on_restore`` thread the non-optimizer
@@ -50,20 +61,37 @@ state (amp scaler dict, BN batch_stats) through the checkpoint bundle:
 from __future__ import annotations
 
 import dataclasses
+import errno
 import inspect
 import time
 import warnings
-from typing import Any, Callable, Optional, Tuple, Type
+from typing import Any, Callable, Optional, Tuple, Type, Union
 
 import jax
 
 from apex_tpu.resilience import faults as _faults
+from apex_tpu.resilience import fleet as _fleet
 from apex_tpu.resilience import watchdog as _watchdog
 from apex_tpu.resilience.manager import CheckpointManager
 from apex_tpu.resilience.preemption import PreemptionGuard
 from apex_tpu.resilience.retry import RetryPolicy
 
 Pytree = Any
+
+# OSErrors no amount of retrying can fix: restoring and replaying onto
+# a full / quota-exhausted / read-only filesystem fails the same way
+# every time — burning the whole retry budget on them just delays the
+# inevitable abort by the full backoff schedule
+_FATAL_ERRNOS = frozenset(
+    e for e in (getattr(errno, "ENOSPC", None),
+                getattr(errno, "EDQUOT", None),
+                getattr(errno, "EROFS", None)) if e is not None)
+
+
+def _fatal_io(e: BaseException) -> bool:
+    """True for a retryable-TYPED error whose errno says retrying is
+    hopeless (ENOSPC and friends) — the straight-to-abort path."""
+    return isinstance(e, OSError) and e.errno in _FATAL_ERRNOS
 
 
 @dataclasses.dataclass
@@ -75,6 +103,7 @@ class ElasticResult:
     restarts: int                   # in-job recoveries performed
     restored_from: Optional[int]    # initial resume step (None: fresh)
     rollbacks: int = 0              # watchdog rollback-and-replays
+    mesh_shrinks: int = 0           # shrink-to-healthy-mesh recoveries
 
 
 def run_elastic(step_fn: Callable[[int], Any],
@@ -86,6 +115,11 @@ def run_elastic(step_fn: Callable[[int], Any],
                 guard: Optional[PreemptionGuard] = None,
                 watchdog=None,
                 on_quarantine: Optional[Callable] = None,
+                fleet=None,
+                step_deadline: Union[None, str, float,
+                                     "_fleet.DeadlineCalibrator"] = None,
+                on_shrink: Optional[Callable] = None,
+                shrink_sharding=None,
                 save_extras: Optional[Callable[[], dict]] = None,
                 on_restore: Optional[Callable] = None,
                 retryable: Tuple[Type[BaseException], ...] = (OSError,),
@@ -126,7 +160,42 @@ def run_elastic(step_fn: Callable[[int], Any],
     ``policy.rollback`` budget + widening backoff, abort writes the
     post-mortem bundle then raises ``WatchdogAbort``.  Cadence saves
     are reported to the watchdog and pinned until the clean-window
-    rule resolves them (good -> ``manager.mark_good``)."""
+    rule resolves them (good -> ``manager.mark_good``).
+
+    ``fleet``: a :class:`~apex_tpu.resilience.fleet.FleetMonitor`
+    beaten once per completed step (publish this host's beacon,
+    classify peers).  A peer declared DEAD triggers shrink recovery
+    (below); a SLOW peer warns only.  ``step_deadline`` arms each
+    step's materialization and each cadence save with a watchdog
+    timer (``"auto"``: deadline calibrated from the trailing
+    step-time baseline via
+    :class:`~apex_tpu.resilience.fleet.DeadlineCalibrator` — pass
+    your own instance to tune it — or a fixed number of seconds): a
+    hung collective converts into a catchable
+    :class:`~apex_tpu.resilience.fleet.StepDeadlineExceeded` instead
+    of an eternal block, and with a ``fleet`` monitor present enters
+    the same shrink recovery (without one it propagates).
+
+    Shrink recovery: barrier-free survivor agreement
+    (``fleet.agree_survivors``), mesh re-init over the survivors
+    (``on_shrink(survivors, epoch)`` when given, else
+    ``comm.shrink_mesh`` when a global mesh is installed), a sweep of
+    the dead hosts' orphaned ``.tmp`` checkpoint files by the agreed
+    lowest-rank survivor, then restore of the last-known-good
+    checkpoint through ``manager.restore_good`` — passing
+    ``shrink_sharding`` (a sharding pytree, or a zero-arg callable
+    evaluated AFTER the mesh re-init) into the existing ``sharding=``
+    reshard flow so the restored state lands on the shrunk mesh.
+    Each shrink consumes the shared ``retry`` budget and increments
+    ``ElasticResult.mesh_shrinks``; an exhausted budget or a missing
+    restore target raises
+    :class:`~apex_tpu.resilience.fleet.FleetRecoveryFailed`.
+
+    Retryable-TYPED errors whose errno is hopeless (ENOSPC, EDQUOT,
+    EROFS) skip the retry loop entirely: the post-mortem bundle is
+    written (when a watchdog is attached) and the error propagates —
+    retrying a full disk just delays the abort by the whole backoff
+    schedule."""
     if optimizer is None and params_like is None:
         raise ValueError("need an optimizer or params_like to restore")
     if retry is None:
@@ -156,15 +225,71 @@ def run_elastic(step_fn: Callable[[int], Any],
     own_guard = guard is not None and not guard._installed
     if own_guard:
         guard.install()
+    runner: Optional[_fleet.DeadlineRunner] = None
+    calibrator: Optional[_fleet.DeadlineCalibrator] = None
+    fixed_deadline: Optional[float] = None
+    if step_deadline is not None:
+        runner = _fleet.DeadlineRunner()
+        if step_deadline == "auto":
+            # seed from the step-time baseline the watchdog already
+            # tracks (its straggler detector's trailing history), so
+            # the deadline is calibrated before our own notes accrue
+            calibrator = _fleet.DeadlineCalibrator(
+                history_source=(watchdog.recent_step_times
+                                if watchdog is not None else None))
+        elif isinstance(step_deadline, _fleet.DeadlineCalibrator):
+            calibrator = step_deadline
+        else:
+            fixed_deadline = float(step_deadline)
     restarts = 0
     rollbacks = 0
+    mesh_shrinks = 0
     try:
         def _extras() -> dict:
             return save_extras() if save_extras is not None else {}
 
-        def _restore(restore_fn=None) -> Optional[int]:
+        def _deadline_s() -> float:
+            return (calibrator.deadline_s() if calibrator is not None
+                    else fixed_deadline)
+
+        def _armed_step(step: int) -> None:
+            """Chaos hook + step body, deadline-armed when configured.
+            The hook runs INSIDE the armed region (an injected hang
+            must convert like a real one); a thunk abandoned while
+            blocked there re-checks the runner generation and skips
+            the state-mutating body — an abandoned worker must never
+            race the recovery that replaced it."""
+            if runner is None:
+                _faults.notify_step(step)
+                step_fn(step)
+                return
+            gen = runner.generation
+
+            def thunk():
+                _faults.notify_step(step)
+                if runner.generation == gen:
+                    step_fn(step)
+            t0 = time.monotonic()
+            runner.run(thunk, _deadline_s(), step=step, phase="step")
+            if calibrator is not None:
+                calibrator.note(time.monotonic() - t0)
+
+        def _armed_save(step: int, extras: dict) -> bool:
+            """Cadence save, deadline-armed when configured (the save
+            schedule joins the PREVIOUS async write — a hung network
+            filesystem blocks exactly here)."""
+            if runner is None:
+                return manager.maybe_save(step, optimizer=optimizer,
+                                          **extras)
+            return runner.run(
+                lambda: manager.maybe_save(step, optimizer=optimizer,
+                                           **extras),
+                _deadline_s(), step=step, phase="save")
+
+        def _restore(restore_fn=None, sharding=None) -> Optional[int]:
             out = (restore_fn or manager.restore_latest)(
-                params_like, optimizer, extra_like=extra_like)
+                params_like, optimizer, extra_like=extra_like,
+                sharding=sharding)
             if out is None:
                 return None
             if on_restore is not None:
@@ -177,6 +302,71 @@ def run_elastic(step_fn: Callable[[int], Any],
                     on_restore(*args)
             return out[2]
 
+        def _abort_fatal_io(step: int, e: BaseException) -> None:
+            """The non-retryable-errno path: post-mortem (when a
+            watchdog is attached), then let the caller re-raise."""
+            warnings.warn(
+                f"run_elastic: step {step} hit a non-retryable IO "
+                f"error ({type(e).__name__}: {e}); aborting without "
+                "burning the retry budget")
+            if watchdog is not None:
+                watchdog.write_postmortem(
+                    step, None, directory=watchdog.postmortem_dir
+                    or manager.directory)
+
+        def _shrink_recover(step: int) -> Optional[int]:
+            """Agreement -> shrunk mesh -> reshard restore -> resume;
+            None when the budget is spent or nothing restores."""
+            nonlocal restarts, mesh_shrinks
+            restarts += 1
+            if retry.exhausted(restarts):
+                return None
+            sleep(retry.delay_s(restarts))
+            # refresh liveness first: on the deadline path the monitor
+            # has not polled since the hang began — a peer that went
+            # silent mid-step must enter the agreement already
+            # suspect, and the agreement's bounded response wait (not
+            # an allgather) is what finally rules on it
+            fleet.beat(step)
+            prev_hosts = list(fleet.hosts)
+            epoch, survivors = fleet.agree_survivors(step)
+            dead = sorted(set(prev_hosts) - set(survivors))
+            warnings.warn(
+                f"run_elastic: shrinking to healthy mesh at step "
+                f"{step}: survivors {survivors}, dead {dead} "
+                f"(epoch {epoch})")
+            if on_shrink is not None:
+                on_shrink(survivors, epoch)
+            else:
+                from apex_tpu import comm as _comm
+                if _comm.is_initialized():
+                    _comm.shrink_mesh(survivors)
+            # the agreed lowest-rank survivor sweeps the dead hosts'
+            # orphaned .tmp files (construction-time GC is scoped to
+            # each host's OWN suffix, so nobody else ever would)
+            manager.gc_dead_host_tmp(dead, survivors, rank=fleet.host)
+            sh = (shrink_sharding() if callable(shrink_sharding)
+                  else shrink_sharding)
+            resumed = _restore(manager.restore_good, sharding=sh)
+            if resumed is None:
+                return None
+            # replay parity with the watchdog rollback path: the
+            # telemetry session's emitted-step watermark must rewind
+            # so the replayed steps re-record (flush filters on
+            # after_step — without this the replay would be silently
+            # dropped from the record), and watchdog detector state
+            # from the abandoned timeline must not re-trigger on
+            # replayed step numbers
+            tel = getattr(fleet, "telemetry", None) or (
+                watchdog.telemetry if watchdog is not None else None)
+            if tel is not None:
+                tel.rewind(resumed)
+            if watchdog is not None:
+                watchdog.reset_after_external_rewind(resumed)
+            mesh_shrinks += 1
+            fleet.note_shrink(step, epoch, survivors, dead, resumed)
+            return resumed
+
         def _forced_save(step: int) -> None:
             """Save NOW, surviving transient IO errors (bounded)."""
             for attempt in range(retry.max_retries + 1):
@@ -185,7 +375,7 @@ def run_elastic(step_fn: Callable[[int], Any],
                     manager.wait()
                     return
                 except retryable as e:
-                    if attempt == retry.max_retries:
+                    if _fatal_io(e) or attempt == retry.max_retries:
                         raise
                     warnings.warn(
                         f"run_elastic: final save at step {step} "
@@ -196,20 +386,43 @@ def run_elastic(step_fn: Callable[[int], Any],
         last_done = restored_from if restored_from is not None else 0
         step = last_done + 1
         while step <= total_steps:
-            _faults.notify_step(step)     # chaos hook; no-op normally
             saved_now = False
             try:
-                step_fn(step)
+                _armed_step(step)         # chaos hook rides inside
                 last_done = step
                 # evaluate extras ONLY on cadence steps: state_dict()
                 # callbacks device_get (loss scale etc.), and a
                 # per-step host sync is the hazard class this whole
                 # stack avoids (APX102)
                 due = manager.due(step)
-                saved_now = manager.maybe_save(
-                    step, optimizer=optimizer,
-                    **(_extras() if due else {}))
+                saved_now = _armed_save(step, _extras() if due else {})
+            except _fleet.StepDeadlineExceeded as e:
+                # a hung collective, converted: without a fleet
+                # monitor there is nobody to agree a shrink with —
+                # propagate (the external scheduler restarts the job)
+                if fleet is None:
+                    raise
+                fleet.note_deadline(e)
+                warnings.warn(
+                    f"run_elastic: {e.phase} at step {step} exceeded "
+                    f"its {e.deadline_s:.3g}s deadline (hung "
+                    "collective?); entering shrink recovery")
+                resumed = _shrink_recover(step)
+                if resumed is None:
+                    raise _fleet.FleetRecoveryFailed(
+                        f"step-deadline recovery at step {step} "
+                        f"failed (restart {restarts}/"
+                        f"{retry.max_retries} or no valid "
+                        "checkpoint)") from e
+                last_done = resumed
+                step = resumed + 1
+                continue
             except retryable as e:
+                if _fatal_io(e):
+                    # ENOSPC and friends: retrying is hopeless —
+                    # straight to the post-mortem-and-abort path
+                    _abort_fatal_io(step, e)
+                    raise
                 restarts += 1
                 if retry.exhausted(restarts):
                     raise
@@ -288,6 +501,33 @@ def run_elastic(step_fn: Callable[[int], Any],
                         + f"; recovery exhausted after "
                         f"{watchdog.rollbacks} rollback(s); "
                         f"post-mortem: {pm}", pm)
+            if fleet is not None:
+                failures = fleet.beat(step)
+                for f in failures:
+                    if f.kind == "host_slow":
+                        # a slow peer is an infrastructure warning,
+                        # never an eviction
+                        warnings.warn(
+                            f"run_elastic: peer host {f.host} is slow "
+                            f"(beacon gap {f.gap_s:.3g}s, lag "
+                            f"{f.lag_steps} steps)")
+                dead = [f for f in failures if f.kind == "host_dead"]
+                if dead:
+                    warnings.warn(
+                        f"run_elastic: peer host(s) "
+                        f"{sorted(f.host for f in dead)} declared "
+                        f"dead at step {step}; entering shrink "
+                        "recovery")
+                    resumed = _shrink_recover(step)
+                    if resumed is None:
+                        raise _fleet.FleetRecoveryFailed(
+                            f"peer-death recovery at step {step} "
+                            f"failed (restart {restarts}/"
+                            f"{retry.max_retries} or no valid "
+                            "checkpoint)")
+                    last_done = resumed
+                    step = resumed + 1
+                    continue
             if guard is not None and guard.check(step):
                 # preemption notice -> durable-now-then-clean-exit at
                 # this step boundary.  A cadence save just scheduled
@@ -308,11 +548,15 @@ def run_elastic(step_fn: Callable[[int], Any],
                 return ElasticResult(step=step, preempted=True,
                                      restarts=restarts,
                                      restored_from=restored_from,
-                                     rollbacks=rollbacks)
+                                     rollbacks=rollbacks,
+                                     mesh_shrinks=mesh_shrinks)
             step += 1
         try:
             manager.wait()                # final cadence save durable
         except retryable as e:
+            if _fatal_io(e):
+                _abort_fatal_io(last_done, e)
+                raise
             # the LAST async save's deferred failure surfaces here,
             # past the loop's retry handling — re-write the newest
             # state under the same bounded-retry contract
@@ -323,7 +567,10 @@ def run_elastic(step_fn: Callable[[int], Any],
         return ElasticResult(step=last_done, preempted=False,
                              restarts=restarts,
                              restored_from=restored_from,
-                             rollbacks=rollbacks)
+                             rollbacks=rollbacks,
+                             mesh_shrinks=mesh_shrinks)
     finally:
+        if runner is not None:
+            runner.close()
         if own_guard:
             guard.uninstall()

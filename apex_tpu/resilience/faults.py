@@ -34,6 +34,21 @@ kind                 what happens
 ``straggler``        ``notify_step`` stalls ``delay_s`` seconds — a
                      simulated slow host, visible as a step-time
                      regression to the watchdog's straggler detector
+``disk_full``        the payload write raises ``OSError(ENOSPC)`` — the
+                     NON-retryable disk failure ``run_elastic`` must
+                     abort on instead of burning its retry budget
+``peer_death``       advisory (fleet): the targeted simulated peer
+                     stops beaconing forever — a crashed host, detected
+                     by the FleetMonitor's liveness deadlines
+``peer_hang``        advisory (fleet) + local stall: the targeted peer
+                     stops beaconing AND ``notify_step`` blocks
+                     ``delay_s`` seconds — the hung-collective shape a
+                     deadline-armed step converts into
+                     ``StepDeadlineExceeded``
+``slow_network``     advisory (fleet): the targeted peer's beacons
+                     arrive ``lag_steps`` steps / ``delay_s`` seconds
+                     stale for ``n_steps`` beats — a slow peer the
+                     monitor warns about but never evicts
 ===================  ======================================================
 
 The injector subclasses :class:`apex_tpu.checkpoint.CheckpointIO` and
@@ -80,8 +95,10 @@ class FaultSpec(NamedTuple):
     kind: str                       # one of FaultInjector.KINDS
     at_save: Optional[int] = None   # 0-based checkpoint-write ordinal
     at_step: Optional[int] = None   # training step (step-keyed kinds)
-    delay_s: float = 0.0            # slow_disk / straggler stall
-    n_steps: int = 1                # training-fault application budget
+    delay_s: float = 0.0            # slow_disk / straggler / hang stall
+    n_steps: int = 1                # training/fleet application budget
+    target: Optional[int] = None    # peer host index (fleet kinds)
+    lag_steps: int = 4              # slow_network beacon staleness
 
 
 # module-level active injector: run_elastic's per-step chaos hook
@@ -106,6 +123,16 @@ def training_fault(step: int) -> Optional[FaultSpec]:
     return None
 
 
+def fleet_fault(step: int) -> Optional[FaultSpec]:
+    """The fleet fault (peer_death / peer_hang / slow_network) the
+    beacon simulation should apply at ``step``, if any (a no-op None
+    unless a FaultInjector is installed).  Consumes one unit of the
+    fault's ``n_steps`` budget per call — ask exactly once per beat."""
+    if _ACTIVE is not None:
+        return _ACTIVE.fleet_fault(step)
+    return None
+
+
 class FaultInjector(_ckpt.CheckpointIO):
     """Checkpoint-IO implementation that injects the scheduled faults.
 
@@ -115,13 +142,17 @@ class FaultInjector(_ckpt.CheckpointIO):
     """
 
     KINDS = ("truncate", "fsync_error", "slow_disk", "preempt",
-             "crash_before_publish",
-             "nan_grads", "loss_spike", "scale_collapse", "straggler")
+             "crash_before_publish", "disk_full",
+             "nan_grads", "loss_spike", "scale_collapse", "straggler",
+             "peer_death", "peer_hang", "slow_network")
     # step-keyed kinds delivered through notify_step/training_fault
     STEP_KINDS = ("preempt", "nan_grads", "loss_spike",
-                  "scale_collapse", "straggler")
+                  "scale_collapse", "straggler",
+                  "peer_death", "peer_hang", "slow_network")
     # advisory kinds the TRAINING LOOP applies (training_fault)
     TRAINING_KINDS = ("nan_grads", "loss_spike", "scale_collapse")
+    # advisory kinds the FLEET beacon simulation applies (fleet_fault)
+    FLEET_KINDS = ("peer_death", "peer_hang", "slow_network")
 
     def __init__(self, faults: Sequence[FaultSpec]):
         for f in faults:
@@ -139,6 +170,7 @@ class FaultInjector(_ckpt.CheckpointIO):
         # identical nan storms may be scheduled), so NamedTuple
         # equality would alias them — fired mirrors _fired_idx
         self._fired_idx: set = set()
+        self._hang_stalled: set = set()    # peer_hang local stalls taken
         self._spent = [0] * len(self.faults)
         self._lock = threading.Lock()
         self._prev: Optional[_ckpt.CheckpointIO] = None
@@ -218,10 +250,26 @@ class FaultInjector(_ckpt.CheckpointIO):
         """Step-keyed faults (called from ``notify_step``): deliver a
         REAL SIGTERM so the whole PreemptionGuard signal path is what
         gets tested, not a shortcut flag; a ``straggler`` fault stalls
-        the step boundary itself — a slow host, not slow disk."""
+        the step boundary itself — a slow host, not slow disk.  A
+        ``peer_hang`` stalls too (the hung collective's LOCAL
+        manifestation: this host blocks inside the psum its hung peer
+        never joins), on top of the beacon suppression the fleet
+        simulation applies — with a deadline-armed step, the stall is
+        what converts into ``StepDeadlineExceeded``."""
         lag = self._draw_step_fault(step, ("straggler",))
         if lag is not None:
             time.sleep(lag.delay_s)
+        hang = None
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if f.kind == "peer_hang" and f.at_step is not None \
+                        and step >= f.at_step \
+                        and i not in self._hang_stalled:
+                    self._hang_stalled.add(i)
+                    hang = f
+                    break
+        if hang is not None and hang.delay_s > 0:
+            time.sleep(hang.delay_s)
         with self._lock:
             due = [i for i, f in enumerate(self.faults)
                    if f.kind == "preempt" and i not in self._fired_idx
@@ -236,6 +284,11 @@ class FaultInjector(_ckpt.CheckpointIO):
         budget unit consumed per call — module docstring)."""
         return self._draw_step_fault(step, self.TRAINING_KINDS)
 
+    def fleet_fault(self, step: int) -> Optional[FaultSpec]:
+        """The advisory fleet fault the beacon simulation should apply
+        at ``step`` (one budget unit consumed per call)."""
+        return self._draw_step_fault(step, self.FLEET_KINDS)
+
     # ---- CheckpointIO overrides -----------------------------------------
     def open(self, path: str, mode: str = "wb"):
         if path.endswith(".tmp") and "w" in mode:
@@ -247,6 +300,12 @@ class FaultInjector(_ckpt.CheckpointIO):
         return super().open(path, mode)
 
     def write_array(self, f, arr) -> None:
+        fault = self._take("disk_full")
+        if fault is not None:
+            # ENOSPC: retrying cannot help — run_elastic must abort,
+            # not burn its whole budget on a hopeless loop
+            raise OSError(errno.ENOSPC,
+                          f"injected disk full (save #{self.saves})")
         fault = self._take("truncate")
         if fault is not None:
             # torn write: half the bytes land, then the "process" dies

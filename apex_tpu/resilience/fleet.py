@@ -1,0 +1,838 @@
+"""Multi-host failure domains: peer liveness, deadline-armed step
+boundaries, and shrink-to-healthy-mesh recovery.
+
+The resilience stack survives its OWN preemption (PreemptionGuard) and
+its OWN bad training state (Watchdog) — but a PEER host that dies or
+hangs produces neither a SIGTERM nor an anomaly: the survivors just
+block forever inside the next psum.  This module is the third leg of
+the failure-domain triad, in three pieces:
+
+- **Beacons** — each host publishes a monotonic ``(step, wall_time,
+  incarnation)`` beacon through an out-of-band channel at step
+  boundaries (:class:`KVChannel` over jax.distributed's coordination
+  KV store, :class:`FileChannel` over a shared filesystem, or the
+  in-process :class:`LocalChannel` the chaos suite and the examples
+  drive).  The channel is OUT-OF-BAND by construction: nothing in it
+  ever touches the traced program (the ``fleet.instrumented_step``
+  apexverify spec pins that a monitored step still lowers with zero
+  transfer/callback primitives).
+
+- **:class:`FleetMonitor`** — classifies every peer live / slow / dead
+  against configurable deadlines (wall-clock beacon age AND/OR
+  lockstep step-lag), surfaces typed :class:`HostFailure` events and
+  ``fleet/*`` host counters through the telemetry SinkRegistry, and
+  runs the **barrier-free agreement round**: on a suspected death,
+  each survivor publishes its survivor-set proposal for a fresh epoch
+  and collects its peers' proposals with a bounded wait — the agreed
+  set is the intersection of the responders' proposals restricted to
+  the hosts that responded at all, so a hung host can neither veto nor
+  stall the verdict (the same lockstep-agreement shape
+  ``restore_latest`` uses, minus the collective a dead peer would
+  hang).
+
+- **Deadline-armed step boundaries** — :class:`DeadlineRunner`
+  materializes a step (or a cadence save) on a worker thread with a
+  join deadline, so a hung collective converts into a catchable
+  :class:`StepDeadlineExceeded` instead of an eternal block;
+  :class:`DeadlineCalibrator` derives the deadline from the trailing
+  step-time baseline (the same median the watchdog's straggler
+  detector keeps) so a config constant never has to guess the step
+  time.
+
+``run_elastic(fleet=..., step_deadline=...)`` ties them together: a
+peer agreed dead (or a step deadline) triggers agreement ->
+re-initialize the mesh over the survivors (``comm.shrink_mesh`` or the
+caller's ``on_shrink`` hook) -> restore the last-known-good checkpoint
+through the existing ``sharding=`` reshard flow -> resume, recorded as
+``ElasticResult.mesh_shrinks`` under the same ``RetryPolicy`` budget.
+
+Known scope limits (docs/resilience.md spells them out): a dead host
+REJOINING the shrunk fleet is not handled (restart the job to grow
+back — ROADMAP's elastic-resharding thread), and on a real multi-host
+runtime the mesh re-initialization over survivors requires a runtime
+that supports it (``on_shrink`` is the integration point).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import threading
+import time
+from typing import (Any, Callable, Dict, List, Mapping, Optional,
+                    Sequence, Set, Tuple)
+
+from apex_tpu.resilience import faults as _faults
+from apex_tpu.telemetry import hostmetrics as _hostmetrics
+
+# peer liveness states
+HOST_LIVE = "live"
+HOST_SLOW = "slow"
+HOST_DEAD = "dead"
+
+
+class FleetRecoveryFailed(RuntimeError):
+    """Shrink-to-healthy-mesh recovery could not complete: the retry
+    budget is exhausted, or no valid checkpoint exists to restore the
+    survivors from.  The job should exit and let the external
+    scheduler restart it."""
+
+
+class StepDeadlineExceeded(RuntimeError):
+    """A deadline-armed step (or cadence save) did not materialize in
+    time — the signature of a hung collective whose peer died or hung.
+    ``.step``/``.phase``/``.deadline_s`` identify the blocked work."""
+
+    def __init__(self, message: str, step: int = -1,
+                 phase: str = "step", deadline_s: float = 0.0):
+        super().__init__(message)
+        self.step = step
+        self.phase = phase
+        self.deadline_s = deadline_s
+
+
+# ---------------------------------------------------------------------
+# Beacon channels: the out-of-band host-to-host transport.
+# ---------------------------------------------------------------------
+
+class BeaconChannel:
+    """Tiny keyed-JSON blackboard every host can write and read.
+
+    ``put(key, value)`` overwrites; ``get_all(prefix)`` returns the
+    newest value per key under ``prefix``.  Implementations must be
+    crash-tolerant on the read side (a torn write is skipped, never
+    raised) — the monitor treats a missing beacon exactly like a
+    silent host, which is the failure being detected anyway."""
+
+    def put(self, key: str, value: dict) -> None:
+        raise NotImplementedError
+
+    def get_all(self, prefix: str) -> Dict[str, dict]:
+        raise NotImplementedError
+
+
+class LocalChannel(BeaconChannel):
+    """In-process channel (dict + lock): the faked-multi-host chaos
+    suite and the examples' simulated peers share one instance."""
+
+    def __init__(self):
+        self._data: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, value: dict) -> None:
+        with self._lock:
+            self._data[key] = dict(value)
+
+    def get_all(self, prefix: str) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._data.items()
+                    if k.startswith(prefix)}
+
+
+class FileChannel(BeaconChannel):
+    """Shared-filesystem channel: one small JSON file per key, written
+    atomically (tmp + ``os.replace``) so readers never see a torn
+    beacon.  The practical transport when the checkpoint directory is
+    already on NFS/FUSE and no coordination service is reachable."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @staticmethod
+    def _fname(key: str) -> str:
+        return key.replace("/", "__") + ".json"
+
+    def put(self, key: str, value: dict) -> None:
+        path = os.path.join(self.directory, self._fname(key))
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(value, f)
+        os.replace(tmp, path)
+
+    def get_all(self, prefix: str) -> Dict[str, dict]:
+        want = self._fname(prefix)[:-len(".json")]
+        out: Dict[str, dict] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(want) and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name),
+                          encoding="utf-8") as f:
+                    out[name[:-len(".json")].replace("__", "/")] = \
+                        json.load(f)
+            except (OSError, ValueError):
+                continue              # torn write / vanished: skip
+        return out
+
+
+class KVChannel(BeaconChannel):
+    """jax.distributed coordination-service channel — the production
+    transport: the KV store every multi-host jax job already runs for
+    its startup handshake.
+
+    Newer jax clients support ``key_value_set(..., allow_overwrite=
+    True)``; older ones only write-once, so beacons fall back to
+    sequence-suffixed keys read back newest-wins (and are pruned
+    best-effort with ``key_value_delete`` where available).  This
+    class is necessarily exercised only on real multi-host runs — CI
+    covers the protocol through :class:`LocalChannel`/
+    :class:`FileChannel`, which share every code path above the
+    transport."""
+
+    def __init__(self, client=None, prefix: str = "apex_tpu/fleet/"):
+        if client is None:
+            from jax._src import distributed
+            client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "KVChannel needs an initialized jax.distributed client "
+                "(comm.initialize_distributed); use FileChannel on a "
+                "shared filesystem otherwise")
+        self._client = client
+        self._prefix = prefix
+        self._seq = 0
+        self._overwrite_ok: Optional[bool] = None
+
+    def put(self, key: str, value: dict) -> None:
+        payload = json.dumps(value, sort_keys=True)
+        full = self._prefix + key
+        if self._overwrite_ok is not False:
+            try:
+                self._client.key_value_set(full, payload,
+                                           allow_overwrite=True)
+                self._overwrite_ok = True
+                return
+            except TypeError:         # old client: write-once only
+                self._overwrite_ok = False
+        self._seq += 1
+        self._client.key_value_set(f"{full}/{self._seq:08d}", payload)
+
+    def get_all(self, prefix: str) -> Dict[str, dict]:
+        try:
+            items = self._client.key_value_dir_get(self._prefix + prefix)
+        except Exception:             # noqa: BLE001 — silent host, not a crash
+            return {}
+        newest: Dict[str, Tuple[str, str]] = {}
+        for full_key, payload in items:
+            key = full_key[len(self._prefix):]
+            base, _, seq = key.rpartition("/")
+            # only the write-once fallback appends a sequence segment,
+            # always zero-padded to exactly 8 digits — a bare digit
+            # tail is a HOST ID ("beacon/0", "verdict/3/1") and must
+            # NOT be stripped, or every host collapses into one entry
+            if base and len(seq) == 8 and seq.isdigit():
+                key = base            # seq-suffixed fallback key
+            prev = newest.get(key)
+            if prev is None or full_key > prev[0]:
+                newest[key] = (full_key, payload)
+        out: Dict[str, dict] = {}
+        for key, (_, payload) in newest.items():
+            try:
+                out[key] = json.loads(payload)
+            except ValueError:
+                continue
+        return out
+
+
+# ---------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HostFailure:
+    """One typed peer-liveness event (the fleet analogue of the
+    watchdog's :class:`~.watchdog.Anomaly`)."""
+    kind: str                   # "host_dead" | "host_slow"
+    host: int                   # the peer concerned
+    step: int                   # local step at detection
+    peer_step: int              # the peer's last beacon step (-1: none)
+    gap_s: float                # wall-clock beacon age at detection
+    lag_steps: int              # local step - peer's beacon step
+    evidence: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def record(self) -> dict:
+        """The typed telemetry event (``kind: "fleet"``) emitters
+        write and ``telemetry summarize`` renders as a timeline row."""
+        return {"kind": "fleet", "event": self.kind,
+                "host": self.host, "step": self.step,
+                "peer_step": self.peer_step,
+                "gap_s": round(self.gap_s, 3),
+                "lag_steps": self.lag_steps,
+                **({"evidence": dict(self.evidence)}
+                   if self.evidence else {})}
+
+
+# ---------------------------------------------------------------------
+# The monitor
+# ---------------------------------------------------------------------
+
+class FleetMonitor:
+    """Out-of-band host liveness: publish this host's beacon, classify
+    peers, agree on survivors.
+
+    >>> ch = fleet.FileChannel(os.path.join(ckpt_dir, "fleet"))
+    >>> mon = fleet.FleetMonitor(channel=ch, telemetry=tel)
+    >>> res = run_elastic(step_fn, mgr, opt, total_steps=...,
+    ...                   fleet=mon, step_deadline="auto")
+
+    Liveness criteria (either may be disabled with ``None``; a peer is
+    the WORST of the two):
+
+    - wall clock: beacon age in ``(slow_after_s, dead_after_s]`` is
+      slow, beyond ``dead_after_s`` is dead — the production criterion
+      (clocks need only be comparable to within the slack between the
+      two deadlines, not synchronized).
+    - step lag: a lockstep trainer whose peer's beacon step trails by
+      ``(slow_after_steps, dead_after_steps]`` is slow, beyond is dead
+      — deterministic, and exactly the signal a data-parallel psum
+      cares about.
+
+    ``beat(step)`` is THE step-boundary poll (``run_elastic`` calls it
+    for you): publish, run the registered pre-beat hooks (how
+    :class:`SimulatedPeers` drives faked multi-host), classify, emit
+    ``fleet/*`` counters, return new :class:`HostFailure` events.
+    Detection adds zero device traffic — everything is host-side, and
+    a telemetry session only carries the typed events out through its
+    existing window flush."""
+
+    def __init__(self, channel: BeaconChannel,
+                 host: Optional[int] = None,
+                 n_hosts: Optional[int] = None,
+                 slow_after_s: Optional[float] = 30.0,
+                 dead_after_s: Optional[float] = 120.0,
+                 slow_after_steps: Optional[int] = None,
+                 dead_after_steps: Optional[int] = None,
+                 agreement_timeout_s: float = 30.0,
+                 incarnation: Optional[int] = None,
+                 telemetry=None,
+                 clock: Callable[[], float] = time.time):
+        import jax
+        if (slow_after_s is None) != (dead_after_s is None):
+            raise ValueError("enable both wall deadlines or neither")
+        if (slow_after_steps is None) != (dead_after_steps is None):
+            raise ValueError("enable both step-lag deadlines or neither")
+        if slow_after_s is None and slow_after_steps is None:
+            raise ValueError("at least one liveness criterion required")
+        if slow_after_s is not None and not \
+                (0 < slow_after_s < dead_after_s):
+            raise ValueError("need 0 < slow_after_s < dead_after_s")
+        if slow_after_steps is not None and not \
+                (0 < slow_after_steps < dead_after_steps):
+            raise ValueError(
+                "need 0 < slow_after_steps < dead_after_steps")
+        self.channel = channel
+        self.host = jax.process_index() if host is None else int(host)
+        n = jax.process_count() if n_hosts is None else int(n_hosts)
+        self.hosts: List[int] = list(range(n))
+        self.slow_after_s = slow_after_s
+        self.dead_after_s = dead_after_s
+        self.slow_after_steps = slow_after_steps
+        self.dead_after_steps = dead_after_steps
+        self.agreement_timeout_s = float(agreement_timeout_s)
+        self.incarnation = (int(incarnation) if incarnation is not None
+                            else int(time.time() * 1e3) % (1 << 31))
+        self._clock = clock
+        self.epoch = 0
+        self.timeline: List[HostFailure] = []     # full event history
+        self.events: List[dict] = []              # shrink/deadline too
+        self._event_records: List[dict] = []      # queued for flush
+        self._status: Dict[int, str] = {h: HOST_LIVE for h in self.hosts}
+        self._slow_warned: Set[int] = set()
+        self._pre_beat: List[Callable[[int], None]] = []
+        self._spin_hooks: List[Callable[[int], None]] = []
+        self._publish_warned = False
+        self._start_wall = clock()
+        self._last_step = 0
+        self.telemetry = telemetry
+        self._attached = False
+        if telemetry is not None:
+            telemetry.add_observer(self._on_flush)
+            self._attached = True
+
+    # ---- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        if self._attached and self.telemetry is not None:
+            if self._event_records:
+                # drain queued events through one last flush while the
+                # observer is still attached — a shrink right before
+                # shutdown must reach the JSONL
+                try:
+                    self.telemetry.flush()
+                except Exception:        # noqa: BLE001 — teardown path
+                    pass
+            self.telemetry.remove_observer(self._on_flush)
+            self._attached = False
+
+    def __enter__(self) -> "FleetMonitor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _on_flush(self, records) -> List[dict]:
+        """Telemetry flush observer: hand queued fleet event records
+        to the emitters (the watchdog's observer discipline)."""
+        out, self._event_records = self._event_records, []
+        return out
+
+    def add_beat_hook(self, fn: Callable[[int], None]) -> None:
+        """Run ``fn(step)`` at the start of every ``beat`` — the seam
+        :class:`SimulatedPeers` (and tests) publish peer beacons
+        through before classification reads them."""
+        self._pre_beat.append(fn)
+
+    def add_spin_hook(self, fn: Callable[[int], None]) -> None:
+        """Run ``fn(epoch)`` on every agreement-round poll — how
+        simulated peers answer verdicts without their own thread."""
+        self._spin_hooks.append(fn)
+
+    # ---- beacons ---------------------------------------------------------
+    def publish(self, step: int) -> None:
+        """Publish this host's ``(step, wall_time, incarnation)``
+        beacon (monotonic per incarnation).  A transient channel
+        failure must never kill training: it degrades to a missed
+        beacon (this host looks slow to its peers — which is true)."""
+        self._last_step = int(step)
+        try:
+            self.channel.put(f"beacon/{self.host}", {
+                "host": self.host, "step": int(step),
+                "wall_time": self._clock(),
+                "incarnation": self.incarnation,
+                "epoch": self.epoch})
+        except OSError as e:
+            if not self._publish_warned:
+                self._publish_warned = True
+                import warnings
+                warnings.warn(
+                    f"fleet: beacon publish failed "
+                    f"({type(e).__name__}: {e}); continuing — peers "
+                    "will see this host as slow until the channel "
+                    "recovers")
+
+    def peers(self) -> List[int]:
+        return [h for h in self.hosts if h != self.host]
+
+    def _read_beacons(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        try:
+            beacons = self.channel.get_all("beacon/")
+        except OSError:
+            return out            # unreadable channel = silent peers
+        for key, rec in beacons.items():
+            try:
+                h = int(key.rsplit("/", 1)[-1])
+            except ValueError:
+                continue
+            if h in self.hosts and h != self.host:
+                out[h] = rec
+        return out
+
+    def _classify(self, step: int, beacon: Optional[dict],
+                  now: float) -> Tuple[str, float, int]:
+        """-> (status, gap_s, lag_steps) for one peer."""
+        if beacon is None:
+            # no beacon yet: age from monitor start (startup grace),
+            # lag from step 0
+            gap_s = now - self._start_wall
+            peer_step = -1
+        else:
+            gap_s = max(0.0, now - float(beacon.get("wall_time", now)))
+            peer_step = int(beacon.get("step", -1))
+        lag = int(step) - max(peer_step, 0)
+        status = HOST_LIVE
+        if self.slow_after_s is not None:
+            if gap_s > self.dead_after_s:
+                status = HOST_DEAD
+            elif gap_s > self.slow_after_s:
+                status = HOST_SLOW
+        if self.slow_after_steps is not None and status != HOST_DEAD:
+            if lag > self.dead_after_steps:
+                status = HOST_DEAD
+            elif lag > self.slow_after_steps and status == HOST_LIVE:
+                status = HOST_SLOW
+        return status, gap_s, lag
+
+    def poll(self, step: int) -> List[HostFailure]:
+        """Classify every peer against the deadlines; return NEW
+        failure events (dead fires once and is sticky; slow fires once
+        per episode, re-armed by recovery).  Emits the ``fleet/*``
+        counters."""
+        now = self._clock()
+        beacons = self._read_beacons()
+        found: List[HostFailure] = []
+        worst_gap, worst_lag = 0.0, 0
+        for h in self.peers():
+            if self._status.get(h) == HOST_DEAD:
+                continue              # sticky until the shrink
+            status, gap_s, lag = self._classify(step, beacons.get(h),
+                                                now)
+            worst_gap = max(worst_gap, gap_s)
+            worst_lag = max(worst_lag, lag)
+            prev = self._status.get(h, HOST_LIVE)
+            self._status[h] = status
+            b = beacons.get(h)
+            peer_step = int(b.get("step", -1)) if b else -1
+            if status == HOST_DEAD:
+                found.append(HostFailure(
+                    kind="host_dead", host=h, step=int(step),
+                    peer_step=peer_step, gap_s=gap_s, lag_steps=lag))
+            elif status == HOST_SLOW and h not in self._slow_warned:
+                self._slow_warned.add(h)
+                found.append(HostFailure(
+                    kind="host_slow", host=h, step=int(step),
+                    peer_step=peer_step, gap_s=gap_s, lag_steps=lag))
+            elif status == HOST_LIVE and prev == HOST_SLOW:
+                self._slow_warned.discard(h)      # episode over: re-arm
+        statuses = [self._status[h] for h in self.peers()]
+        _hostmetrics.emit("fleet/hosts_live",
+                          1 + statuses.count(HOST_LIVE))
+        _hostmetrics.emit("fleet/hosts_slow", statuses.count(HOST_SLOW))
+        _hostmetrics.emit("fleet/hosts_dead", statuses.count(HOST_DEAD))
+        _hostmetrics.emit("fleet/beacon_gap_ms", worst_gap * 1e3)
+        _hostmetrics.emit("fleet/beacon_lag_steps", worst_lag)
+        for f in found:
+            self.timeline.append(f)
+            self._event_records.append(f.record())
+        return found
+
+    def beat(self, step: int) -> List[HostFailure]:
+        """THE step-boundary poll: publish + pre-beat hooks +
+        classify.  ``run_elastic(fleet=...)`` calls it once per
+        completed step."""
+        self.publish(step)
+        for hook in list(self._pre_beat):
+            hook(step)
+        return self.poll(step)
+
+    # ---- views -----------------------------------------------------------
+    def status(self, host: int) -> str:
+        return HOST_LIVE if host == self.host \
+            else self._status.get(host, HOST_LIVE)
+
+    def live_hosts(self) -> List[int]:
+        """Hosts not declared dead (self included; slow counts as
+        live — a slow peer gets warned about, not evicted)."""
+        return [h for h in self.hosts
+                if self.status(h) != HOST_DEAD]
+
+    def dead_hosts(self) -> List[int]:
+        return [h for h in self.hosts if self.status(h) == HOST_DEAD]
+
+    # ---- agreement -------------------------------------------------------
+    def agree_survivors(self, step: int,
+                        timeout_s: Optional[float] = None
+                        ) -> Tuple[int, List[int]]:
+        """Barrier-free survivor agreement for a fresh epoch.
+
+        Every survivor publishes its proposal (its live set) under the
+        epoch and polls for its peers' proposals; a host that fails to
+        publish within the deadline is treated as dead — it cannot
+        stall the round the way it would stall an allgather.  The
+        agreed set is the intersection of the responders' proposals
+        restricted to the responders themselves, so every responding
+        host computes the SAME set from the same published verdicts
+        (the ``restore_latest`` lockstep-agreement shape, minus the
+        collective).  A host the agreed set excludes — possible when
+        a peer's proposal ruled it dead — raises
+        :class:`FleetRecoveryFailed` and self-evicts instead of
+        rebuilding a divergent (split-brain) mesh.  Updates the
+        monitor's host set to the agreed survivors and bumps
+        ``epoch``."""
+        epoch = self.epoch + 1
+        proposal = sorted(self.live_hosts())
+        self.channel.put(f"verdict/{epoch}/{self.host}", {
+            "host": self.host, "epoch": epoch, "step": int(step),
+            "survivors": proposal, "incarnation": self.incarnation})
+        deadline = self._clock() + (timeout_s if timeout_s is not None
+                                    else self.agreement_timeout_s)
+        spins = 0
+        while True:
+            spins += 1
+            for hook in list(self._spin_hooks):
+                hook(epoch)
+            verdicts = self.channel.get_all(f"verdict/{epoch}/")
+            responders: Dict[int, List[int]] = {}
+            for rec in verdicts.values():
+                try:
+                    responders[int(rec["host"])] = \
+                        [int(s) for s in rec["survivors"]]
+                except (KeyError, TypeError, ValueError):
+                    continue
+            if set(proposal) <= set(responders):
+                break                 # everyone we expected answered
+            if self._clock() >= deadline or spins > 1_000_000:
+                break                 # non-responders are dead
+            time.sleep(0.001)
+        agreed = set(responders)
+        for survivors in responders.values():
+            agreed &= set(survivors)
+        survivors = sorted(agreed)
+        if self.host not in agreed:
+            # a responder's proposal excluded US: by the same rule
+            # every other survivor applies, this host is out of the
+            # fleet — self-evict rather than rebuild a divergent
+            # (split-brain) mesh the real survivors don't share
+            raise FleetRecoveryFailed(
+                f"host {self.host} is excluded from the agreed "
+                f"survivor set {survivors} (epoch {epoch}) — the "
+                "fleet considers this host failed; exiting for the "
+                "external scheduler to restart it")
+        self.epoch = epoch
+        self._shrink_to(survivors)
+        _hostmetrics.emit("fleet/epoch", epoch)
+        return epoch, survivors
+
+    def _shrink_to(self, survivors: Sequence[int]) -> None:
+        self.hosts = sorted(set(int(h) for h in survivors)
+                            | {self.host})
+        self._status = {h: HOST_LIVE for h in self.hosts}
+        self._slow_warned.clear()
+
+    # ---- action events (recorded by run_elastic) -------------------------
+    def _event(self, rec: dict) -> None:
+        self.events.append(rec)
+        self._event_records.append(rec)
+
+    def note_shrink(self, step: int, epoch: int,
+                    survivors: Sequence[int], dead: Sequence[int],
+                    restored_step: Optional[int]) -> None:
+        _hostmetrics.emit("fleet/mesh_shrinks", 1)
+        self._event({
+            "kind": "fleet", "event": "shrink", "step": int(step),
+            "epoch": int(epoch), "survivors": list(survivors),
+            "dead": list(dead),
+            "to_step": (int(restored_step)
+                        if restored_step is not None else None)})
+
+    def note_deadline(self, exc: "StepDeadlineExceeded") -> None:
+        self._event({
+            "kind": "fleet", "event": "deadline_exceeded",
+            "step": int(exc.step), "phase": exc.phase,
+            "deadline_s": round(exc.deadline_s, 3)})
+
+
+# ---------------------------------------------------------------------
+# Simulated peers: faked multi-host for the chaos suite + examples.
+# ---------------------------------------------------------------------
+
+class SimulatedPeers:
+    """Drive the OTHER hosts of a faked fleet in-process.
+
+    Publishes a live beacon per simulated peer on every monitor beat
+    and answers agreement rounds on their behalf — so the full
+    beacon -> classify -> agree -> shrink protocol runs end to end in
+    one process (the examples' ``--fleet`` mode and the chaos matrix).
+    Consumes the scheduled ``peer_death`` / ``peer_hang`` /
+    ``slow_network`` faults from :mod:`~apex_tpu.resilience.faults`:
+    a killed peer stops beaconing (its last beacon ages out / lags
+    behind exactly like a real dead host's), a slow-networked peer
+    publishes stale beacons for the fault's budget.
+
+    >>> sim = SimulatedPeers(channel, hosts=[1, 2])
+    >>> sim.attach(monitor)      # beat + agreement hooks
+    """
+
+    def __init__(self, channel: BeaconChannel, hosts: Sequence[int],
+                 clock: Callable[[], float] = time.time,
+                 incarnation: int = 1):
+        self.channel = channel
+        self.hosts = [int(h) for h in hosts]
+        self.killed: Set[int] = set()
+        self._lag: Dict[int, Tuple[int, float]] = {}   # host -> (steps, s)
+        self._clock = clock
+        self.incarnation = incarnation
+
+    def attach(self, monitor: FleetMonitor) -> "SimulatedPeers":
+        monitor.add_beat_hook(self.beat)
+        monitor.add_spin_hook(self.answer_agreement)
+        return self
+
+    def kill(self, host: int) -> None:
+        """The peer stops beaconing from now on (host crashed/hung)."""
+        self.killed.add(int(host))
+
+    def _default_target(self) -> int:
+        alive = [h for h in self.hosts if h not in self.killed]
+        return alive[-1] if alive else self.hosts[-1]
+
+    def beat(self, step: int) -> None:
+        """Publish one beacon per live simulated peer; apply any
+        scheduled fleet fault first."""
+        f = _faults.fleet_fault(step)
+        if f is not None:
+            target = f.target if f.target is not None \
+                else self._default_target()
+            if f.kind in ("peer_death", "peer_hang"):
+                self.kill(target)
+            elif f.kind == "slow_network":
+                self._lag[target] = (int(f.lag_steps), float(f.delay_s))
+        now = self._clock()
+        for h in self.hosts:
+            if h in self.killed:
+                continue
+            lag_steps, lag_s = self._lag.get(h, (0, 0.0))
+            self.channel.put(f"beacon/{h}", {
+                "host": h, "step": int(step) - lag_steps,
+                "wall_time": now - lag_s,
+                "incarnation": self.incarnation, "epoch": 0})
+        # a slow-network lag expires with the fault budget: faults
+        # hand out one unit per beat, so clear when no longer drawn
+        if f is None:
+            self._lag.clear()
+
+    def answer_agreement(self, epoch: int) -> None:
+        """Publish each live peer's verdict for ``epoch``: its own
+        survivor view (everything it can see beaconing = everything
+        not killed, plus the real hosts)."""
+        verdicts = self.channel.get_all(f"verdict/{epoch}/")
+        real_hosts = sorted(
+            int(rec["host"]) for rec in verdicts.values()
+            if "host" in rec and int(rec["host"]) not in self.hosts)
+        view = sorted(set(real_hosts)
+                      | {h for h in self.hosts if h not in self.killed})
+        for h in self.hosts:
+            if h in self.killed:
+                continue              # a dead peer answers nothing
+            key = f"verdict/{epoch}/{h}"
+            if key in verdicts:
+                continue
+            self.channel.put(key, {
+                "host": h, "epoch": int(epoch), "step": -1,
+                "survivors": view, "incarnation": self.incarnation})
+
+
+# ---------------------------------------------------------------------
+# Deadline-armed execution
+# ---------------------------------------------------------------------
+
+class DeadlineCalibrator:
+    """Derive the step deadline from the trailing step-time baseline.
+
+    ``deadline_s() = clamp(factor * median(recent durations), min_s,
+    max_s)`` — the same trailing-median shape the watchdog's
+    :class:`~.watchdog.StepTimeDetector` keeps, so the deadline tracks
+    warmup/compile drift instead of guessing a constant.  Before
+    ``min_history`` samples exist, ``history_source`` (a zero-arg
+    callable returning recent durations — ``run_elastic`` passes the
+    watchdog's ``recent_step_times`` so the baseline the watchdog
+    already tracks calibrates the deadline too) is consulted; with
+    neither, ``default_s`` applies (generous: the first steps include
+    compilation)."""
+
+    def __init__(self, factor: float = 10.0, min_s: float = 1.0,
+                 max_s: float = 600.0, default_s: float = 120.0,
+                 min_history: int = 5, history: int = 64,
+                 history_source: Optional[
+                     Callable[[], Sequence[float]]] = None):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        self.factor = float(factor)
+        self.min_s = float(min_s)
+        self.max_s = float(max_s)
+        self.default_s = float(default_s)
+        self.min_history = int(min_history)
+        self.history_source = history_source
+        import collections
+        self._hist = collections.deque(maxlen=int(history))
+
+    def note(self, duration_s: float) -> None:
+        """Record one COMPLETED step's duration (a timed-out step is
+        not a baseline sample)."""
+        self._hist.append(float(duration_s))
+
+    def deadline_s(self) -> float:
+        samples = list(self._hist)
+        if len(samples) < self.min_history \
+                and self.history_source is not None:
+            samples = list(self.history_source())
+        if len(samples) < self.min_history:
+            return self.default_s
+        med = sorted(samples)[len(samples) // 2]
+        return min(max(self.factor * med, self.min_s), self.max_s)
+
+
+class DeadlineRunner:
+    """Run a thunk on a persistent worker thread with a join deadline.
+
+    A hung collective blocks its thread forever; Python cannot
+    interrupt it.  What it CAN do is stop WAITING: ``run`` hands the
+    thunk to the worker and waits at most ``deadline_s`` for the
+    result — on expiry it abandons the (daemon) worker, respawns a
+    fresh one for the next call, and raises
+    :class:`StepDeadlineExceeded`.  Results from an abandoned worker
+    go to its abandoned queue and can never be mistaken for a live
+    call's (queues are replaced on every timeout).  Exceptions from
+    the thunk re-raise in the caller."""
+
+    def __init__(self):
+        self._inq: Optional[queue.Queue] = None
+        self._outq: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        # bumped on every timeout: a thunk captured before submission
+        # can re-check it after a blocking prologue and skip its
+        # side-effecting body once abandoned (run_elastic's step thunk
+        # does), so an abandoned worker can never mutate training
+        # state concurrently with the recovery that replaced it
+        self.generation = 0
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._inq, self._outq = queue.Queue(), queue.Queue()
+
+        def loop(inq: queue.Queue, outq: queue.Queue) -> None:
+            while True:
+                item = inq.get()
+                if item is None:
+                    return
+                fn = item
+                try:
+                    outq.put(("ok", fn()))
+                except BaseException as e:    # noqa: BLE001 — re-raised
+                    outq.put(("err", e))
+
+        self._worker = threading.Thread(
+            target=loop, args=(self._inq, self._outq),
+            name="apex-tpu-deadline-runner", daemon=True)
+        self._worker.start()
+
+    def run(self, fn: Callable[[], Any], deadline_s: float,
+            step: int = -1, phase: str = "step") -> Any:
+        self._ensure_worker()
+        self._inq.put(fn)
+        try:
+            kind, payload = self._outq.get(timeout=max(deadline_s,
+                                                       1e-3))
+        except queue.Empty:
+            # abandon the stuck worker: its queues are dropped with it,
+            # so a late result can never satisfy a FUTURE call
+            self.generation += 1
+            self._worker = None
+            self._inq = self._outq = None
+            raise StepDeadlineExceeded(
+                f"{phase} at step {step} did not materialize within "
+                f"{deadline_s:.3g}s — a hung collective (dead or hung "
+                f"peer?)", step=step, phase=phase,
+                deadline_s=deadline_s) from None
+        if kind == "err":
+            raise payload
+        return payload
+
+    def close(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            self._inq.put(None)
+        self._worker = None
+        self._inq = self._outq = None
+
+    def __enter__(self) -> "DeadlineRunner":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -142,6 +142,51 @@ class CheckpointManager:
                     except OSError:
                         pass
 
+    def gc_dead_host_tmp(self, dead_hosts, survivors,
+                         rank: Optional[int] = None) -> int:
+        """Clear ``.tmp`` orphans belonging to hosts the fleet
+        agreement declared DEAD (never a live host's — on a shared
+        filesystem a live peer's ``.tmp`` may be an in-flight write).
+
+        The construction-time GC is strictly scoped to THIS host's
+        suffix, so a dead PEER's torn ``.tmp`` files would otherwise
+        accumulate forever in a shared checkpoint dir.  Exactly one
+        survivor does the sweep — the agreed lowest-rank one (every
+        survivor holds the same agreed sets, so the election needs no
+        extra round); everyone else no-ops.  Returns the number of
+        files removed.
+
+        Covers both naming modes: ``all_hosts=True`` peers write
+        ``step-N.p<idx>.ckpt.tmp``; with a single writer
+        (``all_hosts=False``) only host 0's plain
+        ``step-N.ckpt.tmp`` shape exists — swept only when host 0
+        itself is among the dead."""
+        dead = sorted(set(int(h) for h in dead_hosts))
+        alive = sorted(set(int(h) for h in survivors))
+        if not dead or not alive:
+            return 0
+        if rank is None:
+            rank = jax.process_index()
+        if int(rank) != alive[0]:
+            return 0
+        patterns = [re.compile(rf"^step-\d+\.p{h}\.ckpt\.tmp$")
+                    for h in dead]
+        if 0 in dead:
+            patterns.append(re.compile(r"^step-\d+\.ckpt\.tmp$"))
+        removed = 0
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        for name in names:
+            if any(p.match(name) for p in patterns):
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
     # how many of the newest local steps each host contributes to the
     # multi-host agreement.  MUST be the same on every host (allgather
     # needs equal shapes even when hosts configure different `keep`),

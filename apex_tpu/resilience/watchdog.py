@@ -509,6 +509,17 @@ class Watchdog:
         """Rollbacks spent from the policy's budget so far."""
         return self._rollbacks
 
+    def recent_step_times(self) -> List[float]:
+        """The straggler detector's trailing step-time samples
+        (seconds; empty without a :class:`StepTimeDetector`) — the
+        baseline ``run_elastic(step_deadline="auto")`` seeds its
+        :class:`~apex_tpu.resilience.fleet.DeadlineCalibrator` from,
+        so the deadline is calibrated before the calibrator's own
+        history accrues."""
+        if self._time_det is None:
+            return []
+        return list(self._time_det._hist)
+
     # ---- observation (window-flush cadence, host side) -------------------
     def _on_flush(self, records: Sequence[dict]) -> List[dict]:
         """Telemetry flush observer: detect, then hand the anomaly +
@@ -654,6 +665,20 @@ class Watchdog:
             "rollbacks": self._rollbacks})
         if self.telemetry is not None:
             self.telemetry.rewind(restored_step)
+        self.reset_after_external_rewind(restored_step)
+
+    def reset_after_external_rewind(self, restored_step: int) -> None:
+        """The run was rewound to ``restored_step`` and the steps
+        after it are about to be REPLAYED — by this watchdog's own
+        rollback, or by an external recovery (the fleet's
+        shrink-to-healthy-mesh) whose telemetry rewind the caller
+        already performed.  Reset every detector (replayed step
+        numbers must not re-trigger on stale history from the
+        abandoned timeline), drop pending anomalies, void the aging
+        save candidates, and clear the incident state — the restored
+        state predates the incident, so replayed saves are
+        trustworthy candidates again.  Touches neither the rollback
+        budget nor the event log."""
         for det in self.detectors:
             det.reset()
         self._pending = []
@@ -661,8 +686,6 @@ class Watchdog:
             self._resolved.append((s, False))
         self._pending_saves.clear()
         self._quarantines.clear()
-        # the restored state predates the incident: replayed saves are
-        # trustworthy candidates again
         self._last_anomaly_step = None
         self._last_step_t = None             # restore time is not a step
 

@@ -146,6 +146,96 @@ def bench_profiler_overhead(layers: int = 48, hidden: int = 256,
     return out
 
 
+def bench_fleet_overhead(layers: int = 48, hidden: int = 256,
+                         window: int = 64, n_hosts: int = 4,
+                         iters: int = 10, reps: int = 3):
+    """Fleet-monitor overhead: the IDENTICAL instrumented train step,
+    with a FleetMonitor attached to the session vs the bare step.
+
+    The monitor's contract is that the liveness beacon is host-side
+    and OUT-OF-BAND — the traced program is unchanged, so a ratio of
+    ~1.0 IS the pass condition (``fleet.instrumented_step`` in
+    apexverify proves the same fact structurally).  The host cost that
+    DOES exist — one beacon publish + peer classification per step
+    boundary — is measured separately as ``fleet_beat_ms`` (on the
+    in-process channel; a KV/file channel adds its transport's own
+    latency on top, off the device's critical path either way)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp, telemetry
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.bucketing_bench import many_leaf_params
+    from apex_tpu.resilience import fleet as fleet_mod
+
+    params = many_leaf_params(jax, jnp, layers, hidden)
+    scaler = amp.LossScaleState.create(2.0 ** 12)
+    grads = jax.tree_util.tree_map(
+        lambda p: (p * 1e-3 + 1e-4) * float(scaler.loss_scale), params)
+
+    opt = FusedAdam(params, lr=1e-3, fuse_buckets=True)
+    pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0)
+
+    def train_body(work, opt_state, grads, scaler_state, step):
+        flat = pipe.unscale_and_norm(pipe.pack(grads), scaler_state)
+        new_work, new_state = opt.functional_step(
+            work, opt_state, flat.bufs, step, clip_coef=flat.clip_coef)
+        return new_work, new_state, flat.found_inf
+
+    tel = telemetry.Telemetry(run_dir=None, window=window,
+                              retrace=False)
+    channel = fleet_mod.LocalChannel()
+    mon = fleet_mod.FleetMonitor(
+        channel=channel, host=0, n_hosts=n_hosts,
+        slow_after_steps=8, dead_after_steps=1 << 30,
+        slow_after_s=None, dead_after_s=None, telemetry=tel)
+    sim = fleet_mod.SimulatedPeers(channel,
+                                   hosts=list(range(1, n_hosts)))
+    sim.attach(mon)
+    out = {
+        "fleet_leaves": len(jax.tree_util.tree_leaves(params)),
+        "fleet_hosts": n_hosts,
+        "fleet_window": window,
+    }
+
+    # bare step (identical math, no ring, no monitor)
+    # two programs, two compiles — not a hot-loop retrace
+    # apexlint: disable-next=APX302
+    off = jax.jit(train_body)
+    out["fleet_off_ms"] = round(timeit(
+        off, params, opt.opt_state, grads, scaler, jnp.int32(2),
+        iters=iters, reps=reps), 3)
+
+    # instrumented step with the monitor attached: the traced program
+    # must be the instrumented step, unchanged
+    # apexlint: disable-next=APX302
+    on = jax.jit(tel.instrument(train_body))
+    out["fleet_on_ms"] = round(timeit(
+        on, tel.buf, jnp.int32(2), params, opt.opt_state, grads,
+        scaler, jnp.int32(2), iters=iters, reps=reps), 3)
+
+    # host beat cost (publish + simulated-peer beacons + classify),
+    # paid once per step boundary off the device's critical path
+    import statistics
+    import time
+    beat_ms = []
+    for rep in range(max(3, reps)):
+        t0 = time.perf_counter()
+        for s in range(window):
+            mon.beat(rep * window + s + 1)
+        beat_ms.append((time.perf_counter() - t0) * 1e3 / window)
+    out["fleet_beat_ms"] = round(statistics.median(beat_ms), 5)
+
+    if out["fleet_off_ms"]:
+        out["fleet_overhead_pct"] = round(
+            (out["fleet_on_ms"] - out["fleet_off_ms"])
+            / out["fleet_off_ms"] * 100.0, 2)
+    mon.close()
+    tel.close()
+    return out
+
+
 def bench_watchdog_overhead(layers: int = 48, hidden: int = 256,
                             window: int = 64,
                             iters: int = 10, reps: int = 3):

@@ -70,6 +70,27 @@ def _anomaly_row(r: dict) -> List[str]:
             detail or "-"]
 
 
+def _fleet_row(r: dict) -> List[str]:
+    """One fleet-timeline row from a ``kind:"fleet"`` liveness event
+    (host_dead / host_slow), shrink action, or deadline event."""
+    step = str(r.get("step", "-"))
+    event = r.get("event", "-")
+    if event == "shrink":
+        detail = (f"survivors={r.get('survivors')} "
+                  f"dead={r.get('dead')} epoch={r.get('epoch')}")
+        if r.get("to_step") is not None:
+            detail += f" to_step={r['to_step']}"
+        return [step, event, "-", detail]
+    if event == "deadline_exceeded":
+        return [step, event, "-",
+                f"phase={r.get('phase')} "
+                f"deadline_s={_fmt_cell(r.get('deadline_s'))}"]
+    detail = (f"gap_s={_fmt_cell(r.get('gap_s'))} "
+              f"lag_steps={_fmt_cell(r.get('lag_steps'))} "
+              f"peer_step={_fmt_cell(r.get('peer_step'))}")
+    return [step, event, str(r.get("host", "-")), detail]
+
+
 def _render_table(header: List[str], rows: List[List[str]], out) -> None:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(header)]
@@ -91,9 +112,10 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
     schema, records = load_jsonl(resolved)
     steps = [r for r in records if r.get("kind", "step") == "step"]
     # span/counter/retrace records are cumulative snapshots: keep the
-    # newest per name; anomaly/watchdog records are EVENTS — every one
-    # is a timeline row
+    # newest per name; anomaly/watchdog/fleet records are EVENTS —
+    # every one is a timeline row
     spans, counters, retraces, anomalies = {}, {}, {}, []
+    fleet_events: List[dict] = []
     for r in records:
         if r.get("kind") == "span":
             spans[r["name"]] = r
@@ -103,6 +125,8 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
             retraces[r["name"]] = r
         elif r.get("kind") in ("anomaly", "watchdog"):
             anomalies.append(r)
+        elif r.get("kind") == "fleet":
+            fleet_events.append(r)
     if not steps:
         print(f"{resolved}: no step records", file=out)
         return 1
@@ -129,6 +153,7 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
         json.dump({"source": resolved, "steps": steps,
                    "overflow_steps": overflows,
                    "anomalies": anomalies,
+                   "fleet": fleet_events,
                    "perf": perf,
                    "spans": sorted(spans.values(),
                                    key=lambda r: r["name"]),
@@ -159,6 +184,16 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
             ["step", "event", "severity/action", "detail"],
             [_anomaly_row(r)
              for r in sorted(anomalies,
+                             key=lambda r: r.get("step", 0))], out)
+    if fleet_events:
+        # the fleet timeline: beacon-gap liveness events (host_slow /
+        # host_dead) interleaved with the actions taken (shrink,
+        # deadline_exceeded) in step order
+        print("\nfleet timeline:", file=out)
+        _render_table(
+            ["step", "event", "host", "detail"],
+            [_fleet_row(r)
+             for r in sorted(fleet_events,
                              key=lambda r: r.get("step", 0))], out)
     if spans:
         print("\nspans (cumulative):", file=out)

@@ -18,6 +18,16 @@ a :class:`~apex_tpu.resilience.PreemptionGuard` that converts SIGTERM
 checkpoint and a clean exit.  Kill it, rerun it, and it continues
 bit-exactly where it left off.
 
+Multi-host failure domains (``--fleet``, needs ``--checkpoint-dir``):
+a :class:`~apex_tpu.resilience.FleetMonitor` over an in-process beacon
+channel plus N-1 simulated peer hosts — each step boundary publishes a
+liveness beacon and classifies the peers.  Prove the recovery with
+``--kill-host-at N``: the last simulated peer stops beaconing at step
+N, the survivors agree on the death within the step-lag deadline,
+"shrink" the mesh, restore the last-known-good checkpoint and replay —
+the whole sequence (beacon gap -> host_dead -> shrink -> resume)
+renders as the fleet timeline in ``telemetry summarize``.
+
 Self-healing (``--watchdog``, needs both dirs above): a
 :class:`~apex_tpu.resilience.Watchdog` watches the telemetry window
 flushes for NaN storms, loss spikes and loss-scale collapse, and
@@ -84,6 +94,18 @@ def parse_args(argv=None):
                         "rolls back to last-known-good and replays)")
     p.add_argument("--inject-nan-steps", type=int, default=6,
                    help="how many steps the NaN fault poisons")
+    p.add_argument("--fleet", action="store_true",
+                   help="multi-host failure domains: liveness beacons "
+                        "+ a FleetMonitor over simulated peer hosts "
+                        "(needs --checkpoint-dir)")
+    p.add_argument("--fleet-hosts", type=int, default=3,
+                   help="fleet size incl. this host (the others are "
+                        "simulated peers on an in-process channel)")
+    p.add_argument("--kill-host-at", type=int, default=None,
+                   help="chaos: the last simulated peer stops "
+                        "beaconing at step N (the monitor detects the "
+                        "death, survivors agree, shrink and resume "
+                        "from the last checkpoint)")
     return p.parse_args(argv)
 
 
@@ -117,12 +139,20 @@ def main(argv=None):
         pred = forward(p, x.astype(jnp.bfloat16))
         return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
 
-    injector = None
+    fault_specs = []
     if args.inject_nan_at is not None:
-        from apex_tpu.resilience.faults import FaultInjector, FaultSpec
-        injector = FaultInjector([FaultSpec(
+        from apex_tpu.resilience.faults import FaultSpec
+        fault_specs.append(FaultSpec(
             "nan_grads", at_step=args.inject_nan_at,
-            n_steps=args.inject_nan_steps)]).install()
+            n_steps=args.inject_nan_steps))
+    if args.kill_host_at is not None:
+        from apex_tpu.resilience.faults import FaultSpec
+        fault_specs.append(FaultSpec("peer_death",
+                                     at_step=args.kill_host_at))
+    injector = None
+    if fault_specs:
+        from apex_tpu.resilience.faults import FaultInjector
+        injector = FaultInjector(fault_specs).install()
     from apex_tpu.resilience.faults import training_fault
 
     box = {"amp": amp_state}
@@ -171,6 +201,28 @@ def main(argv=None):
                        ScaleCollapseDetector()],
             telemetry=tel, clean_window=8)
 
+    fleet_mon = None
+    if args.fleet:
+        if not args.checkpoint_dir:
+            raise SystemExit("--fleet needs --checkpoint-dir (shrink "
+                             "recovery restores from the rotating "
+                             "checkpoints)")
+        from apex_tpu.resilience import fleet as fleet_mod
+        # in-process fleet: this host plus N-1 simulated peers on a
+        # LocalChannel; step-lag deadlines keep detection
+        # deterministic at toy step rates
+        channel = fleet_mod.LocalChannel()
+        fleet_mon = fleet_mod.FleetMonitor(
+            channel=channel, host=0, n_hosts=args.fleet_hosts,
+            slow_after_steps=4, dead_after_steps=8,
+            slow_after_s=None, dead_after_s=None,
+            agreement_timeout_s=0.2, telemetry=tel)
+        fleet_mod.SimulatedPeers(
+            channel,
+            hosts=list(range(1, args.fleet_hosts))).attach(fleet_mon)
+        print(f"fleet: {args.fleet_hosts} hosts "
+              f"({args.fleet_hosts - 1} simulated peers)")
+
     preempted = False
     resumed = False
     if args.checkpoint_dir:
@@ -182,7 +234,7 @@ def main(argv=None):
                 train_one, mgr, opt, total_steps=args.steps,
                 guard=PreemptionGuard(
                     preempt_at_step=args.preempt_at_step),
-                watchdog=wd,
+                watchdog=wd, fleet=fleet_mon,
                 on_quarantine=lambda anomaly: box.update(
                     amp=box["amp"].re_anchor()),
                 save_extras=lambda: {
@@ -196,6 +248,9 @@ def main(argv=None):
         if res.rollbacks:
             print(f"watchdog: rolled back and replayed "
                   f"{res.rollbacks}x — run self-healed")
+        if res.mesh_shrinks:
+            print(f"fleet: peer failure survived — shrank to healthy "
+                  f"mesh {res.mesh_shrinks}x and resumed")
         preempted = res.preempted
         if preempted:
             print(f"preempted: final checkpoint durable at step "
@@ -203,6 +258,8 @@ def main(argv=None):
     else:
         for step in range(1, args.steps + 1):
             train_one(step)
+    if fleet_mon is not None:
+        fleet_mon.close()
     if wd is not None:
         wd.close()
     if injector is not None:

@@ -97,6 +97,36 @@ def test_train_toy_watchdog_self_heals_nan_fault(tmp_path, capsys):
     assert "nan_streak" in out and "rollback" in out
 
 
+def test_train_toy_fleet_kill_one_host_shrinks_and_recovers(tmp_path,
+                                                            capsys):
+    """The multi-host failure-domain acceptance flow: one faked host
+    of the toy's 3-host fleet stops beaconing mid-run, the survivors
+    agree on the death within the step-lag deadline, shrink, restore
+    the last checkpoint and replay to completion — and the whole
+    sequence (beacon gap -> host_dead -> shrink -> resume) renders as
+    the fleet timeline on the summarize surface."""
+    import warnings as _warnings
+
+    ckpt = str(tmp_path / "ckpt")
+    tel = str(tmp_path / "telemetry")
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")      # the recovery warns: fine
+        _run("examples/simple/train_toy.py",
+             ["--steps", "48", "--save-every", "6",
+              "--checkpoint-dir", ckpt, "--telemetry-dir", tel,
+              "--fleet", "--kill-host-at", "20"])
+    out = capsys.readouterr().out
+    assert "fleet: 3 hosts (2 simulated peers)" in out
+    assert "shrank to healthy mesh" in out
+    assert "OK:" in out                       # replay converged
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["summarize", tel]) == 0
+    out = capsys.readouterr().out
+    assert "fleet timeline:" in out
+    assert "host_dead" in out and "shrink" in out
+    assert "fleet/hosts_dead" in out          # counters table rows
+
+
 def test_imagenet_preempt_and_resume(tmp_path, capsys):
     """The imagenet example's save path rides the same resilience
     manager: --checkpoint-dir rotates bucket-native checkpoints and a

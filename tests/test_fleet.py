@@ -1,0 +1,899 @@
+"""apex_tpu.resilience.fleet — multi-host failure domains: liveness
+beacons, deadline-armed step boundaries, survivor agreement, and the
+shrink-to-healthy-mesh recovery driven through run_elastic (the third
+leg of the failure-domain triad)."""
+
+import errno
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.resilience import (CheckpointManager, FleetMonitor,
+                                 FleetRecoveryFailed,
+                                 StepDeadlineExceeded, Watchdog,
+                                 run_elastic)
+from apex_tpu.resilience import fleet as fleet_mod
+from apex_tpu.resilience.faults import FaultInjector, FaultSpec
+from apex_tpu.resilience.fleet import (DeadlineCalibrator,
+                                       DeadlineRunner, FileChannel,
+                                       LocalChannel, SimulatedPeers)
+
+
+# ---------------------------------------------------------------------
+# Channels
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("make", [
+    lambda tmp: LocalChannel(),
+    lambda tmp: FileChannel(str(tmp / "fleet")),
+], ids=["local", "file"])
+def test_channel_roundtrip_newest_wins_and_prefix(tmp_path, make):
+    ch = make(tmp_path)
+    ch.put("beacon/0", {"step": 1})
+    ch.put("beacon/0", {"step": 2})          # overwrite: newest wins
+    ch.put("beacon/1", {"step": 7})
+    ch.put("verdict/1/0", {"survivors": [0]})
+    got = ch.get_all("beacon/")
+    assert got == {"beacon/0": {"step": 2}, "beacon/1": {"step": 7}}
+    assert set(ch.get_all("verdict/1/")) == {"verdict/1/0"}
+
+
+class _FakeKVClient:
+    """jax.distributed KV-client shape: key_value_set (optionally
+    rejecting allow_overwrite like old clients) + key_value_dir_get."""
+
+    def __init__(self, allow_overwrite_supported):
+        self._ok = allow_overwrite_supported
+        self.data = {}
+
+    def key_value_set(self, key, value, allow_overwrite=None):
+        if allow_overwrite is not None and not self._ok:
+            raise TypeError("allow_overwrite not supported")
+        self.data[key] = value
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in sorted(self.data.items())
+                if k.startswith(prefix)]
+
+
+@pytest.mark.parametrize("overwrite", [True, False],
+                         ids=["overwrite", "seq-fallback"])
+def test_kv_channel_keeps_per_host_keys(overwrite):
+    """Host ids are digit key tails ('beacon/0', 'verdict/3/1') and
+    must NOT be mistaken for the write-once fallback's 8-digit
+    sequence suffix — that collapse made every peer look silent on
+    the production transport."""
+    from apex_tpu.resilience.fleet import KVChannel
+    ch = KVChannel(client=_FakeKVClient(overwrite))
+    for h in (0, 1, 2):
+        ch.put(f"beacon/{h}", {"host": h, "step": 1})
+        ch.put(f"beacon/{h}", {"host": h, "step": 2})   # newest wins
+    got = ch.get_all("beacon/")
+    assert set(got) == {"beacon/0", "beacon/1", "beacon/2"}
+    assert all(rec["step"] == 2 for rec in got.values())
+    ch.put("verdict/3/1", {"host": 1, "survivors": [0, 1]})
+    assert set(ch.get_all("verdict/3/")) == {"verdict/3/1"}
+
+
+def test_file_channel_skips_torn_writes(tmp_path):
+    ch = FileChannel(str(tmp_path))
+    ch.put("beacon/0", {"step": 3})
+    # a crashed writer leaves garbage bytes under a beacon name
+    with open(os.path.join(str(tmp_path), "beacon__1.json"), "w") as f:
+        f.write('{"step": ')
+    got = ch.get_all("beacon/")
+    assert got == {"beacon/0": {"step": 3}}  # torn file skipped
+
+
+# ---------------------------------------------------------------------
+# FleetMonitor classification
+# ---------------------------------------------------------------------
+
+def _lag_monitor(ch, host=0, n_hosts=3, slow=2, dead=4, **kw):
+    """A step-lag-only monitor (deterministic: no wall clock)."""
+    return FleetMonitor(channel=ch, host=host, n_hosts=n_hosts,
+                        slow_after_steps=slow, dead_after_steps=dead,
+                        slow_after_s=None, dead_after_s=None,
+                        agreement_timeout_s=0.2, **kw)
+
+
+def test_monitor_validation():
+    ch = LocalChannel()
+    with pytest.raises(ValueError, match="criterion"):
+        FleetMonitor(channel=ch, host=0, n_hosts=2,
+                     slow_after_s=None, dead_after_s=None)
+    with pytest.raises(ValueError):
+        FleetMonitor(channel=ch, host=0, n_hosts=2,
+                     slow_after_s=5.0, dead_after_s=1.0)
+    with pytest.raises(ValueError):
+        FleetMonitor(channel=ch, host=0, n_hosts=2,
+                     slow_after_s=None, dead_after_s=None,
+                     slow_after_steps=8, dead_after_steps=4)
+    with pytest.raises(ValueError, match="both"):
+        FleetMonitor(channel=ch, host=0, n_hosts=2,
+                     slow_after_s=1.0, dead_after_s=None)
+
+
+def test_step_lag_classification_slow_then_dead_sticky():
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, slow=2, dead=4)
+    sim = SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    for s in range(1, 4):
+        assert mon.beat(s) == []
+    sim.kill(2)                               # beacons freeze at step 3
+    events = []
+    for s in range(4, 12):
+        events += mon.beat(s)
+    kinds = [(e.kind, e.host) for e in events]
+    # one slow warning, then one dead event, then silence (sticky)
+    assert kinds == [("host_slow", 2), ("host_dead", 2)]
+    assert mon.dead_hosts() == [2]
+    assert mon.live_hosts() == [0, 1]
+    assert mon.status(1) == fleet_mod.HOST_LIVE
+
+
+def test_slow_episode_rearms_on_recovery():
+    """A slow peer warns once per EPISODE: recovery re-arms, a second
+    episode warns again — the slow-network contract (warn only,
+    never evict)."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, n_hosts=2, slow=2, dead=50)
+    sim = SimulatedPeers(ch, hosts=[1]).attach(mon)
+    with FaultInjector([
+            FaultSpec("slow_network", at_step=3, target=1, n_steps=3,
+                      lag_steps=3),
+            FaultSpec("slow_network", at_step=10, target=1, n_steps=3,
+                      lag_steps=3)]):
+        events = []
+        for s in range(1, 16):
+            events += mon.beat(s)
+    assert [(e.kind, e.host) for e in events] == \
+        [("host_slow", 1), ("host_slow", 1)]
+    assert mon.dead_hosts() == []             # slow never kills
+
+
+def test_wall_clock_classification_with_fake_clock():
+    clk = [1000.0]
+    ch = LocalChannel()
+    mon = FleetMonitor(channel=ch, host=0, n_hosts=2,
+                       slow_after_s=1.0, dead_after_s=3.0,
+                       clock=lambda: clk[0])
+    ch.put("beacon/1", {"host": 1, "step": 1, "wall_time": clk[0],
+                        "incarnation": 7})
+    assert mon.poll(1) == []
+    clk[0] += 2.0                             # age 2s: slow
+    evs = mon.poll(2)
+    assert [e.kind for e in evs] == ["host_slow"]
+    clk[0] += 2.0                             # age 4s: dead
+    evs = mon.poll(3)
+    assert [e.kind for e in evs] == ["host_dead"]
+    assert evs[0].gap_s >= 3.0 and evs[0].peer_step == 1
+
+
+def test_missing_beacon_ages_from_monitor_start():
+    """A peer that NEVER beacons must still be declared dead (startup
+    grace = the dead deadline from monitor start), not live forever."""
+    clk = [0.0]
+    ch = LocalChannel()
+    mon = FleetMonitor(channel=ch, host=0, n_hosts=2,
+                       slow_after_s=1.0, dead_after_s=2.0,
+                       clock=lambda: clk[0])
+    assert mon.poll(1) == []                  # inside the grace
+    clk[0] += 3.0
+    assert [e.kind for e in mon.poll(2)] == ["host_dead"]
+
+
+def test_fleet_counters_emitted():
+    from apex_tpu.telemetry import hostmetrics
+    got = {}
+    sink = lambda name, v: got.__setitem__(name, v)
+    hostmetrics.add_sink(sink)
+    try:
+        ch = LocalChannel()
+        mon = _lag_monitor(ch, n_hosts=3)
+        SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+        mon.beat(1)
+    finally:
+        hostmetrics.remove_sink(sink)
+    assert got["fleet/hosts_live"] == 3
+    assert got["fleet/hosts_dead"] == 0
+    assert got["fleet/hosts_slow"] == 0
+    assert "fleet/beacon_gap_ms" in got
+    assert "fleet/beacon_lag_steps" in got
+
+
+def test_beacon_channel_failure_degrades_not_crashes(tmp_path):
+    """A transient channel failure must never kill training: publish
+    warns (once) and classification treats the channel as silent."""
+    import shutil
+    ch = FileChannel(str(tmp_path / "fleet"))
+    mon = _lag_monitor(ch, n_hosts=2)
+    shutil.rmtree(str(tmp_path / "fleet"))    # channel gone
+    with pytest.warns(UserWarning, match="beacon publish failed"):
+        assert mon.beat(1) == []              # degrades, no raise
+    mon.beat(2)                               # warned once, no flood
+
+
+def test_host_failure_record_shape():
+    f = fleet_mod.HostFailure(kind="host_dead", host=2, step=9,
+                              peer_step=4, gap_s=1.5, lag_steps=5)
+    rec = f.record()
+    assert rec["kind"] == "fleet" and rec["event"] == "host_dead"
+    assert rec["host"] == 2 and rec["step"] == 9
+    json.dumps(rec)                           # JSONL-able
+
+
+# ---------------------------------------------------------------------
+# Agreement
+# ---------------------------------------------------------------------
+
+def test_two_real_monitors_agree_and_drop_silent_third():
+    """Two live hosts (each a real monitor on the shared channel) and
+    one silent host: both survivors compute the SAME agreed set with
+    the silent host dropped — by response timeout, not by an allgather
+    a dead host would hang."""
+    ch = LocalChannel()
+    m0 = _lag_monitor(ch, host=0, n_hosts=3)
+    m1 = _lag_monitor(ch, host=1, n_hosts=3)
+    # each answers the other's round when polled (no threads needed:
+    # publishing a verdict is non-blocking, reading is idempotent)
+    m0.add_spin_hook(lambda epoch: ch.put(
+        f"verdict/{epoch}/1", {"host": 1, "epoch": epoch, "step": 5,
+                               "survivors": [0, 1, 2]}))
+    e0, s0 = m0.agree_survivors(5, timeout_s=0.05)
+    m1.add_spin_hook(lambda epoch: None)
+    e1, s1 = m1.agree_survivors(5, timeout_s=0.05)
+    assert s0 == [0, 1]                       # 2 never responded
+    # m1 reads the SAME published verdicts for epoch 1 (m0's proposal
+    # [0,1,2] and the injected host-1 verdict), so it lands on the
+    # same set
+    assert (e1, s1) == (e0, [0, 1])
+    assert m0.hosts == [0, 1] and m0.epoch == e0
+
+
+def test_agreement_fast_path_when_all_respond():
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, host=0, n_hosts=3)
+    SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    t0 = time.monotonic()
+    epoch, survivors = mon.agree_survivors(3, timeout_s=5.0)
+    assert survivors == [0, 1, 2] and epoch == 1
+    assert time.monotonic() - t0 < 2.0        # no timeout wait burned
+
+
+def test_agreement_excluding_self_evicts_instead_of_split_brain():
+    """When a responder's proposal rules THIS host dead, the agreed
+    set excludes it — the host must self-evict (typed raise), never
+    rebuild a divergent mesh the real survivors don't share."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, host=0, n_hosts=3)
+    # host 1 answers but its live view is {1, 2} — it ruled us dead
+    mon.add_spin_hook(lambda epoch: ch.put(
+        f"verdict/{epoch}/1", {"host": 1, "epoch": epoch, "step": 4,
+                               "survivors": [1, 2]}))
+    with pytest.raises(FleetRecoveryFailed, match="excluded"):
+        mon.agree_survivors(4, timeout_s=0.05)
+
+
+def test_agreement_intersects_divergent_proposals():
+    """A responder that itself saw another host dead shrinks the
+    agreed set: intersection of proposals, restricted to responders."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, host=0, n_hosts=3)
+    # host 1 responds but claims host 2 is dead; host 2 responds too
+    mon.add_spin_hook(lambda epoch: (
+        ch.put(f"verdict/{epoch}/1",
+               {"host": 1, "epoch": epoch, "step": 4,
+                "survivors": [0, 1]}),
+        ch.put(f"verdict/{epoch}/2",
+               {"host": 2, "epoch": epoch, "step": 4,
+                "survivors": [0, 1, 2]})))
+    _, survivors = mon.agree_survivors(4, timeout_s=0.05)
+    assert survivors == [0, 1]
+
+
+# ---------------------------------------------------------------------
+# Deadline machinery
+# ---------------------------------------------------------------------
+
+def test_deadline_runner_result_exception_and_timeout():
+    with DeadlineRunner() as r:
+        assert r.run(lambda: 41 + 1, 5.0) == 42
+        with pytest.raises(ZeroDivisionError):
+            r.run(lambda: 1 // 0, 5.0)
+        gen = r.generation
+        with pytest.raises(StepDeadlineExceeded) as ei:
+            r.run(lambda: time.sleep(5.0), 0.1, step=7, phase="save")
+        assert ei.value.step == 7 and ei.value.phase == "save"
+        assert ei.value.deadline_s == 0.1
+        assert r.generation == gen + 1        # abandoned: gen bumped
+        # a fresh worker serves the next call; the abandoned one's
+        # late result cannot leak into it
+        assert r.run(lambda: "fresh", 5.0) == "fresh"
+
+
+def test_deadline_runner_close_idempotent():
+    r = DeadlineRunner()
+    r.run(lambda: None, 1.0)
+    r.close()
+    r.close()
+    assert r.run(lambda: 1, 1.0) == 1         # usable again
+    r.close()
+
+
+def test_deadline_calibrator_tracks_baseline():
+    c = DeadlineCalibrator(factor=5.0, min_s=0.5, max_s=10.0,
+                           default_s=99.0, min_history=3)
+    assert c.deadline_s() == 99.0             # no history yet
+    for _ in range(4):
+        c.note(0.2)
+    assert c.deadline_s() == pytest.approx(1.0)   # 5 x median
+    for _ in range(64):
+        c.note(10.0)
+    assert c.deadline_s() == 10.0             # clamped at max_s
+    with pytest.raises(ValueError):
+        DeadlineCalibrator(factor=1.0)
+
+
+def test_deadline_calibrator_seeds_from_watchdog_baseline():
+    """run_elastic(step_deadline='auto') calibrates from the step-time
+    baseline the watchdog already tracks: before the calibrator's own
+    history accrues, the watchdog's straggler-detector samples set the
+    deadline instead of the blind default."""
+    from apex_tpu.resilience.watchdog import StepTimeDetector
+
+    wd = Watchdog(detectors=[StepTimeDetector(min_history=4)],
+                  clean_window=4)
+    t = [0.0]
+    wd._clock = lambda: t[0]
+    for i in range(8):                        # 0.2s/step baseline
+        t[0] += 0.2
+        wd.check(i)
+    assert len(wd.recent_step_times()) >= 4
+    c = DeadlineCalibrator(factor=5.0, min_s=0.1, max_s=60.0,
+                           default_s=99.0, min_history=3,
+                           history_source=wd.recent_step_times)
+    assert c.deadline_s() == pytest.approx(1.0)   # 5 x 0.2, not 99
+    c.note(2.0)
+    c.note(2.0)
+    c.note(2.0)                               # own history takes over
+    assert c.deadline_s() == pytest.approx(10.0)
+    # a watchdog without a StepTimeDetector reports an empty baseline
+    assert Watchdog(detectors=[], clean_window=4) \
+        .recent_step_times() == []
+
+
+# ---------------------------------------------------------------------
+# run_elastic integration: the fleet chaos matrix.
+# peer_death / peer_hang / slow_network x {mid-step, mid-save,
+# pre-restore} under faked multi-host — each must end in the
+# documented action, and recovery must replay bit-exact vs an
+# uninterrupted run on the same (shrunk) mesh.
+# ---------------------------------------------------------------------
+
+_TOTAL, _EVERY = 12, 3
+
+
+def _mixed_tree():
+    return {
+        "w1": jnp.linspace(-1.0, 1.0, 256).astype(jnp.bfloat16
+                                                  ).reshape(16, 16),
+        "b1": jnp.linspace(0.0, 1.0, 16).astype(jnp.float32),
+    }
+
+
+def _grads_for(tree):
+    return jax.tree_util.tree_map(
+        lambda p: (p.astype(jnp.float32) * 1e-2 + 1e-3).astype(p.dtype),
+        tree)
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _mirror_peer(mgr):
+    """Fake the manager's 2-host lockstep agreement (peer mirrors this
+    host) so the restore after a shrink drives the full collective
+    code path too."""
+    def allgather(arr):
+        arr = np.asarray(arr)
+        return np.stack([arr, arr])
+    mgr._allgather = allgather
+    mgr._process_count = lambda: 2
+
+
+class _FleetJob:
+    """One faked-multi-host 'process lifetime': optimizer + manager
+    (mirror-peer lockstep) + FleetMonitor over simulated peers."""
+
+    def __init__(self, ckpt_dir, n_hosts=3, slow=2, dead=4,
+                 total=_TOTAL):
+        tree = _mixed_tree()
+        self.opt = FusedAdam(tree, lr=1e-2)
+        self.g = _grads_for(tree)
+        self.total = total
+        self.mgr = CheckpointManager(ckpt_dir, keep=3, every=_EVERY)
+        _mirror_peer(self.mgr)
+        self.template = jax.tree_util.tree_map(jnp.zeros_like, tree)
+        self.channel = LocalChannel()
+        self.mon = _lag_monitor(self.channel, n_hosts=n_hosts,
+                                slow=slow, dead=dead)
+        self.sim = SimulatedPeers(self.channel,
+                                  hosts=list(range(1, n_hosts)))
+        self.sim.attach(self.mon)
+        self.shrinks = []
+
+    def step_fn(self, step):
+        self.opt.step(self.g)
+
+    def run(self, **kw):
+        kw.setdefault("backoff_s", 0.0)
+        return run_elastic(
+            self.step_fn, self.mgr, self.opt, total_steps=self.total,
+            params_like=self.template, fleet=self.mon,
+            on_shrink=lambda survivors, epoch:
+                self.shrinks.append((epoch, tuple(survivors))), **kw)
+
+    def close(self):
+        self.mon.close()
+        self.mgr.close()
+
+
+@pytest.fixture(scope="module")
+def _fleet_reference(tmp_path_factory):
+    """The uninterrupted faked-fleet run every recovered run must
+    match bit-exactly (the 'uninterrupted shrunk run': the step math
+    is mesh-size-independent here, so one reference serves)."""
+    job = _FleetJob(str(tmp_path_factory.mktemp("fleet_ref")))
+    res = job.run()
+    assert res.step == _TOTAL and res.mesh_shrinks == 0
+    job.close()
+    return job
+
+
+# phase -> the step the fault lands on: mid-step (off-cadence),
+# mid-save (on the save cadence), pre-restore (dead before this
+# incarnation's first step — the job below seeds checkpoints first)
+_PHASES = {"mid-step": 5, "mid-save": _EVERY * 2, "pre-restore": 1}
+
+
+@pytest.mark.parametrize("phase", sorted(_PHASES))
+def test_peer_death_shrinks_and_replays_bit_exact(tmp_path, phase,
+                                                  _fleet_reference):
+    """Acceptance: kill one faked host -> survivors agree on the death
+    within the step-lag deadline, re-initialize the shrunk mesh
+    (on_shrink), restore via the manager and replay bit-exact vs an
+    uninterrupted run."""
+    if phase == "pre-restore":
+        seed = _FleetJob(str(tmp_path), total=_EVERY * 2)
+        assert seed.run().step == _EVERY * 2
+        seed.close()
+    with FaultInjector([FaultSpec("peer_death",
+                                  at_step=_PHASES[phase],
+                                  target=2)]) as inj:
+        job = _FleetJob(str(tmp_path))
+        with pytest.warns(UserWarning, match="shrinking to healthy"):
+            res = job.run()
+        assert inj.fired
+    assert res.step == _TOTAL and res.mesh_shrinks == 1
+    assert job.shrinks and job.shrinks[0][1] == (0, 1)
+    assert job.mon.hosts == [0, 1]            # monitor shrank too
+    kinds = [f.kind for f in job.mon.timeline]
+    assert "host_dead" in kinds
+    shrink_events = [e for e in job.mon.events
+                     if e.get("event") == "shrink"]
+    assert shrink_events and shrink_events[0]["dead"] == [2]
+    _assert_tree_equal(job.opt.params, _fleet_reference.opt.params)
+    job.close()
+
+
+# the hang must land AFTER the calibrator has a baseline (the first
+# steps include jit compilation, covered by the generous default
+# deadline): pre-restore resumes at 7 and hangs on step 9, two clean
+# resumed steps into the new incarnation
+_HANG_PHASES = {"mid-step": 5, "mid-save": _EVERY * 2,
+                "pre-restore": 9}
+
+
+def _test_calibrator(max_s=2.0):
+    """Generous default (first steps compile), tight once calibrated —
+    the auto-calibration shape at test-friendly scales."""
+    return DeadlineCalibrator(factor=20.0, min_s=0.5, max_s=max_s,
+                              default_s=30.0, min_history=2)
+
+
+@pytest.mark.parametrize("phase", sorted(_HANG_PHASES))
+def test_peer_hang_converts_to_deadline_and_recovers(tmp_path, phase,
+                                                     _fleet_reference):
+    """Acceptance: a hung peer converts the would-be infinite block
+    into a typed StepDeadlineExceeded WITHIN the (calibrated)
+    deadline, then the same agreement -> shrink -> restore ->
+    bit-exact replay."""
+    if phase == "pre-restore":
+        seed = _FleetJob(str(tmp_path), total=_EVERY * 2)
+        assert seed.run().step == _EVERY * 2
+        seed.close()
+    hang_s = 30.0
+    with FaultInjector([FaultSpec("peer_hang",
+                                  at_step=_HANG_PHASES[phase],
+                                  target=2, delay_s=hang_s)]) as inj:
+        job = _FleetJob(str(tmp_path))
+        t0 = time.monotonic()
+        with pytest.warns(UserWarning, match="deadline"):
+            res = job.run(step_deadline=_test_calibrator())
+        wall = time.monotonic() - t0
+        assert inj.fired
+    # converted within the deadline, nowhere near the hang duration
+    assert wall < hang_s / 2
+    assert res.step == _TOTAL and res.mesh_shrinks == 1
+    assert any(e.get("event") == "deadline_exceeded"
+               for e in job.mon.events)
+    assert job.shrinks and job.shrinks[0][1] == (0, 1)
+    _assert_tree_equal(job.opt.params, _fleet_reference.opt.params)
+    job.close()
+
+
+@pytest.mark.parametrize("phase", sorted(_PHASES))
+def test_slow_network_warns_only(tmp_path, phase, _fleet_reference):
+    """A slow peer is an infrastructure warning: no agreement, no
+    shrink, no state action — the run completes bit-exact."""
+    with FaultInjector([FaultSpec("slow_network",
+                                  at_step=_PHASES[phase], target=1,
+                                  n_steps=3, lag_steps=3)]) as inj:
+        job = _FleetJob(str(tmp_path), slow=2, dead=50)
+        with pytest.warns(UserWarning, match="is slow"):
+            res = job.run()
+        assert inj.fired
+    assert res.step == _TOTAL and res.mesh_shrinks == 0
+    assert res.restarts == 0
+    assert [f.kind for f in job.mon.timeline] == ["host_slow"]
+    assert not job.shrinks
+    _assert_tree_equal(job.opt.params, _fleet_reference.opt.params)
+    job.close()
+
+
+def test_shrink_restore_reshards_onto_shrunk_mesh(tmp_path,
+                                                  _fleet_reference):
+    """The shrink restore rides the existing ``sharding=`` reshard
+    flow: ``shrink_sharding`` (evaluated AFTER the mesh re-init)
+    lands the restored state on the shrunk device set, and the replay
+    still matches bit-exact."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    ndev = min(4, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices")
+    evaluated = []
+
+    def shrink_sharding():
+        # built lazily — the real flow constructs it over the mesh
+        # on_shrink just re-initialized
+        s = NamedSharding(Mesh(np.array(jax.devices()[:ndev]), ("x",)),
+                          PartitionSpec())
+        evaluated.append(s)
+        return s
+
+    with FaultInjector([FaultSpec("peer_death", at_step=5,
+                                  target=2)]) as inj:
+        job = _FleetJob(str(tmp_path))
+        with pytest.warns(UserWarning, match="shrinking to healthy"):
+            res = job.run(shrink_sharding=shrink_sharding)
+        assert inj.fired
+    assert res.mesh_shrinks == 1 and len(evaluated) == 1
+    # the restored-and-replayed state lives on the shrunk device set
+    for buf in job.opt._param_bufs:
+        assert len(buf.sharding.device_set) == ndev
+    _assert_tree_equal(job.opt.params, _fleet_reference.opt.params)
+    job.close()
+
+
+def test_shrink_recovery_rewinds_telemetry_and_resets_watchdog(
+        tmp_path):
+    """Replay parity with the watchdog rollback path: a shrink
+    recovery must rewind the telemetry session (the flush watermark
+    would otherwise silently drop the replayed steps' records) and
+    reset watchdog detector state (stale history from the abandoned
+    timeline must not re-trigger on replayed step numbers)."""
+    from apex_tpu import telemetry as telemetry_mod
+    from apex_tpu.resilience.watchdog import Detector
+
+    class _ResetSpy(Detector):
+        name = "spy"
+        resets = 0
+
+        def observe(self, records):
+            return []
+
+        def reset(self):
+            self.resets += 1
+
+    tel = telemetry_mod.Telemetry(run_dir=None, window=4,
+                                  retrace=False)
+    spy = _ResetSpy()
+    wd = Watchdog(detectors=[spy], telemetry=tel, clean_window=2)
+    job = _FleetJob(str(tmp_path))
+    job.mon.telemetry = tel                   # the session the fleet
+    #                                           events would ride
+    rewinds = []
+    orig_rewind = tel.rewind
+    tel.rewind = lambda s: (rewinds.append(s), orig_rewind(s))[1]
+    spy.resets = 0
+    with FaultInjector([FaultSpec("peer_death", at_step=5,
+                                  target=2)]):
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            res = job.run(watchdog=wd)
+    assert res.mesh_shrinks == 1
+    shrink = next(e for e in job.mon.events
+                  if e.get("event") == "shrink")
+    assert rewinds == [shrink["to_step"]]     # rewound to the restore
+    assert spy.resets >= 1                    # detectors cleared
+    wd.close()
+    tel.close()
+    job.close()
+
+
+def test_shrink_budget_exhaustion_raises_typed(tmp_path):
+    """Shrink recovery rides the shared RetryPolicy budget: with zero
+    retries, a peer death raises FleetRecoveryFailed instead of
+    looping."""
+    with FaultInjector([FaultSpec("peer_death", at_step=2, target=2)]):
+        job = _FleetJob(str(tmp_path))
+        with pytest.raises(FleetRecoveryFailed):
+            with pytest.warns(UserWarning):
+                job.run(max_restarts=0)
+    job.close()
+
+
+def test_shrink_without_any_checkpoint_raises_typed(tmp_path):
+    """A death before the first save has nothing to restore the
+    survivors from: typed failure, not a silent fresh restart."""
+    with FaultInjector([FaultSpec("peer_death", at_step=1, target=2)]):
+        job = _FleetJob(str(tmp_path))
+        job.mgr.every = 10_000                # no cadence save ever
+        with pytest.raises(FleetRecoveryFailed):
+            with pytest.warns(UserWarning):
+                job.run()
+    job.close()
+
+
+def test_step_deadline_without_fleet_propagates(tmp_path):
+    """A deadline conversion with no fleet monitor has nobody to
+    agree a shrink with — the typed error propagates to the external
+    scheduler."""
+    job = _FleetJob(str(tmp_path))
+
+    def hung_step(step):
+        if step == 4:
+            time.sleep(30.0)
+        job.step_fn(step)
+
+    t0 = time.monotonic()
+    with pytest.raises(StepDeadlineExceeded) as ei:
+        run_elastic(hung_step, job.mgr, job.opt, total_steps=_TOTAL,
+                    params_like=job.template,
+                    step_deadline=_test_calibrator(), backoff_s=0.0)
+    assert ei.value.phase == "step" and ei.value.step == 4
+    assert time.monotonic() - t0 < 15.0
+    job.close()
+
+
+def test_hung_save_converts_to_deadline(tmp_path):
+    """The cadence save is deadline-armed too: a save blocked joining
+    a hung in-flight write (slow NFS) converts instead of blocking
+    forever."""
+    job = _FleetJob(str(tmp_path))
+    with FaultInjector([FaultSpec("slow_disk", at_save=0,
+                                  delay_s=3.0)]):
+        with pytest.raises(StepDeadlineExceeded) as ei:
+            run_elastic(job.step_fn, job.mgr, job.opt,
+                        total_steps=_TOTAL, params_like=job.template,
+                        step_deadline=_test_calibrator(max_s=0.5),
+                        backoff_s=0.0)
+    assert ei.value.phase == "save"
+    job.close()
+
+
+def test_step_deadline_auto_calibrates_and_completes(tmp_path):
+    """step_deadline='auto' must never false-positive on a healthy
+    run: the calibrated deadline tracks the trailing baseline."""
+    job = _FleetJob(str(tmp_path))
+    res = job.run(step_deadline="auto")
+    assert res.step == _TOTAL and res.mesh_shrinks == 0
+    job.close()
+
+
+# ---------------------------------------------------------------------
+# Satellite: non-retryable errnos (ENOSPC) abort instead of burning
+# the retry budget.
+# ---------------------------------------------------------------------
+
+def test_disk_full_aborts_without_burning_retry_budget(tmp_path):
+    """An ENOSPC save failure goes straight to the abort path: no
+    backoff sleeps, no restore-and-replay loop."""
+    job = _FleetJob(str(tmp_path))
+    slept = []
+    with FaultInjector([FaultSpec("disk_full", at_save=0)]) as inj:
+        with pytest.raises(OSError) as ei:
+            with pytest.warns(UserWarning, match="non-retryable"):
+                run_elastic(job.step_fn, job.mgr, job.opt,
+                            total_steps=_TOTAL,
+                            params_like=job.template,
+                            sleep=slept.append)
+        assert inj.fired
+    assert ei.value.errno == errno.ENOSPC
+    assert slept == []                        # zero retries attempted
+    job.close()
+
+
+def test_disk_full_writes_postmortem_with_watchdog(tmp_path):
+    """With a watchdog attached, the non-retryable abort leaves the
+    post-mortem bundle on disk before propagating."""
+    job = _FleetJob(str(tmp_path / "ckpt"))
+    pm_dir = str(tmp_path / "pm")
+    wd = Watchdog(detectors=[], clean_window=4, postmortem_dir=pm_dir)
+    with FaultInjector([FaultSpec("disk_full", at_save=0)]):
+        with pytest.raises(OSError):
+            with pytest.warns(UserWarning, match="non-retryable"):
+                run_elastic(job.step_fn, job.mgr, job.opt,
+                            total_steps=_TOTAL,
+                            params_like=job.template, watchdog=wd)
+    bundles = [d for d in os.listdir(pm_dir)
+               if d.startswith("postmortem-")]
+    assert bundles, "no post-mortem bundle written"
+    job.close()
+
+
+def test_transient_oserror_still_retries(tmp_path):
+    """The classification must not over-reach: a garden-variety
+    transient OSError keeps the existing bounded retry behavior."""
+    job = _FleetJob(str(tmp_path))
+    job.opt.step(job.g)
+    job.mgr.save(3, optimizer=job.opt)
+    job.mgr.wait()
+    failed = []
+
+    def flaky(step):
+        if step == 5 and not failed:
+            failed.append(step)
+            raise OSError(errno.EIO, "transient")
+        job.step_fn(step)
+
+    with pytest.warns(UserWarning, match="restoring newest"):
+        res = run_elastic(flaky, job.mgr, job.opt, total_steps=_TOTAL,
+                          params_like=job.template, backoff_s=0.0)
+    assert res.restarts == 1 and res.step == _TOTAL
+    job.close()
+
+
+# ---------------------------------------------------------------------
+# Satellite: dead-host stale-.tmp GC.
+# ---------------------------------------------------------------------
+
+def test_gc_dead_host_tmp_scoped_to_dead_hosts_only(tmp_path):
+    """The agreed lowest-rank survivor clears a DEAD peer's orphaned
+    .tmp files — never a live peer's (their .tmp may be an in-flight
+    write) and never published checkpoints."""
+    mgr = CheckpointManager(str(tmp_path), keep=2, every=5,
+                            all_hosts=True)
+    dead_tmp = tmp_path / "step-5.p2.ckpt.tmp"
+    live_tmp = tmp_path / "step-5.p1.ckpt.tmp"
+    published = tmp_path / "step-5.p2.ckpt"
+    for p in (dead_tmp, live_tmp, published):
+        p.write_bytes(b"x")
+    # a non-lowest-rank survivor must not sweep
+    assert mgr.gc_dead_host_tmp([2], [0, 1], rank=1) == 0
+    assert dead_tmp.exists()
+    # the lowest-rank survivor sweeps exactly the dead host's .tmp
+    assert mgr.gc_dead_host_tmp([2], [0, 1], rank=0) == 1
+    assert not dead_tmp.exists()
+    assert live_tmp.exists() and published.exists()
+    mgr.close()
+
+
+def test_gc_dead_host_tmp_single_writer_form(tmp_path):
+    """With all_hosts=False only host 0 writes the plain .ckpt.tmp
+    shape — swept only when host 0 itself is among the dead, by the
+    new lowest-rank survivor."""
+    mgr = CheckpointManager(str(tmp_path), keep=2, every=5)
+    orphan = tmp_path / "step-7.ckpt.tmp"
+    orphan.write_bytes(b"x")                  # after init (own-suffix GC)
+    # host 0 alive: nobody touches its tmp
+    assert mgr.gc_dead_host_tmp([2], [0, 1], rank=0) == 0
+    assert orphan.exists()
+    # host 0 dead: survivor 1 sweeps it
+    assert mgr.gc_dead_host_tmp([0], [1, 2], rank=1) == 1
+    assert not orphan.exists()
+    mgr.close()
+
+
+# ---------------------------------------------------------------------
+# Telemetry: fleet events ride the session flush; summarize renders
+# the fleet timeline in text and --json.
+# ---------------------------------------------------------------------
+
+def test_fleet_events_land_in_session_jsonl_and_summarize(tmp_path):
+    from apex_tpu import telemetry as telemetry_mod
+    from apex_tpu.telemetry.cli import summarize
+
+    run_dir = str(tmp_path / "run")
+    tel = telemetry_mod.Telemetry(run_dir, window=4, retrace=False)
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, slow=2, dead=4, telemetry=tel)
+    sim = SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    for s in range(1, 4):
+        tel.record({"loss": 1.0}, s)
+        mon.beat(s)
+    sim.kill(2)
+    for s in range(4, 12):
+        tel.record({"loss": 1.0}, s)
+        mon.beat(s)
+    epoch, survivors = mon.agree_survivors(11, timeout_s=0.2)
+    mon.note_shrink(11, epoch, survivors, [2], restored_step=9)
+    mon.close()
+    tel.close()
+
+    recs = [json.loads(l) for l in
+            open(os.path.join(run_dir, "telemetry.jsonl"))]
+    fleet_recs = [r for r in recs if r.get("kind") == "fleet"]
+    assert {r["event"] for r in fleet_recs} == \
+        {"host_slow", "host_dead", "shrink"}
+    counters = {r["name"] for r in recs if r.get("kind") == "counter"}
+    assert {"fleet/hosts_live", "fleet/hosts_dead",
+            "fleet/beacon_lag_steps", "fleet/mesh_shrinks"} <= counters
+
+    import io
+    out = io.StringIO()
+    assert summarize(run_dir, out=out) == 0
+    text = out.getvalue()
+    assert "fleet timeline:" in text
+    assert "host_dead" in text and "shrink" in text
+    assert "survivors=[0, 1]" in text
+
+    out = io.StringIO()
+    assert summarize(run_dir, as_json=True, out=out) == 0
+    doc = json.loads(out.getvalue())
+    assert [e["event"] for e in doc["fleet"]].count("host_dead") == 1
+    assert any(e["event"] == "shrink" for e in doc["fleet"])
+
+
+def test_summarize_without_fleet_records_has_no_timeline(tmp_path):
+    from apex_tpu.telemetry.cli import summarize
+    import io
+    p = tmp_path / "telemetry.jsonl"
+    p.write_text('{"kind": "step", "step": 1, "loss": 1.0}\n')
+    out = io.StringIO()
+    assert summarize(str(tmp_path), out=out) == 0
+    assert "fleet timeline:" not in out.getvalue()
+
+
+# ---------------------------------------------------------------------
+# Bench smoke (tier-1: proves the harness, not performance) + result
+# surface.
+# ---------------------------------------------------------------------
+
+def test_fleet_overhead_bench_smoke():
+    from apex_tpu.telemetry.bench import bench_fleet_overhead
+    r = bench_fleet_overhead(layers=2, hidden=16, window=8, n_hosts=3,
+                             iters=2, reps=1)
+    assert r["fleet_on_ms"] > 0 and r["fleet_off_ms"] > 0
+    assert r["fleet_beat_ms"] >= 0
+    assert r["fleet_hosts"] == 3
+
+
+def test_elastic_result_mesh_shrinks_defaults_zero():
+    from apex_tpu.resilience import ElasticResult
+    res = ElasticResult(step=1, preempted=False, restarts=0,
+                        restored_from=None)
+    assert res.mesh_shrinks == 0 and res.rollbacks == 0

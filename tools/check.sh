@@ -3,9 +3,11 @@
 # + baseline diff over the package, then the relaxed profile over
 # tests/, examples/ and tools/ (APX101/102 exempt inside test bodies —
 # a test syncing to assert a device value is the point of the test).
-# The semantic tier includes the watchdog.instrumented_step spec: a
-# watchdog-attached flat-AMP step must contain zero transfer/callback
-# primitives (self-healing detectors are host-side, window-cadence).
+# The semantic tier includes the watchdog.instrumented_step and
+# fleet.instrumented_step specs: a watchdog-attached / fleet-monitored
+# flat-AMP step must contain zero transfer/callback primitives
+# (self-healing detectors are host-side window-cadence consumers; the
+# fleet liveness beacon is host-side and out-of-band).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #
