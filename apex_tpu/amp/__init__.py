@@ -36,7 +36,8 @@ from apex_tpu.amp.frontend import (
     state_dict,
     update_scaler,
 )
-from apex_tpu.amp.flat_pipeline import FlatGradPipeline, FlatGrads
+from apex_tpu.amp.flat_pipeline import FlatGradPipeline, FlatGrads, \
+    GradAccum
 from apex_tpu.amp.wrap import auto_cast, cast_inputs
 from apex_tpu.amp import lists
 
@@ -47,6 +48,6 @@ __all__ = [
     "scaled_value_and_grad", "unscale_grads", "update_state",
     "AmpState", "initialize", "master_params_to_model_params",
     "update_scaler", "state_dict", "load_state_dict",
-    "FlatGradPipeline", "FlatGrads",
+    "FlatGradPipeline", "FlatGrads", "GradAccum",
     "auto_cast", "cast_inputs", "lists",
 ]

@@ -145,7 +145,7 @@ def re_anchor(state: LossScaleState,
 
 def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
                           has_aux: bool = False, grads_layout: str = "tree",
-                          plan=None, **kwargs):
+                          plan=None, microbatches: int = 1, **kwargs):
     """value_and_grad of a LOSS-SCALED objective, then unscale.
 
     The canonical TPU replacement for the reference's
@@ -162,6 +162,16 @@ def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
     grads), unscaled by one fused kernel per bucket that also yields
     the global norm and the overflow flag.  The per-leaf ``"tree"``
     layout stays the oracle.
+
+    ``microbatches=N`` (N > 1) splits every batch argument
+    (``args[1:]``) along its leading axis and accumulates gradients
+    across a scan before unscaling ONCE by ``1/(loss_scale * N)`` (the
+    mean-over-global-batch convention), with the overflow flag latched
+    across microbatches.  On the flat layout the accumulation is the
+    fused per-bucket ``flat_accumulate`` path (zero per-leaf work —
+    docs/amp.md "Gradient accumulation"); on the tree layout it is the
+    per-leaf f32 oracle of the same schedule.  With ``has_aux`` the
+    aux comes back stacked along a leading microbatch axis.
     """
     if grads_layout not in ("tree", "flat"):
         raise ValueError(f"unknown grads_layout {grads_layout!r}")
@@ -175,7 +185,8 @@ def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
             # gradient tree at first pack
             pipe = FlatGradPipeline(plan=plan, defer_plan=plan is None)
         out, flat = pipe.scaled_value_and_grad(
-            loss_fn, state, *args, has_aux=has_aux, **kwargs)
+            loss_fn, state, *args, has_aux=has_aux,
+            microbatches=microbatches, **kwargs)
         return out, flat, flat.found_inf
 
     def scaled_fn(*a, **kw):
@@ -184,6 +195,10 @@ def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
             loss, aux = out
             return scale_loss(loss, state), aux
         return scale_loss(out, state)
+
+    if microbatches > 1:
+        return _microbatched_tree(scaled_fn, state, args, has_aux,
+                                  int(microbatches), kwargs)
 
     if has_aux:
         (scaled, aux), grads = jax.value_and_grad(
@@ -199,6 +214,75 @@ def scaled_value_and_grad(loss_fn, state: LossScaleState, *args,
     _tape.emit("loss", loss)
     if has_aux:
         return (loss, aux), grads, found_inf
+    return loss, grads, found_inf
+
+
+def split_microbatch_args(args, n: int):
+    """``(params, stacked-batch)`` from a microbatched call's args:
+    every argument after the params (args[0]) splits ``(n, lead/n,
+    ...)`` along its leading axis — the ONE splitting contract shared
+    by the per-leaf oracle below and FlatGradPipeline's fused path."""
+    if len(args) < 2:
+        raise ValueError(
+            "microbatches=N needs batch arguments after the params "
+            "(they are split along their leading axis)")
+    params, *batch = args
+    leads = {tuple(getattr(a, "shape", ()))[:1]
+             for a in jax.tree_util.tree_leaves(tuple(batch))}
+    if () in leads or len(leads) != 1:
+        # a 0-d arg (step scalar, key) or mismatched leading dims
+        # would silently mis-split into wrong per-microbatch slices —
+        # every split arg must share ONE batch axis
+        raise ValueError(
+            "microbatches=N splits every argument after the params "
+            "along a shared leading batch axis, but the batch "
+            f"arguments have leading dims {sorted(leads)} — close "
+            "over non-batch values instead of passing them "
+            "positionally")
+
+    def split(a):
+        if a.shape[0] % n:
+            raise ValueError(
+                f"microbatches={n} does not divide the leading batch "
+                f"axis of shape {a.shape}")
+        return a.reshape((n, a.shape[0] // n) + tuple(a.shape[1:]))
+
+    return params, jax.tree_util.tree_map(split, tuple(batch))
+
+
+def _microbatched_tree(scaled_fn, state, args, has_aux, n, kwargs):
+    """Per-leaf microbatch accumulation (the tree-layout oracle of
+    FlatGradPipeline's fused path): scan over leading-axis splits,
+    accumulate SCALED grads in f32 per leaf, unscale once by
+    ``1/(loss_scale * n)``, latch found_inf across microbatches."""
+    params, xs = split_microbatch_args(args, n)
+
+    def wrapped(p, *b):
+        out = scaled_fn(p, *b, **kwargs)
+        return out if has_aux else (out, None)
+
+    def body(carry, micro):
+        acc, scaled_sum, bad = carry
+        (scaled, aux), grads = jax.value_and_grad(
+            wrapped, has_aux=True)(params, *micro)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), acc, grads)
+        bad = jnp.maximum(bad, check_finite(acc))
+        return (acc, scaled_sum + scaled, bad), aux
+
+    acc0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (acc, scaled_sum, found_inf), auxes = jax.lax.scan(
+        body, (acc0, jnp.float32(0.0), jnp.int32(0)), xs)
+    inv = 1.0 / (state.loss_scale * jnp.float32(n))
+    grads = jax.tree_util.tree_map(
+        lambda a, p: (a * inv).astype(p.dtype), acc, params)
+    loss = scaled_sum / (jnp.float32(n) * state.loss_scale)
+    _tape.emit("amp/found_inf", found_inf, reduce="max")
+    _tape.emit("amp/loss_scale", state.loss_scale)
+    _tape.emit("loss", loss)
+    if has_aux:
+        return (loss, auxes), grads, found_inf
     return loss, grads, found_inf
 
 

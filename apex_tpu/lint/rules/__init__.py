@@ -11,6 +11,7 @@ from typing import List
 from apex_tpu.lint.engine import Rule
 from apex_tpu.lint.rules.host_sync import HostSyncRule
 from apex_tpu.lint.rules.telemetry_sync import TelemetrySyncRule
+from apex_tpu.lint.rules.accum_unpack import AccumUnpackRule
 from apex_tpu.lint.rules.dtype_promotion import (
     Float64Rule, MatmulAccumulationRule, StrongScalarRule)
 from apex_tpu.lint.rules.retrace import (
@@ -27,6 +28,7 @@ from apex_tpu.lint.rules.trace_state import TraceSharedStateRule
 _RULE_CLASSES = (
     HostSyncRule,
     TelemetrySyncRule,
+    AccumUnpackRule,
     MatmulAccumulationRule,
     Float64Rule,
     StrongScalarRule,

@@ -1,0 +1,106 @@
+"""APX103 per-microbatch-unpack-in-accum-loop.
+
+The gradient-accumulation twin of APX101/102: a microbatch
+accumulation loop that unpacks the packed gradient buckets back into a
+per-leaf pytree (``plan.unpack_grads(...)``) or accumulates with a
+per-leaf tree-map add (``tree_map(lambda a, g: a + g, acc, grads)``)
+pays the per-leaf dispatch the flat pipeline exists to kill — once per
+MICROBATCH, the hottest loop in a grad-accumulation step.  The fix is
+``ops.multi_tensor.flat_accumulate`` via
+``amp.FlatGradPipeline.accumulate()`` (or simply
+``scaled_value_and_grad(..., microbatches=N)``): one fused
+read-modify-write per dtype bucket into donated f32 accumulators, the
+found_inf latch from the same HBM sweep, zero per-leaf work
+(docs/amp.md "Gradient accumulation").
+
+Scope: ``unpack_grads`` flags in ANY loop body (there is no
+per-iteration reason to unpack gradients — inspection belongs outside
+the loop).  The tree-map-add form flags only when the mapped function
+is an addition and the operands LOOK like gradient accumulation (an
+identifier mentions grad/accum/micro): precision beats recall, a
+tree-map over non-gradient data is not this rule's business.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.engine import Rule
+from apex_tpu.lint.findings import WARNING
+
+_ACCUM_HINTS = ("grad", "accum", "micro")
+
+_FIX_HINT = ("accumulate into the packed buckets with "
+             "ops.multi_tensor.flat_accumulate "
+             "(amp.FlatGradPipeline.accumulate, or "
+             "scaled_value_and_grad(..., microbatches=N)) instead")
+
+
+def _identifiers(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr
+
+
+def _mentions_accum(nodes) -> bool:
+    return any(h in ident.lower()
+               for node in nodes for ident in _identifiers(node)
+               for h in _ACCUM_HINTS)
+
+
+def _is_add_mapper(fn: ast.AST) -> bool:
+    """A tree_map first argument that performs addition: a lambda whose
+    body is (or contains only) a ``+`` over its parameters, or
+    ``operator.add`` / ``jnp.add`` by name."""
+    if isinstance(fn, ast.Lambda):
+        body = fn.body
+        return isinstance(body, ast.BinOp) \
+            and isinstance(body.op, ast.Add)
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "add"
+    return False
+
+
+class AccumUnpackRule(Rule):
+    id = "APX103"
+    name = "per-microbatch-unpack-in-accum-loop"
+    severity = WARNING
+    description = (
+        "`unpack_grads(...)` or a per-leaf tree-map add on gradients "
+        "inside an accumulation loop: per-leaf dispatch once per "
+        "microbatch in the hottest loop of a grad-accumulation step; "
+        "use the fused flat_accumulate path "
+        "(amp.FlatGradPipeline.accumulate / "
+        "scaled_value_and_grad(microbatches=N)).")
+
+    def check(self, ctx):
+        seen = set()              # nested loops walk shared call nodes
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "unpack_grads":
+                    yield self.finding(
+                        ctx, node,
+                        "`unpack_grads(...)` inside a loop body "
+                        "rebuilds a per-leaf gradient tree every "
+                        f"iteration; {_FIX_HINT}")
+                    continue
+                q = ctx.qualname(node.func) or ""
+                is_tree_map = q.endswith("tree_map") or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "tree_map")
+                if is_tree_map and node.args \
+                        and _is_add_mapper(node.args[0]) \
+                        and _mentions_accum(node.args[1:]):
+                    yield self.finding(
+                        ctx, node,
+                        "per-leaf tree-map add on gradients inside a "
+                        "loop body: one XLA add per leaf per "
+                        f"microbatch; {_FIX_HINT}")

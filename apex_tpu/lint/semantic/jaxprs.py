@@ -142,6 +142,84 @@ def orphan_collectives(jaxpr) -> List[str]:
     return dead
 
 
+def collective_compute_cones(jaxpr, compute_prims=("dot_general",)):
+    """Per-scope dependency-cone analysis of the collectives — the
+    interleaved-schedule invariant (ROADMAP item 2) made structural.
+
+    For every (sub)jaxpr scope containing collectives, returns
+    ``{"collectives": [{"prim", "cone_compute", "cone"}, ...],
+    "total_compute": n}`` — per collective its primitive name, the
+    NUMBER of compute equations in its transitive input cone, and the
+    cone itself as a frozenset of compute-equation indices (so two
+    equal-sized but different cones stay distinguishable).  The cone
+    of an equation is its transitive input set within the scope (an
+    equation carrying nested sub-jaxprs counts their compute
+    atomically).  A TRAILING schedule
+    is the pathology where every collective's cone contains ALL of the
+    program's compute — the reduce depends on the entire backward, so
+    no scheduler can overlap it.  An interleaved (chunked-bucket)
+    schedule shows collectives whose cones are proper, pairwise
+    distinct subsets: bucket k's psum is schedulable while the
+    remaining buckets' compute still runs.  This is the property the
+    latency-hiding scheduler exploits; the runtime twin is the
+    profiler's hidden-overlap fraction
+    (telemetry/profiler/attribution.py)."""
+    out: List[dict] = []
+
+    def nested_compute(eqn) -> int:
+        n = 0
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                j = getattr(sub, "jaxpr",
+                            sub if hasattr(sub, "eqns") else None)
+                if j is not None:
+                    for e in j.eqns:
+                        if e.primitive.name in compute_prims:
+                            n += 1
+                        n += nested_compute(e)
+        return n
+
+    def scan(j):
+        j = _as_jaxpr(j)
+        eqns = j.eqns
+        producer = {}
+        own = [1 if e.primitive.name in compute_prims else 0
+               for e in eqns]
+        nested = [nested_compute(e) for e in eqns]
+        cone: List[Set[int]] = [set() for _ in eqns]
+        for i, e in enumerate(eqns):
+            deps: Set[int] = set()
+            for v in e.invars:
+                pi = producer.get(id(v))
+                if pi is not None:
+                    deps.add(pi)
+                    deps |= cone[pi]
+            cone[i] = deps
+            for v in e.outvars:
+                producer[id(v)] = i
+        total = sum(own) + sum(nested)
+        colls = [
+            {"prim": e.primitive.name,
+             "cone_compute": sum(own[d] + nested[d] for d in cone[i]),
+             "cone": frozenset(d for d in cone[i]
+                               if own[d] or nested[d])}
+            for i, e in enumerate(eqns)
+            if e.primitive.name in COLLECTIVE_PRIMS
+            and e.primitive.name != "axis_index"]
+        if colls:
+            out.append({"collectives": colls, "total_compute": total})
+        for e in eqns:
+            for v in e.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                    jj = getattr(sub, "jaxpr",
+                                 sub if hasattr(sub, "eqns") else None)
+                    if jj is not None:
+                        scan(jj)
+
+    scan(jaxpr)
+    return out
+
+
 def donated_alias_count(lowered_text: str) -> int:
     """How many input buffers the lowered module aliases to outputs —
     ``tf.aliasing_output`` argument attributes in StableHLO are the

@@ -54,6 +54,10 @@ Supported invariants:
 ``donated_aliases``      exact aliased-input count
 ``no_orphan_collectives`` every collective's result is live
 ``collective_axes``      exact set of named axes collectives reduce over
+``interleaved_collectives`` ``{"min_collectives": n}`` — >= n per-bucket
+                         collectives whose dependency cones are proper,
+                         distinct subsets of the program's compute (the
+                         overlap schedule: not all trailing)
 ``psum_count``           exact number of ``psum`` equations
 ``dus_min``              at least n ``dynamic_update_slice`` eqns (ring
                          writes)
@@ -214,6 +218,39 @@ def _chk_psum_count(env, expected):
     return None
 
 
+def _chk_interleaved_collectives(env, expected):
+    """``{"min_collectives": n}`` — the overlap-schedule invariant:
+    the scope holding the data-parallel collectives must emit at least
+    n of them, at least one with a dependency cone that is a PROPER
+    subset of the scope's compute (not trailing the whole backward),
+    and with pairwise-distinct cones (per-bucket structure the
+    scheduler can interleave — all-equal cones mean the collectives
+    are serialized behind the same compute)."""
+    scopes = jaxprs.collective_compute_cones(env["jaxpr"])
+    if not scopes:
+        return "no collectives found in any scope"
+    scope = max(scopes, key=lambda s: len(s["collectives"]))
+    colls = scope["collectives"]
+    total = scope["total_compute"]
+    need = int(expected.get("min_collectives", 2))
+    if len(colls) < need:
+        return (f"expected >= {need} per-bucket collective(s), found "
+                f"{len(colls)} — is the bucket plan chunked "
+                f"(max_bucket_bytes)?")
+    counts = [c["cone_compute"] for c in colls]
+    if total > 0 and min(counts) >= total:
+        return (f"TRAILING schedule: every collective depends on all "
+                f"{total} compute eqn(s) — nothing can overlap")
+    # distinctness compares the cone SETS, not their sizes: two
+    # equal-compute but different cones (symmetric towers) are a
+    # perfectly interleavable schedule
+    if len(colls) >= 2 and len({c["cone"] for c in colls}) < 2:
+        return (f"collectives share one dependency cone "
+                f"({sorted(counts)} compute eqn(s)) — no per-bucket "
+                "schedule structure to interleave")
+    return None
+
+
 def _chk_dus_min(env, expected):
     got = env["counts"].get("dynamic_update_slice", 0)
     if got < expected:
@@ -242,6 +279,7 @@ _CHECKERS: Dict[str, Callable] = {
     "donated_aliases": _chk_donated_aliases,
     "no_orphan_collectives": _chk_no_orphan_collectives,
     "collective_axes": _chk_collective_axes,
+    "interleaved_collectives": _chk_interleaved_collectives,
     "psum_count": _chk_psum_count,
     "dus_min": _chk_dus_min,
     "counter": _chk_counter,

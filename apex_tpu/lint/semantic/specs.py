@@ -12,6 +12,14 @@ inputs and pins the structural facts earlier PRs proved ad hoc:
   check;
 * ``amp.scaled_value_and_grad`` (per-leaf oracle surface) — no host
   traffic, no f64;
+* the interleaved-schedule DDP step (chunked buckets + the
+  reduce-in-backward seam) — one psum per bucket whose dependency
+  cone is a proper, distinct subset of the backward's compute
+  (collectives schedulable under remaining compute, never all
+  trailing), donation aliasing intact;
+* the fused microbatch-accumulation step — one pack + one
+  ``flat_accumulate`` per bucket, accumulator buffers aliased in the
+  lowered HLO (the add is in place), zero per-leaf work;
 * a telemetry-instrumented step — ZERO callback/transfer primitives
   (the ring write is a plain dynamic_update_slice) — and the same
   step with a resilience Watchdog attached (detectors are host-side,
@@ -203,6 +211,117 @@ def _build_flat_pipeline_step():
         expect["pallas_calls"] = 2 * nb
         expect["is_finite_max"] = 0
     return {"fn": flat_step, "args": args, "expect": expect}
+
+
+@register_spec(
+    "amp.interleaved_flat_step",
+    anchor="apex_tpu/amp/flat_pipeline.py",
+    description="interleaved-schedule flat AMP DDP step (chunked "
+                "buckets + reduce-in-backward seam): one psum per "
+                "bucket whose dependency cone is a proper, distinct "
+                "subset of the backward's compute — the collectives "
+                "are schedulable under remaining compute, NOT "
+                "trailing; donation aliasing intact, zero host "
+                "traffic")
+def _build_interleaved_flat_step():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu import amp, comm
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers._base import _fold_clip
+
+    params = _mlp_params()
+    # ~300 B cap: one 8x8+8 f32 layer (288 B) per bucket -> 3 buckets,
+    # 3 per-bucket collectives with distinct cotangent cones
+    opt = FusedAdam(params, lr=1e-3, max_bucket_bytes=300)
+    plan = opt._plan
+    nb = len(plan.buckets)
+    assert nb >= 2, "chunking produced a monolithic plan"
+    pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0,
+                                axis_name=comm.AXIS_DATA,
+                                interleave=True)
+    hypers = _traced_hypers(opt)
+    scaler = amp.LossScaleState.create()
+    x = jax.random.normal(jax.random.key(3), (8, 8))
+    mesh = Mesh(np.array(jax.devices()[:1]), (comm.AXIS_DATA,))
+
+    def flat_step(param_bufs, opt_state, scaler, x, step):
+        ptree = plan.unpack_model(param_bufs)
+        loss, flat = pipe.scaled_value_and_grad(_mlp_loss, scaler,
+                                                ptree, x)
+        new_bufs, _, new_state = opt._full_step_flat(
+            param_bufs, None, opt_state, flat.bufs, step,
+            _fold_clip(1.0, flat.clip_coef), hypers, flat.found_inf)
+        return loss, new_bufs, new_state
+
+    fn = comm.shard_map(
+        flat_step, mesh,
+        in_specs=(P(), P(), P(), P(comm.AXIS_DATA), P()),
+        out_specs=P())
+    args = (opt._param_bufs, opt.opt_state, scaler, x, jnp.int32(1))
+    n_state = len(jax.tree_util.tree_leaves(opt.opt_state))
+    return {
+        "fn": fn, "args": args,
+        "jit_kwargs": {"donate_argnums": (1,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "psum_count": nb,
+            "collective_axes": {comm.AXIS_DATA},
+            "interleaved_collectives": {"min_collectives": 2},
+            "donated_aliases_min": n_state,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "amp.flat_accumulate_step",
+    anchor="apex_tpu/amp/flat_pipeline.py",
+    description="fused microbatch accumulation step: one gradient "
+                "pack + one flat_accumulate read-modify-write per "
+                "bucket, accumulator buffers DONATED (aliased in the "
+                "lowered HLO — the add is in place), found_inf "
+                "latched on device, zero per-leaf work, zero host "
+                "traffic")
+def _build_flat_accumulate_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.ops._dispatch import op_enabled
+
+    params = _tiny_params()
+    opt = FusedAdam(params, lr=1e-3)
+    plan = opt._plan
+    nb = len(plan.buckets)
+    pipe = amp.FlatGradPipeline(optimizer=opt)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    acc0 = opt.grad_accum_init()
+
+    def accum_step(acc, grads):
+        return pipe.accumulate(acc, grads)
+
+    expect = {
+        "no_host_transfer": True,
+        "no_f64": True,
+        # ONE pack per bucket feeding the fused add — and nothing else
+        "bucket_concats": {"count": nb,
+                           "sizes": {(b.size,) for b in plan.buckets}},
+        # the accumulator buckets alias outputs: the add is in place
+        "donated_aliases_min": nb,
+        "no_orphan_collectives": True,
+    }
+    if op_enabled("multi_tensor"):
+        expect["pallas_calls"] = nb        # flat_accumulate per bucket
+        expect["is_finite_max"] = 0
+    return {
+        "fn": accum_step, "args": (acc0, grads),
+        "jit_kwargs": {"donate_argnums": (0,)},
+        "expect": expect,
+    }
 
 
 @register_spec(

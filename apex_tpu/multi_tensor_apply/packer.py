@@ -56,20 +56,36 @@ class BucketPlan:
     master->model writeback stays a single-dtype cast per bucket.
     """
 
-    def __init__(self, treedef, buckets: Sequence[Bucket]):
+    def __init__(self, treedef, buckets: Sequence[Bucket],
+                 max_bucket_bytes: Optional[int] = None):
         self.treedef = treedef
         self.buckets = tuple(buckets)
         self.n_leaves = sum(len(b.leaves) for b in self.buckets)
+        # the chunking cap this plan was built with (None = monolithic
+        # per dtype group) — consumers that were ASKED for a specific
+        # cap can detect a mismatching supplied plan (FlatGradPipeline)
+        self.max_bucket_bytes = max_bucket_bytes
         self._seg_ids = None
 
     # ---- construction ----------------------------------------------------
     @classmethod
-    def from_tree(cls, work: Pytree,
-                  model: Optional[Pytree] = None) -> Optional["BucketPlan"]:
+    def from_tree(cls, work: Pytree, model: Optional[Pytree] = None,
+                  max_bucket_bytes: Optional[int] = None
+                  ) -> Optional["BucketPlan"]:
         """Build a plan, or None when packing is unsupported: empty
         trees, non-floating leaves (nothing for an optimizer kernel to
         do with them), or multi-device leaves (concatenation would
-        destroy their sharding — the per-leaf path preserves it)."""
+        destroy their sharding — the per-leaf path preserves it).
+
+        ``max_bucket_bytes``: optional chunking cap.  By default every
+        dtype group packs into ONE bucket — maximal kernel fusion, but
+        the data-parallel all-reduce then depends on the ENTIRE
+        backward (one trailing collective).  With a cap, a dtype group
+        splits into multiple buckets of at most that many bytes (leaf
+        order preserved, every bucket holds >= 1 leaf), so each
+        bucket's collective depends only on its own leaves' cotangents
+        and the scheduler can overlap bucket k's psum with bucket
+        k-1's backward compute (docs/perf.md "Overlap schedule")."""
         work_leaves, treedef = jax.tree_util.tree_flatten(work)
         if not work_leaves:
             return None
@@ -96,13 +112,23 @@ class BucketPlan:
             groups.setdefault(key, []).append((i, w))
         buckets = []
         for (wdt, mdt), entries in groups.items():
+            cap_elems = None
+            if max_bucket_bytes is not None:
+                cap_elems = max(1, int(max_bucket_bytes)
+                                // jnp.dtype(wdt).itemsize)
             specs, offset = [], 0
             for i, w in entries:
                 size = int(np.prod(w.shape)) if w.shape else 1
+                if cap_elems is not None and specs \
+                        and offset + size > cap_elems:
+                    # start a fresh bucket: the cap is a soft split
+                    # point, never a reason to split one leaf
+                    buckets.append(Bucket(wdt, mdt, tuple(specs), offset))
+                    specs, offset = [], 0
                 specs.append(LeafSpec(i, tuple(w.shape), size, offset))
                 offset += size
             buckets.append(Bucket(wdt, mdt, tuple(specs), offset))
-        return cls(treedef, buckets)
+        return cls(treedef, buckets, max_bucket_bytes=max_bucket_bytes)
 
     # ---- packing ---------------------------------------------------------
     def pack(self, tree: Pytree, dtypes=None) -> List[jax.Array]:
@@ -303,14 +329,16 @@ def _leaf_multi_device(l):
         return None
 
 
-def cached_plan(tree: Pytree,
-                model: Optional[Pytree] = None) -> Optional[BucketPlan]:
+def cached_plan(tree: Pytree, model: Optional[Pytree] = None,
+                max_bucket_bytes: Optional[int] = None
+                ) -> Optional[BucketPlan]:
     """Memoized ``BucketPlan.from_tree`` (grad-only pack entry point).
 
     Works on concrete arrays and tracers alike.  Returns None exactly
     when ``from_tree`` would (non-float or multi-device leaves); the
-    key carries shapes, dtypes AND device placement, so the memo never
-    bypasses from_tree's multi-device guard."""
+    key carries shapes, dtypes, device placement AND the chunking cap,
+    so the memo never bypasses from_tree's multi-device guard and a
+    chunked plan is never served where a monolithic one was asked."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     sig = tuple((tuple(getattr(l, "shape", ())),
                  jnp.dtype(getattr(l, "dtype", jnp.float32)).name,
@@ -321,9 +349,10 @@ def cached_plan(tree: Pytree,
     if model is not None:
         sig += tuple(jnp.dtype(l.dtype).name
                      for l in jax.tree_util.tree_leaves(model))
-    key = (treedef, sig)
+    key = (treedef, sig, max_bucket_bytes)
     if key not in _PLAN_CACHE:
         if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
             _PLAN_CACHE.clear()
-        _PLAN_CACHE[key] = BucketPlan.from_tree(tree, model)
+        _PLAN_CACHE[key] = BucketPlan.from_tree(
+            tree, model, max_bucket_bytes=max_bucket_bytes)
     return _PLAN_CACHE[key]

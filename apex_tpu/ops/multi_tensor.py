@@ -182,6 +182,71 @@ def flat_axpby_ref(a, x, b, y, out_dtype=None):
 
 
 # ---------------------------------------------------------------------------
+# fused gradient accumulation   [reference: the grad-accum loops around
+# amp.scale_loss — per-parameter p.grad += micro.grad walks; here ONE
+# read-modify-write per bucket into a donated f32 accumulator]
+# ---------------------------------------------------------------------------
+
+def _accumulate_kernel(s_ref, a_ref, g_ref, o_ref, flag_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        flag_ref[0] = 0
+
+    r = a_ref[...] + _f32(g_ref[...]) * s_ref[0]
+    o_ref[...] = r
+    # flag the RESULT: a non-finite microbatch gradient propagates into
+    # the sum (inf+x=inf, inf-inf=nan, nan+x=nan), and f32 accumulator
+    # overflow is caught too — the per-microbatch latch the step skip
+    # needs, from the same HBM sweep as the add
+    bad = jnp.logical_not(_all_finite(r)).astype(jnp.int32)
+    flag_ref[0] = jnp.maximum(flag_ref[0], bad)
+
+
+def flat_accumulate(acc: jax.Array, g: jax.Array, scale=1.0):
+    """acc += g * scale over flat buffers in ONE read-modify-write.
+
+    ``acc`` is the persistent f32 accumulator bucket (ALIASED to the
+    output — inside a jit that donates it, the add is in place, so a
+    microbatch accumulation step moves one gradient bucket through HBM
+    once and never materializes a per-leaf tree).  ``g`` may be any
+    float dtype (bf16 model grads accumulate in f32).  Returns
+    ``(new_acc f32, found_inf i32)``; the flag covers the accumulated
+    RESULT, so one bad microbatch latches through every later add.
+    """
+    if acc.dtype != jnp.float32:
+        raise ValueError(f"accumulator must be f32, got {acc.dtype}")
+    if not op_enabled("multi_tensor"):
+        return flat_accumulate_ref(acc, g, scale)
+    a2d, n = _as_tiles(acc)
+    g2d, _ = _as_tiles(g)
+    s = jnp.asarray([scale], jnp.float32).reshape(1)
+    out, flag = pl.pallas_call(
+        _accumulate_kernel,
+        grid=(_grid(a2d.shape[0]),),
+        in_specs=[_smem_spec(), _vec_spec(), _vec_spec()],
+        out_specs=[_vec_spec(), _scalar_out_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(a2d.shape, jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        input_output_aliases={1: 0},
+        interpret=interpret_mode(),
+        name="apex_multi_tensor_accumulate",
+    )(s, a2d, g2d)
+    return _from_tiles(out, n), flag[0]
+
+
+def flat_accumulate_ref(acc, g, scale=1.0):
+    if acc.dtype != jnp.float32:
+        raise ValueError(f"accumulator must be f32, got {acc.dtype}")
+    r = acc + _f32(g) * jnp.asarray(scale, jnp.float32)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(r))).astype(jnp.int32)
+    return r, bad
+
+
+# ---------------------------------------------------------------------------
 # fused unscale + non-finite check + squared-L2   [reference: amp+clip
 # issue multi_tensor_scale and multi_tensor_l2norm back-to-back — two
 # HBM sweeps; here ONE read feeds all three outputs]

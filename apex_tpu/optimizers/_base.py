@@ -165,7 +165,8 @@ class FusedOptimizerBase:
     def __init__(self, params: Pytree, master_weights: Optional[bool] = None,
                  masters: Optional[Pytree] = None,
                  offload_state: bool = False,
-                 fuse_buckets: bool = True, **hypers):
+                 fuse_buckets: bool = True,
+                 max_bucket_bytes: Optional[int] = None, **hypers):
         self.hypers: Dict[str, Any] = dict(self.defaults)
         unknown = set(hypers) - set(self.hypers)
         if unknown:
@@ -201,8 +202,13 @@ class FusedOptimizerBase:
         work = masters if masters is not None else params
 
         # ---- bucket plan (tentpole): one-time packing layout --------------
+        # max_bucket_bytes: optional chunking cap — multiple buckets
+        # per dtype group so the DDP collectives become per-chunk and
+        # schedulable under the remaining backward (docs/perf.md
+        # "Overlap schedule"); None keeps the maximal-fusion default
         self._plan = (BucketPlan.from_tree(
-            work, params if masters is not None else None)
+            work, params if masters is not None else None,
+            max_bucket_bytes=max_bucket_bytes)
             if fuse_buckets else None)
         self.fuse_buckets = self._plan is not None
         self._params_tree = None
@@ -530,6 +536,25 @@ class FusedOptimizerBase:
 
     def zero_grad(self):
         """No-op for parity: JAX grads are freshly computed, never stored."""
+
+    def grad_accum_init(self):
+        """Fresh zeroed microbatch gradient-accumulation state in this
+        optimizer's bucket layout (``amp.GradAccum``): per-bucket f32
+        accumulator buffers + the cross-microbatch found_inf latch +
+        the microbatch count.  Thread it through
+        ``FlatGradPipeline.accumulate()`` per microbatch and hand the
+        ``finalize()`` result to ``step(flat, found_inf=...)`` — a
+        latched overflow skips the whole committed step and holds the
+        step clock, exactly like a single-batch overflow.  Requires
+        the bucketed path (the accumulators ARE bucket buffers)."""
+        if self._plan is None:
+            raise ValueError(
+                "grad_accum_init requires the bucketed path "
+                "(fuse_buckets=False or the packer declined this "
+                "tree); accumulate per leaf with "
+                "amp.scaled_value_and_grad(microbatches=N) instead")
+        from apex_tpu.amp.flat_pipeline import GradAccum
+        return GradAccum.zeros(self._plan)
 
     # ---- bucket-native checkpoint capture --------------------------------
     def packed_snapshot(self):

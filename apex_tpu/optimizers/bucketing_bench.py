@@ -151,6 +151,132 @@ def bench_amp_pipeline(layers: int = 48, hidden: int = 256,
     return out
 
 
+def bench_flat_accumulate(layers: int = 48, hidden: int = 256,
+                          iters: int = 10, reps: int = 3):
+    """One microbatch accumulation, per-leaf tree-map-add vs fused
+    flat: the loop body a grad-accumulation train step pays N_micro
+    times per step.  Per-leaf: one XLA add per leaf (hundreds of tiny
+    dispatches on a transformer tree) into a per-leaf f32 accumulator
+    tree.  Flat: grads arrive PACKED (the pipeline's reality — packed
+    once at the backward) and ``flat_accumulate`` does one fused
+    read-modify-write per dtype bucket with the found_inf latch from
+    the same HBM sweep.  The per-leaf side gets its latch the per-leaf
+    way (``check_finite``), so both sides answer the same question."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.optimizers import FusedAdam
+
+    params = many_leaf_params(jax, jnp, layers, hidden)
+    grads = jax.tree_util.tree_map(lambda p: p * 1e-3 + 1e-4, params)
+
+    opt = FusedAdam(params, lr=1e-3)
+    pipe = amp.FlatGradPipeline(optimizer=opt)
+    acc_flat = opt.grad_accum_init()
+    acc_tree = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    packed = opt._plan.pack_grads(grads)
+
+    def per_leaf(acc, grads, bad):
+        new = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), acc, grads)
+        return new, jnp.maximum(bad, amp.check_finite(new))
+
+    def flat(acc, bufs):
+        return pipe.accumulate(acc, bufs)
+
+    out = {
+        "accum_leaves": len(jax.tree_util.tree_leaves(params)),
+        "accum_elements": sum(int(l.size) for l in
+                              jax.tree_util.tree_leaves(params)),
+    }
+    # two programs, two compiles — not a hot-loop retrace
+    # apexlint: disable-next=APX302
+    ms_pl = timeit(jax.jit(per_leaf), acc_tree, grads, jnp.int32(0),
+                   iters=iters, reps=reps)
+    # apexlint: disable-next=APX302
+    ms_fl = timeit(jax.jit(flat), acc_flat, packed,
+                   iters=iters, reps=reps)
+    out["accum_per_leaf_ms"] = round(ms_pl, 3)
+    out["accum_flat_ms"] = round(ms_fl, 3)
+    if ms_fl:
+        out["accum_flat_speedup"] = round(ms_pl / ms_fl, 2)
+    return out
+
+
+def bench_grad_accum(layers: int = 16, hidden: int = 128,
+                     batch: int = 32, n_micro=(1, 4, 8),
+                     iters: int = 5, reps: int = 3):
+    """Full microbatched AMP train steps, per-leaf vs flat
+    accumulation, at N_micro in {1,4,8} (bench.py's grad_accum train
+    legs).  Each leg is one jitted step: scaled_value_and_grad with
+    ``microbatches=N`` on the respective layout, then the fused (or
+    per-leaf) optimizer update with the latched found_inf."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.optimizers import FusedAdam
+
+    params = many_leaf_params(jax, jnp, layers, hidden)
+    x = jax.random.normal(jax.random.key(1), (batch, hidden))
+    scaler = amp.LossScaleState.create(2.0 ** 12)
+
+    def loss_fn(p, x):
+        h = x
+        for k in sorted(p):
+            h = jnp.tanh(h @ p[k]["w"] + p[k]["b"]) \
+                * p[k]["scale"] + p[k]["shift"]
+        return jnp.mean(h ** 2)
+
+    out = {"grad_accum_batch": batch,
+           "grad_accum_leaves":
+           len(jax.tree_util.tree_leaves(params))}
+    opt_fl = FusedAdam(params, lr=1e-3)
+    pipe = amp.FlatGradPipeline(optimizer=opt_fl)
+    opt_pl = FusedAdam(params, lr=1e-3, fuse_buckets=False)
+    hypers = {k: jnp.asarray(v, jnp.float32)
+              for k, v in opt_fl.hypers.items()
+              if isinstance(v, float)}
+    for n in n_micro:
+        def flat_step(work, opt_state, x, step, n=n):
+            ptree = pipe.plan.unpack(work)
+            loss, flat = pipe.scaled_value_and_grad(
+                loss_fn, scaler, ptree, x, microbatches=n)
+            new_w, _, new_s = opt_fl._full_step_flat(
+                work, None, opt_state, flat.bufs, step, 1.0,
+                hypers, flat.found_inf)
+            return loss, new_w, new_s
+
+        def per_leaf_step(work, opt_state, x, step, n=n):
+            loss, grads, found = amp.scaled_value_and_grad(
+                loss_fn, scaler, work, x, microbatches=n)
+            new_w, new_s = opt_pl.functional_step(
+                work, opt_state, grads, step, found_inf=found)
+            return loss, new_w, new_s
+
+        # each (layout, N) pair is its own program by design (not a
+        # hot-loop retrace), and the bench reruns one program many
+        # times over the SAME state arrays — donating opt_state would
+        # delete the inputs after the first rep
+        # apexlint: disable-next=APX302
+        ms_fl = timeit(jax.jit(flat_step), opt_fl._param_bufs,   # apexlint: disable=APX401
+                       opt_fl.opt_state, x, jnp.int32(2),
+                       iters=iters, reps=reps)
+        # apexlint: disable-next=APX302
+        ms_pl = timeit(jax.jit(per_leaf_step), params,   # apexlint: disable=APX401
+                       opt_pl.opt_state, x, jnp.int32(2),
+                       iters=iters, reps=reps)
+        out[f"grad_accum_flat_n{n}_ms"] = round(ms_fl, 3)
+        out[f"grad_accum_per_leaf_n{n}_ms"] = round(ms_pl, 3)
+        if ms_fl:
+            out[f"grad_accum_n{n}_speedup"] = round(ms_pl / ms_fl, 2)
+    return out
+
+
 def mixed_dtype_params(jax, jnp, layers: int = 48, hidden: int = 256):
     """The many-leaf tree in amp-O2 clothing: bf16 matmul weights plus
     f32 norm vectors per layer — two dtype buckets, masters for the

@@ -74,48 +74,97 @@ def all_reduce_gradients(grads: Pytree,
     _emit_reduce_telemetry(jax.tree_util.tree_leaves(grads))
 
     def reduce_leaf(g):
-        gf = g.astype(jnp.float32)
+        # same cast discipline as the bucketed path: f32 leaves pay no
+        # convert in either direction
+        gf = g if g.dtype == jnp.float32 else g.astype(jnp.float32)
         if pre != 1.0:
             gf = gf / pre
         gf = jax.lax.psum(gf, axis_name)
         if post != 1.0:
             gf = gf / post
-        return gf.astype(g.dtype)
+        return gf if gf.dtype == g.dtype else gf.astype(g.dtype)
 
     return jax.tree_util.tree_map(reduce_leaf, grads)
 
 
+def _reduce_one_flat_buffer(b, axis_name, world, pre, post,
+                            decompose: str = "psum",
+                            out_dtype=None):
+    """One bucket's data-parallel sum: f32 accumulation, cast back to
+    ``out_dtype`` (default: the buffer's own dtype).
+
+    Cast discipline: an already-f32 bucket pays NO convert in either
+    direction — the old unconditional ``astype(f32)``/cast-back pair
+    wrapped every f32 bucket (the common case) in two no-op converts
+    that sat between the pack and the collective and could block
+    fusion.  ``decompose="reduce_scatter"`` lowers the sum as
+    psum_scatter + all_gather — bitwise the same result, but the two
+    halves are independently schedulable async collectives (the
+    scatter's reduction can start as soon as the bucket exists and the
+    gather can complete under later compute), the latency-hiding
+    scheduler's preferred shape for large buckets (docs/perf.md)."""
+    bf = b if b.dtype == jnp.float32 else b.astype(jnp.float32)
+    if pre != 1.0:
+        bf = bf / pre
+    if decompose == "reduce_scatter" and world > 1:
+        n = bf.shape[0]
+        pad = (-n) % world
+        if pad:
+            bf = jnp.pad(bf, (0, pad))
+        bf = jax.lax.psum_scatter(bf, axis_name, scatter_dimension=0,
+                                  tiled=True)
+        bf = jax.lax.all_gather(bf, axis_name, axis=0, tiled=True)
+        if pad:
+            bf = jax.lax.slice(bf, (0,), (n,))
+    else:
+        bf = jax.lax.psum(bf, axis_name)
+    if post != 1.0:
+        bf = bf / post
+    want = jnp.dtype(out_dtype) if out_dtype is not None \
+        else jnp.dtype(b.dtype)
+    return bf if bf.dtype == want else bf.astype(want)
+
+
 def all_reduce_flat_buffers(bufs, axis_name: str = comm.AXIS_DATA,
                             average: bool = True,
-                            gradient_predivide_factor: float = 1.0):
-    """Bucket-granular all-reduce: ONE psum per flat bucket buffer.
+                            gradient_predivide_factor: float = 1.0,
+                            decompose: str = "psum",
+                            always_fp32: bool = False):
+    """Bucket-granular all-reduce: ONE collective per flat bucket.
 
     The flat AMP pipeline's collective stage — gradients arrive packed
     in a BucketPlan layout (a handful of large 1-D buffers instead of
     hundreds of leaves), so DDP-shaped reduction issues one collective
     per bucket.  Same average/predivide semantics as
     ``all_reduce_gradients``; f32 accumulation, results cast back to
-    each buffer's dtype.  No-op outside shard_map (pjit/GSPMD already
-    reduced) — identical contract to the per-leaf entry point.
+    each buffer's dtype — with no convert at all when a bucket is
+    already f32.  No-op outside shard_map (pjit/GSPMD already reduced)
+    — identical contract to the per-leaf entry point.
+
+    ``decompose="reduce_scatter"`` emits each bucket's sum as
+    psum_scatter + all_gather (see :func:`_reduce_one_flat_buffer`).
+    ``always_fp32=True`` keeps the REDUCED buffers in f32 instead of
+    casting back to the input dtype — the reference's
+    ``allreduce_always_fp32`` without the caller pre-casting (which
+    paid a second convert on the way in).
     """
+    if decompose not in ("psum", "reduce_scatter"):
+        raise ValueError(f"unknown decompose {decompose!r}")
     bufs = list(bufs)
     if axis_name is None or not _in_shard_map(axis_name):
+        if always_fp32:
+            return [b if b.dtype == jnp.float32
+                    else b.astype(jnp.float32) for b in bufs]
         return bufs
     world = comm.bound_axis_size(axis_name)
     pre = gradient_predivide_factor
     post = world / pre if average else 1.0 / pre
     _emit_reduce_telemetry(bufs)
-
-    def reduce_buf(b):
-        bf = b.astype(jnp.float32)
-        if pre != 1.0:
-            bf = bf / pre
-        bf = jax.lax.psum(bf, axis_name)
-        if post != 1.0:
-            bf = bf / post
-        return bf.astype(b.dtype)
-
-    return [reduce_buf(b) for b in bufs]
+    out_dtype = jnp.float32 if always_fp32 else None
+    return [_reduce_one_flat_buffer(b, axis_name, world, pre, post,
+                                    decompose=decompose,
+                                    out_dtype=out_dtype)
+            for b in bufs]
 
 
 def broadcast_params(params: Pytree) -> Pytree:
@@ -213,11 +262,13 @@ class DistributedDataParallel:
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
                  axis_name: str = comm.AXIS_DATA,
-                 bucket_plan=None):
+                 bucket_plan=None,
+                 reduce_decompose: str = "psum"):
         # bucketing/overlap knobs accepted for parity; XLA owns scheduling
         del message_size, delay_allreduce, shared_param
         del allreduce_trigger_params, retain_allreduce_buffers
         self.apply_fn = apply_fn
+        self.reduce_decompose = reduce_decompose
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
@@ -240,11 +291,16 @@ class DistributedDataParallel:
                 return grads
             bufs = (list(grads) if packed
                     else self.bucket_plan.pack_grads(grads))
-            if self.allreduce_always_fp32:
-                bufs = [b.astype(jnp.float32) for b in bufs]
+            # allreduce_always_fp32 rides the reduction's own f32
+            # accumulation (skip the cast-back) instead of pre-casting
+            # every bucket — the old pre-cast put a second convert in
+            # front of the collective for buckets that were bf16 and a
+            # no-op convert for ones already f32
             bufs = all_reduce_flat_buffers(
                 bufs, self.axis_name, average=self.gradient_average,
-                gradient_predivide_factor=self.gradient_predivide_factor)
+                gradient_predivide_factor=self.gradient_predivide_factor,
+                decompose=self.reduce_decompose,
+                always_fp32=self.allreduce_always_fp32)
             # packed in -> packed out (the flat pipeline consumes the
             # buckets directly); tree in -> tree out
             return bufs if packed else self.bucket_plan.unpack_grads(bufs)

@@ -33,6 +33,12 @@ def main():
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--virtual", type=int, default=2,
                     help="virtual chunks per pipe stage (1: plain 1F1B)")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient-accumulation microbatches per step "
+                         "(amp.scaled_value_and_grad's microbatches= "
+                         "path — the scan-based accumulation with the "
+                         "latched found_inf; replaces any hand-rolled "
+                         "accumulation loop)")
     ap.add_argument("--steps", type=int, default=30)
     args = ap.parse_args()
     n = args.dp * args.pp * args.tp
@@ -138,13 +144,16 @@ def main():
             x = jnp.transpose(x, (1, 0, 2))           # (S, B, H)
             if sp:
                 x = tp.scatter_to_sequence_parallel_region(x)
+            # -1, not the global M: under --accum the loss sees a
+            # microbatch slice of the local batch, so the pipeline
+            # microbatch count adapts (B_micro // MB)
             ub = jnp.transpose(
-                x.reshape(x.shape[0], M, MB, H), (1, 0, 2, 3))
+                x.reshape(x.shape[0], -1, MB, H), (1, 0, 2, 3))
             y = spmd.spmd_pipeline_interleaved_1f1b_apply(
                 lambda pv, xx: stage.apply(pv, xx),
                 jax.tree_util.tree_map(lambda a: a[0], sv), ub)
             y = jnp.transpose(y, (1, 0, 2, 3)).reshape(
-                x.shape[0], B_local, H)
+                x.shape[0], -1, H)
             # exactly ONE f-mapping syncs the head's partial d/dy
             # over tp ranks (see GPTModel): under SP the exit gather's
             # bwd reduce-scatter is it — final LN stays INSIDE the
@@ -168,8 +177,14 @@ def main():
             return tp.reduce_from_tensor_model_parallel_region(
                 jnp.where(pipe_rank == pp_size - 1, loss, 0.0), A_P)
 
+        # microbatches=N accumulates across a scan with the latched
+        # found_inf (one bad microbatch skips the whole step); the
+        # per-leaf layout is the right fit here — this step's state
+        # shards per leaf across THREE mesh axes, which the packer
+        # declines by design
         loss, grads, found_inf = amp.scaled_value_and_grad(
-            loss_fn, scaler, params, tok, lab)
+            loss_fn, scaler, params, tok, lab,
+            microbatches=args.accum)
         gev, gsv, glnf = grads
         gev, glnf = (jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, A_P), t) for t in (gev, glnf))

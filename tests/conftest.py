@@ -49,6 +49,7 @@ SLOW_MODULES = {
     "test_distributed_launch.py",  # spawns multi-process jax workers
 }
 SLOW_TESTS = {
+    "test_grad_accum.py::test_overlap_schedule_bench_smoke",
     "test_models.py::test_gpt_single_device_loss_decreases",
     "test_models.py::test_resnet18_forward_and_train_step",
     "test_models.py::test_gpt_tp_matches_tp1",

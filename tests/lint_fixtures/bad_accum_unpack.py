@@ -1,0 +1,26 @@
+# apexlint fixture: every per-microbatch unpack / per-leaf tree-map
+# add below must trip APX103 (and only APX103 — nothing here is a host
+# sync or jit-reachable, so the families stay isolated).
+# These files are linted as TEXT, never imported.
+import jax
+
+
+def accumulate_microbatches(plan, micro_grad_bufs, params):
+    acc = None
+    for bufs in micro_grad_bufs:
+        grads = plan.unpack_grads(bufs)                  # APX103: unpack
+        if acc is None:
+            acc = grads
+        else:
+            acc = jax.tree_util.tree_map(                # APX103: tree add
+                lambda a, g: a + g, acc, grads)
+    return acc
+
+
+def accumulate_trees(micro_grads, accum):
+    step = 0
+    while step < len(micro_grads):
+        accum = jax.tree_util.tree_map(                  # APX103: tree add
+            lambda a, g: a + g, accum, micro_grads[step])
+        step += 1
+    return accum

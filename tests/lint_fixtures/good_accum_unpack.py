@@ -1,0 +1,36 @@
+# apexlint fixture: the clean twin of bad_accum_unpack.py — fused flat
+# accumulation (no per-leaf work in the loop), unpacking OUTSIDE the
+# loop, and tree-map adds on non-gradient data are all fine.
+import jax
+
+from apex_tpu import amp
+from apex_tpu.ops import multi_tensor as mt
+
+
+def accumulate_flat(pipe, micro_grad_bufs):
+    acc = pipe.init_accum()
+    for bufs in micro_grad_bufs:
+        acc = pipe.accumulate(acc, bufs)     # fused: one RMW per bucket
+    return pipe.finalize(acc, inv_scale=1.0)
+
+
+def accumulate_kernel(acc_bufs, micro_grad_bufs):
+    for bufs in micro_grad_bufs:
+        acc_bufs = [mt.flat_accumulate(a, g)[0]
+                    for a, g in zip(acc_bufs, bufs)]
+    return acc_bufs
+
+
+def inspect_after_the_loop(plan, acc_bufs):
+    # unpacking once, outside any loop, is the documented
+    # inspection/test path
+    return plan.unpack_grads(acc_bufs)
+
+
+def merge_metrics(windows):
+    out = None
+    for w in windows:
+        # tree-map add on NON-gradient data: not this rule's business
+        out = w if out is None else jax.tree_util.tree_map(
+            lambda a, b: a + b, out, w)
+    return out

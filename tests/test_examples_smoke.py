@@ -152,6 +152,18 @@ def test_imagenet_tiny_cpu(capsys):
     assert "throughput" in capsys.readouterr().out
 
 
+def test_imagenet_grad_accum_flat(capsys):
+    # microbatches= adoption: the flat-accumulation path (ISSUE 10)
+    # drives the same loop — 2 microbatches per step, fused adds, the
+    # latched found_inf feeding the branch-free skip
+    _run("examples/imagenet/main_amp.py",
+         ["--cpu", "--steps", "2", "--batch-size", "4",
+          "--image-size", "32", "--arch", "resnet18",
+          "--grad-accum", "2"])
+    out = capsys.readouterr().out
+    assert "throughput" in out and "grad-accum 2 (flat)" in out
+
+
 def test_imagenet_space_to_depth_stem(capsys):
     # the MXU-efficient stem bench.py enables on hardware, reachable
     # from the reference-shaped CLI too
@@ -211,6 +223,13 @@ def test_train_pp_interleaved_converges(capsys):
     _run("examples/simple/train_pp.py", ["--virtual", "2"])
     out = capsys.readouterr().out
     assert "OK: loss" in out and "interleaved-1F1B V=2" in out
+
+
+def test_train_4d_gpt_converges_with_grad_accum(capsys):
+    # microbatches= adoption on the per-leaf path (3-axis-sharded
+    # state: the packer declines by design, the scan oracle runs)
+    _run("examples/gpt/train_4d.py", ["--steps", "8", "--accum", "2"])
+    assert "OK:" in capsys.readouterr().out
 
 
 def test_train_4d_gpt_converges(capsys):

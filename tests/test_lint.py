@@ -25,6 +25,7 @@ REPO = os.path.dirname(HERE)
 BAD_FIXTURES = {
     "bad_host_sync.py": {"APX101"},
     "bad_telemetry_sync.py": {"APX102"},
+    "bad_accum_unpack.py": {"APX103"},
     "bad_dtype.py": {"APX201", "APX202", "APX203"},
     "bad_retrace.py": {"APX301", "APX302", "APX303"},
     "bad_donation.py": {"APX401"},
@@ -35,7 +36,8 @@ BAD_FIXTURES = {
     "bad_trace_state.py": {"APX801"},
 }
 GOOD_FIXTURES = [
-    "good_host_sync.py", "good_telemetry_sync.py", "good_dtype.py",
+    "good_host_sync.py", "good_telemetry_sync.py",
+    "good_accum_unpack.py", "good_dtype.py",
     "good_retrace.py", "good_donation.py", "good_use_after_donate.py",
     "good_pallas.py", "good_import_env.py", "good_collectives.py",
     "good_trace_state.py",
@@ -67,7 +69,7 @@ def test_every_rule_family_has_fixture_coverage():
     covered = set().union(*BAD_FIXTURES.values())
     families = {rid[:4] for rid, _, _ in rule_catalog()}
     assert {rid[:4] for rid in covered} == families
-    assert len(BAD_FIXTURES) >= 10 == len(GOOD_FIXTURES)
+    assert len(BAD_FIXTURES) >= 11 == len(GOOD_FIXTURES)
     ids = [r.id for r in all_rules()]
     assert len(ids) == len(set(ids))
 

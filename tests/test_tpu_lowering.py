@@ -174,6 +174,8 @@ def test_lower_multi_tensor_family():
     lower_tpu(mt.flat_scale, p, jnp.float32(0.5))
     lower_tpu(lambda x, y: mt.flat_axpby(0.5, x, -0.25, y), p, p)
     lower_tpu(mt.flat_l2norm, p)
+    lower_tpu(lambda a, g: mt.flat_accumulate(a, g, 0.5), p,
+              p.astype(jnp.bfloat16))
     lower_tpu(lambda *a: mt.flat_adam(
         *a, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.01,
         step=3, adam_w_mode=True), p, p, p, p)

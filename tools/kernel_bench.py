@@ -98,6 +98,7 @@ _OP_FAMILY = {
     "flat_adam": "multi_tensor",
     "flat_lamb": "multi_tensor",
     "flat_unscale_norm": "multi_tensor",
+    "flat_accumulate": "multi_tensor",
     "welford_mean_var": "welford",
 }
 
@@ -421,6 +422,21 @@ def main():
         "kernel_ms": ra["amp_step_flat_ms"],
         "oracle_ms": ra["amp_step_per_leaf_ms"],
         "speedup": ra.get("amp_pipeline_speedup")})
+
+    # microbatch accumulation loop body, fused flat_accumulate (one
+    # RMW per bucket + found_inf latch) vs the per-leaf tree-map add
+    # (the APX103 shape) on the same many-leaf tree
+    from apex_tpu.optimizers.bucketing_bench import bench_flat_accumulate
+    rg = bench_flat_accumulate()
+    rg["backend"] = backend
+    print(json.dumps(rg), flush=True)
+    rows.append({
+        "kernel": "flat_accumulate",
+        "shape": f"{rg['accum_leaves']}leaves/{rg['accum_elements']}elem",
+        "dtype": "f32",
+        "kernel_ms": rg["accum_flat_ms"],
+        "oracle_ms": rg["accum_per_leaf_ms"],
+        "speedup": rg.get("accum_flat_speedup")})
 
     # training-state snapshot+serialize, bucket-native (v2: one device
     # copy + one d2h per bucket) vs per-leaf (v1: state_dict walk) on a
