@@ -53,6 +53,7 @@ import jax.numpy as jnp
 from apex_tpu.amp.scaler import (LossScaleState, scale_loss,
                                  split_microbatch_args)
 from apex_tpu.multi_tensor_apply.packer import BucketPlan, cached_plan
+from apex_tpu.ops import _dispatch
 from apex_tpu.ops import multi_tensor as mt
 from apex_tpu.telemetry import _tape
 
@@ -143,7 +144,25 @@ class FlatGradPipeline:
                  defer_plan: bool = False,
                  interleave: bool = False,
                  reduce_decompose: str = "psum",
-                 max_bucket_bytes: Optional[int] = None):
+                 max_bucket_bytes=None):
+        if reduce_decompose == "auto":
+            # measured per-topology preference (tools/autotune.py);
+            # absent entry = the design default
+            reduce_decompose = _dispatch.pipeline_pref(
+                "reduce_decompose", "psum")
+        if max_bucket_bytes == "auto":
+            supplied = plan if plan is not None \
+                else getattr(optimizer, "_plan", None)
+            if supplied is not None:
+                # a supplied plan owns its chunking: "auto" asks the
+                # measured table only when THIS pipeline derives the
+                # plan (chunk at the source, e.g. FusedAdam(...,
+                # max_bucket_bytes=...), to steer a shared plan)
+                max_bucket_bytes = getattr(supplied,
+                                           "max_bucket_bytes", None)
+            else:
+                max_bucket_bytes = _dispatch.pipeline_pref(
+                    "max_bucket_bytes", None)
         if plan is None and optimizer is not None:
             plan = getattr(optimizer, "_plan", None)
             if plan is None:

@@ -38,7 +38,7 @@ import numpy as np
 from jax import lax
 
 __all__ = ["chunked_train_bench", "cost_flops", "dispatch_overhead_ms",
-           "loop_on_device", "sync", "timeit"]
+           "loop_on_device", "noise_floor_pct", "sync", "timeit"]
 
 
 def sync(o) -> None:
@@ -120,6 +120,24 @@ def timeit(f, *args, iters: int = 20, reps: int = 3,
             n = max(n + 1, int(200.0 / max(ms, 1e-3)))
             ms = run(n)
     return ms
+
+
+def noise_floor_pct(f, *args, trials: int = 3, iters: int = 10,
+                    reps: int = 2, floor: float = 2.0) -> float:
+    """Measured repeatability of the amortized timer on this machine /
+    session: time the SAME jitted body ``trials`` times and report the
+    relative spread (max-min)/median as a percent, floored at
+    ``floor``%.  Sweep distillers (tools/autotune.py,
+    tools/kernel_bench.py --write-prefs) stamp this into the written
+    prefs table and refuse to flip a dispatch decision on an edge
+    inside it — a winner within the session's own wobble is noise, not
+    a measurement."""
+    samples = [timeit(f, *args, iters=iters, reps=reps)
+               for _ in range(max(2, trials))]
+    med = statistics.median(samples)
+    if med <= 0:
+        return floor
+    return max(floor, (max(samples) - min(samples)) / med * 100.0)
 
 
 def cost_flops(jitted, *args):

@@ -36,6 +36,22 @@ def many_leaf_params(jax, jnp, layers: int = 48, hidden: int = 256):
     }
 
 
+def many_leaf_loss(jnp):
+    """The loss over a :func:`many_leaf_params` tree (tanh stack with
+    scale/shift), shared so every consumer measures the SAME model:
+    bench_grad_accum's train legs ground the perf-budget row
+    (grad_accum_n8_speedup) that tools/autotune.py restamps, and the
+    autotuner's pipeline-chunk sweep must not drift onto a different
+    toy network."""
+    def loss_fn(p, x):
+        h = x
+        for k in sorted(p):
+            h = jnp.tanh(h @ p[k]["w"] + p[k]["b"]) \
+                * p[k]["scale"] + p[k]["shift"]
+        return jnp.mean(h ** 2)
+    return loss_fn
+
+
 def bench_optimizer_bucketing(layers: int = 48, hidden: int = 256,
                               iters: int = 10, reps: int = 3,
                               optimizer: str = "adam"):
@@ -224,13 +240,7 @@ def bench_grad_accum(layers: int = 16, hidden: int = 128,
     params = many_leaf_params(jax, jnp, layers, hidden)
     x = jax.random.normal(jax.random.key(1), (batch, hidden))
     scaler = amp.LossScaleState.create(2.0 ** 12)
-
-    def loss_fn(p, x):
-        h = x
-        for k in sorted(p):
-            h = jnp.tanh(h @ p[k]["w"] + p[k]["b"]) \
-                * p[k]["scale"] + p[k]["shift"]
-        return jnp.mean(h ** 2)
+    loss_fn = many_leaf_loss(jnp)
 
     out = {"grad_accum_batch": batch,
            "grad_accum_leaves":

@@ -268,6 +268,12 @@ class DistributedDataParallel:
         del message_size, delay_allreduce, shared_param
         del allreduce_trigger_params, retain_allreduce_buffers
         self.apply_fn = apply_fn
+        if reduce_decompose == "auto":
+            # measured per-topology preference (tools/autotune.py);
+            # absent entry = the design default
+            from apex_tpu.ops import _dispatch
+            reduce_decompose = _dispatch.pipeline_pref(
+                "reduce_decompose", "psum")
         self.reduce_decompose = reduce_decompose
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average

@@ -139,6 +139,8 @@ def _neutral_dispatch(monkeypatch):
     from apex_tpu.ops import _dispatch
     monkeypatch.setattr(_dispatch, "_PREFS", {})
     monkeypatch.setattr(_dispatch, "_ATTN_CAPS", {})
+    monkeypatch.setattr(_dispatch, "_PIPELINE", {})
+    monkeypatch.setattr(_dispatch, "_INSTALLED", None)
     monkeypatch.delenv("APEX_TPU_PREFER_PALLAS", raising=False)
     monkeypatch.delenv("APEX_TPU_PREFER_XLA", raising=False)
 

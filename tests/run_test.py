@@ -59,9 +59,11 @@ SUITES = {
     "run_offload": ["tests/test_offload.py"],
     "run_quantization": ["tests/test_quantization.py"],
     # harness/tooling logic (platform select, amortized timer, the
-    # kernel-bench distillers that write dispatch defaults)
+    # kernel-bench distillers that write dispatch defaults, and the
+    # autotuner + per-topology dispatch tables + perf_gate auto mode)
     "run_harness": ["tests/test_platform.py", "tests/test_benchlib.py",
-                    "tests/test_kernel_bench_logic.py"],
+                    "tests/test_kernel_bench_logic.py",
+                    "tests/test_autotune.py"],
     "run_lint": ["tests/test_lint.py"],
     # apexverify: jaxpr-level invariant specs over the public jitted
     # entry points + the findings-baseline diff gate (tools/check.sh)

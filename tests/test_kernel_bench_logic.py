@@ -126,6 +126,44 @@ class TestWritePrefs:
         assert json.loads(p.read_text())["attn_block_cap"] == {
             "128": 512}
 
+    def test_topology_and_noise_metadata(self, tmp_path):
+        """--write-prefs records WHERE (topology block) and HOW
+        REPEATABLY (noise floor) the table was measured, making
+        hand-run bench output schema-compatible with autotune's
+        per-topology tables — and topology-checked at load."""
+        at = _load_tool("autotune")
+        p = tmp_path / "prefs.json"
+        topo = {"key": "tpu_v5e-8", "device_kind": "TPU v5e",
+                "device_count": 8, "process_count": 2}
+        rows = [{"kernel": "welford_mean_var", "speedup": 1.2,
+                 "backend": "tpu"}]
+        kb.write_prefs(rows, str(p), topology=topo,
+                       noise_floor_pct=3.456)
+        doc = json.loads(p.read_text())
+        assert doc["topology"] == topo
+        assert doc["schema"] == 2
+        assert doc["noise_floor_pct"] == 3.46
+        # the written table passes the check.sh schema validator
+        assert at.validate_table(doc, per_topology=False) == []
+        # legacy call shape (no metadata) stays valid and stamp-free
+        kb.write_prefs(rows, str(p.with_name("p2.json")))
+        doc2 = json.loads(p.with_name("p2.json").read_text())
+        assert "topology" not in doc2 and "noise_floor_pct" not in doc2
+
+    def test_stale_era_doc_strips_topology_metadata(self, tmp_path):
+        """_load_trusted_doc must not launder a stale-era table's
+        topology/noise stamps into the fresh doc (they describe the
+        discarded measurements, not the new ones)."""
+        p = tmp_path / "prefs.json"
+        p.write_text(json.dumps({
+            "methodology": "dispatch-per-iteration",
+            "topology": {"key": "tpu_v4-8"}, "schema": 2,
+            "noise_floor_pct": 1.0,
+            "pipeline": {"reduce_decompose": "reduce_scatter"}}))
+        doc = kb._load_trusted_doc(str(p))
+        for k in ("topology", "schema", "noise_floor_pct", "pipeline"):
+            assert k not in doc, k
+
     def test_corrupt_existing_file_does_not_abort(self, tmp_path):
         p = tmp_path / "prefs.json"
         p.write_text("{truncated")
@@ -312,6 +350,27 @@ class TestBertPackedVarlenBench:
                   "bert_varlen_dense_real_tokens_per_sec",
                   "bert_varlen_packed_speedup"):
             assert k in out and out[k] > 0, (k, out)
+
+
+def test_bench_final_line_carries_measured_at():
+    """The child's final bench line must stamp its capture time:
+    perf_gate's auto-gating compares it against the budget's
+    stamped_at, so a live hardware round without it could NEVER arm
+    the gate (it would fall into the 'cannot compare' report-only
+    branch forever)."""
+    import re
+
+    bench = _load_bench()
+    pg = _load_tool("perf_gate")
+    out = bench._stamp_measured_at({"backend": "tpu", "value": 1.0})
+    assert re.fullmatch(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z",
+                        out["measured_at"])
+    # ...and perf_gate reads exactly this field
+    assert pg.round_when(out) == out["measured_at"]
+    # an existing stamp (a re-emitted cached line) is preserved
+    assert bench._stamp_measured_at(
+        {"measured_at": "2026-07-31T03:41:18Z"})["measured_at"] \
+        == "2026-07-31T03:41:18Z"
 
 
 class TestCachedTpuResult:

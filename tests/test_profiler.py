@@ -523,9 +523,14 @@ def test_gate_stale_metric_fails_when_newest_round_drops_it(tmp_path):
     budget.write_text(json.dumps(
         _budget({"extra.mfu": {"floor": 0.25, "noise_pct": 5.0}})))
     assert perf_gate.main(["--budget", str(budget),
-                           "--root", str(tmp_path)]) == 1
+                           "--root", str(tmp_path), "--gate"]) == 1
     assert perf_gate.main(["--budget", str(budget),
                            "--root", str(tmp_path), "--report"]) == 0
+    # auto mode cannot prove these stamp-less synthetic rounds postdate
+    # the budget, so it reports without gating (the full auto-mode
+    # date matrix lives in tests/test_autotune.py)
+    assert perf_gate.main(["--budget", str(budget),
+                           "--root", str(tmp_path)]) == 0
 
 
 def test_gate_non_numeric_value_skips_round_not_crashes(tmp_path):
@@ -549,17 +554,19 @@ def test_gate_main_exit_codes_and_report_mode(tmp_path, capsys):
         _budget({"value": {"floor": 2000.0, "noise_pct": 5.0}})))
     _write_round(str(tmp_path), 1, "tpu", 1500.0)    # regression
     assert perf_gate.main(["--budget", str(budget),
-                           "--root", str(tmp_path)]) == 1
+                           "--root", str(tmp_path), "--gate"]) == 1
     capsys.readouterr()
     # --report: same verdicts, never gates
     assert perf_gate.main(["--budget", str(budget),
                            "--root", str(tmp_path), "--report"]) == 0
     assert "regression" in capsys.readouterr().out
-    # --json stays parseable
+    # --json stays parseable (and carries the chosen mode)
     assert perf_gate.main(["--budget", str(budget),
-                           "--root", str(tmp_path), "--json"]) == 1
+                           "--root", str(tmp_path), "--gate",
+                           "--json"]) == 1
     doc = json.loads(capsys.readouterr().out)
     assert doc["regressions"] == 1
+    assert doc["gating"] and "forced" in doc["mode_reason"]
     # missing budget: usage error, not a crash
     assert perf_gate.main(["--budget", str(tmp_path / "no.json"),
                            "--root", str(tmp_path)]) == 2

@@ -1,0 +1,897 @@
+"""Kernel autotuner: sweep the dispatch candidate spaces and persist
+per-topology dispatch tables (+ restamped perf budgets).
+
+The measurement substrate existed (benchlib amortized timing, the PR-8
+device-event attribution, perf_budget provenance, the stale-table
+RuntimeWarning contract); this is its consumer.  Per (op family, shape
+class, dtype, topology) the sweep times:
+
+- **routing**: each Pallas kernel family vs its XLA oracle
+  (``prefer_pallas`` booleans, the VERDICT r2 #2 table);
+- **attn_block_cap**: flash-attention sequence-block geometries per
+  padded head dim (the kernel_bench --sweep-attn grid);
+- **pipeline.max_bucket_bytes**: flat-pipeline bucket chunking for the
+  comm/compute overlap schedule;
+- **pipeline.reduce_decompose**: psum vs reduce-scatter+all-gather for
+  the bucketed all-reduce.
+
+Every timing uses benchlib's amortized on-device loop; a decision that
+flips a design default must beat it beyond the session's measured
+noise floor (``benchlib.noise_floor_pct``), and wall-clock winners are
+cross-checked against device-event attribution
+(``telemetry.profiler``): a winner whose edge disappears in the device
+timeline is rejected as noise.  Results persist as ONE prefs table per
+topology — ``apex_tpu/ops/dispatch_prefs.<topology>.json`` with
+methodology + topology + noise-floor stamps — which
+``ops/_dispatch.py`` selects by runtime topology (falling back to the
+shipped default table with the loud-warning discipline).  The sweep
+also restamps ``tools/perf_budget.json`` rows it can ground, so the
+perf gate and the tuner share one source of truth.
+
+    python tools/autotune.py --cpu-smoke [--out DIR]
+        # deterministic plumbing run: tiny shapes, fixed candidate
+        # lists, CPU interpret mode; writes the per-topology table and
+        # a restamped budget COPY into --out (never the repo files),
+        # then demonstrates the table changes >= 1 dispatch decision
+    python tools/autotune.py --full
+        # hardware sweep: full candidate spaces; installs
+        # apex_tpu/ops/dispatch_prefs.<topology>.json and restamps
+        # tools/perf_budget.json in place (refuses off-TPU)
+    python tools/autotune.py --validate [FILES...]
+        # stdlib-only schema check over shipped dispatch_prefs*.json
+        # (tools/check.sh runs this: a hand-edited table fails fast
+        # instead of being silently discarded at import)
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import glob
+import json
+import os as _os
+import re
+import sys as _sys
+import time
+
+# runnable straight from a checkout with no install (tools/lint.py idiom)
+_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _ROOT not in _sys.path:
+    _sys.path.insert(0, _ROOT)
+
+_TOOLS = _os.path.join(_ROOT, "tools")
+DEFAULT_OUT = _os.path.join(_TOOLS, "artifacts", "autotune")
+BUDGET_PATH = _os.path.join(_TOOLS, "perf_budget.json")
+
+# keep in sync with apex_tpu.ops._dispatch.SCHEMA_VERSION (asserted by
+# tests/test_autotune.py); duplicated so --validate stays jax-free.
+SCHEMA_VERSION = 2
+
+_REDUCE_CHOICES = ("psum", "reduce_scatter")
+
+
+def _load_sibling(name):
+    """Import a sibling tools/ module (tools/ is not a package)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, _os.path.join(_TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+# ---------------------------------------------------------------------------
+# schema validation (stdlib only — check.sh runs this on every push)
+# ---------------------------------------------------------------------------
+
+def validate_table(doc, *, per_topology: bool, path: str = "") -> list:
+    """Schema errors for one dispatch-prefs doc (empty list = valid).
+
+    The default ``dispatch_prefs.json`` (``per_topology=False``) needs
+    the methodology stamp and in-domain values; a per-topology
+    ``dispatch_prefs.<key>.json`` additionally needs the schema
+    version, a topology block whose key matches the filename, and a
+    noise-floor stamp — everything ``ops/_dispatch.py`` would silently
+    discard the table for lacking must fail loudly here instead."""
+    errs = []
+    if not isinstance(doc, dict):
+        return [f"{path or '<doc>'}: not a JSON object"]
+
+    def err(msg):
+        errs.append(f"{path or '<doc>'}: {msg}")
+
+    if doc.get("methodology") != "amortized":
+        err(f"methodology must be 'amortized', found "
+            f"{doc.get('methodology')!r} (tables without the stamp "
+            "measured the relay, not the kernels, and are ignored at "
+            "import)")
+
+    prefs = doc.get("prefer_pallas", {})
+    if not isinstance(prefs, dict):
+        err("prefer_pallas must be an object")
+    else:
+        for k, v in prefs.items():
+            if not isinstance(v, bool):
+                err(f"prefer_pallas[{k!r}] must be a JSON boolean, "
+                    f"found {v!r}")
+
+    caps = doc.get("attn_block_cap", {})
+    if not isinstance(caps, dict):
+        err("attn_block_cap must be an object")
+    else:
+        for k, v in caps.items():
+            if not isinstance(v, int) or isinstance(v, bool) \
+                    or v <= 0 or v % 128:
+                err(f"attn_block_cap[{k!r}] must be a positive "
+                    f"multiple of 128, found {v!r}")
+
+    pipe = doc.get("pipeline", {})
+    if not isinstance(pipe, dict):
+        err("pipeline must be an object")
+    else:
+        if "max_bucket_bytes" in pipe:
+            v = pipe["max_bucket_bytes"]
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v <= 0):
+                err(f"pipeline.max_bucket_bytes must be a positive "
+                    f"integer or null, found {v!r}")
+        if "reduce_decompose" in pipe \
+                and pipe["reduce_decompose"] not in _REDUCE_CHOICES:
+            err(f"pipeline.reduce_decompose must be one of "
+                f"{_REDUCE_CHOICES}, found {pipe['reduce_decompose']!r}")
+
+    topo = doc.get("topology")
+    if topo is not None:
+        if not isinstance(topo, dict) or not isinstance(
+                topo.get("key"), str) or not topo.get("key"):
+            err("topology block must be an object with a string 'key'")
+        else:
+            for field, typ in (("device_kind", str),
+                               ("device_count", int)):
+                if not isinstance(topo.get(field), typ) \
+                        or isinstance(topo.get(field), bool):
+                    err(f"topology.{field} must be a {typ.__name__}")
+
+    if per_topology:
+        if doc.get("schema") != SCHEMA_VERSION:
+            err(f"per-topology tables require schema={SCHEMA_VERSION}, "
+                f"found {doc.get('schema')!r}")
+        if topo is None:
+            err("per-topology tables require a topology block")
+        elif isinstance(topo, dict) and isinstance(topo.get("key"), str) \
+                and path:
+            want = f"dispatch_prefs.{topo['key']}.json"
+            if _os.path.basename(path) != want:
+                err(f"filename must match topology.key "
+                    f"(expected {want})")
+        nf = doc.get("noise_floor_pct")
+        if not isinstance(nf, (int, float)) or isinstance(nf, bool) \
+                or nf < 0:
+            err(f"noise_floor_pct must be a non-negative number, "
+                f"found {nf!r}")
+    return errs
+
+
+def validate_paths(paths=None) -> list:
+    """Validate every shipped dispatch_prefs*.json (or the given
+    paths); returns all errors.  Unreadable JSON is an error — a
+    hand-edit that truncates the file must fail CI, not degrade to
+    design defaults silently."""
+    if not paths:
+        paths = sorted(glob.glob(_os.path.join(
+            _ROOT, "apex_tpu", "ops", "dispatch_prefs*.json")))
+    errs = []
+    for p in paths:
+        per_topo = re.fullmatch(r"dispatch_prefs\..+\.json",
+                                _os.path.basename(p)) is not None
+        try:
+            with open(p, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            errs.append(f"{p}: unreadable ({e})")
+            continue
+        errs.extend(validate_table(doc, per_topology=per_topo, path=p))
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# budget restamp (stdlib only)
+# ---------------------------------------------------------------------------
+
+def restamp_budget(budget: dict, measured: dict, *, topology: str,
+                   backend: str, noise_floor_pct: float, mode: str,
+                   when: str) -> list:
+    """Restamp ``perf_budget.json`` rows the sweep grounded: for each
+    measured metric present in the budget, the floor (or ceiling)
+    moves to the measured value and the row gains sweep provenance, so
+    the perf gate defends what the tuner just measured — one source of
+    truth.  The top-level stamp date only moves on a HARDWARE sweep
+    (perf_gate's auto-gating keys off it; a CPU smoke restamp is
+    plumbing, not a perf claim).  Mutates ``budget``; returns the
+    restamped row names."""
+    rows = []
+    metrics = budget.setdefault("metrics", {})
+    for name, value in sorted(measured.items()):
+        spec = metrics.get(name)
+        if not isinstance(spec, dict) or not isinstance(
+                value, (int, float)) or isinstance(value, bool):
+            continue
+        if spec.get("direction", "higher") == "higher":
+            spec["floor"] = round(float(value), 3)
+        else:
+            spec["ceiling"] = round(float(value), 3)
+        spec["restamped"] = {
+            "by": "tools/autotune.py", "mode": mode,
+            "topology": topology, "backend": backend,
+            "measured": round(float(value), 4),
+            "noise_floor_pct": round(float(noise_floor_pct), 2),
+            "at": when}
+        rows.append(name)
+    if rows and backend == "tpu":
+        budget["stamped_at"] = when
+        budget["stamped_from"] = (f"tools/autotune.py sweep on "
+                                  f"{topology} at {when}")
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# sweep machinery (jax imported lazily)
+# ---------------------------------------------------------------------------
+
+def smoke_config() -> dict:
+    """Fixed tiny candidate spaces: the whole sweep -> table ->
+    dispatch-decision-change -> budget-restamp pipeline runs
+    deterministically in CPU interpret mode (tier-1), no hardware."""
+    return {
+        "mode": "cpu-smoke", "iters": 20, "reps": 3,
+        "mt_n": 4096,
+        "welford_shape": (256, 128),
+        "attn_shapes": [(1, 1, 256, 64)],
+        "attn_caps": [128, 256],
+        "attn_grad": False,
+        "chunk_candidates": [None, 16384],
+        "pipe_layers": 4, "pipe_hidden": 32, "pipe_batch": 8,
+        "reduce_n": 8192,
+        "accum": dict(layers=3, hidden=32, batch=8, n_micro=(8,),
+                      iters=2, reps=2),
+        "device_check_families": ["multi_tensor"],
+    }
+
+
+def full_config() -> dict:
+    """Hardware candidate spaces (the overdue re-measure: run this in
+    the first live TPU window — it restamps everything that predates
+    the flat pipeline and the overlap schedule)."""
+    return {
+        "mode": "full", "iters": 10, "reps": 3,
+        "mt_n": 1 << 24,
+        "welford_shape": (64 * 56 * 56, 256),
+        "attn_shapes": [(8, 16, 512, 64), (4, 16, 2048, 128),
+                        (2, 16, 2048, 256)],
+        "attn_caps": [128, 256, 512, 1024],
+        "attn_grad": True,
+        "chunk_candidates": [None, 1 << 25, 1 << 26, 1 << 27],
+        "pipe_layers": 48, "pipe_hidden": 256, "pipe_batch": 64,
+        "reduce_n": 1 << 22,
+        "accum": dict(layers=16, hidden=128, batch=32, n_micro=(8,),
+                      iters=5, reps=3),
+        "device_check_families": ["multi_tensor", "welford",
+                                  "layer_norm", "pipeline"],
+    }
+
+
+def _time(fn, *args, cfg):
+    import jax
+
+    from apex_tpu.benchlib import timeit
+    return timeit(jax.jit(fn), *args, iters=cfg["iters"],
+                  reps=cfg["reps"], adaptive=(cfg["mode"] == "full"))
+
+
+def measure_noise_floor(cfg) -> float:
+    """Session noise floor from a representative fused body (the
+    welford oracle at this config's shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.benchlib import noise_floor_pct
+    from apex_tpu.ops import welford as wf
+    r, c = cfg["welford_shape"]
+    x = jax.random.normal(jax.random.key(11), (r, c), jnp.bfloat16)
+    return round(noise_floor_pct(
+        jax.jit(wf.welford_mean_var_ref), x,
+        trials=3, iters=cfg["iters"], reps=cfg["reps"]), 2)
+
+
+def device_event_check(label: str, fast, slow, outdir: str) -> dict:
+    """Cross-check a wall-clock verdict against the device timeline:
+    capture the winner and the loser under short profiler windows and
+    compare device-busy time (compute+collective+transfer, interval-
+    union).  ``fast``/``slow`` are (callable, args) with the
+    wall-clock winner first.  Verdict "rejected" means the wall-clock
+    edge disappeared on device — the decision must not flip a default
+    on it."""
+    import jax
+
+    from apex_tpu.benchlib import sync
+    from apex_tpu.telemetry.profiler import attribution, capture, events
+    busy, n_events = {}, {}
+    for side, (fn, args) in (("fast", fast), ("slow", slow)):
+        d = _os.path.join(outdir, "device_check",
+                          re.sub(r"[^A-Za-z0-9_.-]", "_",
+                                 f"{label}_{side}"))
+        _os.makedirs(d, exist_ok=True)
+        try:
+            # two sides = two programs by design (one jit each, not a
+            # per-iteration retrace: the capture loop reuses jf)
+            # apexlint: disable-next=APX302
+            jf = jax.jit(fn)
+            out = jf(*args)
+            sync(out)                 # compile OUTSIDE the window
+            with capture.trace(d):
+                for _ in range(3):
+                    out = jf(*args)
+                sync(out)
+            evs = events.load_device_events(d)
+        except Exception as e:       # a failed capture must not kill
+            return {"checked": False,  # the sweep — record and move on
+                    "reason": f"capture failed: {e!r}"[:200]}
+        b = attribution.attribute(evs)
+        busy[side] = round(b.compute_ms + b.collective_ms
+                           + b.transfer_ms, 4)
+        n_events[side] = b.n_events
+    if not n_events["fast"] or not n_events["slow"]:
+        return {"checked": False, "reason": "no device events parsed",
+                "n_events": n_events}
+    verdict = "confirmed" if busy["fast"] < busy["slow"] else "rejected"
+    return {"checked": True, "verdict": verdict,
+            "fast_busy_ms": busy["fast"], "slow_busy_ms": busy["slow"],
+            "n_events": n_events}
+
+
+def _routing_cases(cfg):
+    """(family, shape_desc, dtype, kernel_fn, oracle_fn, args) per
+    measured shape class.  Smoke keeps the two cheapest families; full
+    covers every family kernel_bench maps (tools/kernel_bench.py
+    _OP_FAMILY)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import multi_tensor as mt
+    from apex_tpu.ops import welford as wf
+    cases = []
+    key = jax.random.key(0)
+
+    n = cfg["mt_n"]
+    p = jax.random.normal(key, (n,), jnp.float32)
+    g = jax.random.normal(jax.random.key(2), (n,), jnp.float32) * 0.01
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.01, step=3, adam_w_mode=True)
+    cases.append(("multi_tensor", f"flat_adam/n={n}", "f32",
+                  functools.partial(mt.flat_adam, **kw),
+                  functools.partial(mt.flat_adam_ref, **kw),
+                  (p, g, m, v)))
+
+    r, c = cfg["welford_shape"]
+    xw = jax.random.normal(key, (r, c), jnp.bfloat16)
+    cases.append(("welford", f"{r}x{c}", "bf16",
+                  wf.welford_mean_var, wf.welford_mean_var_ref, (xw,)))
+
+    if cfg["mode"] == "full":
+        from apex_tpu.ops import attention as attn
+        from apex_tpu.ops import layer_norm as ln
+        from apex_tpu.ops import softmax as sm
+        from apex_tpu.ops import xentropy as xe
+
+        def grad_of(f, n_args):
+            return jax.grad(
+                lambda *a: jnp.sum(f(*a).astype(jnp.float32) ** 2),
+                argnums=tuple(range(n_args)))
+
+        for (b, h, s, d) in cfg["attn_shapes"][:2]:
+            ks = jax.random.split(key, 3)
+            q, k, v_ = (jax.random.normal(kk, (b, h, s, d),
+                                          jnp.bfloat16) for kk in ks)
+            f_k = functools.partial(attn.flash_attention, causal=True)
+            f_o = functools.partial(attn.attention_ref, causal=True)
+            cases.append(("attention", f"b{b}h{h}s{s}d{d}", "bf16",
+                          grad_of(f_k, 3), grad_of(f_o, 3),
+                          (q, k, v_)))
+        qf, kf, vf = (jax.random.normal(kk, (8, 16, 512, 64),
+                                        jnp.float32)
+                      for kk in jax.random.split(jax.random.key(5), 3))
+        cases.append(("attention_f32", "b8h16s512d64", "f32",
+                      grad_of(functools.partial(attn.flash_attention,
+                                                causal=True), 3),
+                      grad_of(functools.partial(attn.attention_ref,
+                                                causal=True), 3),
+                      (qf, kf, vf)))
+        for (r_, hdim) in [(8192, 1024), (4096, 4096)]:
+            x = jax.random.normal(key, (r_, hdim), jnp.bfloat16)
+            w = jnp.ones((hdim,), jnp.bfloat16)
+            b_ = jnp.zeros((hdim,), jnp.bfloat16)
+            cases.append(("layer_norm", f"{r_}x{hdim}", "bf16",
+                          ln.fused_layer_norm, ln.layer_norm_ref,
+                          (x, w, b_)))
+        xs = jax.random.normal(key, (8 * 16, 512, 512), jnp.bfloat16)
+        cases.append(("softmax", "128x512x512", "bf16",
+                      functools.partial(
+                          sm.scaled_upper_triang_masked_softmax,
+                          scale=1.0),
+                      functools.partial(
+                          sm.scaled_upper_triang_masked_softmax_ref,
+                          scale=1.0), (xs,)))
+        logits = jax.random.normal(key, (4096, 32768), jnp.bfloat16)
+        labels = jax.random.randint(jax.random.key(1), (4096,), 0,
+                                    32768)
+        cases.append(("xentropy", "4096x32768", "bf16",
+                      lambda l: xe.softmax_cross_entropy(l, labels),
+                      lambda l: xe.softmax_cross_entropy_ref(l, labels),
+                      (logits,)))
+    return cases
+
+
+def sweep_routing(cfg, noise_pct: float, outdir: str) -> list:
+    """Pallas-vs-XLA-oracle routing per family × shape class.  A
+    family flips to the XLA path only when some shape lost beyond the
+    noise floor AND (where a device check ran) the edge survives in
+    the device timeline."""
+    records = []
+    by_family = {}
+    for fam, shape, dtype, kern, oracle, args in _routing_cases(cfg):
+        k_ms = _time(kern, *args, cfg=cfg)
+        o_ms = _time(oracle, *args, cfg=cfg)
+        rec = {"space": "routing", "family": fam, "shape": shape,
+               "dtype": dtype, "kernel_ms": round(k_ms, 4),
+               "oracle_ms": round(o_ms, 4),
+               "speedup": round(o_ms / k_ms, 3) if k_ms else None,
+               "noise_floor_pct": noise_pct}
+        records.append(rec)
+        by_family.setdefault(fam, []).append(
+            (rec, kern, oracle, args))
+
+    for fam, shapes in by_family.items():
+        sps = [r["speedup"] for r, *_ in shapes
+               if r["speedup"] is not None]
+        lost = [x for x in sps if x < 1.0 - noise_pct / 100.0]
+        prefer = not lost
+        if lost and fam in cfg["device_check_families"]:
+            # cross-check the WORST shape's verdict on the device
+            # timeline before routing the whole family off Pallas
+            worst = min(shapes, key=lambda s: s[0]["speedup"] or 1.0)
+            rec, kern, oracle, args = worst
+            check = device_event_check(
+                f"routing_{fam}", fast=(oracle, args),
+                slow=(kern, args), outdir=outdir)
+            rec["device_check"] = check
+            if check.get("checked") and check["verdict"] == "rejected":
+                prefer = True
+                rec["rejected_as_noise"] = True
+        for rec, *_ in shapes:
+            rec["decision"] = {"prefer_pallas": {fam: prefer}}
+    return records
+
+
+def sweep_attn_caps(cfg, noise_pct: float) -> list:
+    """Flash-attention sequence-block-cap sweep (the kernel_bench
+    --sweep-attn grid through the same amortized timer); winner per
+    padded head dim via kernel_bench.select_attn_caps (a cap must be
+    measured on EVERY swept shape of its dp to win)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import attention as attn
+    kb = _load_sibling("kernel_bench")
+    records = []
+    sweep_times = {}
+    for (b, h, s, d) in cfg["attn_shapes"]:
+        ks = jax.random.split(jax.random.key(7), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+                   for kk in ks)
+        dp = attn._round_up(d, attn._LANES)
+        if cfg["attn_grad"]:
+            fn = jax.grad(
+                lambda q, k, v: jnp.sum(attn.flash_attention(
+                    q, k, v, causal=True).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))
+        else:
+            fn = functools.partial(attn.flash_attention, causal=True)
+        shape_ms = {}
+        # save/restore an operator's own cap override (the pop-only
+        # shape would delete it for the rest of the process)
+        prev_cap = _os.environ.get("APEX_TPU_ATTN_BLOCK_CAP")
+        for cap in cfg["attn_caps"]:
+            if (cap > attn._round_up(s, attn._LANES)
+                    or cap > attn._sweep_cap_ceiling(dp)):
+                continue
+            _os.environ["APEX_TPU_ATTN_BLOCK_CAP"] = str(cap)
+            try:
+                # re-jit per cap ON PURPOSE: the env knob changes
+                # kernel geometry (apexlint: disable-next=APX302)
+                ms = _time(fn, q, k, v, cfg=cfg)
+            except Exception as e:
+                records.append({"space": "attn_block_cap",
+                                "family": "attention",
+                                "shape": f"b{b}h{h}s{s}d{d}",
+                                "cap": cap, "error": repr(e)[:200]})
+                continue
+            finally:
+                if prev_cap is None:
+                    _os.environ.pop("APEX_TPU_ATTN_BLOCK_CAP", None)
+                else:
+                    _os.environ["APEX_TPU_ATTN_BLOCK_CAP"] = prev_cap
+            shape_ms[cap] = ms
+        if not shape_ms:
+            continue
+        best = min(shape_ms.values())
+        for cap, ms in shape_ms.items():
+            sweep_times.setdefault((dp, cap), []).append(ms / best)
+        records.append({"space": "attn_block_cap",
+                        "family": "attention",
+                        "shape": f"b{b}h{h}s{s}d{d}", "dtype": "bf16",
+                        "dp": dp, "noise_floor_pct": noise_pct,
+                        "candidates_ms": {str(c): round(m, 4)
+                                          for c, m in shape_ms.items()}})
+    caps = kb.select_attn_caps(sweep_times)
+    if caps:
+        records.append({"space": "attn_block_cap", "family": "attention",
+                        "decision": {"attn_block_cap": caps}})
+    return records
+
+
+def sweep_pipeline_chunk(cfg, noise_pct: float, outdir: str) -> list:
+    """``max_bucket_bytes`` candidates through a full flat-AMP train
+    step (pack → unscale/norm → fused optimizer) on a many-leaf tree;
+    the monolithic plan (None) is the design default and a chunked
+    winner must beat it beyond the noise floor."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.bucketing_bench import (many_leaf_loss,
+                                                     many_leaf_params)
+    params = many_leaf_params(jax, jnp, cfg["pipe_layers"],
+                              cfg["pipe_hidden"])
+    x = jax.random.normal(jax.random.key(1),
+                          (cfg["pipe_batch"], cfg["pipe_hidden"]))
+    scaler = amp.LossScaleState.create(2.0 ** 12)
+    # the SAME toy model bench_grad_accum measures (and the budget row
+    # this sweep restamps) — see bucketing_bench.many_leaf_loss
+    loss_fn = many_leaf_loss(jnp)
+
+    times, steps = {}, {}
+    for mbb in cfg["chunk_candidates"]:
+        opt = FusedAdam(params, lr=1e-3, max_bucket_bytes=mbb)
+        pipe = amp.FlatGradPipeline(optimizer=opt)
+        hypers = {k: jnp.asarray(v, jnp.float32)
+                  for k, v in opt.hypers.items()
+                  if isinstance(v, float)}
+
+        def step(work, opt_state, x, s, pipe=pipe, opt=opt,
+                 hypers=hypers):
+            loss, flat = pipe.scaled_value_and_grad(
+                loss_fn, scaler, pipe.plan.unpack(work), x)
+            new_w, _, new_s = opt._full_step_flat(
+                work, None, opt_state, flat.bufs, s, 1.0, hypers,
+                flat.found_inf)
+            return loss, new_w, new_s
+
+        # each candidate is its own bucket layout, so its own program
+        # by design (apexlint: disable-next=APX302)
+        times[mbb] = _time(step, opt._param_bufs, opt.opt_state, x,
+                           jnp.int32(2), cfg=cfg)
+        steps[mbb] = (step, (opt._param_bufs, opt.opt_state, x,
+                             jnp.int32(2)))
+
+    default_ms = times[None] if None in times else None
+    winner = min(times, key=times.get)
+    rec = {"space": "pipeline.max_bucket_bytes", "family": "pipeline",
+           "shape": f"{cfg['pipe_layers']}layers"
+                    f"x{cfg['pipe_hidden']}", "dtype": "f32",
+           "noise_floor_pct": noise_pct,
+           "candidates_ms": {str(k): round(v, 4)
+                             for k, v in times.items()}}
+    if winner is not None and default_ms is not None \
+            and times[winner] < default_ms * (1.0 - noise_pct / 100.0):
+        if "pipeline" in cfg["device_check_families"]:
+            check = device_event_check(
+                "pipeline_chunk", fast=steps[winner],
+                slow=steps[None], outdir=outdir)
+            rec["device_check"] = check
+            if check.get("checked") and check["verdict"] == "rejected":
+                rec["rejected_as_noise"] = True
+                return [rec]
+        rec["decision"] = {"pipeline": {"max_bucket_bytes": winner}}
+    return [rec]
+
+
+def sweep_reduce_decompose(cfg, noise_pct: float) -> list:
+    """psum vs reduce-scatter+all-gather for the bucketed all-reduce,
+    timed under shard_map over every local device; psum is the design
+    default and reduce_scatter must win beyond the noise floor."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import comm
+    from apex_tpu.parallel.distributed import all_reduce_flat_buffers
+    comm.destroy()
+    mesh = comm.initialize(data=jax.device_count())
+    try:
+        buf = jax.random.normal(jax.random.key(3), (cfg["reduce_n"],),
+                                jnp.float32)
+        times = {}
+        for dec in _REDUCE_CHOICES:
+            def f(b, dec=dec):
+                return all_reduce_flat_buffers(
+                    [b], comm.AXIS_DATA, decompose=dec)[0]
+            # the two decompositions are two programs by design
+            # (apexlint: disable-next=APX302)
+            fn = comm.shard_map(f, mesh, in_specs=(P(),), out_specs=P())
+            times[dec] = _time(fn, buf, cfg=cfg)
+    finally:
+        comm.destroy()
+    rec = {"space": "pipeline.reduce_decompose", "family": "pipeline",
+           "shape": f"n={cfg['reduce_n']}/dev{jax.device_count()}",
+           "dtype": "f32", "noise_floor_pct": noise_pct,
+           "candidates_ms": {k: round(v, 4) for k, v in times.items()}}
+    if times["reduce_scatter"] < times["psum"] * (1.0
+                                                  - noise_pct / 100.0):
+        rec["decision"] = {"pipeline":
+                           {"reduce_decompose": "reduce_scatter"}}
+    return [rec]
+
+
+def measure_budget_rows(cfg) -> dict:
+    """Sweep measurements that ground perf_budget rows (dotted metric
+    path -> value).  grad_accum_n8_speedup comes from the same flat-vs-
+    per-leaf accumulation legs bench.py reports, at this config's
+    scale."""
+    from apex_tpu.optimizers.bucketing_bench import bench_grad_accum
+    r = bench_grad_accum(**cfg["accum"])
+    out = {}
+    if "grad_accum_n8_speedup" in r:
+        out["extra.grad_accum_n8_speedup"] = r["grad_accum_n8_speedup"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# table assembly + decision-change demonstration
+# ---------------------------------------------------------------------------
+
+def build_table(records, topology: dict, backend: str,
+                noise_pct: float, mode: str) -> dict:
+    """Fold sweep records into one schema-versioned per-topology prefs
+    doc (the layout ops/_dispatch.py selects by runtime topology)."""
+    prefer, caps, pipeline, speedups = {}, {}, {}, {}
+    for rec in records:
+        if rec.get("space") == "routing" and rec.get("speedup") \
+                is not None:
+            speedups.setdefault(rec["family"], []).append(
+                rec["speedup"])
+        dec = rec.get("decision")
+        if not dec:
+            continue
+        prefer.update(dec.get("prefer_pallas", {}))
+        caps.update(dec.get("attn_block_cap", {}))
+        pipeline.update(dec.get("pipeline", {}))
+    return {
+        "schema": SCHEMA_VERSION,
+        "methodology": "amortized",
+        "source": "tools/autotune.py",
+        "mode": mode,
+        "backend": backend,
+        "generated_at": _now(),
+        "topology": topology,
+        "noise_floor_pct": noise_pct,
+        "prefer_pallas": prefer,
+        "attn_block_cap": caps,
+        "pipeline": pipeline,
+        "speedups": {k: sorted(v) for k, v in speedups.items()},
+        "sweep": {"records": records},
+    }
+
+
+def demonstrate_decision_changes(doc) -> list:
+    """Install the table through the new accessor and report every
+    dispatch decision it changes vs the uninstalled (file-backed /
+    default) state — the proof the sweep's output actually steers.
+    Restores the prior installed state."""
+    from apex_tpu.ops import _dispatch
+
+    prev = _dispatch._INSTALLED
+    try:
+        _dispatch.install_prefs(None)
+        # probe a FIXED decision set (union of both tables' keys, so a
+        # per-topology table that DROPS a default-table entry — back to
+        # the design default — counts as the decision change it is)
+        base = _dispatch.dispatch_tables()
+        fams = sorted(set(doc.get("prefer_pallas", {}))
+                      | set(base.prefer_pallas)
+                      | {"multi_tensor", "welford", "attention"})
+        dps = sorted(set(doc.get("attn_block_cap", {}))
+                     | set(base.attn_block_cap))
+
+        def snapshot():
+            out = {}
+            for f in fams:
+                out[f"op_enabled:{f}"] = _dispatch.op_enabled(f)
+            for dp in dps:
+                out[f"attn_block_cap:{dp}"] = \
+                    _dispatch.attn_block_cap(dp)
+            out["pipeline:max_bucket_bytes"] = _dispatch.pipeline_pref(
+                "max_bucket_bytes")
+            out["pipeline:reduce_decompose"] = _dispatch.pipeline_pref(
+                "reduce_decompose", "psum")
+            return out
+
+        before = snapshot()
+        _dispatch.install_prefs(doc)
+        after = snapshot()
+    finally:
+        _dispatch._INSTALLED = prev
+        _dispatch.invalidate_prefs_cache()
+    return [{"decision": k, "before": before[k], "after": after[k]}
+            for k in before if before[k] != after[k]]
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_SWEPT_FAMILIES = ("multi_tensor", "welford", "attention",
+                   "attention_f32", "layer_norm", "softmax", "xentropy")
+
+
+def run_sweep(cfg, out_dir: str, budget_path: str,
+              install: bool) -> dict:
+    """The whole pipeline: sweep -> per-topology table -> decision-
+    change demonstration -> budget restamp.  Returns the summary dict
+    (also written to <out>/autotune_summary.json)."""
+    import jax
+
+    from apex_tpu.ops import _dispatch
+    from apex_tpu.platform import enable_compilation_cache, \
+        select_platform
+    select_platform()
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    topology = _dispatch.topology_block()
+    _os.makedirs(out_dir, exist_ok=True)
+
+    # pin every family to its Pallas path WHILE TIMING (kernel_bench
+    # discipline: a previously written table must not make the
+    # "kernel" leg silently measure the oracle)
+    prev_pin = _os.environ.get("APEX_TPU_PREFER_PALLAS")
+    _os.environ["APEX_TPU_PREFER_PALLAS"] = ",".join(_SWEPT_FAMILIES)
+    try:
+        noise_pct = measure_noise_floor(cfg)
+        records = []
+        records += sweep_routing(cfg, noise_pct, out_dir)
+        records += sweep_attn_caps(cfg, noise_pct)
+        records += sweep_pipeline_chunk(cfg, noise_pct, out_dir)
+        records += sweep_reduce_decompose(cfg, noise_pct)
+        budget_rows = measure_budget_rows(cfg)
+    finally:
+        if prev_pin is None:
+            _os.environ.pop("APEX_TPU_PREFER_PALLAS", None)
+        else:
+            _os.environ["APEX_TPU_PREFER_PALLAS"] = prev_pin
+
+    doc = build_table(records, topology, backend, noise_pct,
+                      cfg["mode"])
+    # the writer must never emit a table its own validator (and thus
+    # check.sh) would reject
+    errs = validate_table(doc, per_topology=True)
+    if errs:
+        raise RuntimeError(f"autotune produced an invalid table: {errs}")
+
+    table_path = _os.path.join(out_dir,
+                               f"dispatch_prefs.{topology['key']}.json")
+    with open(table_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    # demonstrate BEFORE installing into the live ops directory: the
+    # baseline snapshot must see the pre-sweep state, or an installed
+    # run would compare the new table against itself (zero changes)
+    changes = demonstrate_decision_changes(doc)
+    installed_path = None
+    if install:
+        installed_path = _dispatch.topology_prefs_path(topology["key"])
+        with open(installed_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        _dispatch.invalidate_prefs_cache()
+
+    with open(budget_path, encoding="utf-8") as f:
+        budget = json.load(f)
+    when = _now()
+    restamped = restamp_budget(
+        budget, budget_rows, topology=topology["key"], backend=backend,
+        noise_floor_pct=noise_pct, mode=cfg["mode"], when=when)
+    budget_out = (budget_path if install
+                  else _os.path.join(out_dir, "perf_budget.json"))
+    with open(budget_out, "w") as f:
+        json.dump(budget, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    summary = {"mode": cfg["mode"], "backend": backend,
+               "topology": topology, "noise_floor_pct": noise_pct,
+               "table": table_path, "installed": installed_path,
+               "decision_changes": changes,
+               "budget": budget_out, "budget_rows_restamped": restamped,
+               "budget_measurements": budget_rows,
+               "records": len(records)}
+    with open(_os.path.join(out_dir, "autotune_summary.json"),
+              "w") as f:
+        json.dump({**summary, "sweep_records": records}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-topology kernel autotuner "
+                    "(see module docstring)")
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--cpu-smoke", action="store_true",
+                      help="deterministic tiny sweep; writes table + "
+                           "restamped budget copy into --out only")
+    mode.add_argument("--full", action="store_true",
+                      help="hardware sweep; installs the per-topology "
+                           "table and restamps tools/perf_budget.json")
+    mode.add_argument("--validate", nargs="*", metavar="FILE",
+                      help="schema-check dispatch_prefs*.json "
+                           "(default: every shipped table)")
+    ap.add_argument("--out", default=DEFAULT_OUT,
+                    help="artifact directory (cpu-smoke writes here "
+                         "INSTEAD of the repo tables)")
+    ap.add_argument("--budget", default=BUDGET_PATH)
+    args = ap.parse_args(argv)
+
+    if args.validate is not None:
+        errs = validate_paths(args.validate)
+        if errs:
+            for e in errs:
+                print(f"autotune --validate: {e}", file=_sys.stderr)
+            return 1
+        n = len(args.validate) if args.validate else len(glob.glob(
+            _os.path.join(_ROOT, "apex_tpu", "ops",
+                          "dispatch_prefs*.json")))
+        print(f"autotune --validate: {n} table(s) schema-valid")
+        return 0
+
+    if args.cpu_smoke:
+        # interpret-mode determinism: same kernels, no hardware needed
+        _os.environ.setdefault("APEX_TPU_PALLAS_INTERPRET", "1")
+        cfg = smoke_config()
+        summary = run_sweep(cfg, args.out, args.budget, install=False)
+    else:
+        cfg = full_config()
+        import jax
+
+        from apex_tpu.platform import select_platform
+        select_platform()
+        if jax.default_backend() != "tpu":
+            print(json.dumps({
+                "error": "--full needs TPU hardware (interpret-mode "
+                         "timings must never steer real dispatch); "
+                         "use --cpu-smoke to exercise the plumbing",
+                "backend": jax.default_backend()}))
+            return 2
+        summary = run_sweep(cfg, args.out, args.budget, install=True)
+
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

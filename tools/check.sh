@@ -24,11 +24,18 @@ python -m apex_tpu.lint --semantic apex_tpu/
 echo "== apexlint relaxed profile: tests/ examples/ tools/"
 python -m apex_tpu.lint --relax-test-bodies tests/ examples/ tools/
 
+echo "== dispatch prefs: schema-validate shipped dispatch_prefs*.json"
+# a hand-edited table must fail HERE, not be silently discarded at
+# import (the ops/_dispatch.py tolerance would fall back to design
+# defaults with only a RuntimeWarning); stdlib-only, milliseconds
+python tools/autotune.py --validate
+
 echo "== perf_gate: BENCH trajectory vs tools/perf_budget.json"
-# report-only until a fresh live-TPU window restamps the budget: the
-# cached r04/r05 numbers predate the flat pipeline, so gating on them
-# would block exactly the PRs item 2 needs.  Flip --report off once
-# live numbers return.
-python tools/perf_gate.py --report
+# auto mode: gates exactly when the newest BENCH round is a hardware
+# round measured after the budget's stamped_at (a fresh live-TPU
+# window — tools/autotune.py --full restamps the budget from it);
+# the cached pre-flat-pipeline rounds stay report-only so they cannot
+# block the PRs that will re-measure them.
+python tools/perf_gate.py
 
 echo "check.sh: all gates clean"

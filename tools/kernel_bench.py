@@ -119,12 +119,13 @@ def _load_trusted_doc(path):
         return {}
     if out.get("methodology") != "amortized":
         for stale in ("prefer_pallas", "speedups", "attn_block_cap",
-                      "backend", "attn_sweep_backend"):
+                      "backend", "attn_sweep_backend", "topology",
+                      "noise_floor_pct", "schema", "pipeline"):
             out.pop(stale, None)
     return out
 
 
-def write_prefs(rows, path):
+def write_prefs(rows, path, topology=None, noise_floor_pct=None):
     """Distill measured rows into the dispatch preference table
     (VERDICT r2 #2): an op family prefers Pallas only if NO measured
     shape was slower than its XLA oracle (speedup < 1.0 anywhere ->
@@ -132,7 +133,14 @@ def write_prefs(rows, path):
 
     Read-modify-write: the same file carries the sweep's
     attn_block_cap table, which a plain --write-prefs run (or the
-    sweep-then-prefs order inside one run) must not erase."""
+    sweep-then-prefs order inside one run) must not erase.
+
+    ``topology`` (the ops._dispatch.topology_block() dict) and
+    ``noise_floor_pct`` (benchlib.noise_floor_pct) stamp WHERE and HOW
+    REPEATABLY the table was measured, making hand-run bench output
+    schema-compatible with tools/autotune.py's per-topology tables
+    (and topology-checked at load: a table benched on one fleet never
+    silently steers another)."""
     fam = {}
     for r in rows:
         base = r["kernel"].removesuffix("_grad")
@@ -151,6 +159,11 @@ def write_prefs(rows, path):
                 "methodology": "amortized",
                 "backend": rows[0]["backend"] if rows else "unknown",
                 "speedups": {op: sorted(sp) for op, sp in fam.items()}})
+    if topology is not None:
+        out["topology"] = topology
+        out["schema"] = 2        # == ops._dispatch.SCHEMA_VERSION
+    if noise_floor_pct is not None:
+        out["noise_floor_pct"] = round(float(noise_floor_pct), 2)
     with open(path, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)
         f.write("\n")
@@ -205,6 +218,16 @@ def main():
 
     rows = []
     key = jax.random.key(0)
+
+    # session noise floor: the amortized timer's measured repeatability
+    # on a representative fused body, stamped into any table this run
+    # writes — a dispatch decision must never flip on an edge inside it
+    from apex_tpu.benchlib import noise_floor_pct
+    xnf = jax.random.normal(key, (4096, 256), jnp.bfloat16)
+    noise_pct = round(noise_floor_pct(
+        lambda t: jnp.sum(t.astype(jnp.float32) ** 2), xnf), 2)
+    print(json.dumps({"noise_floor_pct": noise_pct,
+                      "backend": backend}), flush=True)
 
     # flash attention: bench shapes (BERT-L-ish and long-context)
     for (b, h, s, d) in [(8, 16, 512, 64), (4, 16, 2048, 128),
@@ -343,6 +366,9 @@ def main():
             prefs_doc.setdefault("source", "tools/kernel_bench.py")
             prefs_doc.setdefault("attn_block_cap", {}).update(caps_out)
             prefs_doc["attn_sweep_backend"] = backend
+            prefs_doc["topology"] = _dispatch.topology_block()
+            prefs_doc["schema"] = _dispatch.SCHEMA_VERSION
+            prefs_doc["noise_floor_pct"] = noise_pct
             # the sweep times with the same amortized timer; a
             # sweep-only run must still produce a table _load_prefs
             # will trust (see write_prefs)
@@ -541,7 +567,10 @@ def main():
             w.writerows(rows)
     if args.write_prefs:
         from apex_tpu.ops import _dispatch
-        prefs = write_prefs(rows, _dispatch._PREFS_PATH)
+        prefs = write_prefs(rows, _dispatch._PREFS_PATH,
+                            topology=_dispatch.topology_block(),
+                            noise_floor_pct=noise_pct)
+        _dispatch.invalidate_prefs_cache()
         print(json.dumps({"prefs_written": prefs}), flush=True)
 
 

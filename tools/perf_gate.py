@@ -36,6 +36,14 @@ Only real hardware rounds count (``backend`` "tpu" or "tpu-cached",
 positive value): the CPU-fallback liveness lines prove the harness,
 not performance, and a cached round re-served across windows compares
 equal to itself (no false regression while the tunnel is down).
+
+**Gating is automatic**: with neither ``--report`` nor ``--gate``, the
+gate flips on exactly when the newest BENCH round is a hardware round
+measured AFTER the budget's ``stamped_at`` date — fresh hardware
+numbers must be defended, while the cached pre-flat-pipeline rounds
+(whose capture date the budget was stamped from) stay report-only so
+they cannot block the PRs that will re-measure them.  The chosen mode
+and its reason are always printed.
 """
 
 from __future__ import annotations
@@ -163,6 +171,50 @@ def _check(name: str, spec: dict,
     return verdict
 
 
+def round_when(parsed: dict) -> Optional[str]:
+    """ISO capture timestamp of one bench line: live rounds carry
+    ``measured_at``; cached rounds re-serve the original window's
+    stamp as ``extra.cached_measured_at``."""
+    when = parsed.get("measured_at")
+    if isinstance(when, str) and when:
+        return when
+    extra = parsed.get("extra")
+    if isinstance(extra, dict):
+        when = extra.get("cached_measured_at")
+        if isinstance(when, str) and when:
+            return when
+    return None
+
+
+def choose_mode(budget: dict,
+                rounds: List[Tuple[int, dict]]) -> Tuple[bool, str]:
+    """(gating, reason) for auto mode: gate exactly when the newest
+    BENCH round is a hardware round measured after the budget's
+    ``stamped_at`` (ISO strings compare lexicographically).  Anything
+    unprovable — no rounds, a CPU newest round, missing timestamps —
+    stays report-only, loudly."""
+    if not rounds:
+        return False, "report-only: no BENCH rounds found"
+    n, parsed = rounds[-1]
+    if parsed.get("backend") not in _HW_BACKENDS \
+            or _numeric(parsed.get("value")) <= 0:
+        return False, (f"report-only: newest round r{n:02d} is not a "
+                       "hardware round")
+    when = round_when(parsed)
+    stamped = budget.get("stamped_at")
+    if not when or not isinstance(stamped, str) or not stamped:
+        return False, (f"report-only: cannot compare newest round "
+                       f"r{n:02d} ({when or 'no timestamp'}) against "
+                       f"budget stamp ({stamped or 'no stamped_at'})")
+    if when > stamped:
+        return True, (f"gating: newest hardware round r{n:02d} "
+                      f"({when}) postdates the budget stamp "
+                      f"({stamped}) — fresh numbers are defended")
+    return False, (f"report-only: newest hardware round r{n:02d} "
+                   f"({when}) does not postdate the budget stamp "
+                   f"({stamped}); the budget already covers it")
+
+
 def evaluate(budget: dict,
              rounds: List[Tuple[int, dict]]) -> List[dict]:
     hw = hardware_rounds(rounds)
@@ -178,9 +230,10 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=_ROOT,
                     help="directory holding BENCH_r*.json")
     ap.add_argument("--report", action="store_true",
-                    help="report-only: print verdicts, always exit 0 "
-                         "(tools/check.sh mode until fresh TPU numbers "
-                         "exist)")
+                    help="force report-only: print verdicts, always "
+                         "exit 0")
+    ap.add_argument("--gate", action="store_true",
+                    help="force gating regardless of round/stamp dates")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -192,6 +245,12 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rounds = load_rounds(args.root)
+    if args.report:
+        gating, reason = False, "report-only: forced by --report"
+    elif args.gate:
+        gating, reason = True, "gating: forced by --gate"
+    else:
+        gating, reason = choose_mode(budget, rounds)
     verdicts = evaluate(budget, rounds)
     # stale (metric vanished from the newest hardware round) gates
     # like a regression: a crashed leg must not pass
@@ -203,11 +262,12 @@ def main(argv=None) -> int:
                           "hardware_rounds":
                           [n for n, _ in hardware_rounds(rounds)],
                           "regressions": len(regressions),
-                          "gating": not args.report}))
+                          "gating": gating, "mode_reason": reason}))
     else:
         hw = hardware_rounds(rounds)
         print(f"perf_gate: {len(hw)} hardware round(s) "
               f"{[n for n, _ in hw]} of {len(rounds)} total")
+        print(f"perf_gate: {reason}")
         for v in verdicts:
             line = f"  {v['status']:<10} {v['metric']}"
             if v.get("newest") is not None:
@@ -223,10 +283,10 @@ def main(argv=None) -> int:
         if regressions:
             print(f"perf_gate: {len(regressions)} above-noise "
                   "regression(s)"
-                  + (" (report-only, not gating)" if args.report else ""))
+                  + ("" if gating else " (report-only, not gating)"))
         else:
             print("perf_gate: trajectory clean")
-    return 0 if (args.report or not regressions) else 1
+    return 0 if (not gating or not regressions) else 1
 
 
 if __name__ == "__main__":
