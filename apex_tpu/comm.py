@@ -192,6 +192,39 @@ def initialize_distributed(
     return initialize(**mesh_axes)
 
 
+def _rebuild_mesh_over(hosts: Sequence[int],
+                       devices: Optional[Sequence[jax.Device]],
+                       verb: str) -> Mesh:
+    """Re-initialize the global mesh over the devices of ``hosts`` —
+    the shared mesh half of shrink-to-healthy-mesh recovery AND its
+    inverse, admission-driven grow.  The DATA axis absorbs the size
+    change; pipe/ctx/model are preserved while the new device count
+    still divides by them, else the rebuild falls back to
+    all-data-parallel (a restore through the ``sharding=`` reshard
+    flow is valid on any mesh, so correctness never depends on
+    preserving the old layout)."""
+    alive = set(int(h) for h in hosts)
+    if devices is None:
+        devices = [d for d in jax.devices()
+                   if getattr(d, "process_index", 0) in alive]
+        if not devices:
+            # faked multi-host (or a host set naming no local
+            # process): never hand initialize() an empty device list
+            devices = list(jax.devices())
+    cfg = _CONFIG
+    pipe, ctx, model = ((cfg.pipe, cfg.ctx, cfg.model) if cfg is not None
+                        else (1, 1, 1))
+    if len(devices) % max(1, pipe * ctx * model) != 0:
+        import warnings
+        warnings.warn(
+            f"{verb}_mesh: {len(devices)} member devices not "
+            f"divisible by pipe*ctx*model={pipe * ctx * model}; "
+            "rebuilding all-data-parallel")
+        pipe = ctx = model = 1
+    return initialize(data=-1, pipe=pipe, ctx=ctx, model=model,
+                      devices=devices)
+
+
 def shrink_mesh(survivors: Sequence[int],
                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Re-initialize the global mesh over the devices of the surviving
@@ -212,26 +245,24 @@ def shrink_mesh(survivors: Sequence[int],
     devices — the shrink is then exercised at the protocol layer
     (agreement, restore, counters) with the mesh rebuilt in place.
     """
-    alive = set(int(h) for h in survivors)
-    if devices is None:
-        devices = [d for d in jax.devices()
-                   if getattr(d, "process_index", 0) in alive]
-        if not devices:
-            # faked multi-host (or a survivor set naming no local
-            # process): never hand initialize() an empty device list
-            devices = list(jax.devices())
-    cfg = _CONFIG
-    pipe, ctx, model = ((cfg.pipe, cfg.ctx, cfg.model) if cfg is not None
-                        else (1, 1, 1))
-    if len(devices) % max(1, pipe * ctx * model) != 0:
-        import warnings
-        warnings.warn(
-            f"shrink_mesh: {len(devices)} surviving devices not "
-            f"divisible by pipe*ctx*model={pipe * ctx * model}; "
-            "rebuilding all-data-parallel")
-        pipe = ctx = model = 1
-    return initialize(data=-1, pipe=pipe, ctx=ctx, model=model,
-                      devices=devices)
+    return _rebuild_mesh_over(survivors, devices, "shrink")
+
+
+def grow_mesh(members: Sequence[int],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The inverse of :func:`shrink_mesh`: re-initialize the global
+    mesh over the devices of the agreed member set after an admission
+    round re-admitted a recovered host (or admitted a new one) —
+    ``resilience.fleet.agree_admission`` /
+    ``run_elastic(fleet=...)``'s grow recovery.
+
+    The DATA axis absorbs the growth (more data-parallel replicas),
+    pipe/ctx/model are preserved while the larger device count still
+    divides by them.  The restored state then reshards onto the grown
+    mesh through the same ``sharding=`` restore flow shrink recovery
+    uses — a checkpoint written on N devices restores onto more just
+    as it restores onto fewer."""
+    return _rebuild_mesh_over(members, devices, "grow")
 
 
 def process_index() -> int:

@@ -351,12 +351,14 @@ def _build_scaled_value_and_grad():
 
 
 def _instrumented_step_jaxpr(with_watchdog: bool = False,
-                             with_fleet: bool = False):
+                             with_fleet: bool = False,
+                             with_controller: bool = False):
     """The telemetry-instrumented flat-AMP step's jaxpr, optionally
-    with a resilience watchdog and/or a fleet monitor attached to the
-    session — both are host-side (window-cadence detectors; out-of-band
-    beacons), so the traced program must be byte-for-byte free of
-    callbacks/transfers either way."""
+    with a resilience watchdog, a fleet monitor and/or a fleet
+    autoscale controller attached to the session — all are host-side
+    (window-cadence detectors; out-of-band beacons; window-flush
+    decision policy), so the traced program must be byte-for-byte free
+    of callbacks/transfers either way."""
     import jax
     import jax.numpy as jnp
     from apex_tpu import amp, telemetry
@@ -370,17 +372,24 @@ def _instrumented_step_jaxpr(with_watchdog: bool = False,
     tel = telemetry.Telemetry(run_dir=None, window=8, retrace=False)
     wd = None
     mon = None
+    ctrl = None
     try:
         if with_watchdog:
             from apex_tpu.resilience.watchdog import Watchdog
             wd = Watchdog(telemetry=tel)
-        if with_fleet:
+        if with_fleet or with_controller:
             from apex_tpu.resilience import fleet as fleet_mod
             mon = fleet_mod.FleetMonitor(
                 channel=fleet_mod.LocalChannel(), host=0, n_hosts=2,
                 slow_after_steps=4, dead_after_steps=8,
                 slow_after_s=None, dead_after_s=None, telemetry=tel)
             mon.beat(0)           # beacons are published host-side
+        if with_controller:
+            from apex_tpu.resilience import fleet as fleet_mod
+            ctrl = fleet_mod.FleetController(
+                telemetry=tel, step_time_high_s=60.0)
+            ctrl.note_step(0, 0.1)        # host-side intake
+            ctrl.decide(0, n_hosts=2)     # host-side decision
 
         def train_step(work_bufs, opt_state, scaler, x, step):
             ptree = opt._plan.unpack_model(work_bufs)
@@ -396,6 +405,8 @@ def _instrumented_step_jaxpr(with_watchdog: bool = False,
             tel.buf, jnp.int32(0), opt._param_bufs, opt.opt_state,
             scaler, x, jnp.int32(1))
     finally:
+        if ctrl is not None:
+            ctrl.close()
         if mon is not None:
             mon.close()
         if wd is not None:
@@ -454,6 +465,28 @@ def _build_watchdog_instrumented_step():
 def _build_fleet_instrumented_step():
     return {
         "jaxpr": _instrumented_step_jaxpr(with_fleet=True),
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "dus_min": 1,             # the ring write, nothing more
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "fleet.autoscaled_step",
+    anchor="apex_tpu/resilience/fleet.py",
+    description="controller-observed instrumented flat AMP step: the "
+                "fleet autoscaler is a host-side window-flush "
+                "observer emitting typed grow/shrink/stay decisions, "
+                "so the traced step still contains ZERO "
+                "callback/transfer primitives — load-driven scaling "
+                "adds no per-step device syncs")
+def _build_fleet_autoscaled_step():
+    return {
+        "jaxpr": _instrumented_step_jaxpr(with_fleet=True,
+                                          with_controller=True),
         "expect": {
             "no_host_transfer": True,
             "no_f64": True,

@@ -556,6 +556,67 @@ class FusedOptimizerBase:
         from apex_tpu.amp.flat_pipeline import GradAccum
         return GradAccum.zeros(self._plan)
 
+    # ---- elastic re-chunking (fleet resize) ------------------------------
+    def rechunk(self, max_bucket_bytes) -> bool:
+        """Rebuild the :class:`BucketPlan` with a new
+        ``max_bucket_bytes`` chunking cap and repack the LIVE training
+        state (params, masters, every optimizer-state field) into the
+        new layout.
+
+        The elastic-resize hook: when the per-host HBM share changes
+        because the fleet grew or shrank (``run_elastic``'s
+        ``grow_max_bucket_bytes=``), the overlap schedule's chunk size
+        should track it (docs/perf.md).  Chunk boundaries always fall
+        on leaf boundaries, so the update math is bit-identical across
+        layouts — only the packing changes (the chunked-vs-monolithic
+        equivalence the overlap schedule already pins).  One eager
+        per-leaf unpack + repack per resize — a rare event by
+        construction.  Offloaded state round-trips through device for
+        the repack and lands back on host.  Callers holding a
+        ``FlatGradPipeline`` bound to the old plan must rebuild it
+        (the pipeline snapshots the plan at construction).  Returns
+        False (no-op) when the cap already matches."""
+        if self._plan is None:
+            raise RuntimeError(
+                "rechunk requires the bucketed path (fuse_buckets="
+                "True and a tree the packer accepted)")
+        if max_bucket_bytes == self._plan.max_bucket_bytes:
+            return False
+        params = self.params              # cached lazy unpack
+        masters = self.masters
+        state = self.opt_state
+        if self.offload_state:
+            state = place_on_device(state)
+        state_trees = {k: self._plan.unpack_state_field(v)
+                       for k, v in state.items()}
+        work = masters if masters is not None else params
+        self._plan = BucketPlan.from_tree(
+            work, params if masters is not None else None,
+            max_bucket_bytes=max_bucket_bytes)
+        self._param_bufs = self._plan.pack_model(params)
+        self._master_bufs = (self._plan.pack_work(masters)
+                             if masters is not None else None)
+        self._params_cache = params
+        self._masters_cache = masters
+        self._unpack_model_jit = jax.jit(self._plan.unpack_model)
+        self._unpack_work_jit = jax.jit(self._plan.unpack)
+        self.opt_state = {k: self._plan.pack_state_field(v)
+                          for k, v in state_trees.items()}
+        if self.offload_state:
+            self.opt_state = place_on_host(self.opt_state)
+        # fresh jit: the step body closes over the plan
+        if self._fused_offload:
+            # no donation: the state crosses memory kinds (__init__)
+            self._jit_step = jax.jit(  # apexlint: disable=APX401
+                self._full_step_offload,
+                out_shardings=(None, None,
+                               tree_map(_host_sharding,
+                                        self.opt_state)))
+        else:
+            self._jit_step = jax.jit(self._full_step_impl,
+                                     donate_argnums=(2,))
+        return True
+
     # ---- bucket-native checkpoint capture --------------------------------
     def packed_snapshot(self):
         """Checkpoint capture that NEVER unpacks: one async device-side

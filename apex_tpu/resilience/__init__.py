@@ -19,11 +19,16 @@ harness (SURVEY.md §5: the failure story the reference lacks).
   (bounded widening backoff, shared by ``run_elastic``'s transient
   retries and the watchdog's rollback budget);
 - :mod:`~apex_tpu.resilience.fleet` — :class:`FleetMonitor`
-  (out-of-band host liveness beacons classified live/slow/dead,
-  typed :class:`HostFailure` events, the barrier-free survivor
-  agreement round, and the deadline-armed step machinery —
+  (out-of-band host liveness beacons classified live/slow/dead with
+  sticky-dead keyed on ``(host, incarnation)``, typed
+  :class:`HostFailure` events, the barrier-free survivor AND
+  admission agreement rounds, and the deadline-armed step machinery —
   :class:`StepDeadlineExceeded` — behind ``run_elastic``'s
-  shrink-to-healthy-mesh recovery);
+  shrink-to-healthy-mesh recovery and its inverse, beacon-admitted
+  host rejoin with grow-capable resharding) plus
+  :class:`FleetController` (the load-driven fleet autoscaler:
+  typed :class:`ScaleDecision` grow/shrink/stay decisions with
+  hysteresis, executed through the same machinery);
 - :mod:`~apex_tpu.resilience.faults` — :class:`FaultInjector`
   (seeded schedules of torn writes, fsync errors, slow disks, full
   disks, preemption signals, crash-before-publish, the training-state
@@ -33,8 +38,10 @@ harness (SURVEY.md §5: the failure story the reference lacks).
 """
 
 from apex_tpu.resilience.elastic import ElasticResult, run_elastic
-from apex_tpu.resilience.fleet import (FleetMonitor, FleetRecoveryFailed,
-                                       HostFailure, StepDeadlineExceeded)
+from apex_tpu.resilience.fleet import (FleetController, FleetMonitor,
+                                       FleetRecoveryFailed, HostFailure,
+                                       ScaleDecision,
+                                       StepDeadlineExceeded)
 from apex_tpu.resilience.manager import CheckpointManager
 from apex_tpu.resilience.preemption import PreemptionGuard
 from apex_tpu.resilience.retry import RetryPolicy
@@ -45,11 +52,13 @@ __all__ = [
     "Anomaly",
     "CheckpointManager",
     "ElasticResult",
+    "FleetController",
     "FleetMonitor",
     "FleetRecoveryFailed",
     "HostFailure",
     "PreemptionGuard",
     "RetryPolicy",
+    "ScaleDecision",
     "StepDeadlineExceeded",
     "Watchdog",
     "WatchdogAbort",

@@ -104,6 +104,8 @@ class ElasticResult:
     restored_from: Optional[int]    # initial resume step (None: fresh)
     rollbacks: int = 0              # watchdog rollback-and-replays
     mesh_shrinks: int = 0           # shrink-to-healthy-mesh recoveries
+    #                                 (failure-driven + autoscaler)
+    mesh_grows: int = 0             # admission-driven mesh grows
 
 
 def run_elastic(step_fn: Callable[[int], Any],
@@ -120,6 +122,11 @@ def run_elastic(step_fn: Callable[[int], Any],
                                      "_fleet.DeadlineCalibrator"] = None,
                 on_shrink: Optional[Callable] = None,
                 shrink_sharding=None,
+                on_grow: Optional[Callable] = None,
+                grow_sharding=None,
+                grow_max_bucket_bytes=None,
+                admission_cooldown_steps: int = 0,
+                autoscale=None,
                 save_extras: Optional[Callable[[], dict]] = None,
                 on_restore: Optional[Callable] = None,
                 retryable: Tuple[Type[BaseException], ...] = (OSError,),
@@ -191,6 +198,41 @@ def run_elastic(step_fn: Callable[[int], Any],
     restore target raises
     :class:`~apex_tpu.resilience.fleet.FleetRecoveryFailed`.
 
+    Grow recovery (the inverse flow): a recovered or new host
+    beaconing a FRESH incarnation becomes a return candidate
+    (``fleet.return_candidates``); at the next step boundary the
+    members run ``fleet.agree_admission`` (the survivor agreement
+    inverted), re-initialize the mesh over the grown member set
+    (``on_grow(members, epoch)`` when given, else ``comm.grow_mesh``),
+    optionally re-chunk the optimizer's BucketPlan
+    (``grow_max_bucket_bytes``: a byte cap, or a callable
+    ``members -> cap`` — per-host HBM changed, so the overlap chunk
+    size should track it; the restore lands in the new layout through
+    the reconstruct path), then restore the last-known-good checkpoint
+    through ``manager.restore_good`` with ``grow_sharding`` (pytree or
+    zero-arg callable, evaluated AFTER the mesh re-init) — the same
+    reshard flow as shrink, in the grow direction — with the same
+    bit-exact-replay guarantee (telemetry rewind + watchdog detector
+    reset).  Counted as ``ElasticResult.mesh_grows``.  Admission
+    hysteresis: an admission is REFUSED (``admission_refused``
+    timeline event) while the watchdog has an open incident and within
+    ``admission_cooldown_steps`` of any resize — a flapping host
+    therefore causes exactly one shrink and no grow/shrink
+    oscillation.  A grow that admits hosts but then finds no valid
+    checkpoint raises ``FleetRecoveryFailed`` (the grown mesh needs
+    the reshard restore to be coherent).
+
+    ``autoscale``: a :class:`~apex_tpu.resilience.fleet.
+    FleetController` (requires ``fleet``).  The supervisor clocks each
+    completed step into it, asks it to decide at every boundary, and
+    executes: ``grow`` admits the current return candidates through
+    the admission flow above; ``shrink`` voluntarily releases the
+    highest-rank peer through ``fleet.agree_survivors(exclude=...)``
+    and the same shrink machinery (no retry budget consumed — a
+    planned resize is not a failure); ``stay`` does nothing.  Every
+    resize (including failure shrinks) arms the controller's cooldown
+    via ``note_resize``.
+
     Retryable-TYPED errors whose errno is hopeless (ENOSPC, EDQUOT,
     EROFS) skip the retry loop entirely: the post-mortem bundle is
     written (when a watchdog is attached) and the error propagates —
@@ -198,6 +240,11 @@ def run_elastic(step_fn: Callable[[int], Any],
     schedule."""
     if optimizer is None and params_like is None:
         raise ValueError("need an optimizer or params_like to restore")
+    if autoscale is not None and fleet is None:
+        raise ValueError(
+            "run_elastic(autoscale=...) needs a fleet monitor — the "
+            "controller decides, the fleet's admission/shrink "
+            "machinery executes")
     if retry is None:
         retry = RetryPolicy(max_retries=max_restarts,
                             base_delay_s=backoff_s)
@@ -244,6 +291,8 @@ def run_elastic(step_fn: Callable[[int], Any],
     restarts = 0
     rollbacks = 0
     mesh_shrinks = 0
+    mesh_grows = 0
+    last_resize_step: Optional[int] = None
     try:
         def _extras() -> dict:
             return save_extras() if save_extras is not None else {}
@@ -314,6 +363,28 @@ def run_elastic(step_fn: Callable[[int], Any],
                     step, None, directory=watchdog.postmortem_dir
                     or manager.directory)
 
+        def _rewind_replay(resumed: int) -> None:
+            """Replay parity with the watchdog rollback path: the
+            telemetry session's emitted-step watermark must rewind so
+            the replayed steps re-record (flush filters on after_step
+            — without this the replay would be silently dropped from
+            the record), and watchdog detector state from the
+            abandoned timeline must not re-trigger on replayed step
+            numbers.  Shared by the shrink and grow recoveries — the
+            bit-exact-replay guarantee is direction-independent."""
+            tel = getattr(fleet, "telemetry", None) or (
+                watchdog.telemetry if watchdog is not None else None)
+            if tel is not None:
+                tel.rewind(resumed)
+            if watchdog is not None:
+                watchdog.reset_after_external_rewind(resumed)
+
+        def _note_resize(step: int) -> None:
+            nonlocal last_resize_step
+            last_resize_step = step
+            if autoscale is not None:
+                autoscale.note_resize(step)
+
         def _shrink_recover(step: int) -> Optional[int]:
             """Agreement -> shrunk mesh -> reshard restore -> resume;
             None when the budget is spent or nothing restores."""
@@ -350,22 +421,147 @@ def run_elastic(step_fn: Callable[[int], Any],
             resumed = _restore(manager.restore_good, sharding=sh)
             if resumed is None:
                 return None
-            # replay parity with the watchdog rollback path: the
-            # telemetry session's emitted-step watermark must rewind
-            # so the replayed steps re-record (flush filters on
-            # after_step — without this the replay would be silently
-            # dropped from the record), and watchdog detector state
-            # from the abandoned timeline must not re-trigger on
-            # replayed step numbers
-            tel = getattr(fleet, "telemetry", None) or (
-                watchdog.telemetry if watchdog is not None else None)
-            if tel is not None:
-                tel.rewind(resumed)
-            if watchdog is not None:
-                watchdog.reset_after_external_rewind(resumed)
+            _rewind_replay(resumed)
             mesh_shrinks += 1
+            _note_resize(step)
             fleet.note_shrink(step, epoch, survivors, dead, resumed)
             return resumed
+
+        def _grow_recover(step: int) -> Optional[int]:
+            """Admission -> grown mesh -> reshard restore -> resume.
+            The inverse of ``_shrink_recover`` (no retry budget: an
+            admission is a planned resize, not a failure); None when
+            the round admitted nobody."""
+            nonlocal mesh_grows
+            candidates = dict(fleet.return_candidates())
+            if not candidates:
+                return None
+            prev_live = set(fleet.live_hosts())
+            epoch, members = fleet.agree_admission(step, candidates)
+            admitted = sorted(set(members) - prev_live)
+            if not admitted:
+                # a candidate that went silent again, or a member that
+                # still rules it dead: the round degraded to a no-op
+                fleet.note_admission_refused(step, candidates,
+                                             "not_agreed")
+                return None
+            warnings.warn(
+                f"run_elastic: admitting host(s) {admitted} at step "
+                f"{step}: mesh grows to {members} (epoch {epoch})")
+            if on_grow is not None:
+                on_grow(members, epoch)
+            else:
+                from apex_tpu import comm as _comm
+                if _comm.is_initialized():
+                    _comm.grow_mesh(members)
+            if grow_max_bucket_bytes is not None and optimizer is not \
+                    None and getattr(optimizer, "_plan", None) is not None:
+                # per-host HBM changed with the fleet size: re-chunk
+                # the BucketPlan so the overlap schedule tracks it; the
+                # restore below lands in the new layout through the
+                # checkpoint reconstruct path
+                cap = (grow_max_bucket_bytes(members)
+                       if callable(grow_max_bucket_bytes)
+                       else grow_max_bucket_bytes)
+                optimizer.rechunk(cap)
+            sh = (grow_sharding() if callable(grow_sharding)
+                  else grow_sharding)
+            resumed = _restore(manager.restore_good, sharding=sh)
+            if resumed is None:
+                # the mesh already grew: without the reshard restore
+                # the admitted hosts hold nothing coherent to train on
+                raise _fleet.FleetRecoveryFailed(
+                    f"admission at step {step} (hosts {admitted}) "
+                    "found no valid checkpoint to reshard onto the "
+                    "grown mesh")
+            _rewind_replay(resumed)
+            mesh_grows += 1
+            _note_resize(step)
+            fleet.note_grow(step, epoch, members, admitted, resumed)
+            return resumed
+
+        def _voluntary_shrink(step: int, decision) -> Optional[int]:
+            """The autoscaler's planned release: exclude the
+            highest-rank MEMBER from this host's proposal, agree,
+            shrink the mesh and reshard-restore — the failure
+            machinery minus the retry budget and the dead-host GC.
+            The victim is ``max(fleet.hosts)`` INCLUDING self: every
+            host must compute the SAME victim (divergent proposals
+            would intersect away two hosts), so when this host is the
+            highest rank it excludes itself and ``agree_survivors``
+            raises the typed ``FleetRecoveryFailed`` — the released
+            host's clean self-eviction path (exit for the external
+            scheduler)."""
+            nonlocal mesh_shrinks
+            if len(fleet.hosts) < 2:
+                return None
+            victim = max(fleet.hosts)
+            prev_hosts = list(fleet.hosts)
+            epoch, survivors = fleet.agree_survivors(
+                step, exclude=(victim,))
+            released = sorted(set(prev_hosts) - set(survivors))
+            if not released:
+                return None           # peers vetoed the release
+            warnings.warn(
+                f"run_elastic: autoscaler releasing host(s) "
+                f"{released} at step {step} ({decision.reason}="
+                f"{decision.signal}): mesh shrinks to {survivors} "
+                f"(epoch {epoch})")
+            if on_shrink is not None:
+                on_shrink(survivors, epoch)
+            else:
+                from apex_tpu import comm as _comm
+                if _comm.is_initialized():
+                    _comm.shrink_mesh(survivors)
+            sh = (shrink_sharding() if callable(shrink_sharding)
+                  else shrink_sharding)
+            resumed = _restore(manager.restore_good, sharding=sh)
+            if resumed is None:
+                raise _fleet.FleetRecoveryFailed(
+                    f"autoscale release at step {step} found no valid "
+                    "checkpoint to reshard onto the shrunk mesh")
+            _rewind_replay(resumed)
+            mesh_shrinks += 1
+            _note_resize(step)
+            fleet.note_shrink(step, epoch, survivors, released,
+                              resumed, reason="autoscale")
+            return resumed
+
+        def _admission_and_autoscale(step: int) -> Optional[int]:
+            """The grow half of the boundary: execute the autoscaler's
+            decision, or (without one) admit any return candidates
+            under the plain hysteresis gates.  Returns the resumed
+            step when a resize+restore happened."""
+            candidates = fleet.return_candidates()
+            incident = (watchdog.open_incident(step)
+                        if watchdog is not None else False)
+            if autoscale is not None:
+                dec = autoscale.decide(step, n_hosts=len(fleet.hosts),
+                                       candidates=len(candidates),
+                                       incident=incident)
+                if dec.action == "grow":
+                    return _grow_recover(step)
+                if dec.action == "shrink":
+                    return _voluntary_shrink(step, dec)
+                if candidates and dec.reason == "open_incident":
+                    fleet.note_admission_refused(step, candidates,
+                                                 "open_incident")
+                return None
+            if not candidates:
+                return None
+            if incident:
+                # grow_during_incident: resharding (and replicating
+                # onto a new host) state the watchdog may be about to
+                # roll away from — refuse until the incident closes
+                fleet.note_admission_refused(step, candidates,
+                                             "open_incident")
+                return None
+            if last_resize_step is not None and \
+                    step - last_resize_step < admission_cooldown_steps:
+                fleet.note_admission_refused(step, candidates,
+                                             "cooldown")
+                return None
+            return _grow_recover(step)
 
         def _forced_save(step: int) -> None:
             """Save NOW, surviving transient IO errors (bounded)."""
@@ -388,7 +584,11 @@ def run_elastic(step_fn: Callable[[int], Any],
         while step <= total_steps:
             saved_now = False
             try:
+                t_step0 = time.monotonic()
                 _armed_step(step)         # chaos hook rides inside
+                if autoscale is not None:
+                    autoscale.note_step(step,
+                                        time.monotonic() - t_step0)
                 last_done = step
                 # evaluate extras ONLY on cadence steps: state_dict()
                 # callbacks device_get (loss scale etc.), and a
@@ -511,6 +711,12 @@ def run_elastic(step_fn: Callable[[int], Any],
                             f"run_elastic: peer host {f.host} is slow "
                             f"(beacon gap {f.gap_s:.3g}s, lag "
                             f"{f.lag_steps} steps)")
+                    elif f.kind == "host_return":
+                        warnings.warn(
+                            f"run_elastic: peer host {f.host} "
+                            "returned with a fresh incarnation "
+                            f"({dict(f.evidence).get('incarnation')});"
+                            " awaiting admission at a step boundary")
                 dead = [f for f in failures if f.kind == "host_dead"]
                 if dead:
                     warnings.warn(
@@ -525,6 +731,14 @@ def run_elastic(step_fn: Callable[[int], Any],
                             f"failed (restart {restarts}/"
                             f"{retry.max_retries} or no valid "
                             "checkpoint)")
+                    last_done = resumed
+                    step = resumed + 1
+                    continue
+                # the grow half of the boundary: autoscaler decision
+                # or plain admission of return candidates (hysteresis
+                # gates inside)
+                resumed = _admission_and_autoscale(step)
+                if resumed is not None:
                     last_done = resumed
                     step = resumed + 1
                     continue
@@ -549,7 +763,8 @@ def run_elastic(step_fn: Callable[[int], Any],
                                      restarts=restarts,
                                      restored_from=restored_from,
                                      rollbacks=rollbacks,
-                                     mesh_shrinks=mesh_shrinks)
+                                     mesh_shrinks=mesh_shrinks,
+                                     mesh_grows=mesh_grows)
             step += 1
         try:
             manager.wait()                # final cadence save durable
@@ -568,7 +783,8 @@ def run_elastic(step_fn: Callable[[int], Any],
                              restarts=restarts,
                              restored_from=restored_from,
                              rollbacks=rollbacks,
-                             mesh_shrinks=mesh_shrinks)
+                             mesh_shrinks=mesh_shrinks,
+                             mesh_grows=mesh_grows)
     finally:
         if runner is not None:
             runner.close()

@@ -49,6 +49,20 @@ kind                 what happens
                      arrive ``lag_steps`` steps / ``delay_s`` seconds
                      stale for ``n_steps`` beats — a slow peer the
                      monitor warns about but never evicts
+``host_return``      advisory (fleet): a previously-dead peer resumes
+                     beaconing under a FRESH incarnation — the rejoin
+                     candidate the admission round admits at a step
+                     boundary (a stale-incarnation beacon would be a
+                     split-brain zombie and stays ignored)
+``flapping_host``    advisory (fleet): the peer returns with a fresh
+                     incarnation then dies AGAIN when its ``n_steps``
+                     budget expires — hysteresis (the post-resize
+                     admission cooldown) must yield exactly one shrink
+                     and zero grow/shrink oscillation
+``grow_during_incident``  advisory (fleet): the peer returns while the
+                     watchdog has an OPEN incident — the admission
+                     must be refused (``admission_refused`` timeline
+                     event) until the incident closes
 ===================  ======================================================
 
 The injector subclasses :class:`apex_tpu.checkpoint.CheckpointIO` and
@@ -144,15 +158,20 @@ class FaultInjector(_ckpt.CheckpointIO):
     KINDS = ("truncate", "fsync_error", "slow_disk", "preempt",
              "crash_before_publish", "disk_full",
              "nan_grads", "loss_spike", "scale_collapse", "straggler",
-             "peer_death", "peer_hang", "slow_network")
+             "peer_death", "peer_hang", "slow_network",
+             "host_return", "flapping_host", "grow_during_incident")
     # step-keyed kinds delivered through notify_step/training_fault
     STEP_KINDS = ("preempt", "nan_grads", "loss_spike",
                   "scale_collapse", "straggler",
-                  "peer_death", "peer_hang", "slow_network")
+                  "peer_death", "peer_hang", "slow_network",
+                  "host_return", "flapping_host",
+                  "grow_during_incident")
     # advisory kinds the TRAINING LOOP applies (training_fault)
     TRAINING_KINDS = ("nan_grads", "loss_spike", "scale_collapse")
     # advisory kinds the FLEET beacon simulation applies (fleet_fault)
-    FLEET_KINDS = ("peer_death", "peer_hang", "slow_network")
+    FLEET_KINDS = ("peer_death", "peer_hang", "slow_network",
+                   "host_return", "flapping_host",
+                   "grow_during_incident")
 
     def __init__(self, faults: Sequence[FaultSpec]):
         for f in faults:

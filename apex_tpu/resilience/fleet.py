@@ -46,11 +46,38 @@ caller's ``on_shrink`` hook) -> restore the last-known-good checkpoint
 through the existing ``sharding=`` reshard flow -> resume, recorded as
 ``ElasticResult.mesh_shrinks`` under the same ``RetryPolicy`` budget.
 
-Known scope limits (docs/resilience.md spells them out): a dead host
-REJOINING the shrunk fleet is not handled (restart the job to grow
-back — ROADMAP's elastic-resharding thread), and on a real multi-host
-runtime the mesh re-initialization over survivors requires a runtime
-that supports it (``on_shrink`` is the integration point).
+The GROW half (elastic scale-UP) is the inverse flow:
+
+- **Rejoin protocol** — a recovered (or brand-new) host announces
+  itself on the same beacon channel with a FRESH incarnation.  The
+  monitor's sticky-dead classification keys on ``(host,
+  incarnation)``: the dead incarnation's beacons stay ignored forever
+  (a split-brain zombie must never look alive again), while a fresh
+  incarnation beaconing within the liveness deadlines becomes a
+  **return candidate** (typed ``host_return`` event).  At a step
+  boundary the survivors run :meth:`FleetMonitor.agree_admission` —
+  the ``agree_survivors`` proposal/poll shape inverted: each survivor
+  proposes its live set PLUS the candidates under a fresh epoch, and
+  the agreed member set is the same responder-restricted intersection
+  — then ``comm.grow_mesh`` (the inverse of ``comm.shrink_mesh``)
+  re-initializes the mesh and the last-known-good checkpoint reshards
+  onto the larger device set through the existing
+  ``restore_good(sharding=)`` flow, with the same bit-exact-replay
+  guarantee (telemetry rewind + watchdog detector reset) as shrink
+  recovery.
+
+- **:class:`FleetController`** — a load-driven fleet autoscaler: a
+  host-side observer on the telemetry session that watches step-time
+  / queue-depth / ``fleet/*`` signals across window flushes and emits
+  typed :class:`ScaleDecision` grow/shrink/stay decisions with
+  hysteresis (cooldown after ANY resize, never a resize inside an
+  open watchdog incident), executed by ``run_elastic(autoscale=...)``
+  through the same admission/shrink machinery.
+
+Known scope limit (docs/resilience.md spells it out): on a real
+multi-host runtime the mesh re-initialization over a changed host set
+requires a runtime that supports it (``on_shrink``/``on_grow`` are the
+integration points).
 """
 
 from __future__ import annotations
@@ -345,6 +372,16 @@ class FleetMonitor:
         self._event_records: List[dict] = []      # queued for flush
         self._status: Dict[int, str] = {h: HOST_LIVE for h in self.hosts}
         self._slow_warned: Set[int] = set()
+        # rejoin bookkeeping: sticky-dead keys on (host, incarnation) —
+        # the incarnation a host held when it was declared dead (or
+        # evicted by an agreement round) stays dead forever; only a
+        # FRESH incarnation beaconing within the liveness deadlines
+        # becomes a return candidate
+        self._peer_incarnation: Dict[int, int] = {}
+        self._dead_incarnation: Dict[int, int] = {}
+        self._candidates: Dict[int, int] = {}     # host -> fresh inc
+        self._return_seen: Set[Tuple[int, int]] = set()
+        self._refused_seen: Set[Tuple[int, int, str]] = set()
         self._pre_beat: List[Callable[[int], None]] = []
         self._spin_hooks: List[Callable[[int], None]] = []
         self._publish_warned = False
@@ -420,6 +457,9 @@ class FleetMonitor:
         return [h for h in self.hosts if h != self.host]
 
     def _read_beacons(self) -> Dict[int, dict]:
+        """Every non-self beacon on the channel — member peers AND
+        non-members (an evicted host announcing a fresh incarnation,
+        or a brand-new host joining)."""
         out: Dict[int, dict] = {}
         try:
             beacons = self.channel.get_all("beacon/")
@@ -430,7 +470,7 @@ class FleetMonitor:
                 h = int(key.rsplit("/", 1)[-1])
             except ValueError:
                 continue
-            if h in self.hosts and h != self.host:
+            if h != self.host:
                 out[h] = rec
         return out
 
@@ -459,27 +499,73 @@ class FleetMonitor:
                 status = HOST_SLOW
         return status, gap_s, lag
 
+    def _consider_return(self, h: int, beacon: Optional[dict],
+                         step: int, now: float,
+                         found: List[HostFailure]) -> None:
+        """Rejoin candidacy for a sticky-dead member or a non-member
+        host.  Sticky-dead keys on ``(host, incarnation)``: the dead
+        incarnation's beacons stay ignored (split-brain zombie), a
+        FRESH incarnation beaconing within the liveness deadlines is a
+        return candidate — surfaced once per incarnation as a typed
+        ``host_return`` event and re-validated every poll (a candidate
+        that stops beaconing — a flapping host — drops out again)."""
+        if beacon is None:
+            self._candidates.pop(h, None)
+            return
+        try:
+            inc = int(beacon.get("incarnation", -1))
+        except (TypeError, ValueError):
+            return
+        if inc <= self._dead_incarnation.get(h, -1):
+            self._candidates.pop(h, None)     # stale incarnation: zombie
+            return
+        status, gap_s, lag = self._classify(step, beacon, now)
+        if status != HOST_LIVE:
+            self._candidates.pop(h, None)     # flapped away again
+            return
+        self._candidates[h] = inc
+        if (h, inc) not in self._return_seen:
+            self._return_seen.add((h, inc))
+            found.append(HostFailure(
+                kind="host_return", host=h, step=int(step),
+                peer_step=int(beacon.get("step", -1)), gap_s=gap_s,
+                lag_steps=lag, evidence={"incarnation": inc}))
+
     def poll(self, step: int) -> List[HostFailure]:
         """Classify every peer against the deadlines; return NEW
-        failure events (dead fires once and is sticky; slow fires once
-        per episode, re-armed by recovery).  Emits the ``fleet/*``
-        counters."""
+        failure events (dead fires once and is sticky per
+        ``(host, incarnation)``; slow fires once per episode, re-armed
+        by recovery; a dead or evicted host beaconing a FRESH
+        incarnation fires ``host_return`` once per incarnation).
+        Emits the ``fleet/*`` counters."""
         now = self._clock()
         beacons = self._read_beacons()
         found: List[HostFailure] = []
         worst_gap, worst_lag = 0.0, 0
         for h in self.peers():
+            b = beacons.get(h)
+            if b is not None:
+                try:
+                    self._peer_incarnation[h] = int(
+                        b.get("incarnation", -1))
+                except (TypeError, ValueError):
+                    pass
             if self._status.get(h) == HOST_DEAD:
-                continue              # sticky until the shrink
-            status, gap_s, lag = self._classify(step, beacons.get(h),
-                                                now)
+                # sticky for THIS incarnation — but a fresh incarnation
+                # beaconing live is a rejoin candidate, not a zombie
+                self._consider_return(h, b, step, now, found)
+                continue
+            status, gap_s, lag = self._classify(step, b, now)
             worst_gap = max(worst_gap, gap_s)
             worst_lag = max(worst_lag, lag)
             prev = self._status.get(h, HOST_LIVE)
             self._status[h] = status
-            b = beacons.get(h)
             peer_step = int(b.get("step", -1)) if b else -1
             if status == HOST_DEAD:
+                # the incarnation dying here is what stays dead; a
+                # return must present a NEWER one
+                self._dead_incarnation[h] = \
+                    self._peer_incarnation.get(h, -1)
                 found.append(HostFailure(
                     kind="host_dead", host=h, step=int(step),
                     peer_step=peer_step, gap_s=gap_s, lag_steps=lag))
@@ -490,6 +576,15 @@ class FleetMonitor:
                     peer_step=peer_step, gap_s=gap_s, lag_steps=lag))
             elif status == HOST_LIVE and prev == HOST_SLOW:
                 self._slow_warned.discard(h)      # episode over: re-arm
+        # non-member hosts (evicted after a shrink, or brand-new):
+        # their fresh-incarnation beacons are admission candidates
+        for h, b in sorted(beacons.items()):
+            if h in self.hosts:
+                continue
+            self._consider_return(h, b, step, now, found)
+        for h in list(self._candidates):
+            if h not in beacons:      # beacon gone entirely: drop
+                self._candidates.pop(h, None)
         statuses = [self._status[h] for h in self.peers()]
         _hostmetrics.emit("fleet/hosts_live",
                           1 + statuses.count(HOST_LIVE))
@@ -526,30 +621,19 @@ class FleetMonitor:
         return [h for h in self.hosts if self.status(h) == HOST_DEAD]
 
     # ---- agreement -------------------------------------------------------
-    def agree_survivors(self, step: int,
-                        timeout_s: Optional[float] = None
-                        ) -> Tuple[int, List[int]]:
-        """Barrier-free survivor agreement for a fresh epoch.
-
-        Every survivor publishes its proposal (its live set) under the
-        epoch and polls for its peers' proposals; a host that fails to
-        publish within the deadline is treated as dead — it cannot
-        stall the round the way it would stall an allgather.  The
-        agreed set is the intersection of the responders' proposals
-        restricted to the responders themselves, so every responding
-        host computes the SAME set from the same published verdicts
-        (the ``restore_latest`` lockstep-agreement shape, minus the
-        collective).  A host the agreed set excludes — possible when
-        a peer's proposal ruled it dead — raises
-        :class:`FleetRecoveryFailed` and self-evicts instead of
-        rebuilding a divergent (split-brain) mesh.  Updates the
-        monitor's host set to the agreed survivors and bumps
-        ``epoch``."""
-        epoch = self.epoch + 1
-        proposal = sorted(self.live_hosts())
+    def _agreement_round(self, epoch: int, proposal: Sequence[int],
+                         timeout_s: Optional[float]) -> Set[int]:
+        """Publish this host's proposal for ``epoch``, poll peers'
+        proposals with a bounded wait, and return the agreed set: the
+        intersection of the responders' proposals restricted to the
+        responders themselves — so every responding host computes the
+        SAME set from the same published verdicts, and a host that
+        fails to publish within the deadline can neither veto nor
+        stall the round the way it would stall an allgather."""
         self.channel.put(f"verdict/{epoch}/{self.host}", {
-            "host": self.host, "epoch": epoch, "step": int(step),
-            "survivors": proposal, "incarnation": self.incarnation})
+            "host": self.host, "epoch": epoch,
+            "survivors": list(proposal),
+            "incarnation": self.incarnation})
         deadline = self._clock() + (timeout_s if timeout_s is not None
                                     else self.agreement_timeout_s)
         spins = 0
@@ -573,6 +657,36 @@ class FleetMonitor:
         agreed = set(responders)
         for survivors in responders.values():
             agreed &= set(survivors)
+        return agreed
+
+    def agree_survivors(self, step: int,
+                        timeout_s: Optional[float] = None,
+                        exclude: Sequence[int] = ()
+                        ) -> Tuple[int, List[int]]:
+        """Barrier-free survivor agreement for a fresh epoch.
+
+        Every survivor publishes its proposal (its live set) under the
+        epoch and polls for its peers' proposals; a host that fails to
+        publish within the deadline is treated as dead — it cannot
+        stall the round the way it would stall an allgather.  The
+        agreed set is the intersection of the responders' proposals
+        restricted to the responders themselves, so every responding
+        host computes the SAME set from the same published verdicts
+        (the ``restore_latest`` lockstep-agreement shape, minus the
+        collective).  A host the agreed set excludes — possible when
+        a peer's proposal ruled it dead — raises
+        :class:`FleetRecoveryFailed` and self-evicts instead of
+        rebuilding a divergent (split-brain) mesh.  Updates the
+        monitor's host set to the agreed survivors and bumps
+        ``epoch``.
+
+        ``exclude``: hosts left out of THIS host's proposal — the
+        autoscaler's voluntary release (the intersection rule then
+        drops them from the agreed set, and an excluded host that
+        polls the round self-evicts exactly like a ruled-dead one)."""
+        epoch = self.epoch + 1
+        proposal = sorted(set(self.live_hosts()) - set(exclude))
+        agreed = self._agreement_round(epoch, proposal, timeout_s)
         survivors = sorted(agreed)
         if self.host not in agreed:
             # a responder's proposal excluded US: by the same rule
@@ -585,13 +699,67 @@ class FleetMonitor:
                 "fleet considers this host failed; exiting for the "
                 "external scheduler to restart it")
         self.epoch = epoch
-        self._shrink_to(survivors)
+        self._set_members(survivors)
         _hostmetrics.emit("fleet/epoch", epoch)
         return epoch, survivors
 
-    def _shrink_to(self, survivors: Sequence[int]) -> None:
-        self.hosts = sorted(set(int(h) for h in survivors)
-                            | {self.host})
+    def return_candidates(self) -> Dict[int, int]:
+        """Hosts currently announcing a fresh incarnation within the
+        liveness deadlines (host -> incarnation) — what
+        :meth:`agree_admission` admits.  Re-validated every poll: a
+        candidate that stops beaconing (a flapping host) drops out
+        before it is ever admitted."""
+        return dict(self._candidates)
+
+    def agree_admission(self, step: int,
+                        joiners: Mapping[int, int],
+                        timeout_s: Optional[float] = None
+                        ) -> Tuple[int, List[int]]:
+        """Barrier-free ADMISSION agreement — :meth:`agree_survivors`
+        inverted: every member proposes its live set PLUS the joiners
+        (``host -> fresh incarnation``, normally
+        :meth:`return_candidates`) under a fresh epoch; the agreed
+        member set is the same responder-restricted intersection.  A
+        joiner is admitted only when it answers the round itself AND
+        every responding member proposed it — a member that still
+        considers it dead (or a joiner that went silent again) drops
+        it from the intersection and the round degrades to a no-op.
+        Updates the monitor's host set to the agreed members (admitted
+        joiners enter LIVE under the new epoch) and bumps ``epoch``."""
+        joiners = {int(h): int(inc) for h, inc in dict(joiners).items()}
+        epoch = self.epoch + 1
+        proposal = sorted(set(self.live_hosts()) | set(joiners))
+        agreed = self._agreement_round(epoch, proposal, timeout_s)
+        members = sorted(agreed)
+        if self.host not in agreed:
+            raise FleetRecoveryFailed(
+                f"host {self.host} is excluded from the agreed "
+                f"member set {members} (epoch {epoch}) — the fleet "
+                "considers this host failed; exiting for the external "
+                "scheduler to restart it")
+        self.epoch = epoch
+        for h in set(members) & set(joiners):
+            # this incarnation is IN: only a still-newer one may
+            # re-candidate after a future death
+            self._peer_incarnation[h] = joiners[h]
+            self._dead_incarnation[h] = joiners[h] - 1
+            self._candidates.pop(h, None)
+        self._set_members(members)
+        _hostmetrics.emit("fleet/epoch", epoch)
+        return epoch, members
+
+    def _set_members(self, members: Sequence[int]) -> None:
+        """Adopt an agreed member set (shrink or grow).  Hosts leaving
+        the set keep their current incarnation recorded as dead, so a
+        released (not crashed) host's continuing beacons are ignored
+        as stale until it restarts with a fresh incarnation."""
+        new = sorted(set(int(h) for h in members) | {self.host})
+        for h in self.hosts:
+            if h not in new and h != self.host:
+                self._dead_incarnation[h] = max(
+                    self._dead_incarnation.get(h, -1),
+                    self._peer_incarnation.get(h, -1))
+        self.hosts = new
         self._status = {h: HOST_LIVE for h in self.hosts}
         self._slow_warned.clear()
 
@@ -602,14 +770,43 @@ class FleetMonitor:
 
     def note_shrink(self, step: int, epoch: int,
                     survivors: Sequence[int], dead: Sequence[int],
-                    restored_step: Optional[int]) -> None:
+                    restored_step: Optional[int],
+                    reason: str = "failure") -> None:
         _hostmetrics.emit("fleet/mesh_shrinks", 1)
         self._event({
             "kind": "fleet", "event": "shrink", "step": int(step),
             "epoch": int(epoch), "survivors": list(survivors),
-            "dead": list(dead),
+            "dead": list(dead), "reason": reason,
             "to_step": (int(restored_step)
                         if restored_step is not None else None)})
+
+    def note_grow(self, step: int, epoch: int,
+                  members: Sequence[int], admitted: Sequence[int],
+                  restored_step: Optional[int]) -> None:
+        _hostmetrics.emit("fleet/mesh_grows", 1)
+        self._event({
+            "kind": "fleet", "event": "grow", "step": int(step),
+            "epoch": int(epoch), "members": list(members),
+            "admitted": list(admitted),
+            "to_step": (int(restored_step)
+                        if restored_step is not None else None)})
+
+    def note_admission_refused(self, step: int,
+                               candidates: Mapping[int, int],
+                               reason: str) -> None:
+        """Record a refused admission (open watchdog incident, resize
+        cooldown, or a round the members did not agree) — once per
+        (host, incarnation, reason), so a candidate polling every
+        boundary does not flood the timeline."""
+        for h, inc in sorted(dict(candidates).items()):
+            key = (int(h), int(inc), reason)
+            if key in self._refused_seen:
+                continue
+            self._refused_seen.add(key)
+            self._event({
+                "kind": "fleet", "event": "admission_refused",
+                "step": int(step), "host": int(h),
+                "incarnation": int(inc), "reason": reason})
 
     def note_deadline(self, exc: "StepDeadlineExceeded") -> None:
         self._event({
@@ -627,13 +824,17 @@ class SimulatedPeers:
 
     Publishes a live beacon per simulated peer on every monitor beat
     and answers agreement rounds on their behalf — so the full
-    beacon -> classify -> agree -> shrink protocol runs end to end in
-    one process (the examples' ``--fleet`` mode and the chaos matrix).
-    Consumes the scheduled ``peer_death`` / ``peer_hang`` /
-    ``slow_network`` faults from :mod:`~apex_tpu.resilience.faults`:
-    a killed peer stops beaconing (its last beacon ages out / lags
-    behind exactly like a real dead host's), a slow-networked peer
-    publishes stale beacons for the fault's budget.
+    beacon -> classify -> agree -> shrink/grow protocol runs end to
+    end in one process (the examples' ``--fleet`` mode and the chaos
+    matrix).  Consumes the scheduled fleet faults from
+    :mod:`~apex_tpu.resilience.faults`: a killed peer
+    (``peer_death``/``peer_hang``) stops beaconing (its last beacon
+    ages out / lags behind exactly like a real dead host's), a
+    slow-networked peer publishes stale beacons for the fault's
+    budget, a returning peer (``host_return`` /
+    ``grow_during_incident``) resumes beaconing under a FRESH
+    incarnation, and a ``flapping_host`` returns then dies again when
+    the fault's ``n_steps`` budget expires.
 
     >>> sim = SimulatedPeers(channel, hosts=[1, 2])
     >>> sim.attach(monitor)      # beat + agreement hooks
@@ -648,6 +849,8 @@ class SimulatedPeers:
         self._lag: Dict[int, Tuple[int, float]] = {}   # host -> (steps, s)
         self._clock = clock
         self.incarnation = incarnation
+        self._inc: Dict[int, int] = {}    # per-host current incarnation
+        self._flap_target: Optional[int] = None
 
     def attach(self, monitor: FleetMonitor) -> "SimulatedPeers":
         monitor.add_beat_hook(self.beat)
@@ -658,21 +861,47 @@ class SimulatedPeers:
         """The peer stops beaconing from now on (host crashed/hung)."""
         self.killed.add(int(host))
 
+    def revive(self, host: int) -> None:
+        """The peer returns: resumes beaconing under a FRESH
+        incarnation (a restarted process, not the dead one's zombie —
+        idempotent while already alive)."""
+        h = int(host)
+        if h in self.killed:
+            self.killed.discard(h)
+            self._inc[h] = self._inc.get(h, self.incarnation) + 1
+
+    def incarnation_of(self, host: int) -> int:
+        return self._inc.get(int(host), self.incarnation)
+
     def _default_target(self) -> int:
         alive = [h for h in self.hosts if h not in self.killed]
         return alive[-1] if alive else self.hosts[-1]
+
+    def _default_return_target(self) -> int:
+        dead = sorted(self.killed)
+        return dead[-1] if dead else self.hosts[-1]
 
     def beat(self, step: int) -> None:
         """Publish one beacon per live simulated peer; apply any
         scheduled fleet fault first."""
         f = _faults.fleet_fault(step)
         if f is not None:
-            target = f.target if f.target is not None \
-                else self._default_target()
-            if f.kind in ("peer_death", "peer_hang"):
-                self.kill(target)
-            elif f.kind == "slow_network":
-                self._lag[target] = (int(f.lag_steps), float(f.delay_s))
+            if f.kind in ("host_return", "flapping_host",
+                          "grow_during_incident"):
+                target = f.target if f.target is not None \
+                    else self._default_return_target()
+                self.revive(target)
+                if f.kind == "flapping_host":
+                    # dies again when the fault's budget expires
+                    self._flap_target = target
+            else:
+                target = f.target if f.target is not None \
+                    else self._default_target()
+                if f.kind in ("peer_death", "peer_hang"):
+                    self.kill(target)
+                elif f.kind == "slow_network":
+                    self._lag[target] = (int(f.lag_steps),
+                                         float(f.delay_s))
         now = self._clock()
         for h in self.hosts:
             if h in self.killed:
@@ -681,16 +910,22 @@ class SimulatedPeers:
             self.channel.put(f"beacon/{h}", {
                 "host": h, "step": int(step) - lag_steps,
                 "wall_time": now - lag_s,
-                "incarnation": self.incarnation, "epoch": 0})
-        # a slow-network lag expires with the fault budget: faults
-        # hand out one unit per beat, so clear when no longer drawn
+                "incarnation": self.incarnation_of(h), "epoch": 0})
+        # a slow-network lag (and a flapping host's second life)
+        # expires with the fault budget: faults hand out one unit per
+        # beat, so apply the expiry when no longer drawn
         if f is None:
             self._lag.clear()
+            if self._flap_target is not None:
+                self.kill(self._flap_target)
+                self._flap_target = None
 
     def answer_agreement(self, epoch: int) -> None:
         """Publish each live peer's verdict for ``epoch``: its own
         survivor view (everything it can see beaconing = everything
-        not killed, plus the real hosts)."""
+        not killed, plus the real hosts).  A revived peer answers too
+        — its response is what lets :meth:`FleetMonitor.
+        agree_admission` admit it."""
         verdicts = self.channel.get_all(f"verdict/{epoch}/")
         real_hosts = sorted(
             int(rec["host"]) for rec in verdicts.values()
@@ -705,7 +940,264 @@ class SimulatedPeers:
                 continue
             self.channel.put(key, {
                 "host": h, "epoch": int(epoch), "step": -1,
-                "survivors": view, "incarnation": self.incarnation})
+                "survivors": view,
+                "incarnation": self.incarnation_of(h)})
+
+
+# ---------------------------------------------------------------------
+# Load-driven fleet autoscaling
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    """One typed autoscaler decision (the fleet analogue of the
+    watchdog's :class:`~.watchdog.Verdict`)."""
+    action: str                 # "grow" | "shrink" | "stay"
+    step: int                   # boundary the decision was made at
+    reason: str                 # signal name or hold reason
+    signal: Optional[float] = None   # windowed value it keyed on
+
+    def record(self) -> dict:
+        rec = {"kind": "fleet", "event": "autoscale",
+               "action": self.action, "step": int(self.step),
+               "reason": self.reason}
+        if self.signal is not None:
+            rec["signal"] = round(float(self.signal), 6)
+        return rec
+
+
+class FleetController:
+    """Load-driven fleet autoscaler: watch the run's load signals
+    host-side, emit typed grow/shrink/stay :class:`ScaleDecision`\\ s
+    with hysteresis.  Decisions are EMITTED here and EXECUTED by
+    ``run_elastic(autoscale=...)`` through the same admission/shrink
+    machinery the failure path uses — the controller never touches the
+    mesh itself.
+
+    Signals (configure at least one high watermark):
+
+    - **step time** — ``note_step(step, duration_s)`` samples from the
+      supervisor's step-boundary clock (the same wall times the
+      watchdog's straggler detector sees); windowed median above
+      ``step_time_high_s`` wants capacity, below ``step_time_low_s``
+      wants release.
+    - **queue depth** — a ring metric named by ``queue_metric`` (e.g.
+      a data-loader backlog the trainer records per step), read from
+      the telemetry session's window flushes when attached
+      (``telemetry=``); same high/low watermark shape.
+    - **fleet health** — the ``fleet/hosts_slow`` counter riding the
+      hostmetrics sinks: a degraded fleet holds every resize (growing
+      into — or shrinking under — an infrastructure wobble just
+      churns the mesh).
+
+    Hysteresis: a signal must hold out-of-band for ``patience``
+    consecutive decisions before a resize fires; after ANY resize
+    (``note_resize`` — run_elastic calls it for failure shrinks too)
+    decisions stay for ``cooldown_steps``; and no resize is ever
+    decided inside an open watchdog incident (``incident=`` passed by
+    run_elastic, or a standalone ``incident_source`` callable).
+    grow/shrink decisions are recorded as ``kind:"fleet"`` /
+    ``event:"autoscale"`` timeline events through the attached
+    session's flush."""
+
+    def __init__(self, telemetry=None,
+                 step_time_high_s: Optional[float] = None,
+                 step_time_low_s: Optional[float] = None,
+                 queue_metric: Optional[str] = None,
+                 queue_high: Optional[float] = None,
+                 queue_low: Optional[float] = None,
+                 window: int = 32, patience: int = 2,
+                 cooldown_steps: int = 100,
+                 min_hosts: int = 1,
+                 max_hosts: Optional[int] = None,
+                 incident_source: Optional[Callable[[], bool]] = None):
+        if step_time_high_s is None and queue_high is None:
+            raise ValueError(
+                "configure at least one grow signal: step_time_high_s "
+                "or queue_metric + queue_high")
+        if queue_metric is None and queue_high is not None:
+            raise ValueError("queue_high needs queue_metric")
+        for lo, hi, what in ((step_time_low_s, step_time_high_s,
+                              "step_time"),
+                             (queue_low, queue_high, "queue")):
+            if lo is not None and (hi is None or not lo < hi):
+                raise ValueError(f"need {what} low < high watermarks")
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        if cooldown_steps < 0:
+            raise ValueError("cooldown_steps must be >= 0")
+        self.step_time_high_s = step_time_high_s
+        self.step_time_low_s = step_time_low_s
+        self.queue_metric = queue_metric
+        self.queue_high = queue_high
+        self.queue_low = queue_low
+        self.patience = int(patience)
+        self.cooldown_steps = int(cooldown_steps)
+        self.min_hosts = int(min_hosts)
+        self.max_hosts = max_hosts
+        self.incident_source = incident_source
+        import collections
+        self._times = collections.deque(maxlen=int(window))
+        self._queue = collections.deque(maxlen=int(window))
+        self._hosts_slow = 0.0
+        self._grow_streak = 0
+        self._shrink_streak = 0
+        self._last_resize: Optional[int] = None
+        # bounded: one decision lands per step boundary for the whole
+        # run (overwhelmingly "stay") — an unbounded list would be a
+        # slow host-RAM leak on multi-million-step autoscaled runs
+        self.decisions = collections.deque(maxlen=512)
+        self._event_records: List[dict] = []
+        self.telemetry = telemetry
+        self._attached = False
+        _hostmetrics.add_sink(self._on_counter)
+        if telemetry is not None:
+            telemetry.add_observer(self._on_flush)
+            self._attached = True
+
+    # ---- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        _hostmetrics.remove_sink(self._on_counter)
+        if self._attached and self.telemetry is not None:
+            if self._event_records:
+                try:
+                    self.telemetry.flush()
+                except Exception:    # noqa: BLE001 — teardown path
+                    pass
+            self.telemetry.remove_observer(self._on_flush)
+            self._attached = False
+
+    def __enter__(self) -> "FleetController":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _on_counter(self, name: str, value: float) -> None:
+        if name == "fleet/hosts_slow":
+            self._hosts_slow = float(value)
+
+    def _on_flush(self, records) -> List[dict]:
+        self.observe(records)
+        out, self._event_records = self._event_records, []
+        return out
+
+    # ---- signal intake ---------------------------------------------------
+    def observe(self, records) -> None:
+        """Window-flush intake: pull the queue-depth metric out of the
+        decoded step records (the telemetry observer calls this; unit
+        tests feed synthetic streams directly)."""
+        if self.queue_metric is None:
+            return
+        for r in records:
+            if r.get("kind", "step") != "step":
+                continue
+            v = r.get(self.queue_metric)
+            if v is not None:
+                try:
+                    self._queue.append(float(v))
+                except (TypeError, ValueError):
+                    continue
+
+    def note_step(self, step: int, duration_s: float) -> None:
+        """One completed step's wall duration (run_elastic's
+        step-boundary clock)."""
+        self._times.append(float(duration_s))
+
+    def note_resize(self, step: int) -> None:
+        """ANY mesh resize happened (grow, voluntary shrink, or a
+        failure shrink): arm the cooldown and drop the streaks — the
+        new mesh gets a fresh observation window."""
+        self._last_resize = int(step)
+        self._grow_streak = 0
+        self._shrink_streak = 0
+
+    @staticmethod
+    def _median(values) -> Optional[float]:
+        vals = sorted(values)
+        return vals[len(vals) // 2] if vals else None
+
+    # ---- the decision ----------------------------------------------------
+    def _decision(self, action: str, step: int, reason: str,
+                  signal: Optional[float]) -> ScaleDecision:
+        d = ScaleDecision(action, int(step), reason, signal)
+        self.decisions.append(d)
+        if action != "stay":
+            self._event_records.append(d.record())
+        return d
+
+    def decide(self, step: int, n_hosts: int = 1, candidates: int = 0,
+               incident: Optional[bool] = None) -> ScaleDecision:
+        """The step-boundary decision.  ``n_hosts``: current member
+        count; ``candidates``: hosts currently announcing a fresh
+        incarnation (a grow can only be EXECUTED with one, so without
+        any the decision stays); ``incident``: whether the watchdog
+        has an open incident (None consults ``incident_source``)."""
+        step = int(step)
+        if incident is None:
+            incident = bool(self.incident_source()) \
+                if self.incident_source is not None else False
+        tmed = self._median(self._times)
+        qmed = self._median(self._queue)
+        if incident:
+            self._grow_streak = self._shrink_streak = 0
+            return self._decision("stay", step, "open_incident", None)
+        if self._hosts_slow > 0:
+            self._grow_streak = self._shrink_streak = 0
+            return self._decision("stay", step, "fleet_degraded",
+                                  self._hosts_slow)
+        if self._last_resize is not None and \
+                step - self._last_resize < self.cooldown_steps:
+            self._grow_streak = self._shrink_streak = 0
+            return self._decision("stay", step, "cooldown", None)
+        grow_sig = shrink_sig = None
+        if self.queue_high is not None and qmed is not None \
+                and qmed > self.queue_high:
+            grow_sig = ("queue_depth", qmed)
+        elif self.step_time_high_s is not None and tmed is not None \
+                and tmed > self.step_time_high_s:
+            grow_sig = ("step_time", tmed)
+        elif self.queue_low is not None and qmed is not None \
+                and qmed < self.queue_low:
+            shrink_sig = ("queue_depth", qmed)
+        elif self.step_time_low_s is not None and tmed is not None \
+                and tmed < self.step_time_low_s:
+            shrink_sig = ("step_time", tmed)
+        if grow_sig is not None:
+            self._grow_streak += 1
+            self._shrink_streak = 0
+            if self._grow_streak < self.patience:
+                return self._decision("stay", step, "patience",
+                                      grow_sig[1])
+            if candidates <= 0:
+                # capacity is wanted but nobody is announcing: surface
+                # the demand on the timeline once per episode (an
+                # external scheduler can act on it), execution waits
+                d = self._decision("stay", step,
+                                   "grow_wanted_no_candidates",
+                                   grow_sig[1])
+                if self._grow_streak == self.patience:
+                    self._event_records.append(d.record())
+                return d
+            if self.max_hosts is not None and n_hosts >= self.max_hosts:
+                return self._decision("stay", step, "at_max_hosts",
+                                      grow_sig[1])
+            return self._decision("grow", step, grow_sig[0],
+                                  grow_sig[1])
+        if shrink_sig is not None:
+            self._shrink_streak += 1
+            self._grow_streak = 0
+            if self._shrink_streak < self.patience:
+                return self._decision("stay", step, "patience",
+                                      shrink_sig[1])
+            if n_hosts <= self.min_hosts:
+                return self._decision("stay", step, "at_min_hosts",
+                                      shrink_sig[1])
+            return self._decision("shrink", step, shrink_sig[0],
+                                  shrink_sig[1])
+        self._grow_streak = self._shrink_streak = 0
+        return self._decision("stay", step, "in_band",
+                              qmed if qmed is not None else tmed)
 
 
 # ---------------------------------------------------------------------

@@ -580,21 +580,32 @@ class Watchdog:
             self._pending_saves.clear()
 
     # ---- supervisor surface (step-boundary cadence) ----------------------
+    def open_incident(self, step: int) -> bool:
+        """True while an incident is OPEN at ``step``: anomalies are
+        awaiting a verdict at this boundary, or ``step`` is within
+        ``clean_window`` of the last quarantine-or-worse anomaly.
+        This is the exact test :meth:`note_save` applies to LKG
+        candidacy — exposed for the fleet's admission gate too: a
+        mesh resize mid-incident would reshard (and replicate onto new
+        hosts) the very state the watchdog may be about to roll away
+        from, so ``run_elastic`` refuses admissions and the
+        :class:`~.fleet.FleetController` holds its decisions while
+        this is True."""
+        return bool(self._pending) or (
+            self._last_anomaly_step is not None
+            and int(step) <= self._last_anomaly_step + self.clean_window)
+
     def note_save(self, step: int) -> None:
         """A cadence checkpoint was scheduled at ``step``; it starts
         aging toward last-known-good (pin it in the manager).
 
-        A save taken inside an OPEN incident — anomalies awaiting a
-        verdict at this very boundary, or within ``clean_window``
-        steps of the last quarantine-or-worse anomaly — is rejected
-        immediately: it snapshots state that went through the
-        anomalous window (the quarantine re-anchor has not even run
-        yet), and letting it age into LKG would hand a later rollback
-        the very state being rolled away from."""
+        A save taken inside an OPEN incident (:meth:`open_incident`)
+        is rejected immediately: it snapshots state that went through
+        the anomalous window (the quarantine re-anchor has not even
+        run yet), and letting it age into LKG would hand a later
+        rollback the very state being rolled away from."""
         step = int(step)
-        if self._pending or (
-                self._last_anomaly_step is not None
-                and step <= self._last_anomaly_step + self.clean_window):
+        if self.open_incident(step):
             self._resolved.append((step, False))
             return
         self._pending_saves.append(step)

@@ -236,6 +236,103 @@ def bench_fleet_overhead(layers: int = 48, hidden: int = 256,
     return out
 
 
+def bench_autoscaler_overhead(layers: int = 48, hidden: int = 256,
+                              window: int = 64, n_hosts: int = 4,
+                              iters: int = 10, reps: int = 3):
+    """Fleet-autoscaler overhead: the IDENTICAL instrumented train
+    step, with a FleetController (and its FleetMonitor) observing the
+    session vs the bare step.
+
+    The controller's contract is that load-driven scaling is entirely
+    host-side — signal intake at window flushes, one decide() per step
+    boundary — so the traced program is unchanged and a ratio of ~1.0
+    IS the pass condition (``fleet.autoscaled_step`` in apexverify
+    proves the same fact structurally).  The host cost that DOES exist
+    — one decision over the windowed medians per boundary — is
+    measured separately as ``autoscaler_decide_ms``."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp, telemetry
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.bucketing_bench import many_leaf_params
+    from apex_tpu.resilience import fleet as fleet_mod
+
+    params = many_leaf_params(jax, jnp, layers, hidden)
+    scaler = amp.LossScaleState.create(2.0 ** 12)
+    grads = jax.tree_util.tree_map(
+        lambda p: (p * 1e-3 + 1e-4) * float(scaler.loss_scale), params)
+
+    opt = FusedAdam(params, lr=1e-3, fuse_buckets=True)
+    pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0)
+
+    def train_body(work, opt_state, grads, scaler_state, step):
+        flat = pipe.unscale_and_norm(pipe.pack(grads), scaler_state)
+        new_work, new_state = opt.functional_step(
+            work, opt_state, flat.bufs, step, clip_coef=flat.clip_coef)
+        return new_work, new_state, flat.found_inf
+
+    tel = telemetry.Telemetry(run_dir=None, window=window,
+                              retrace=False)
+    channel = fleet_mod.LocalChannel()
+    mon = fleet_mod.FleetMonitor(
+        channel=channel, host=0, n_hosts=n_hosts,
+        slow_after_steps=8, dead_after_steps=1 << 30,
+        slow_after_s=None, dead_after_s=None, telemetry=tel)
+    fleet_mod.SimulatedPeers(channel,
+                             hosts=list(range(1, n_hosts))).attach(mon)
+    ctrl = fleet_mod.FleetController(
+        telemetry=tel, step_time_high_s=60.0, step_time_low_s=1e-9,
+        queue_metric="loss", queue_high=1e12, window=window,
+        cooldown_steps=1 << 30)
+    out = {
+        "autoscaler_leaves": len(jax.tree_util.tree_leaves(params)),
+        "autoscaler_hosts": n_hosts,
+        "autoscaler_window": window,
+    }
+
+    # bare step (identical math, no ring, no controller)
+    # two programs, two compiles — not a hot-loop retrace
+    # apexlint: disable-next=APX302
+    off = jax.jit(train_body)
+    out["autoscaler_off_ms"] = round(timeit(
+        off, params, opt.opt_state, grads, scaler, jnp.int32(2),
+        iters=iters, reps=reps), 3)
+
+    # instrumented step with monitor + controller observing: the
+    # traced program must be the instrumented step, unchanged
+    # apexlint: disable-next=APX302
+    on = jax.jit(tel.instrument(train_body))
+    out["autoscaler_on_ms"] = round(timeit(
+        on, tel.buf, jnp.int32(2), params, opt.opt_state, grads,
+        scaler, jnp.int32(2), iters=iters, reps=reps), 3)
+
+    # host decision cost (signal intake + one decide per boundary),
+    # paid off the device's critical path
+    import statistics
+    import time
+    fake_window = [{"step": s, "loss": 1.0} for s in range(window)]
+    decide_ms = []
+    for rep in range(max(3, reps)):
+        t0 = time.perf_counter()
+        ctrl.observe(fake_window)
+        for s in range(window):
+            ctrl.note_step(rep * window + s + 1, 0.01)
+            ctrl.decide(rep * window + s + 1, n_hosts=n_hosts)
+        decide_ms.append((time.perf_counter() - t0) * 1e3 / window)
+    out["autoscaler_decide_ms"] = round(statistics.median(decide_ms), 5)
+
+    if out["autoscaler_off_ms"]:
+        out["autoscaler_overhead_pct"] = round(
+            (out["autoscaler_on_ms"] - out["autoscaler_off_ms"])
+            / out["autoscaler_off_ms"] * 100.0, 2)
+    ctrl.close()
+    mon.close()
+    tel.close()
+    return out
+
+
 def bench_watchdog_overhead(layers: int = 48, hidden: int = 256,
                             window: int = 64,
                             iters: int = 10, reps: int = 3):

@@ -72,15 +72,33 @@ def _anomaly_row(r: dict) -> List[str]:
 
 def _fleet_row(r: dict) -> List[str]:
     """One fleet-timeline row from a ``kind:"fleet"`` liveness event
-    (host_dead / host_slow), shrink action, or deadline event."""
+    (host_dead / host_slow / host_return), a resize action (shrink /
+    grow / admission_refused), an autoscaler decision, or a deadline
+    event."""
     step = str(r.get("step", "-"))
     event = r.get("event", "-")
     if event == "shrink":
         detail = (f"survivors={r.get('survivors')} "
                   f"dead={r.get('dead')} epoch={r.get('epoch')}")
+        if r.get("reason") and r.get("reason") != "failure":
+            detail += f" reason={r['reason']}"
         if r.get("to_step") is not None:
             detail += f" to_step={r['to_step']}"
         return [step, event, "-", detail]
+    if event == "grow":
+        detail = (f"members={r.get('members')} "
+                  f"admitted={r.get('admitted')} epoch={r.get('epoch')}")
+        if r.get("to_step") is not None:
+            detail += f" to_step={r['to_step']}"
+        return [step, event, "-", detail]
+    if event == "admission_refused":
+        return [step, event, str(r.get("host", "-")),
+                f"reason={r.get('reason')} "
+                f"incarnation={_fmt_cell(r.get('incarnation'))}"]
+    if event == "autoscale":
+        return [step, event, "-",
+                f"action={r.get('action')} reason={r.get('reason')} "
+                f"signal={_fmt_cell(r.get('signal'))}"]
     if event == "deadline_exceeded":
         return [step, event, "-",
                 f"phase={r.get('phase')} "
@@ -88,6 +106,9 @@ def _fleet_row(r: dict) -> List[str]:
     detail = (f"gap_s={_fmt_cell(r.get('gap_s'))} "
               f"lag_steps={_fmt_cell(r.get('lag_steps'))} "
               f"peer_step={_fmt_cell(r.get('peer_step'))}")
+    inc = (r.get("evidence") or {}).get("incarnation")
+    if event == "host_return" and inc is not None:
+        detail += f" incarnation={inc}"
     return [step, event, str(r.get("host", "-")), detail]
 
 

@@ -26,7 +26,12 @@ liveness beacon and classifies the peers.  Prove the recovery with
 N, the survivors agree on the death within the step-lag deadline,
 "shrink" the mesh, restore the last-known-good checkpoint and replay —
 the whole sequence (beacon gap -> host_dead -> shrink -> resume)
-renders as the fleet timeline in ``telemetry summarize``.
+renders as the fleet timeline in ``telemetry summarize``.  Add
+``--revive-host-at M`` (M > the shrink) for the GROW half: the killed
+peer returns under a fresh incarnation, the members admit it at a
+step boundary (``agree_admission``), the mesh grows back and the
+checkpoint reshards onto it — kill -> shrink -> return -> admit ->
+grow, end to end, on the same timeline.
 
 Self-healing (``--watchdog``, needs both dirs above): a
 :class:`~apex_tpu.resilience.Watchdog` watches the telemetry window
@@ -106,6 +111,12 @@ def parse_args(argv=None):
                         "beaconing at step N (the monitor detects the "
                         "death, survivors agree, shrink and resume "
                         "from the last checkpoint)")
+    p.add_argument("--revive-host-at", type=int, default=None,
+                   help="chaos: the killed peer returns with a fresh "
+                        "incarnation at step N (the members admit it "
+                        "at a step boundary, the mesh grows back and "
+                        "the checkpoint reshards onto it; needs "
+                        "--kill-host-at with N past the shrink)")
     return p.parse_args(argv)
 
 
@@ -149,6 +160,13 @@ def main(argv=None):
         from apex_tpu.resilience.faults import FaultSpec
         fault_specs.append(FaultSpec("peer_death",
                                      at_step=args.kill_host_at))
+    if args.revive_host_at is not None:
+        if args.kill_host_at is None:
+            raise SystemExit("--revive-host-at needs --kill-host-at "
+                             "(only a killed peer can return)")
+        from apex_tpu.resilience.faults import FaultSpec
+        fault_specs.append(FaultSpec("host_return",
+                                     at_step=args.revive_host_at))
     injector = None
     if fault_specs:
         from apex_tpu.resilience.faults import FaultInjector
@@ -251,6 +269,9 @@ def main(argv=None):
         if res.mesh_shrinks:
             print(f"fleet: peer failure survived — shrank to healthy "
                   f"mesh {res.mesh_shrinks}x and resumed")
+        if res.mesh_grows:
+            print(f"fleet: returned host re-admitted — grew back to "
+                  f"full mesh {res.mesh_grows}x and resumed")
         preempted = res.preempted
         if preempted:
             print(f"preempted: final checkpoint durable at step "

@@ -52,7 +52,8 @@ SUITES = {
                         "tests/test_distributed_launch.py"],
     "run_checkpoint": ["tests/test_native_checkpoint.py",
                        "tests/test_resilience.py",
-                       "tests/test_fleet.py"],
+                       "tests/test_fleet.py",
+                       "tests/test_fleet_grow.py"],
     "run_models": ["tests/test_models.py"],
     "run_examples": ["tests/test_examples_smoke.py"],
     "run_data": ["tests/test_data.py"],

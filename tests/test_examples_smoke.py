@@ -127,6 +127,37 @@ def test_train_toy_fleet_kill_one_host_shrinks_and_recovers(tmp_path,
     assert "fleet/hosts_dead" in out          # counters table rows
 
 
+def test_train_toy_revive_host_admits_and_grows(tmp_path, capsys):
+    """The elastic scale-UP acceptance flow, end to end: kill ->
+    shrink -> return -> admit -> grow.  The killed peer comes back
+    under a fresh incarnation, the members admit it at a step
+    boundary, the mesh grows back to full strength and the checkpoint
+    reshards onto it — with the whole timeline (host_dead -> shrink ->
+    host_return -> grow) visible in ``telemetry summarize``."""
+    import warnings as _warnings
+
+    ckpt = str(tmp_path / "ckpt")
+    tel = str(tmp_path / "telemetry")
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")      # the recoveries warn: fine
+        _run("examples/simple/train_toy.py",
+             ["--steps", "60", "--save-every", "6",
+              "--checkpoint-dir", ckpt, "--telemetry-dir", tel,
+              "--fleet", "--kill-host-at", "16",
+              "--revive-host-at", "34"])
+    out = capsys.readouterr().out
+    assert "shrank to healthy mesh" in out
+    assert "grew back to full mesh" in out
+    assert "OK:" in out                       # replay converged
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["summarize", tel]) == 0
+    out = capsys.readouterr().out
+    assert "fleet timeline:" in out
+    assert "host_dead" in out and "shrink" in out
+    assert "host_return" in out and "grow" in out
+    assert "fleet/mesh_grows" in out          # counters table row
+
+
 def test_imagenet_preempt_and_resume(tmp_path, capsys):
     """The imagenet example's save path rides the same resilience
     manager: --checkpoint-dir rotates bucket-native checkpoints and a

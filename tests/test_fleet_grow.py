@@ -1,0 +1,985 @@
+"""Elastic scale-UP: beacon-admitted host rejoin, grow-capable
+resharding, and the load-driven fleet autoscaler (the inverse flow of
+the failure-domain triad — ISSUE 12)."""
+
+import io
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.resilience import (CheckpointManager, FleetController,
+                                 FleetMonitor, FleetRecoveryFailed,
+                                 ScaleDecision, Watchdog, run_elastic)
+from apex_tpu.resilience import fleet as fleet_mod
+from apex_tpu.resilience.faults import FaultInjector, FaultSpec
+from apex_tpu.resilience.fleet import LocalChannel, SimulatedPeers
+
+
+def _lag_monitor(ch, host=0, n_hosts=3, slow=2, dead=4, **kw):
+    """A step-lag-only monitor (deterministic: no wall clock)."""
+    return FleetMonitor(channel=ch, host=host, n_hosts=n_hosts,
+                        slow_after_steps=slow, dead_after_steps=dead,
+                        slow_after_s=None, dead_after_s=None,
+                        agreement_timeout_s=0.2, **kw)
+
+
+# ---------------------------------------------------------------------
+# Monitor: (host, incarnation)-keyed sticky-dead + return candidates.
+# ---------------------------------------------------------------------
+
+def test_dead_host_with_fresh_incarnation_becomes_candidate():
+    """The satellite fix: sticky-dead keys on (host, incarnation) —
+    a host that dies and returns with a FRESH incarnation surfaces as
+    a host_return candidate instead of staying dead forever."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, slow=2, dead=4)
+    sim = SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    for s in range(1, 4):
+        mon.beat(s)
+    sim.kill(2)
+    events = []
+    for s in range(4, 12):
+        events += mon.beat(s)
+    assert [(e.kind, e.host) for e in events] == \
+        [("host_slow", 2), ("host_dead", 2)]
+    assert mon.return_candidates() == {}
+    sim.revive(2)                             # fresh incarnation
+    events = mon.beat(12)
+    assert [(e.kind, e.host) for e in events] == [("host_return", 2)]
+    assert events[0].evidence["incarnation"] == \
+        sim.incarnation_of(2) == 2
+    assert mon.return_candidates() == {2: 2}
+    # fires once per incarnation, and the host stays classified dead
+    # until an admission round actually admits it
+    assert mon.beat(13) == []
+    assert mon.dead_hosts() == [2]
+    assert mon.return_candidates() == {2: 2}
+
+
+def test_stale_incarnation_beacon_stays_dead_zombie():
+    """A dead host's OLD incarnation beaconing again (split-brain
+    zombie: the process never died, its network partition healed) must
+    stay ignored — no host_return, no candidate, still dead."""
+    clk = [1000.0]
+    ch = LocalChannel()
+    mon = FleetMonitor(channel=ch, host=0, n_hosts=2,
+                       slow_after_s=1.0, dead_after_s=3.0,
+                       clock=lambda: clk[0])
+    ch.put("beacon/1", {"host": 1, "step": 1, "wall_time": clk[0],
+                        "incarnation": 7})
+    assert mon.poll(1) == []
+    clk[0] += 5.0
+    assert [e.kind for e in mon.poll(2)] == ["host_dead"]
+    # the zombie: same incarnation 7, suddenly fresh again
+    ch.put("beacon/1", {"host": 1, "step": 3, "wall_time": clk[0],
+                        "incarnation": 7})
+    assert mon.poll(3) == []
+    assert mon.return_candidates() == {}
+    assert mon.dead_hosts() == [1]
+    # a FRESH incarnation from the same host is a real return
+    ch.put("beacon/1", {"host": 1, "step": 4, "wall_time": clk[0],
+                        "incarnation": 8})
+    evs = mon.poll(4)
+    assert [e.kind for e in evs] == ["host_return"]
+    assert mon.return_candidates() == {1: 8}
+
+
+def test_candidate_drops_when_it_flaps_away_again():
+    """Candidacy is re-validated every poll: a returned host that
+    stops beaconing again (flapping) drops out before admission."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, n_hosts=2, slow=2, dead=4)
+    sim = SimulatedPeers(ch, hosts=[1]).attach(mon)
+    mon.beat(1)
+    sim.kill(1)
+    for s in range(2, 8):
+        mon.beat(s)
+    assert mon.dead_hosts() == [1]
+    sim.revive(1)
+    mon.beat(8)
+    assert mon.return_candidates() == {1: 2}
+    sim.kill(1)                               # flaps away again
+    for s in range(9, 14):
+        mon.beat(s)
+    assert mon.return_candidates() == {}      # stale: dropped
+    assert mon.dead_hosts() == [1]
+
+
+def test_evicted_nonmember_host_can_candidate_after_shrink():
+    """After a shrink evicts the dead host from the member set, its
+    fresh-incarnation beacons (a non-member now) still surface as a
+    candidate — and its old incarnation's beacons do not."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, slow=2, dead=4)
+    sim = SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    for s in range(1, 4):
+        mon.beat(s)
+    sim.kill(2)
+    for s in range(4, 10):
+        mon.beat(s)
+    epoch, survivors = mon.agree_survivors(10, timeout_s=0.2)
+    assert survivors == [0, 1] and mon.hosts == [0, 1]
+    # the dead host's LAST beacon is still on the channel (stale
+    # incarnation): not a candidate
+    mon.beat(11)
+    assert mon.return_candidates() == {}
+    sim.revive(2)
+    events = mon.beat(12)
+    assert [(e.kind, e.host) for e in events] == [("host_return", 2)]
+    assert mon.return_candidates() == {2: 2}
+
+
+def test_agree_admission_grows_members_under_fresh_epoch():
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, slow=2, dead=4)
+    sim = SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    for s in range(1, 4):
+        mon.beat(s)
+    sim.kill(2)
+    for s in range(4, 10):
+        mon.beat(s)
+    e1, survivors = mon.agree_survivors(10, timeout_s=0.2)
+    assert survivors == [0, 1]
+    sim.revive(2)
+    mon.beat(11)
+    cands = mon.return_candidates()
+    e2, members = mon.agree_admission(11, cands, timeout_s=2.0)
+    assert members == [0, 1, 2] and e2 == e1 + 1
+    assert mon.hosts == [0, 1, 2]
+    assert mon.epoch == e2
+    assert mon.status(2) == fleet_mod.HOST_LIVE
+    assert mon.return_candidates() == {}      # consumed by admission
+
+
+def test_agree_admission_without_joiner_response_is_noop():
+    """A joiner that never answers the round (went silent between the
+    candidate poll and the agreement) drops out of the intersection:
+    the round degrades to a no-op, not a phantom admission."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, n_hosts=2, slow=2, dead=50)
+    # peer 1 answers agreement rounds; joiner 3 never does
+    mon.add_spin_hook(lambda epoch: ch.put(
+        f"verdict/{epoch}/1", {"host": 1, "epoch": epoch,
+                               "survivors": [0, 1, 3]}))
+    epoch, members = mon.agree_admission(5, {3: 9}, timeout_s=0.05)
+    assert members == [0, 1]
+    assert mon.hosts == [0, 1]
+
+
+def test_agree_survivors_exclude_releases_live_host():
+    """The autoscaler's voluntary release: exclude= drops the victim
+    from this host's proposal, the intersection rule evicts it, and
+    its unchanged incarnation cannot immediately re-candidate."""
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, slow=2, dead=50)
+    sim = SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    mon.beat(1)
+    epoch, survivors = mon.agree_survivors(2, timeout_s=2.0,
+                                           exclude=(2,))
+    assert survivors == [0, 1] and mon.hosts == [0, 1]
+    # the released host keeps beaconing under the SAME incarnation —
+    # stale by the (host, incarnation) rule, so no rejoin candidate
+    for s in range(3, 6):
+        mon.beat(s)
+    assert mon.return_candidates() == {}
+    # a restart (fresh incarnation) is what re-candidates it
+    sim.kill(2)
+    sim.revive(2)
+    mon.beat(6)
+    assert mon.return_candidates() == {2: 2}
+
+
+# ---------------------------------------------------------------------
+# run_elastic chaos: the grow matrix.
+# ---------------------------------------------------------------------
+
+_TOTAL, _EVERY = 20, 3
+
+
+def _mixed_tree():
+    return {
+        "w1": jnp.linspace(-1.0, 1.0, 256).astype(jnp.bfloat16
+                                                  ).reshape(16, 16),
+        "b1": jnp.linspace(0.0, 1.0, 16).astype(jnp.float32),
+    }
+
+
+def _grads_for(tree):
+    return jax.tree_util.tree_map(
+        lambda p: (p.astype(jnp.float32) * 1e-2 + 1e-3).astype(p.dtype),
+        tree)
+
+
+def _many_tree():
+    """Several same-dtype leaves, so a max_bucket_bytes cap genuinely
+    splits the dtype group into multiple buckets (chunk boundaries
+    fall on leaf boundaries — a 2-leaf tree cannot re-chunk)."""
+    return {f"w{i}": jnp.linspace(-1.0 + i, 1.0 + i, 64
+                                  ).astype(jnp.float32)
+            for i in range(4)}
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class _GrowJob:
+    """One faked-multi-host job: optimizer + manager + FleetMonitor
+    over simulated peers (the test_fleet.py _FleetJob shape, grown)."""
+
+    def __init__(self, ckpt_dir, n_hosts=3, slow=2, dead=4,
+                 total=_TOTAL, tree_fn=_mixed_tree, **opt_kw):
+        tree = tree_fn()
+        self.opt = FusedAdam(tree, lr=1e-2, **opt_kw)
+        self.g = _grads_for(tree)
+        self.total = total
+        self.mgr = CheckpointManager(ckpt_dir, keep=3, every=_EVERY)
+        self.template = jax.tree_util.tree_map(jnp.zeros_like, tree)
+        self.channel = LocalChannel()
+        self.mon = _lag_monitor(self.channel, n_hosts=n_hosts,
+                                slow=slow, dead=dead)
+        self.sim = SimulatedPeers(self.channel,
+                                  hosts=list(range(1, n_hosts)))
+        self.sim.attach(self.mon)
+        self.shrinks = []
+        self.grows = []
+
+    def step_fn(self, step):
+        self.opt.step(self.g)
+
+    def run(self, **kw):
+        kw.setdefault("backoff_s", 0.0)
+        return run_elastic(
+            self.step_fn, self.mgr, self.opt, total_steps=self.total,
+            params_like=self.template, fleet=self.mon,
+            on_shrink=lambda survivors, epoch:
+                self.shrinks.append((epoch, tuple(survivors))),
+            on_grow=lambda members, epoch:
+                self.grows.append((epoch, tuple(members))), **kw)
+
+    def close(self):
+        self.mon.close()
+        self.mgr.close()
+
+
+@pytest.fixture(scope="module")
+def _grow_reference(tmp_path_factory):
+    """The uninterrupted run every recovered run must match bit-exactly
+    (the step math is mesh-size-independent, so one reference serves
+    shrink AND grow recoveries)."""
+    job = _GrowJob(str(tmp_path_factory.mktemp("grow_ref")))
+    res = job.run()
+    assert res.step == _TOTAL
+    assert res.mesh_shrinks == 0 and res.mesh_grows == 0
+    job.close()
+    return job
+
+
+def test_kill_shrink_return_admit_grow_replays_bit_exact(
+        tmp_path, _grow_reference):
+    """THE acceptance flow: a 3-host fleet loses a host (shrink),
+    re-admits it on return under a fresh incarnation and epoch (grow),
+    resumes on the full mesh and replays bit-exactly vs an
+    uninterrupted run."""
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("host_return", at_step=12, target=2)]) as inj:
+        job = _GrowJob(str(tmp_path))
+        with pytest.warns(UserWarning, match="admitting host"):
+            res = job.run()
+        assert len(inj.fired) == 2
+    assert res.step == _TOTAL
+    assert res.mesh_shrinks == 1 and res.mesh_grows == 1
+    assert job.shrinks and job.shrinks[0][1] == (0, 1)
+    assert job.grows and job.grows[0][1] == (0, 1, 2)
+    assert job.mon.hosts == [0, 1, 2]         # back to full strength
+    assert job.mon.epoch == 2                 # shrink + grow epochs
+    kinds = [f.kind for f in job.mon.timeline]
+    assert "host_dead" in kinds and "host_return" in kinds
+    events = [(e.get("event"), e.get("step")) for e in job.mon.events]
+    assert [ev for ev, _ in events] == ["shrink", "grow"]
+    grow = next(e for e in job.mon.events if e.get("event") == "grow")
+    assert grow["admitted"] == [2] and grow["members"] == [0, 1, 2]
+    assert grow["to_step"] is not None
+    _assert_tree_equal(job.opt.params, _grow_reference.opt.params)
+    job.close()
+
+
+def test_flapping_host_one_shrink_zero_oscillation(tmp_path,
+                                                   _grow_reference):
+    """Hysteresis holds: the peer dies (one shrink), returns inside
+    the admission cooldown (refused), dies again — zero grows, zero
+    further shrinks, and the refusal is on the timeline."""
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("flapping_host", at_step=10, target=2,
+                      n_steps=2)]) as inj:
+        job = _GrowJob(str(tmp_path))
+        with pytest.warns(UserWarning, match="returned with a fresh"):
+            res = job.run(admission_cooldown_steps=15)
+        assert len(inj.fired) == 2
+    assert res.step == _TOTAL
+    assert res.mesh_shrinks == 1 and res.mesh_grows == 0
+    assert len(job.shrinks) == 1 and not job.grows
+    assert job.mon.hosts == [0, 1]            # never re-admitted
+    refused = [e for e in job.mon.events
+               if e.get("event") == "admission_refused"]
+    assert refused and refused[0]["reason"] == "cooldown"
+    assert refused[0]["host"] == 2
+    _assert_tree_equal(job.opt.params, _grow_reference.opt.params)
+    job.close()
+
+
+def test_grow_during_incident_refused_then_admitted(tmp_path,
+                                                    _grow_reference):
+    """An admission request while the watchdog has an OPEN incident
+    must be refused; once the incident closes, the same candidate is
+    admitted."""
+    wd = Watchdog(detectors=[], clean_window=4)
+    orig = wd.open_incident
+    wd.open_incident = lambda step: step <= 14 or orig(step)
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("grow_during_incident", at_step=12, target=2)]):
+        job = _GrowJob(str(tmp_path))
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            res = job.run(watchdog=wd)
+    assert res.mesh_shrinks == 1 and res.mesh_grows == 1
+    refused = [e for e in job.mon.events
+               if e.get("event") == "admission_refused"]
+    assert refused and refused[0]["reason"] == "open_incident"
+    grow = next(e for e in job.mon.events if e.get("event") == "grow")
+    assert grow["step"] > 14                  # only after it closed
+    assert job.mon.hosts == [0, 1, 2]
+    _assert_tree_equal(job.opt.params, _grow_reference.opt.params)
+    wd.close()
+    job.close()
+
+
+def test_grow_without_any_checkpoint_raises_typed(tmp_path):
+    """An admission that finds nothing to reshard onto the grown mesh
+    is a typed failure: the mesh already grew, so continuing without
+    the restore would leave the new host incoherent."""
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("host_return", at_step=12, target=2)]):
+        job = _GrowJob(str(tmp_path))
+        job.mgr.every = 10_000                # no cadence save ever
+        with pytest.raises(FleetRecoveryFailed):
+            with pytest.warns(UserWarning):
+                job.run()
+    job.close()
+
+
+def test_grow_sharding_reshards_onto_grown_device_set(
+        tmp_path, _grow_reference):
+    """The grow restore rides the existing ``sharding=`` reshard flow:
+    ``grow_sharding`` (evaluated AFTER the mesh re-init) lands the
+    restored state on the LARGER device set, and the replay still
+    matches bit-exact."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    ndev = min(8, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices")
+    evaluated = []
+
+    def grow_sharding():
+        s = NamedSharding(Mesh(np.array(jax.devices()[:ndev]), ("x",)),
+                          PartitionSpec())
+        evaluated.append(s)
+        return s
+
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("host_return", at_step=12, target=2)]):
+        job = _GrowJob(str(tmp_path))
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            res = job.run(grow_sharding=grow_sharding)
+    assert res.mesh_grows == 1 and len(evaluated) == 1
+    for buf in job.opt._param_bufs:
+        assert len(buf.sharding.device_set) == ndev
+    _assert_tree_equal(job.opt.params, _grow_reference.opt.params)
+    job.close()
+
+
+def test_grow_recovery_rewinds_telemetry_and_resets_watchdog(
+        tmp_path):
+    """Replay parity with shrink recovery: the grow restore rewinds
+    the telemetry session and resets watchdog detector state so the
+    replayed steps re-record and stale history cannot re-trigger."""
+    from apex_tpu import telemetry as telemetry_mod
+    from apex_tpu.resilience.watchdog import Detector
+
+    class _ResetSpy(Detector):
+        name = "spy"
+        resets = 0
+
+        def observe(self, records):
+            return []
+
+        def reset(self):
+            self.resets += 1
+
+    tel = telemetry_mod.Telemetry(run_dir=None, window=4,
+                                  retrace=False)
+    spy = _ResetSpy()
+    wd = Watchdog(detectors=[spy], telemetry=tel, clean_window=2)
+    job = _GrowJob(str(tmp_path))
+    job.mon.telemetry = tel
+    rewinds = []
+    orig_rewind = tel.rewind
+    tel.rewind = lambda s: (rewinds.append(s), orig_rewind(s))[1]
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("host_return", at_step=12, target=2)]):
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            res = job.run(watchdog=wd)
+    assert res.mesh_shrinks == 1 and res.mesh_grows == 1
+    grow = next(e for e in job.mon.events if e.get("event") == "grow")
+    assert rewinds[-1] == grow["to_step"]     # rewound to the restore
+    assert spy.resets >= 2                    # shrink AND grow reset
+    wd.close()
+    tel.close()
+    job.close()
+
+
+def test_grow_rechunks_bucket_plan_and_replays_bit_exact(tmp_path):
+    """``grow_max_bucket_bytes``: per-host HBM changed with the fleet
+    size, so the BucketPlan re-chunks on admission and the restore
+    lands in the new layout through the reconstruct path — still
+    bit-exact (chunk boundaries fall on leaf boundaries)."""
+    ref = _GrowJob(str(tmp_path / "ref"), tree_fn=_many_tree)
+    assert ref.run().step == _TOTAL
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("host_return", at_step=12, target=2)]):
+        job = _GrowJob(str(tmp_path / "job"), tree_fn=_many_tree)
+        nb0 = len(job.opt._plan.buckets)
+        caps = []
+
+        def cap_for(members):
+            caps.append(tuple(members))
+            return 256                        # tiny: forces chunking
+
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            res = job.run(grow_max_bucket_bytes=cap_for)
+    assert res.mesh_grows == 1
+    assert caps == [(0, 1, 2)]                # evaluated with members
+    assert job.opt._plan.max_bucket_bytes == 256
+    assert len(job.opt._plan.buckets) > nb0   # actually re-chunked
+    _assert_tree_equal(job.opt.params, ref.opt.params)
+    ref.close()
+    job.close()
+
+
+# ---------------------------------------------------------------------
+# Reshard-on-grow at the checkpoint layer: {1 -> 2, 2 -> 8}, with and
+# without offloaded optimizer state (conftest fakes 8 CPU devices).
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("offload", [False, True],
+                         ids=["plain", "offloaded"])
+def test_reshard_grow_1_to_2(tmp_path, offload):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from apex_tpu import checkpoint as ckpt_mod
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2, offload_state=offload)
+    opt.step(_grads_for(tree))
+    p = str(tmp_path / "small.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+
+    sharding = NamedSharding(
+        Mesh(np.array(jax.devices()[:2]), ("x",)), PartitionSpec())
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2)
+    params, _, step = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2,
+        sharding=sharding)
+    assert step == 1
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert len(leaf.sharding.device_set) == 2
+    _assert_tree_equal(params, opt.params)
+    # the grown-mesh replay matches the small-mesh one step for step
+    opt.step(_grads_for(tree))
+    opt2.step(_grads_for(tree))
+    _assert_tree_equal(opt2.params, opt.params)
+
+
+@pytest.mark.parametrize("offload", [False, True],
+                         ids=["plain", "offloaded"])
+def test_reshard_grow_2_to_8(tmp_path, offload):
+    """A checkpoint genuinely WRITTEN from 2-device state restores
+    onto 8 — the grow direction of the reshard flow (per-leaf state:
+    the packer declines multi-device trees, exactly the real shape of
+    an already-resharded optimizer)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from apex_tpu import checkpoint as ckpt_mod
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    two = NamedSharding(Mesh(np.array(jax.devices()[:2]), ("x",)),
+                        PartitionSpec())
+    tree = jax.tree_util.tree_map(
+        lambda l: jax.device_put(l, two), _mixed_tree())
+    opt = FusedAdam(tree, lr=1e-2)
+    assert opt._plan is None                  # multi-device: per-leaf
+    opt.step(jax.tree_util.tree_map(
+        lambda l: jax.device_put(l, two), _grads_for(_mixed_tree())))
+    p = str(tmp_path / "two.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+
+    eight = NamedSharding(Mesh(np.array(jax.devices()[:8]), ("x",)),
+                          PartitionSpec())
+    opt2 = FusedAdam(_mixed_tree(), lr=1e-2,
+                     offload_state=offload, fuse_buckets=not offload)
+    params, _, step = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, _mixed_tree()),
+        opt2, sharding=eight)
+    assert step == 1
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert len(leaf.sharding.device_set) == 8
+    _assert_tree_equal(params, opt.params)
+
+
+# ---------------------------------------------------------------------
+# Optimizer re-chunking (the max_bucket_bytes half of grow reshard).
+# ---------------------------------------------------------------------
+
+def test_rechunk_is_bit_exact_across_layout_change():
+    """rechunk() mid-run changes only the packing: N steps monolithic
+    + M steps chunked == N+M steps monolithic, bit for bit."""
+    tree = _many_tree()
+    a = FusedAdam(tree, lr=1e-2)
+    b = FusedAdam(_many_tree(), lr=1e-2)
+    g = _grads_for(tree)
+    for _ in range(3):
+        a.step(g)
+        b.step(g)
+    nb0 = len(a._plan.buckets)
+    assert a.rechunk(256) is True
+    assert len(a._plan.buckets) > nb0
+    assert a.rechunk(256) is False            # idempotent no-op
+    for _ in range(3):
+        a.step(g)
+        b.step(g)
+    _assert_tree_equal(a.params, b.params)
+    assert int(a.step_count) == int(b.step_count) == 6
+    for k in a.opt_state:
+        _assert_tree_equal(a._plan.unpack_state_field(a.opt_state[k]),
+                           b._plan.unpack_state_field(b.opt_state[k]))
+
+
+def test_rechunk_offloaded_state_stays_on_host():
+    tree = _mixed_tree()
+    opt = FusedAdam(tree, lr=1e-2, offload_state=True)
+    g = _grads_for(tree)
+    opt.step(g)
+    assert opt.rechunk(256) is True
+    for bufs in opt.opt_state.values():
+        for b in bufs:
+            assert b.sharding.memory_kind in ("pinned_host",
+                                              "unpinned_host")
+    ref = FusedAdam(_mixed_tree(), lr=1e-2)
+    ref.step(g)
+    opt.step(g)
+    ref.step(g)
+    _assert_tree_equal(opt.params, ref.params)
+
+
+def test_rechunk_requires_bucketed_path():
+    opt = FusedAdam(_mixed_tree(), lr=1e-2, fuse_buckets=False)
+    with pytest.raises(RuntimeError, match="bucketed"):
+        opt.rechunk(256)
+
+
+def test_restore_into_rechunked_plan_reconstructs(tmp_path):
+    """A checkpoint written under one chunking restores into a
+    differently-chunked optimizer (the reconstruct path) — what the
+    grow recovery does when grow_max_bucket_bytes changes the cap."""
+    from apex_tpu import checkpoint as ckpt_mod
+
+    tree = _many_tree()
+    opt = FusedAdam(tree, lr=1e-2)
+    g = _grads_for(tree)
+    opt.step(g)
+    p = str(tmp_path / "mono.ckpt")
+    ckpt_mod.save_training_state(p, optimizer=opt, step=1)
+
+    opt2 = FusedAdam(_many_tree(), lr=1e-2, max_bucket_bytes=256)
+    assert len(opt2._plan.buckets) > len(opt._plan.buckets)
+    params, _, step = ckpt_mod.load_training_state(
+        p, jax.tree_util.tree_map(jnp.zeros_like, tree), opt2)
+    assert step == 1
+    _assert_tree_equal(params, opt.params)
+    opt.step(g)
+    opt2.step(g)
+    _assert_tree_equal(opt2.params, opt.params)
+
+
+# ---------------------------------------------------------------------
+# FleetController decision units (synthetic counter streams).
+# ---------------------------------------------------------------------
+
+def _queue_records(value, n=8, start=0):
+    return [{"step": start + i, "q": float(value)} for i in range(n)]
+
+
+def test_controller_validation():
+    with pytest.raises(ValueError, match="grow signal"):
+        FleetController()
+    with pytest.raises(ValueError, match="queue_metric"):
+        FleetController(queue_high=10.0)
+    with pytest.raises(ValueError, match="low < high"):
+        FleetController(step_time_high_s=1.0, step_time_low_s=2.0)
+    with pytest.raises(ValueError, match="patience"):
+        FleetController(step_time_high_s=1.0, patience=0)
+
+
+def test_controller_queue_grow_with_patience_and_candidates():
+    c = FleetController(queue_metric="q", queue_high=100.0,
+                        queue_low=5.0, patience=2, cooldown_steps=10)
+    c.observe(_queue_records(500.0))
+    d1 = c.decide(1, n_hosts=2, candidates=1)
+    assert (d1.action, d1.reason) == ("stay", "patience")
+    d2 = c.decide(2, n_hosts=2, candidates=1)
+    assert d2.action == "grow" and d2.reason == "queue_depth"
+    assert d2.signal == 500.0
+    # without a candidate the demand is surfaced, not executed
+    c2 = FleetController(queue_metric="q", queue_high=100.0,
+                         patience=1)
+    c2.observe(_queue_records(500.0))
+    d = c2.decide(1, n_hosts=2, candidates=0)
+    assert (d.action, d.reason) == ("stay", "grow_wanted_no_candidates")
+
+
+def test_controller_shrink_on_low_queue_respects_min_hosts():
+    c = FleetController(queue_metric="q", queue_high=100.0,
+                        queue_low=5.0, patience=2, min_hosts=2)
+    c.observe(_queue_records(1.0))
+    c.decide(1, n_hosts=3)
+    d = c.decide(2, n_hosts=3)
+    assert d.action == "shrink" and d.reason == "queue_depth"
+    c.note_resize(2)
+    c.observe(_queue_records(1.0))
+    # at the floor: stay
+    c3 = FleetController(queue_metric="q", queue_high=100.0,
+                         queue_low=5.0, patience=1, min_hosts=2)
+    c3.observe(_queue_records(1.0))
+    assert c3.decide(1, n_hosts=2).reason == "at_min_hosts"
+
+
+def test_controller_step_time_signal():
+    c = FleetController(step_time_high_s=1.0, step_time_low_s=0.01,
+                        patience=1, window=8)
+    for s in range(8):
+        c.note_step(s, 5.0)
+    assert c.decide(9, n_hosts=2, candidates=1).action == "grow"
+    c2 = FleetController(step_time_high_s=1.0, step_time_low_s=0.01,
+                         patience=1)
+    for s in range(8):
+        c2.note_step(s, 0.001)
+    assert c2.decide(9, n_hosts=2).action == "shrink"
+
+
+def test_controller_cooldown_after_any_resize():
+    """Hysteresis: note_resize (grow, voluntary shrink, OR a failure
+    shrink) holds every decision for cooldown_steps."""
+    c = FleetController(queue_metric="q", queue_high=100.0,
+                        patience=1, cooldown_steps=10)
+    c.observe(_queue_records(500.0))
+    assert c.decide(1, n_hosts=2, candidates=1).action == "grow"
+    c.note_resize(1)
+    d = c.decide(5, n_hosts=3, candidates=1)
+    assert (d.action, d.reason) == ("stay", "cooldown")
+    d = c.decide(11, n_hosts=3, candidates=1)
+    assert d.action == "grow"                 # cooldown expired
+
+
+def test_controller_never_resizes_inside_open_incident():
+    c = FleetController(queue_metric="q", queue_high=100.0,
+                        patience=1)
+    c.observe(_queue_records(500.0))
+    d = c.decide(1, n_hosts=2, candidates=1, incident=True)
+    assert (d.action, d.reason) == ("stay", "open_incident")
+    # incident_source form (standalone use)
+    c2 = FleetController(queue_metric="q", queue_high=100.0,
+                         patience=1, incident_source=lambda: True)
+    c2.observe(_queue_records(500.0))
+    assert c2.decide(1, n_hosts=2, candidates=1).reason == \
+        "open_incident"
+
+
+def test_controller_holds_while_fleet_degraded():
+    """The fleet/hosts_slow counter (riding the hostmetrics sinks)
+    parks the controller: never resize under an infrastructure
+    wobble."""
+    from apex_tpu.telemetry import hostmetrics
+    c = FleetController(queue_metric="q", queue_high=100.0,
+                        patience=1)
+    try:
+        c.observe(_queue_records(500.0))
+        hostmetrics.emit("fleet/hosts_slow", 1)
+        d = c.decide(1, n_hosts=2, candidates=1)
+        assert (d.action, d.reason) == ("stay", "fleet_degraded")
+        hostmetrics.emit("fleet/hosts_slow", 0)
+        assert c.decide(2, n_hosts=2, candidates=1).action == "grow"
+    finally:
+        c.close()
+
+
+def test_controller_max_hosts_caps_grow():
+    c = FleetController(queue_metric="q", queue_high=100.0,
+                        patience=1, max_hosts=3)
+    c.observe(_queue_records(500.0))
+    assert c.decide(1, n_hosts=3, candidates=1).reason == \
+        "at_max_hosts"
+    assert c.decide(2, n_hosts=2, candidates=1).action == "grow"
+
+
+def test_controller_decisions_ride_session_flush(tmp_path):
+    """grow/shrink decision events land in the JSONL through the
+    session observer (the watchdog/fleet observer discipline)."""
+    from apex_tpu import telemetry as telemetry_mod
+
+    run_dir = str(tmp_path / "run")
+    tel = telemetry_mod.Telemetry(run_dir, window=4, retrace=False,
+                                  metrics=("loss", "q"))
+    c = FleetController(telemetry=tel, queue_metric="q",
+                        queue_high=100.0, patience=1)
+    for s in range(1, 6):
+        tel.record({"loss": 1.0, "q": 500.0}, s)
+    tel.flush()                               # observer pulls q values
+    d = c.decide(6, n_hosts=2, candidates=1)
+    assert d.action == "grow"
+    c.close()
+    tel.close()
+    recs = [json.loads(l) for l in
+            open(os.path.join(run_dir, "telemetry.jsonl"))]
+    autoscale = [r for r in recs if r.get("event") == "autoscale"]
+    assert autoscale and autoscale[0]["action"] == "grow"
+    assert autoscale[0]["reason"] == "queue_depth"
+
+
+# ---------------------------------------------------------------------
+# run_elastic(autoscale=): controller-driven grow and release.
+# ---------------------------------------------------------------------
+
+def test_autoscale_requires_fleet(tmp_path):
+    c = FleetController(step_time_high_s=1.0)
+    job = _GrowJob(str(tmp_path))
+    with pytest.raises(ValueError, match="fleet"):
+        run_elastic(job.step_fn, job.mgr, job.opt, total_steps=2,
+                    params_like=job.template, autoscale=c)
+    job.close()
+
+
+def test_autoscale_grow_admits_returned_host(tmp_path,
+                                             _grow_reference):
+    """Controller-driven grow: load is high, a host returns, the grow
+    decision executes the admission — and the failure shrink armed
+    the controller's cooldown first (note_resize on EVERY resize)."""
+    c = FleetController(queue_metric="q", queue_high=100.0,
+                        patience=1, cooldown_steps=2)
+    c.observe(_queue_records(500.0))          # standing high load
+    with FaultInjector([
+            FaultSpec("peer_death", at_step=4, target=2),
+            FaultSpec("host_return", at_step=12, target=2)]):
+        job = _GrowJob(str(tmp_path))
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            res = job.run(autoscale=c)
+    assert res.mesh_shrinks == 1 and res.mesh_grows == 1
+    assert job.mon.hosts == [0, 1, 2]
+    grows = [d for d in c.decisions if d.action == "grow"]
+    assert grows and grows[0].reason == "queue_depth"
+    # the failure shrink armed the cooldown
+    assert any(d.reason == "cooldown" for d in c.decisions)
+    _assert_tree_equal(job.opt.params, _grow_reference.opt.params)
+    c.close()
+    job.close()
+
+
+def test_autoscale_shrink_releases_highest_rank_peer(
+        tmp_path, _grow_reference):
+    """Controller-driven release: load is low, the highest-rank peer
+    is excluded from the proposal, the mesh shrinks through the same
+    machinery (reason=autoscale on the timeline), no retry budget is
+    consumed, and the replay stays bit-exact."""
+    c = FleetController(queue_metric="q", queue_high=1e9,
+                        queue_low=5.0, patience=7, min_hosts=2)
+    c.observe(_queue_records(1.0))            # standing low load
+    job = _GrowJob(str(tmp_path))
+    with pytest.warns(UserWarning, match="autoscaler releasing"):
+        res = job.run(autoscale=c, max_restarts=0)
+    assert res.step == _TOTAL
+    assert res.mesh_shrinks == 1 and res.restarts == 0
+    assert job.mon.hosts == [0, 1]            # host 2 released
+    shrink = next(e for e in job.mon.events
+                  if e.get("event") == "shrink")
+    assert shrink["reason"] == "autoscale" and shrink["dead"] == [2]
+    # cooldown: exactly one release, no drain-to-min loop
+    assert [d.action for d in c.decisions].count("shrink") == 1
+    _assert_tree_equal(job.opt.params, _grow_reference.opt.params)
+    c.close()
+    job.close()
+
+
+# ---------------------------------------------------------------------
+# Telemetry surface: grow/admission/autoscale rows render.
+# ---------------------------------------------------------------------
+
+def test_grow_events_land_in_session_jsonl_and_summarize(tmp_path):
+    from apex_tpu import telemetry as telemetry_mod
+    from apex_tpu.telemetry.cli import summarize
+
+    run_dir = str(tmp_path / "run")
+    tel = telemetry_mod.Telemetry(run_dir, window=4, retrace=False)
+    ch = LocalChannel()
+    mon = _lag_monitor(ch, slow=2, dead=4, telemetry=tel)
+    sim = SimulatedPeers(ch, hosts=[1, 2]).attach(mon)
+    for s in range(1, 4):
+        tel.record({"loss": 1.0}, s)
+        mon.beat(s)
+    sim.kill(2)
+    for s in range(4, 10):
+        tel.record({"loss": 1.0}, s)
+        mon.beat(s)
+    epoch, survivors = mon.agree_survivors(9, timeout_s=0.2)
+    mon.note_shrink(9, epoch, survivors, [2], restored_step=6)
+    sim.revive(2)
+    tel.record({"loss": 1.0}, 10)
+    mon.beat(10)
+    mon.note_admission_refused(10, mon.return_candidates(),
+                               "open_incident")
+    epoch, members = mon.agree_admission(11, mon.return_candidates(),
+                                         timeout_s=2.0)
+    mon.note_grow(11, epoch, members, [2], restored_step=9)
+    mon.close()
+    tel.close()
+
+    recs = [json.loads(l) for l in
+            open(os.path.join(run_dir, "telemetry.jsonl"))]
+    fleet_recs = [r for r in recs if r.get("kind") == "fleet"]
+    assert {"host_return", "grow", "admission_refused"} <= \
+        {r["event"] for r in fleet_recs}
+    counters = {r["name"] for r in recs if r.get("kind") == "counter"}
+    assert "fleet/mesh_grows" in counters
+
+    out = io.StringIO()
+    assert summarize(run_dir, out=out) == 0
+    text = out.getvalue()
+    assert "host_return" in text and "incarnation=2" in text
+    assert "grow" in text and "admitted=[2]" in text
+    assert "admission_refused" in text
+    assert "reason=open_incident" in text
+
+    out = io.StringIO()
+    assert summarize(run_dir, as_json=True, out=out) == 0
+    doc = json.loads(out.getvalue())
+    assert any(e["event"] == "grow" for e in doc["fleet"])
+
+
+# ---------------------------------------------------------------------
+# Faults, spec registry, bench smoke, result surface.
+# ---------------------------------------------------------------------
+
+def test_new_fault_kinds_validate_and_need_at_step():
+    for kind in ("host_return", "flapping_host",
+                 "grow_during_incident"):
+        FaultInjector([FaultSpec(kind, at_step=3)])
+        with pytest.raises(ValueError, match="at_step"):
+            FaultInjector([FaultSpec(kind)])
+
+
+def test_simulated_peers_consume_grow_faults():
+    ch = LocalChannel()
+    sim = SimulatedPeers(ch, hosts=[1, 2])
+    sim.kill(2)
+    with FaultInjector([FaultSpec("host_return", at_step=5,
+                                  target=2)]) as inj:
+        sim.beat(5)
+        assert inj.fired
+    assert 2 not in sim.killed
+    assert sim.incarnation_of(2) == 2         # fresh incarnation
+
+
+def test_simulated_peers_flapping_host_dies_when_budget_expires():
+    ch = LocalChannel()
+    sim = SimulatedPeers(ch, hosts=[1, 2])
+    sim.kill(2)
+    with FaultInjector([FaultSpec("flapping_host", at_step=5,
+                                  target=2, n_steps=2)]):
+        sim.beat(5)
+        assert 2 not in sim.killed            # returned
+        sim.beat(6)
+        assert 2 not in sim.killed            # still alive (budget)
+        sim.beat(7)
+        assert 2 in sim.killed                # budget spent: flapped
+
+
+def test_autoscaled_step_spec_registered():
+    from apex_tpu.lint import semantic
+    names = [s.name for s in semantic.all_specs()]
+    assert "fleet.autoscaled_step" in names
+
+
+def test_autoscaler_overhead_bench_smoke():
+    from apex_tpu.telemetry.bench import bench_autoscaler_overhead
+    r = bench_autoscaler_overhead(layers=2, hidden=16, window=8,
+                                  n_hosts=3, iters=2, reps=1)
+    assert r["autoscaler_on_ms"] > 0 and r["autoscaler_off_ms"] > 0
+    assert r["autoscaler_decide_ms"] >= 0
+    assert r["autoscaler_hosts"] == 3
+
+
+def test_elastic_result_mesh_grows_defaults_zero():
+    from apex_tpu.resilience import ElasticResult
+    res = ElasticResult(step=1, preempted=False, restarts=0,
+                        restored_from=None)
+    assert res.mesh_grows == 0 and res.mesh_shrinks == 0
+
+
+def test_scale_decision_record_shape():
+    d = ScaleDecision("grow", 7, "queue_depth", 512.0)
+    rec = d.record()
+    assert rec["kind"] == "fleet" and rec["event"] == "autoscale"
+    assert rec["action"] == "grow" and rec["signal"] == 512.0
+    json.dumps(rec)
+
+
+def test_grow_mesh_is_inverse_of_shrink_mesh():
+    """comm.grow_mesh rebuilds the global mesh over the member set:
+    data axis absorbs the growth, minor axes preserved while
+    divisible."""
+    from apex_tpu import comm
+    ndev = len(jax.devices())
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices")
+    try:
+        comm.initialize(devices=jax.devices())
+        m = comm.grow_mesh([0])               # faked: same process
+        assert m is comm.mesh()
+        assert comm.config().data * comm.config().pipe * \
+            comm.config().ctx * comm.config().model == ndev
+    finally:
+        comm.destroy()

@@ -3,11 +3,13 @@
 # + baseline diff over the package, then the relaxed profile over
 # tests/, examples/ and tools/ (APX101/102 exempt inside test bodies —
 # a test syncing to assert a device value is the point of the test).
-# The semantic tier includes the watchdog.instrumented_step and
-# fleet.instrumented_step specs: a watchdog-attached / fleet-monitored
-# flat-AMP step must contain zero transfer/callback primitives
-# (self-healing detectors are host-side window-cadence consumers; the
-# fleet liveness beacon is host-side and out-of-band).
+# The semantic tier includes the watchdog.instrumented_step,
+# fleet.instrumented_step and fleet.autoscaled_step specs: a
+# watchdog-attached / fleet-monitored / autoscale-controlled flat-AMP
+# step must contain zero transfer/callback primitives (self-healing
+# detectors are host-side window-cadence consumers; the fleet
+# liveness beacon is host-side and out-of-band; the autoscaler is a
+# host-side window-flush decision policy).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #

@@ -556,6 +556,28 @@ def main():
         "speedup": (round(rfl["fleet_off_ms"] / rfl["fleet_on_ms"], 2)
                     if rfl["fleet_on_ms"] else None)})
 
+    # autoscaler overhead: the same instrumented step with a
+    # FleetController (+ monitor) observing the session vs the bare
+    # step ("kernel" = controller-observed, "oracle" = bare — ~1.0 IS
+    # the pass condition: load-driven scaling is host-side window-flush
+    # intake + one decide per boundary, measured separately as
+    # autoscaler_decide_ms.  The fleet.autoscaled_step apexverify spec
+    # proves the same fact structurally)
+    from apex_tpu.telemetry.bench import bench_autoscaler_overhead
+    ras = bench_autoscaler_overhead()
+    ras["backend"] = backend
+    print(json.dumps(ras), flush=True)
+    rows.append({
+        "kernel": "autoscaler_overhead",
+        "shape": (f"{ras['autoscaler_leaves']}leaves/"
+                  f"{ras['autoscaler_hosts']}hosts"),
+        "dtype": "f32",
+        "kernel_ms": ras["autoscaler_on_ms"],
+        "oracle_ms": ras["autoscaler_off_ms"],
+        "speedup": (round(ras["autoscaler_off_ms"]
+                          / ras["autoscaler_on_ms"], 2)
+                    if ras["autoscaler_on_ms"] else None)})
+
     for r in rows:
         r["backend"] = backend
         print(json.dumps(r), flush=True)
