@@ -40,6 +40,8 @@ from apex_tpu.amp.flat_pipeline import FlatGradPipeline, FlatGrads, \
     GradAccum
 from apex_tpu.amp.wrap import auto_cast, cast_inputs
 from apex_tpu.amp import lists
+from apex_tpu.amp import fp8
+from apex_tpu.amp.fp8 import Fp8Policy, Fp8State
 
 __all__ = [
     "Policy", "Properties", "opt_level_properties",
@@ -49,5 +51,6 @@ __all__ = [
     "AmpState", "initialize", "master_params_to_model_params",
     "update_scaler", "state_dict", "load_state_dict",
     "FlatGradPipeline", "FlatGrads", "GradAccum",
+    "Fp8Policy", "Fp8State", "fp8",
     "auto_cast", "cast_inputs", "lists",
 ]

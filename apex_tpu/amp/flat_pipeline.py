@@ -144,7 +144,8 @@ class FlatGradPipeline:
                  defer_plan: bool = False,
                  interleave: bool = False,
                  reduce_decompose: str = "psum",
-                 max_bucket_bytes=None):
+                 max_bucket_bytes=None,
+                 fp8=None):
         if reduce_decompose == "auto":
             # measured per-topology preference (tools/autotune.py);
             # absent entry = the design default
@@ -204,6 +205,13 @@ class FlatGradPipeline:
         self.interleave = bool(interleave)
         self.reduce_decompose = reduce_decompose
         self.max_bucket_bytes = max_bucket_bytes
+        # fp8 delayed scaling for the GRADIENT side: e5m2 per-tensor
+        # scale state packed in this plan's layout (docs/amp.md "fp8
+        # training") — fp8=True resolves the autotuned policy
+        if fp8 is True:
+            from apex_tpu.amp.fp8 import tuned_policy
+            fp8 = tuned_policy()
+        self.fp8 = fp8
         self._seams: dict = {}
 
     # ---- stages ----------------------------------------------------------
@@ -329,6 +337,42 @@ class FlatGradPipeline:
         return FlatGrads(bufs=outs, grad_norm=norm,
                          found_inf=found_inf, clip_coef=clip)
 
+    # ---- fp8 delayed scaling (gradient side) -----------------------------
+    def fp8_init(self):
+        """Fresh packed :class:`~apex_tpu.amp.fp8.Fp8State` over this
+        plan — per-tensor amax history + e5m2 scales for the gradient
+        buffers.  Thread it through the jitted step next to the loss
+        scaler and feed the step's flag to the optimizer's
+        ``found_inf=``."""
+        from apex_tpu.amp import fp8 as _fp8
+        if self.fp8 is None:
+            raise ValueError("pipeline was built without fp8= policy")
+        if self.plan is None:
+            raise ValueError("fp8_init needs a resolved plan "
+                             "(construct with optimizer=/plan=/params=)")
+        return _fp8.init_state(self.plan, self.fp8)
+
+    def fp8_update(self, fp8_state, flat: FlatGrads):
+        """Roll the unscaled gradient buffers' per-tensor amax into
+        the delayed-scaling state (ONE flat pass per bucket) and
+        latch any fp8 overflow into the bundle's ``found_inf`` — a
+        poisoned scale state skips the step and holds the step clock
+        exactly like a loss-scale overflow.  A step already skipped
+        (``flat.found_inf``) holds the fp8 history too — garbage amax
+        must never enter the window — while an overflowed tensor's
+        scale still backs off (the loss scaler's own skip-and-back-off
+        shape; see ``amp.fp8.update_state``).  Returns
+        ``(flat', new_state)``.
+        """
+        from apex_tpu.amp import fp8 as _fp8
+        if self.fp8 is None:
+            raise ValueError("pipeline was built without fp8= policy")
+        new_state, f8_inf = _fp8.update_state(
+            fp8_state, flat.bufs, self.plan, self.fp8,
+            fp8_max_value=self.fp8.bwd_max(), skip=flat.found_inf)
+        return (flat._replace(
+            found_inf=jnp.maximum(flat.found_inf, f8_inf)), new_state)
+
     # ---- microbatch accumulation -----------------------------------------
     def init_accum(self) -> GradAccum:
         """Fresh zeroed accumulator state in the plan's layout."""
@@ -354,14 +398,18 @@ class FlatGradPipeline:
                          count=acc.count + 1)
 
     def finalize(self, acc: GradAccum, state=None, inv_scale=None,
-                 average: bool = True) -> FlatGrads:
+                 average: bool = True, fp8_state=None):
         """Accumulator -> FlatGrads: ONE data-parallel reduce per
         bucket (grad accumulation reduces once per committed step, not
         per microbatch), then the fused unscale+norm+clip epilogue
         with the loss scale and the microbatch count folded into a
         single ``inv_scale`` (``average=True`` divides by ``count`` —
         the mean-over-global-batch convention).  The latched
-        ``found_inf`` ORs into the epilogue's own detection."""
+        ``found_inf`` ORs into the epilogue's own detection.
+
+        ``fp8_state``: delayed-scaling gradient state — its amax
+        update rides the finalized (unscaled) buffers and the return
+        becomes ``(flat, new_fp8_state)``."""
         bufs = self.reduce(acc.bufs)
         if inv_scale is None:
             inv_scale = (1.0 / _scaler_state(state).loss_scale
@@ -371,8 +419,11 @@ class FlatGradPipeline:
             inv_scale = inv_scale / jnp.maximum(
                 acc.count, 1).astype(jnp.float32)
         flat = self.unscale_and_norm(bufs, inv_scale=inv_scale)
-        return flat._replace(
+        flat = flat._replace(
             found_inf=jnp.maximum(flat.found_inf, acc.found_inf))
+        if fp8_state is not None:
+            return self.fp8_update(fp8_state, flat)
+        return flat
 
     def reset_accum(self, acc: GradAccum) -> GradAccum:
         """Zeroed accumulator for the next step, reusing the buffer
@@ -383,7 +434,8 @@ class FlatGradPipeline:
     # ---- end-to-end ------------------------------------------------------
     def scaled_value_and_grad(self, loss_fn, state, *args,
                               has_aux: bool = False,
-                              microbatches: int = 1, **kwargs):
+                              microbatches: int = 1,
+                              fp8_state=None, **kwargs):
         """value_and_grad of the LOSS-SCALED objective, gradients flat.
 
         The flat analog of ``amp.scaled_value_and_grad``: returns
@@ -406,11 +458,18 @@ class FlatGradPipeline:
         the full batch for a mean-over-examples loss); with
         ``has_aux`` the aux comes back stacked along a leading
         microbatch axis.
+
+        ``fp8_state``: packed delayed-scaling gradient state
+        (``fp8_init()``) — the amax/scale update rides the unscaled
+        buffers (one flat pass per bucket) and the return grows a
+        trailing ``new_fp8_state``, with any fp8 overflow latched
+        into ``flat.found_inf``.
         """
         sstate = _scaler_state(state)
         if microbatches > 1:
             return self._microbatched(loss_fn, sstate, args,
-                                      has_aux, int(microbatches), kwargs)
+                                      has_aux, int(microbatches),
+                                      kwargs, fp8_state)
         interleaved = self.interleave and self.axis_name is not None
 
         def scaled_fn(*a, **kw):
@@ -435,11 +494,17 @@ class FlatGradPipeline:
         loss = scaled / sstate.loss_scale
         _tape.emit("amp/loss_scale", sstate.loss_scale)
         _tape.emit("loss", loss)
+        if fp8_state is not None:
+            flat, fp8_state = self.fp8_update(fp8_state, flat)
+            if has_aux:
+                return (loss, aux), flat, fp8_state
+            return loss, flat, fp8_state
         if has_aux:
             return (loss, aux), flat
         return loss, flat
 
-    def _microbatched(self, loss_fn, sstate, args, has_aux, n, kwargs):
+    def _microbatched(self, loss_fn, sstate, args, has_aux, n, kwargs,
+                      fp8_state=None):
         """The ``microbatches=N`` body: scan over leading-axis splits,
         accumulating packed gradients (never a per-leaf tree)."""
         params, xs = split_microbatch_args(args, n)
@@ -469,10 +534,16 @@ class FlatGradPipeline:
 
         (acc, scaled_sum), auxes = jax.lax.scan(
             body, (self.init_accum(), jnp.float32(0.0)), xs)
-        flat = self.finalize(acc, sstate, average=True)
+        out = self.finalize(acc, sstate, average=True,
+                            fp8_state=fp8_state)
+        flat, new_fp8 = out if fp8_state is not None else (out, None)
         loss = scaled_sum / (jnp.float32(n) * sstate.loss_scale)
         _tape.emit("amp/loss_scale", sstate.loss_scale)
         _tape.emit("loss", loss)
+        if fp8_state is not None:
+            if has_aux:
+                return (loss, auxes), flat, new_fp8
+            return loss, flat, new_fp8
         if has_aux:
             return (loss, auxes), flat
         return loss, flat

@@ -62,6 +62,14 @@ class AmpState:
         cast = self.properties.cast_model_type
         return cast if cast is not None else jnp.bfloat16
 
+    @property
+    def fp8_policy(self):
+        """The armed :class:`apex_tpu.amp.fp8.Fp8Policy` (None when
+        this state was initialized without ``fp8=``) — hand it to
+        fp8-capable modules (``FusedDense(fp8=state.fp8_policy)``,
+        the tensor-parallel linears)."""
+        return getattr(self.properties, "fp8", None)
+
     def flat_pipeline(self, optimizer=None, plan=None,
                       max_grad_norm: float = 0.0, axis_name=None,
                       **kw):
@@ -73,6 +81,7 @@ class AmpState:
         with this state's ``scaler`` threaded through the train step.
         """
         from apex_tpu.amp.flat_pipeline import FlatGradPipeline
+        kw.setdefault("fp8", self.fp8_policy)
         return FlatGradPipeline(optimizer=optimizer, plan=plan,
                                 max_grad_norm=max_grad_norm,
                                 axis_name=axis_name, **kw)
@@ -125,12 +134,22 @@ def initialize(params: Pytree,
                master_weights=None,
                loss_scale: Union[str, float, None] = None,
                enabled: bool = True,
+               fp8=None,
                ) -> Tuple[Pytree, AmpState]:
     """Resolve an opt level to a precision configuration and cast params.
 
     Mirrors apex.amp.initialize's signature shape (model, optimizers →
     params pytree here); per-kwarg overrides beat the table defaults, as in
     the reference.  Returns (cast_params, amp_state).
+
+    ``fp8`` (beyond-reference): an ``amp.fp8.Fp8Policy`` (or ``True``
+    for the autotuned defaults) arms the fp8 training path on top of
+    the opt level — matmul-shaped modules built with
+    ``fp8=state.fp8_policy`` quantize to e4m3 forward / e5m2 backward
+    under delayed scaling, and ``state.flat_pipeline()`` threads the
+    packed per-bucket scale state (docs/amp.md "fp8 training").
+    Params still cast per the opt level (fp8 is a COMPUTE format, not
+    a storage format — weights stay bf16/f16 masters-backed).
     """
     props = opt_level_properties(opt_level, half_dtype)
     if cast_model_type is not None:
@@ -142,6 +161,15 @@ def initialize(params: Pytree,
     if loss_scale is not None:
         props.loss_scale = loss_scale
     props.enabled = enabled
+    if fp8 is not None and fp8 is not False:
+        from apex_tpu.amp.fp8 import Fp8Policy, tuned_policy
+        if fp8 is True:
+            fp8 = tuned_policy()
+        if not isinstance(fp8, Fp8Policy):
+            raise TypeError(
+                f"fp8= expects an amp.fp8.Fp8Policy or True, got "
+                f"{type(fp8).__name__}")
+        props.fp8 = fp8
     if not enabled:
         return params, AmpState(master_params=None,
                                 scaler=LossScaleState.create(1.0),

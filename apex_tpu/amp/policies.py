@@ -53,7 +53,12 @@ class Policy:
 
 @dataclasses.dataclass
 class Properties:
-    """Reference-shaped option bundle (apex/amp/frontend.py::Properties)."""
+    """Reference-shaped option bundle (apex/amp/frontend.py::Properties).
+
+    ``fp8``: beyond-reference — an :class:`apex_tpu.amp.fp8.Fp8Policy`
+    extends the opt level with e4m3/e5m2 matmuls under delayed
+    scaling (``amp.initialize(opt_level="O3", fp8=Fp8Policy())``);
+    None keeps the bf16/f16 ceiling."""
     opt_level: str = "O0"
     cast_model_type: Optional[Dtype] = None
     patch_torch_functions: bool = False
@@ -61,6 +66,7 @@ class Properties:
     master_weights: Optional[bool] = None
     loss_scale: Union[str, float] = 1.0
     enabled: bool = True
+    fp8: Optional[Any] = None
 
     def policy(self, half_dtype: Dtype = jnp.bfloat16) -> Policy:
         half = half_dtype

@@ -433,11 +433,26 @@ def _v2_leaves(plan_doc: Dict, bufs: Sequence[np.ndarray]
     scalar = all(b.size == len(d["leaves"])
                  for b, d in zip(bufs, bdocs))
     flat = all(b.size == int(d["size"]) for b, d in zip(bufs, bdocs))
+    # row-stacked per-leaf vectors (the fp8 amax-history slot packs
+    # (n_leaves, H) per bucket, stored flattened): every buffer a
+    # whole multiple of its leaf count with ONE consistent row width
+    widths = {b.size // len(d["leaves"])
+              for b, d in zip(bufs, bdocs)
+              if len(d["leaves"]) and b.size % len(d["leaves"]) == 0}
+    stacked = (not scalar and not flat and len(widths) == 1
+               and all(len(d["leaves"])
+                       and b.size % len(d["leaves"]) == 0
+                       for b, d in zip(bufs, bdocs)))
     for bi, d in enumerate(bdocs):
         buf = bufs[bi]
         if scalar and not flat:
             for j, ld in enumerate(d["leaves"]):
                 leaves[ld["index"]] = buf[j]
+        elif stacked:
+            width = next(iter(widths))
+            rows = buf.reshape(len(d["leaves"]), width)
+            for j, ld in enumerate(d["leaves"]):
+                leaves[ld["index"]] = rows[j]
         else:
             for ld in d["leaves"]:
                 shape = tuple(ld["shape"])

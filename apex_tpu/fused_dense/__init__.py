@@ -1,7 +1,8 @@
 from apex_tpu.fused_dense.fused_dense import (FusedDense,
                                               FusedDenseGeluDense,
+                                              fp8_matmul,
                                               fused_dense_function,
                                               fused_dense_gelu_dense_function)
 
-__all__ = ["FusedDense", "FusedDenseGeluDense", "fused_dense_function",
-           "fused_dense_gelu_dense_function"]
+__all__ = ["FusedDense", "FusedDenseGeluDense", "fp8_matmul",
+           "fused_dense_function", "fused_dense_gelu_dense_function"]

@@ -14,6 +14,7 @@ from apex_tpu.lint.rules.telemetry_sync import TelemetrySyncRule
 from apex_tpu.lint.rules.accum_unpack import AccumUnpackRule
 from apex_tpu.lint.rules.dtype_promotion import (
     Float64Rule, MatmulAccumulationRule, StrongScalarRule)
+from apex_tpu.lint.rules.fp8_scale import Fp8ScaleUnapplyRule
 from apex_tpu.lint.rules.retrace import (
     JitInHotPathRule, TracedBranchRule, TracedRangeRule)
 from apex_tpu.lint.rules.donation import DonationRule
@@ -32,6 +33,7 @@ _RULE_CLASSES = (
     MatmulAccumulationRule,
     Float64Rule,
     StrongScalarRule,
+    Fp8ScaleUnapplyRule,
     TracedBranchRule,
     JitInHotPathRule,
     TracedRangeRule,

@@ -1,0 +1,136 @@
+"""APX204 fp8-value-in-reduction-without-scale-unapply.
+
+fp8 tensors are SCALED storage: a value quantized with
+``q = clip(x * scale).astype(jnp.float8_e4m3fn)`` (or
+``amp.fp8.quantize``) carries ``x * scale``, not ``x``.  Feeding it —
+or any cast of it, ``q.astype(f32)`` included — into a reduction or
+norm (``jnp.sum``/``mean``/``var``/``linalg.norm``/...) in the hot
+path silently computes statistics of the SCALED values: gradient
+norms wrong by the per-tensor scale factor, loss terms off by orders
+of magnitude, and nothing crashes.  Upcasting alone is NOT the fix —
+the scale must be unapplied (multiply/divide by the inverse scale, or
+``amp.fp8`` dequantization) before any reduction.
+
+Taint model (per function, lexical order): a name assigned from an
+fp8 quantize (``.astype(jnp.float8_*)`` or an ``amp.fp8`` quantize
+call) is tainted; taint PROPAGATES through bare dtype casts
+(``.astype(...)`` — still scaled) and clears on any arithmetic
+rebinding (the scale-unapply shape) or a fresh non-fp8 assignment.
+A reduction call over a tainted name (direct or through a cast)
+fires.  Precision over recall: only Name-rooted flows are tracked —
+a false APX204 on legitimately pre-scaled math would teach people to
+suppress the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.lint.engine import Rule
+from apex_tpu.lint.findings import WARNING
+
+_FP8_DTYPES = {"jax.numpy.float8_e4m3fn", "jax.numpy.float8_e5m2",
+               "jax.numpy.float8_e4m3", "jax.numpy.float8_e5m2fnuz",
+               "jax.numpy.float8_e4m3fnuz"}
+
+# reductions/norms only: a matmul over fp8 operands followed by an
+# unscale is the LEGITIMATE fp8 pattern (fused_dense.fp8_matmul) and
+# must not be flagged
+_REDUCTIONS = {"jax.numpy.sum", "jax.numpy.mean", "jax.numpy.var",
+               "jax.numpy.std", "jax.numpy.prod", "jax.numpy.median",
+               "jax.numpy.linalg.norm", "jax.numpy.average",
+               "jax.nn.logsumexp", "jax.numpy.cumsum"}
+
+_FIX_HINT = ("unapply the quantization scale first (multiply by the "
+             "inverse scale / amp.fp8 dequantize) — an fp8 buffer "
+             "holds value*scale, and a cast alone does not unscale it")
+
+
+def _is_fp8_quantize(node: ast.expr, ctx) -> bool:
+    """``<expr>.astype(jnp.float8_*)`` or an amp.fp8 quantize call."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "astype" and node.args:
+        return ctx.qualname(node.args[0]) in _FP8_DTYPES
+    q = ctx.qualname(f) or ""
+    if q.endswith(".quantize") and "fp8" in q:
+        return True
+    tail = q.rsplit(".", 1)[-1]
+    return tail in ("quantize_fp8", "fp8_quantize")
+
+
+def _is_bare_cast_of(node: ast.expr, tainted) -> bool:
+    """``name.astype(...)`` / ``name.view(...)`` of a tainted name —
+    the cast keeps the scale applied, so taint flows through."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("astype", "view", "reshape", "ravel")
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in tainted)
+
+
+def _tainted_operand(node: ast.expr, tainted):
+    """The tainted Name a reduction argument roots at, if any."""
+    if isinstance(node, ast.Name) and node.id in tainted:
+        return node.id
+    if _is_bare_cast_of(node, tainted):
+        return node.func.value.id  # type: ignore[union-attr]
+    return None
+
+
+class Fp8ScaleUnapplyRule(Rule):
+    id = "APX204"
+    name = "fp8-reduction-without-scale-unapply"
+    severity = WARNING
+    description = (
+        "An fp8-quantized value (still carrying value*scale) flows "
+        "into a reduction/norm without the scale being unapplied: the "
+        "statistic is silently wrong by the per-tensor scale factor.  "
+        "Dequantize (multiply by the inverse scale) before reducing; "
+        "upcasting alone does not unscale.")
+
+    def check(self, ctx):
+        hot = ctx.jit_reachable | ctx.kernel_functions
+        for fn in ctx.functions_in(hot):
+            yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx, fn):
+        tainted: dict = {}        # name -> lineno of the quantize
+        for node in self._lexical_walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if _is_fp8_quantize(node.value, ctx):
+                    tainted[name] = node.lineno
+                elif _is_bare_cast_of(node.value, tainted):
+                    # still scaled: taint propagates through the cast
+                    tainted[name] = tainted[
+                        node.value.func.value.id]  # type: ignore
+                else:
+                    # any other rebinding (incl. arithmetic — the
+                    # scale-unapply shape) clears the taint
+                    tainted.pop(name, None)
+                continue
+            if isinstance(node, ast.Call) \
+                    and ctx.qualname(node.func) in _REDUCTIONS:
+                for arg in node.args:
+                    hit = _tainted_operand(arg, tainted)
+                    if hit:
+                        yield self.finding(
+                            ctx, node,
+                            f"`{ctx.qualname(node.func)}` over "
+                            f"`{hit}`, quantized to fp8 at line "
+                            f"{tainted[hit]} with its scale still "
+                            f"applied; {_FIX_HINT}")
+                        break
+
+    @staticmethod
+    def _lexical_walk(fn):
+        """ast.walk is breadth-first; the taint model needs source
+        order.  Line-sorted traversal is exact enough for straight-
+        line hot-path code (precision-over-recall contract above)."""
+        nodes = [n for n in ast.walk(fn)
+                 if isinstance(n, (ast.Assign, ast.Call))]
+        return sorted(nodes, key=lambda n: (getattr(n, "lineno", 0),
+                                            getattr(n, "col_offset", 0)))

@@ -78,6 +78,25 @@ def host_transfer_prims(jaxpr) -> List[str]:
                           for m in HOST_TRANSFER_MARKERS)})
 
 
+def fp8_convert_counts(jaxpr) -> dict:
+    """Quantize-op census: how many ``convert_element_type`` equations
+    produce each fp8 dtype (``{"e4m3": n, "e5m2": m}``, absent = 0).
+    THE count the fp8 specs pin exactly — a refactor that re-quantizes
+    an operand per consumer (instead of sharing one cast) multiplies
+    silently and shows up here."""
+    import numpy as np
+    out: dict = {}
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name != "convert_element_type":
+            continue
+        name = np.dtype(e.params.get("new_dtype", "f4")).name
+        if name.startswith("float8_e4m3"):
+            out["e4m3"] = out.get("e4m3", 0) + 1
+        elif name.startswith("float8_e5m2"):
+            out["e5m2"] = out.get("e5m2", 0) + 1
+    return out
+
+
 def f64_values(jaxpr) -> List[str]:
     """Evidence of float64 entering the program: any
     ``convert_element_type`` to f64, or any equation output aval in

@@ -62,6 +62,9 @@ Supported invariants:
 ``dus_min``              at least n ``dynamic_update_slice`` eqns (ring
                          writes)
 ``counter``              ``{prim_name: exact_count, ...}`` free-form
+``fp8_quantize_counts``  ``{"e4m3": n, "e5m2": m}`` — exact converts INTO
+                         each fp8 dtype (quantize ops; casts must not
+                         silently multiply)
 =====================  =====================================================
 """
 
@@ -268,6 +271,22 @@ def _chk_counter(env, expected):
     return "; ".join(bad) or None
 
 
+def _chk_fp8_quantize_counts(env, expected):
+    """``{"e4m3": n, "e5m2": m}`` — EXACT count of converts into each
+    fp8 dtype (the quantize ops).  Pins the cast economy: one e4m3
+    per forward operand, ONE shared e5m2 per backward cotangent —
+    precision casts must never silently multiply (ROADMAP item 3)."""
+    got = jaxprs.fp8_convert_counts(env["jaxpr"])
+    bad = []
+    for fmt in sorted(set(expected) | set(got)):
+        want = int(expected.get(fmt, 0))
+        have = int(got.get(fmt, 0))
+        if want != have:
+            bad.append(f"{fmt}: expected exactly {want} quantize "
+                       f"convert(s), found {have}")
+    return "; ".join(bad) or None
+
+
 _CHECKERS: Dict[str, Callable] = {
     "no_host_transfer": _chk_no_host_transfer,
     "no_f64": _chk_no_f64,
@@ -283,6 +302,7 @@ _CHECKERS: Dict[str, Callable] = {
     "psum_count": _chk_psum_count,
     "dus_min": _chk_dus_min,
     "counter": _chk_counter,
+    "fp8_quantize_counts": _chk_fp8_quantize_counts,
 }
 
 

@@ -325,6 +325,79 @@ def _build_flat_accumulate_step():
 
 
 @register_spec(
+    "amp.fp8_step",
+    anchor="apex_tpu/amp/fp8.py",
+    description="fp8 delayed-scaling flat AMP train step: EXACT "
+                "quantize-convert counts (2 e4m3 per matmul forward, "
+                "ONE shared e5m2 cotangent per matmul backward — "
+                "precision casts cannot silently multiply), packed "
+                "fp8 scale state donated/aliased like every other "
+                "optimizer slot, zero host traffic, no f64")
+def _build_fp8_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import amp
+    from apex_tpu.amp import fp8 as fp8_mod
+    from apex_tpu.fused_dense import fp8_matmul
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers._base import _fold_clip
+
+    policy = fp8_mod.Fp8Policy(amax_history_len=4)
+    params = _mlp_params()           # 3 layers -> 3 fp8 matmuls
+    n_matmuls = len(params)
+    x = jax.random.normal(jax.random.key(4), (4, 8))
+    scaler = amp.LossScaleState.create()
+    opt = FusedAdam(params, lr=1e-3)
+    opt.enable_fp8(policy)
+    plan = opt._plan
+    nb = len(plan.buckets)
+    pipe = amp.FlatGradPipeline(optimizer=opt, max_grad_norm=1.0,
+                                fp8=policy)
+    hypers = _traced_hypers(opt)
+    f8 = pipe.fp8_init()
+
+    def fp8_loss(p, scales, x):
+        h = x
+        for k in sorted(p):
+            h = jnp.tanh(fp8_matmul(h, p[k]["w"], policy=policy,
+                                    w_scale=scales[k]["w"])
+                         + p[k]["b"])
+        return jnp.mean(h ** 2)
+
+    def fp8_step(param_bufs, opt_state, f8, scaler, x, step):
+        ptree = plan.unpack_model(param_bufs)
+        scales = opt.fp8_scales(opt_state)   # packed-slot slices
+        loss, flat, new_f8 = pipe.scaled_value_and_grad(
+            fp8_loss, scaler, ptree, scales, x, fp8_state=f8)
+        new_bufs, _, new_state = opt._full_step_flat(
+            param_bufs, None, opt_state, flat.bufs, step,
+            _fold_clip(1.0, flat.clip_coef), hypers, flat.found_inf)
+        return loss, new_bufs, new_state, new_f8
+
+    args = (opt._param_bufs, opt.opt_state, f8, scaler, x,
+            jnp.int32(1))
+    import jax as _jax
+    n_state = len(_jax.tree_util.tree_leaves(opt.opt_state))
+    return {
+        "fn": fp8_step, "args": args,
+        "jit_kwargs": {"donate_argnums": (1, 2)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            # the exact quantize economy: 2 e4m3 per matmul forward
+            # (x and w), ONE e5m2 per matmul backward (the cotangent,
+            # shared by dx and dw)
+            "fp8_quantize_counts": {"e4m3": 2 * n_matmuls,
+                                    "e5m2": n_matmuls},
+            # every packed slot — the fp8 amax history and scales
+            # included — aliases an output in the lowered HLO
+            "donated_aliases_min": n_state,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
     "amp.scaled_value_and_grad",
     anchor="apex_tpu/amp/scaler.py",
     description="per-leaf amp oracle surface: scaled loss, unscaled "

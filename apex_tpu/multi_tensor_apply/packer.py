@@ -217,12 +217,34 @@ class BucketPlan:
     #     per-bucket flat buffers (exp_avg, exp_avg_sq, momentum, sum);
     #   * a state field whose leaves are all scalars packs into one
     #     (num_segments,) vector per bucket (NovoGrad's per-tensor
-    #     second moment), indexed by the bucket-local leaf ordinal.
+    #     second moment), indexed by the bucket-local leaf ordinal;
+    #   * a state field whose leaves are all the SAME small (H,) vector
+    #     (and do NOT mirror the param shapes) stacks into one
+    #     (num_segments, H) matrix per bucket, row per leaf — the fp8
+    #     per-tensor amax-history slot.
+    def _field_is_leaf_vectors(self, leaves) -> bool:
+        """True for the row-stacked layout: every leaf a same-length
+        1-D vector that is NOT this plan's own leaf shape (a params
+        tree of uniform (H,) vectors keeps the flat pack — the two
+        layouts would otherwise be write-ambiguous)."""
+        shapes = {tuple(getattr(l, "shape", ())) for l in leaves}
+        if len(shapes) != 1:
+            return False
+        (shape,) = shapes
+        if len(shape) != 1:
+            return False
+        return any(s.shape != shape
+                   for b in self.buckets for s in b.leaves)
+
     def pack_state_field(self, field: Pytree) -> List[jax.Array]:
         leaves = _leaf_arrays(field)
         if len(leaves) != self.n_leaves:
             raise ValueError("state field does not mirror the plan's tree")
         if all(getattr(l, "shape", ()) == () for l in leaves):
+            return [jnp.stack([jnp.asarray(leaves[s.index], jnp.float32)
+                               for s in b.leaves])
+                    for b in self.buckets]
+        if self._field_is_leaf_vectors(leaves):
             return [jnp.stack([jnp.asarray(leaves[s.index], jnp.float32)
                                for s in b.leaves])
                     for b in self.buckets]
@@ -234,12 +256,23 @@ class BucketPlan:
         # (every param leaf itself a scalar) the two agree elementwise,
         # so either unpack is correct.  State dtypes (f32 moments even
         # for bf16 work buffers) are preserved: no work-dtype cast here.
+        # A 2-D (num leaves, H) buffer is the row-stacked per-leaf-
+        # vector layout (fp8 amax history) — unambiguous: the flat
+        # pack always yields 1-D buffers.
+        if all(getattr(bufs[bi], "ndim", None) == 2
+               and bufs[bi].shape[0] == len(b.leaves)
+               for bi, b in enumerate(self.buckets)):
+            leaves: List[Optional[jax.Array]] = [None] * self.n_leaves
+            for bi, b in enumerate(self.buckets):
+                for j, s in enumerate(b.leaves):
+                    leaves[s.index] = bufs[bi][j]
+            return jax.tree_util.tree_unflatten(self.treedef, leaves)
         scalar = all(tuple(bufs[bi].shape) == (len(b.leaves),)
                      for bi, b in enumerate(self.buckets))
         flat = all(bufs[bi].size == b.size
                    for bi, b in enumerate(self.buckets))
         if scalar and not flat:
-            leaves: List[Optional[jax.Array]] = [None] * self.n_leaves
+            leaves = [None] * self.n_leaves
             for bi, b in enumerate(self.buckets):
                 for j, s in enumerate(b.leaves):
                     leaves[s.index] = bufs[bi][j]

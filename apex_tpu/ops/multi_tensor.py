@@ -593,6 +593,121 @@ def flat_segment_sumsq(x, seg_ids, num_segments: int):
                                indices_are_sorted=True)
 
 
+def flat_segment_absmax(x, seg_ids, num_segments: int):
+    """Per-segment max(|x|) of a flat buffer, f32 accumulation.
+
+    One XLA sorted-segment reduce per bucket — the per-TENSOR amax the
+    fp8 delayed-scaling state needs, from the same segment metadata the
+    LAMB/NovoGrad kernels already use.  Non-finite elements propagate
+    (|nan| is nan, |inf| is inf) so the caller's overflow detection
+    sees them."""
+    return jax.ops.segment_max(jnp.abs(_f32(x)), seg_ids,
+                               num_segments=num_segments,
+                               indices_are_sorted=True)
+
+
+# ---------------------------------------------------------------------------
+# fused fp8 amax + delayed-scale update   [beyond-reference: the
+# transformer-engine delayed-scaling recipe collapsed to ONE flat pass
+# per bucket — per-tensor amax via a sorted-segment reduce, history
+# roll, scale recompute and per-tensor overflow backoff all from that
+# single sweep, never a per-leaf tree_map]
+# ---------------------------------------------------------------------------
+
+def flat_amax_scale_update(buf, seg_ids, num_segments: int,
+                           amax_history, scale, *, fp8_max,
+                           margin: float = 0.0,
+                           backoff_factor: float = 0.5,
+                           max_scale: float = 2.0 ** 24,
+                           min_scale: float = 2.0 ** -24,
+                           update=True):
+    """One bucket's fp8 delayed-scaling bookkeeping in a single flat
+    pass.  ``buf``: the bucket's flat buffer (any float dtype);
+    ``amax_history``: (num_segments, H) f32, column 0 newest;
+    ``scale``: (num_segments,) f32 — the CURRENT quantization scales
+    (value * scale fills the fp8 range).
+
+    Per segment (= per tensor): amax of this step's values rolls into
+    the history; the new scale is ``fp8_max / (2**margin *
+    max(history))`` clipped to [min_scale, max_scale].  A segment
+    whose amax is NON-FINITE is an overflow: its history holds (inf
+    must never poison the window) and its scale backs off by
+    ``backoff_factor`` — the loss scaler's backoff discipline layered
+    per bucket.  A segment with no signal yet (all-zero history)
+    keeps its old scale.  ``update`` (bool, traced ok) gates the
+    whole transition — False returns the inputs unchanged (the
+    scale-update-interval cadence and the external step-skip both
+    ride it).
+
+    Returns ``(new_history, new_scale, found_inf i32)`` where
+    found_inf flags ANY non-finite amax in the bucket.
+    """
+    if not op_enabled("multi_tensor"):
+        return flat_amax_scale_update_ref(
+            buf, seg_ids, num_segments, amax_history, scale,
+            fp8_max=fp8_max, margin=margin,
+            backoff_factor=backoff_factor, max_scale=max_scale,
+            min_scale=min_scale, update=update)
+    amax = flat_segment_absmax(buf, seg_ids, num_segments)
+    return _amax_scale_math(amax, amax_history, scale, fp8_max, margin,
+                            backoff_factor, max_scale, min_scale,
+                            update)
+
+
+def flat_amax_scale_update_ref(buf, seg_ids, num_segments: int,
+                               amax_history, scale, *, fp8_max,
+                               margin: float = 0.0,
+                               backoff_factor: float = 0.5,
+                               max_scale: float = 2.0 ** 24,
+                               min_scale: float = 2.0 ** -24,
+                               update=True):
+    """Oracle: per-segment amax via scatter-max instead of the sorted
+    segment reduce; identical update math (bit-exact by test)."""
+    amax = jnp.zeros((num_segments,), jnp.float32).at[seg_ids].max(
+        jnp.abs(_f32(buf)))
+    return _amax_scale_math(amax, amax_history, scale, fp8_max, margin,
+                            backoff_factor, max_scale, min_scale,
+                            update)
+
+
+def _amax_scale_math(amax, amax_history, scale, fp8_max, margin,
+                     backoff_factor, max_scale, min_scale, update):
+    """The ONE delayed-scaling transition (kernel and ref paths, and
+    the per-leaf oracle in amp.fp8, all funnel here so the
+    bookkeeping cannot drift between layouts).
+
+    ``update`` gates the CLEAN transition (history roll + scale
+    recompute: the interval cadence, external skips).  An overflowed
+    segment is handled like the loss scaler handles overflow — the
+    backoff applies EVEN on a gated step (overflow response must not
+    wait for the cadence), while its history always holds (inf must
+    never poison the window)."""
+    fmax = jnp.asarray(fp8_max, jnp.float32)
+    bad_seg = jnp.logical_not(jnp.abs(amax) < jnp.float32(jnp.inf))
+    found_inf = jnp.any(bad_seg).astype(jnp.int32)
+    safe_amax = jnp.where(bad_seg, jnp.float32(0.0), amax)
+    rolled = jnp.concatenate(
+        [safe_amax[:, None], amax_history[:, :-1]], axis=1)
+    amax_max = jnp.max(rolled, axis=1)
+    recomputed = jnp.where(
+        amax_max > 0,
+        jnp.clip(fmax / (jnp.float32(2.0) ** jnp.asarray(
+            margin, jnp.float32) * amax_max),
+            jnp.asarray(min_scale, jnp.float32),
+            jnp.asarray(max_scale, jnp.float32)),
+        scale)
+    upd = jnp.asarray(update, jnp.bool_)
+    hold = jnp.logical_or(bad_seg, jnp.logical_not(upd))
+    new_hist = jnp.where(hold[:, None], amax_history, rolled)
+    new_scale = jnp.where(upd, recomputed, scale)
+    new_scale = jnp.where(
+        bad_seg,
+        jnp.maximum(scale * jnp.asarray(backoff_factor, jnp.float32),
+                    jnp.asarray(min_scale, jnp.float32)),
+        new_scale)
+    return new_hist, new_scale, found_inf
+
+
 # ---------------------------------------------------------------------------
 # NovoGrad step (segmented)   [reference: multi_tensor_novograd.cu]
 # ---------------------------------------------------------------------------

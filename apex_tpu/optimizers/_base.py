@@ -158,6 +158,13 @@ def _fold_clip(grad_scale, clip_coef):
     return gs / jnp.asarray(clip_coef, jnp.float32)
 
 
+# fp8 delayed-scaling state carried as packed optimizer slots (see
+# enable_fp8): updated by the step itself from the post-update work
+# buffers, donated/offloaded/checkpointed like every other slot, and
+# excluded from the per-bucket optimizer math.
+_FP8_SLOTS = ("fp8_amax_history", "fp8_scale")
+
+
 class FusedOptimizerBase:
     """Subclasses set ``defaults`` and implement ``_step_math`` (per-leaf
     oracle path) plus ``_flat_bucket_step`` (bucketed flat path)."""
@@ -334,18 +341,94 @@ class FusedOptimizerBase:
 
     def _flat_step_math(self, work_bufs, grad_bufs, opt_state, step,
                         grad_scale, hypers):
+        # fp8 delayed-scaling slots are carried state, not optimizer
+        # math: split them out of the per-bucket loop and update them
+        # from the POST-step work buffers below (delayed scaling: the
+        # scale the next forward quantizes with reflects this step's
+        # weights)
+        fp8_state = {k: opt_state[k] for k in _FP8_SLOTS
+                     if k in opt_state}
+        core = {k: v for k, v in opt_state.items()
+                if k not in fp8_state}
         extra = self._flat_prologue(work_bufs, grad_bufs, step,
                                     grad_scale, hypers)
         new_bufs: List[Any] = []
-        new_state: Dict[str, List[Any]] = {k: [] for k in opt_state}
+        new_state: Dict[str, List[Any]] = {k: [] for k in core}
         for bi, (p, g) in enumerate(zip(work_bufs, grad_bufs)):
-            bucket_state = {k: v[bi] for k, v in opt_state.items()}
+            bucket_state = {k: v[bi] for k, v in core.items()}
             np_, ns = self._flat_bucket_step(
                 bi, p, g, bucket_state, step, grad_scale, hypers, extra)
             new_bufs.append(np_)
             for k in new_state:
                 new_state[k].append(ns[k])
+        if fp8_state:
+            new_state.update(self._fp8_slot_update(new_bufs, fp8_state,
+                                                   step))
         return new_bufs, new_state
+
+    def _fp8_slot_update(self, new_work_bufs, fp8_state, step):
+        """The packed fp8 weight-scale slots' delayed-scaling
+        transition over the post-step work buffers, riding the step's
+        own jit and donation — the same shared per-bucket pass as the
+        pipeline's gradient-side state (``amp.fp8.update_packed``),
+        gated by the step clock instead of an Fp8State counter (a
+        skipped step's held clock therefore also holds the fp8
+        cadence)."""
+        from apex_tpu.amp.fp8 import update_packed
+        policy = getattr(self, "fp8_policy", None)
+        if policy is None:              # foreign slots: carry through
+            return fp8_state
+        do = jnp.equal(jnp.asarray(step, jnp.int32)
+                       % jnp.int32(policy.interval), 0)
+        hist, scale, _ = update_packed(
+            fp8_state["fp8_amax_history"], fp8_state["fp8_scale"],
+            new_work_bufs, self._plan, policy, update=do,
+            scale_min_metric="fp8/weight_scale_min")
+        return {"fp8_amax_history": hist, "fp8_scale": scale}
+
+    # ---- fp8 delayed-scaling slots ---------------------------------------
+    def enable_fp8(self, policy=None) -> None:
+        """Attach packed fp8 delayed-scaling state for the WEIGHTS as
+        optimizer slots (``fp8_amax_history``: (n_leaves, H) per
+        bucket; ``fp8_scale``: (n_leaves,) per bucket) — donated to
+        the jitted step, offloaded, checkpointed (v1 and v2) and
+        re-chunked like every other slot.  The step updates them from
+        the post-update work buffers; read the current per-leaf
+        scales with :meth:`fp8_scales` and feed them to
+        ``fused_dense.fp8_matmul(w_scale=...)``.  Requires the
+        bucketed path."""
+        if self._plan is None:
+            raise ValueError(
+                "enable_fp8 requires the bucketed path "
+                "(fuse_buckets=False or the packer declined this "
+                "tree)")
+        from apex_tpu.amp.fp8 import Fp8Policy, init_state
+        if policy is None:
+            policy = Fp8Policy()
+        self.fp8_policy = policy
+        st = init_state(self._plan, policy)
+        slots = {"fp8_amax_history": list(st.amax_history),
+                 "fp8_scale": list(st.scale)}
+        if self.offload_state:
+            slots = place_on_host(slots)
+        # a new opt_state STRUCTURE: the jitted step re-traces on the
+        # next call (jit keys on pytree structure), no re-jit needed
+        self.opt_state = {**self.opt_state, **slots}
+
+    def fp8_scales(self, opt_state=None) -> Pytree:
+        """Per-leaf pytree of the current fp8 weight scales (scalar
+        slices of the packed slot — they fuse into the caller's jit).
+        Pass the ``opt_state`` threaded through an embedded
+        ``functional_step`` loop, or omit it for the stateful
+        facade's own state."""
+        if self._plan is None or not hasattr(self, "fp8_policy"):
+            raise ValueError("enable_fp8 was not called")
+        state = self.opt_state if opt_state is None else opt_state
+        from apex_tpu.amp.fp8 import Fp8State, scales_tree
+        st = Fp8State(amax_history=list(state["fp8_amax_history"]),
+                      scale=list(state["fp8_scale"]),
+                      step=self.step_count)
+        return scales_tree(self._plan, st)
 
     def _full_step(self, params, masters, opt_state, grads, step, grad_scale,
                    hypers, found_inf=None):
@@ -407,6 +490,9 @@ class FusedOptimizerBase:
                     or len(field) != len(buckets):
                 return False
             for buf, b in zip(field, buckets):
+                if getattr(buf, "ndim", None) == 2 \
+                        and buf.shape[0] == len(b.leaves):
+                    continue    # row-stacked per-leaf vectors (fp8)
                 if getattr(buf, "ndim", None) != 1:
                     return False
                 if tuple(buf.shape) not in ((b.size,), (len(b.leaves),)):
@@ -676,20 +762,38 @@ class FusedOptimizerBase:
             self._master_bufs = None
         self._params_cache = None
         self._masters_cache = None
+        # the v2 payload stores every state buffer flattened; a
+        # non-flat slot (the fp8 (n_leaves, H) amax history) adopts the
+        # LIVE slot's shape back — same element count, the layout
+        # check upstream already matched the plan
+        old = self.opt_state
+
+        def _shaped(b, o):
+            # metadata-only reshape (numpy and jax alike): never a
+            # copy, never an extra device placement
+            want = (tuple(o.shape)
+                    if o is not None and hasattr(o, "shape") else None)
+            if want is not None \
+                    and tuple(getattr(b, "shape", ())) != want \
+                    and getattr(b, "size", None) == o.size:
+                b = b.reshape(want)
+            return b
+
         if self.offload_state:
             # adopt each buffer straight onto the existing (host)
             # placement — asarray-then-place_on_host would stage the
             # whole state in HBM, the state-size spike offloading
             # exists to avoid (the load_state_dict mirror of the
             # packed_snapshot in-place rule)
-            old = self.opt_state
             self.opt_state = {
-                k: [jax.device_put(b, o.sharding)
+                k: [jax.device_put(_shaped(b, o), o.sharding)
                     for b, o in zip(v, old[k])]
                 for k, v in state.items()}
         else:
-            self.opt_state = {k: [jnp.asarray(b) for b in v]
-                              for k, v in state.items()}
+            self.opt_state = {
+                k: [jnp.asarray(_shaped(b, o))
+                    for b, o in zip(v, old.get(k, [None] * len(v)))]
+                for k, v in state.items()}
 
     # ---- serialization (torch Optimizer.state_dict shape) ---------------
     def state_dict(self):

@@ -83,14 +83,22 @@ def _dynamic_quant_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 def int8_matmul(x: jax.Array, w: QTensor, *,
-                dynamic: bool = False) -> jax.Array:
+                dynamic: Optional[bool] = False) -> jax.Array:
     """``x @ dequant(w)`` with int8 weights; w quantized on axis 0
     (shape (K, N), scale (1, N)).
 
     dynamic=False: weight-only — dequant folds into the dot operand.
     dynamic=True: per-row activation quant + int8×int8 MXU dot with i32
     accumulation, rescaled to x's dtype.
+    dynamic=None ("auto"): the measured per-topology preference
+    (``ops._dispatch.quantization_pref("int8_dynamic")``, written by
+    the autotuner's quantization sweep) decides; absent entry =
+    weight-only, the design default.  An explicit bool always wins —
+    the table steers only callers that asked it to.
     """
+    if dynamic is None:
+        from apex_tpu.ops._dispatch import quantization_pref
+        dynamic = bool(quantization_pref("int8_dynamic", False))
     if not dynamic:
         return jax.lax.dot_general(
             x, dequantize(w, x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
@@ -141,7 +149,9 @@ class QuantDense:
     """
 
     def __init__(self, qweight: QTensor, bias: Optional[jax.Array] = None,
-                 dynamic: bool = False):
+                 dynamic: Optional[bool] = False):
+        # dynamic=None defers to the measured per-topology routing at
+        # each call (int8_matmul's "auto" contract)
         self.qweight = qweight    # stored (In, Out), scale (1, Out)
         self.bias = bias
         self.dynamic = dynamic
@@ -149,7 +159,7 @@ class QuantDense:
     @classmethod
     def from_weights(cls, weight: jax.Array,
                      bias: Optional[jax.Array] = None,
-                     dynamic: bool = False) -> "QuantDense":
+                     dynamic: Optional[bool] = False) -> "QuantDense":
         # (Out, In) -> transpose once at quantization time so the hot
         # matmul is a plain (…, In) @ (In, Out)
         return cls(quantize_int8(jnp.transpose(weight), axis=0),

@@ -83,6 +83,7 @@ _LADDER = (ACTION_NONE, ACTION_WARN, ACTION_QUARANTINE,
 DEFAULT_ACTIONS: Mapping[str, str] = {
     "nan_streak": ACTION_ROLLBACK,
     "scale_collapse": ACTION_ROLLBACK,
+    "fp8_scale_collapse": ACTION_ROLLBACK,
     "loss_spike": ACTION_QUARANTINE,
     "grad_norm_explosion": ACTION_QUARANTINE,
     "straggler": ACTION_WARN,
@@ -288,14 +289,16 @@ class ScaleCollapseDetector(Detector):
     ever forming the contiguous streak :class:`NanStreakDetector`
     requires."""
 
+    kind = "scale_collapse"
+
     def __init__(self, floor: float = 1.0, windows: int = 2,
-                 metric: str = "amp/loss_scale"):
+                 metric: Optional[str] = None):
         if windows < 1:
             raise ValueError(f"windows must be >= 1, got {windows}")
-        self.name = "scale_collapse"
+        self.name = self.kind
         self.floor = float(floor)
         self.windows = int(windows)
-        self.metric = metric
+        self.metric = metric if metric is not None else "amp/loss_scale"
         self.reset()
 
     def reset(self) -> None:
@@ -319,14 +322,43 @@ class ScaleCollapseDetector(Detector):
             if self._consec >= self.windows and not self._fired:
                 self._fired = True
                 return [Anomaly(
-                    kind="scale_collapse", severity=SEVERITY_CRITICAL,
+                    kind=self.kind, severity=SEVERITY_CRITICAL,
                     step=scales[-1][0], first_step=self._first,
                     detector=self.name,
-                    evidence={"floor": self.floor,
+                    evidence={"floor": self.floor, "metric": self.metric,
                               "windows_at_floor": self._consec})]
         else:
             self.reset()
         return []
+
+
+class Fp8ScaleCollapseDetector(ScaleCollapseDetector):
+    """fp8 delayed-scaling collapse: the MINIMUM per-tensor fp8 scale
+    (``fp8/scale_min`` from the flat pipeline's gradient state, or
+    ``fp8/weight_scale_min`` from the optimizer's packed weight
+    slots) pinned at/below ``floor`` for ``windows`` consecutive
+    flushes.  A healthy scale is ``fp8_max / amax`` — well above 1
+    for sane tensors; a scale stuck at the floor means some tensor's
+    amax history is saturated (divergence, a poisoned batch, or an
+    overflow storm the per-tensor backoff keeps fighting), the exact
+    state-is-the-damage shape rollback exists for.  Same
+    quarantine->rollback ladder as the loss-scale collapse
+    (DEFAULT_ACTIONS maps ``fp8_scale_collapse`` to rollback).
+
+    The default floor is 2^-8, NOT 1.0: a tensor with no gradient
+    signal yet (frozen/unused leaf) keeps its INIT scale of exactly
+    1.0 forever, and a floor of 1.0 would read that healthy
+    no-information state as a collapse.  Reaching 2^-8 takes eight
+    consecutive per-tensor backoffs (or a sustained amax around
+    fp8_max * 2^8) — unambiguously a storm."""
+
+    kind = "fp8_scale_collapse"
+
+    def __init__(self, floor: float = 2.0 ** -8, windows: int = 2,
+                 metric: Optional[str] = None):
+        super().__init__(floor=floor, windows=windows,
+                         metric=metric if metric is not None
+                         else "fp8/scale_min")
 
 
 class StepTimeDetector(Detector):
@@ -381,11 +413,14 @@ class StepTimeDetector(Detector):
 
 def default_detectors(scale_floor: float = 1.0) -> List[Detector]:
     """The standard detector suite (``scale_floor`` should match the
-    scaler config's ``min_loss_scale``)."""
+    scaler config's ``min_loss_scale``).  The fp8 collapse detector is
+    inert in non-fp8 runs (no ``fp8/scale_min`` records = no
+    information = never fires)."""
     return [NanStreakDetector(),
             LossSpikeDetector(),
             GradNormDetector(),
             ScaleCollapseDetector(floor=scale_floor),
+            Fp8ScaleCollapseDetector(),
             StepTimeDetector()]
 
 

@@ -62,6 +62,10 @@ DEFAULT_METRICS = (
     "optim/skipped",
     "ddp/bytes_allreduced",
     "ddp/buckets",
+    "fp8/scale_min",
+    "fp8/weight_scale_min",
+    "fp8/amax_max",
+    "fp8/found_inf",
 )
 
 

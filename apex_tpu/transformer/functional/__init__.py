@@ -1,6 +1,12 @@
 """apex_tpu.transformer.functional (reference:
-apex/transformer/functional)."""
+apex/transformer/functional).
 
+``fp8_matmul`` (beyond-reference) is the e4m3/e5m2 quantized matmul
+the transformer blocks take under ``amp.initialize(..., fp8=...)`` —
+the tensor-parallel linears route their local dot through it when
+built with ``fp8=state.fp8_policy`` (docs/amp.md "fp8 training")."""
+
+from apex_tpu.fused_dense.fused_dense import fp8_matmul
 from apex_tpu.transformer.functional.fused_softmax import (
     FusedScaleMaskSoftmax,
     generic_scaled_masked_softmax,
@@ -14,6 +20,7 @@ from apex_tpu.transformer.functional.fused_rope import (
 
 __all__ = [
     "FusedScaleMaskSoftmax",
+    "fp8_matmul",
     "generic_scaled_masked_softmax",
     "scaled_masked_softmax",
     "scaled_upper_triang_masked_softmax",

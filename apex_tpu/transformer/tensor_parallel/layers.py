@@ -51,6 +51,21 @@ def _sharded_init(base_init: Callable):
     return init
 
 
+def _local_matmul(x, w, fp8):
+    """The per-rank local matmul shared by Column/RowParallelLinear:
+    plain bf16/f32 dot, or — with an ``fp8``
+    :class:`~apex_tpu.amp.fp8.Fp8Policy` — the e4m3-forward /
+    e5m2-backward quantized path (``fused_dense.fp8_matmul``); the
+    surrounding tensor-parallel collectives are unchanged (reductions
+    always run on the DEQUANTIZED f32/compute-dtype output — never on
+    raw fp8 values, the APX204 discipline)."""
+    if fp8 is not None:
+        from apex_tpu.fused_dense import fp8_matmul
+        return fp8_matmul(x, w, policy=fp8)
+    return jnp.dot(x, w, preferred_element_type=jnp.float32
+                   ).astype(x.dtype)
+
+
 class ColumnParallelLinear(nn.Module):
     """Y = X A + b with A sharded along its OUTPUT dim.
 
@@ -69,6 +84,7 @@ class ColumnParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     params_dtype: jnp.dtype = jnp.float32
     compute_dtype: Optional[jnp.dtype] = None
+    fp8: Optional[object] = None
 
     @nn.compact
     def __call__(self, x):
@@ -84,8 +100,7 @@ class ColumnParallelLinear(nn.Module):
         elif tp > 1:
             x = mappings.copy_to_tensor_model_parallel_region(x, AXIS)
         dt = self.compute_dtype or x.dtype
-        y = jnp.dot(x.astype(dt), w.astype(dt),
-                    preferred_element_type=jnp.float32).astype(dt)
+        y = _local_matmul(x.astype(dt), w.astype(dt), self.fp8)
         if b is not None and not self.skip_bias_add:
             y = y + b.astype(dt)
         if self.gather_output and tp > 1:
@@ -115,6 +130,7 @@ class RowParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     params_dtype: jnp.dtype = jnp.float32
     compute_dtype: Optional[jnp.dtype] = None
+    fp8: Optional[object] = None
 
     @nn.compact
     def __call__(self, x):
@@ -131,8 +147,7 @@ class RowParallelLinear(nn.Module):
         if not self.input_is_parallel and tp > 1:
             x = mappings.scatter_to_tensor_model_parallel_region(x, AXIS)
         dt = self.compute_dtype or x.dtype
-        y = jnp.dot(x.astype(dt), w.astype(dt),
-                    preferred_element_type=jnp.float32).astype(dt)
+        y = _local_matmul(x.astype(dt), w.astype(dt), self.fp8)
         if tp > 1:
             if self.sequence_parallel_enabled:
                 y = mappings.reduce_scatter_to_sequence_parallel_region(

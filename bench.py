@@ -710,6 +710,16 @@ def run_child(backend):
 
         print(_dump(out), flush=True)
         try:
+            # fp8 vs bf16 fused_dense fwd+bwd: grounds the
+            # extra.fp8_matmul_speedup perf-budget row (floor 1.5 on
+            # fp8-capable chips; graded no-data until this lands)
+            from apex_tpu.amp.fp8_bench import bench_fp8_matmul
+            out["extra"].update(bench_fp8_matmul())
+        except Exception as e:
+            out["extra"]["fp8_matmul_error"] = repr(e)[:200]
+
+        print(_dump(out), flush=True)
+        try:
             # BERT-L at b32: the throughput/MFU story (b8 ran at MFU
             # 0.34; larger batches amortize fixed per-step work)
             r32 = _bert_lamb_one_batch(jax, jnp, True, 32, 512, 20,

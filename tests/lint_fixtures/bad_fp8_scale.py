@@ -1,0 +1,24 @@
+# apexlint fixture: every fp8-scaled reduction below must trip APX204
+# (and only APX204 — no host syncs, no other dtype hazards).
+# These files are linted as TEXT, never imported.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def grad_norm_of_quantized(g, scale):
+    q = (g * scale).astype(jnp.float8_e4m3fn)
+    return jnp.sum(q)                           # APX204: scaled sum
+
+
+@jax.jit
+def upcast_does_not_unscale(g, scale):
+    q = (g * scale).astype(jnp.float8_e5m2)
+    f = q.astype(jnp.float32)                   # cast keeps the scale
+    return jnp.linalg.norm(f)                   # APX204: scaled norm
+
+
+@jax.jit
+def mean_of_fp8(x, scale):
+    q = jnp.clip(x * scale, -448.0, 448.0).astype(jnp.float8_e4m3fn)
+    return jnp.mean(q.astype(jnp.bfloat16))     # APX204: scaled mean

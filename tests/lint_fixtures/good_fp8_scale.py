@@ -1,0 +1,36 @@
+# apexlint fixture: the clean twins — scale unapplied before every
+# reduction, fp8 dots with post-hoc unscale, non-fp8 casts.  Must lint
+# clean.  These files are linted as TEXT, never imported.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def norm_after_dequant(g, scale):
+    q = (g * scale).astype(jnp.float8_e4m3fn)
+    f = q.astype(jnp.float32) / scale           # scale unapplied
+    return jnp.linalg.norm(f)
+
+
+@jax.jit
+def sum_after_inverse_scale(g, scale):
+    q = (g * scale).astype(jnp.float8_e5m2)
+    deq = q.astype(jnp.float32) * (1.0 / scale)
+    return jnp.sum(deq)
+
+
+@jax.jit
+def fp8_dot_then_unscale(x, w, sx, sw):
+    qx = (x * sx).astype(jnp.float8_e4m3fn)
+    qw = (w * sw).astype(jnp.float8_e4m3fn)
+    # the legitimate fp8 matmul shape: dot over scaled operands,
+    # unscaled afterwards — not a reduction hazard
+    acc = jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return acc / (sx * sw)
+
+
+@jax.jit
+def bf16_cast_is_not_fp8(x):
+    h = x.astype(jnp.bfloat16)
+    return jnp.sum(h)                           # plain cast: no scale

@@ -27,6 +27,7 @@ SUITES = {
     "run_amp": ["tests/test_amp.py", "tests/test_amp_wrap.py",
                 "tests/test_amp_flat_pipeline.py",
                 "tests/test_grad_accum.py",
+                "tests/test_fp8.py",
                 "tests/test_L1_trajectory.py",
                 "tests/test_torch_amp.py"],
     "run_optimizers": ["tests/test_multi_tensor.py",

@@ -27,6 +27,7 @@ BAD_FIXTURES = {
     "bad_telemetry_sync.py": {"APX102"},
     "bad_accum_unpack.py": {"APX103"},
     "bad_dtype.py": {"APX201", "APX202", "APX203"},
+    "bad_fp8_scale.py": {"APX204"},
     "bad_retrace.py": {"APX301", "APX302", "APX303"},
     "bad_donation.py": {"APX401"},
     "bad_use_after_donate.py": {"APX402"},
@@ -38,6 +39,7 @@ BAD_FIXTURES = {
 GOOD_FIXTURES = [
     "good_host_sync.py", "good_telemetry_sync.py",
     "good_accum_unpack.py", "good_dtype.py",
+    "good_fp8_scale.py",
     "good_retrace.py", "good_donation.py", "good_use_after_donate.py",
     "good_pallas.py", "good_import_env.py", "good_collectives.py",
     "good_trace_state.py",
@@ -69,7 +71,7 @@ def test_every_rule_family_has_fixture_coverage():
     covered = set().union(*BAD_FIXTURES.values())
     families = {rid[:4] for rid, _, _ in rule_catalog()}
     assert {rid[:4] for rid in covered} == families
-    assert len(BAD_FIXTURES) >= 11 == len(GOOD_FIXTURES)
+    assert len(BAD_FIXTURES) >= 12 == len(GOOD_FIXTURES)
     ids = [r.id for r in all_rules()]
     assert len(ids) == len(set(ids))
 

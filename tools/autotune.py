@@ -144,6 +144,24 @@ def validate_table(doc, *, per_topology: bool, path: str = "") -> list:
             err(f"pipeline.reduce_decompose must be one of "
                 f"{_REDUCE_CHOICES}, found {pipe['reduce_decompose']!r}")
 
+    f8 = doc.get("fp8", {})
+    if not isinstance(f8, dict):
+        err("fp8 must be an object")
+    else:
+        for k in ("amax_history_len", "interval"):
+            if k in f8 and (not isinstance(f8[k], int)
+                            or isinstance(f8[k], bool) or f8[k] <= 0):
+                err(f"fp8.{k} must be a positive integer, "
+                    f"found {f8[k]!r}")
+
+    quant = doc.get("quantization", {})
+    if not isinstance(quant, dict):
+        err("quantization must be an object")
+    elif "int8_dynamic" in quant \
+            and not isinstance(quant["int8_dynamic"], bool):
+        err(f"quantization.int8_dynamic must be a JSON boolean, "
+            f"found {quant['int8_dynamic']!r}")
+
     topo = doc.get("topology")
     if topo is not None:
         if not isinstance(topo, dict) or not isinstance(
@@ -258,6 +276,10 @@ def smoke_config() -> dict:
         "reduce_n": 8192,
         "accum": dict(layers=3, hidden=32, batch=8, n_micro=(8,),
                       iters=2, reps=2),
+        "fp8_hist_candidates": [4, 16],
+        "fp8_interval_candidates": [1, 4],
+        "fp8_layers": 4, "fp8_hidden": 32, "fp8_batch": 8,
+        "int8_mkn": (64, 64, 64),
         "device_check_families": ["multi_tensor"],
     }
 
@@ -279,8 +301,12 @@ def full_config() -> dict:
         "reduce_n": 1 << 22,
         "accum": dict(layers=16, hidden=128, batch=32, n_micro=(8,),
                       iters=5, reps=3),
+        "fp8_hist_candidates": [4, 16, 64],
+        "fp8_interval_candidates": [1, 4, 16],
+        "fp8_layers": 24, "fp8_hidden": 512, "fp8_batch": 64,
+        "int8_mkn": (4096, 4096, 4096),
         "device_check_families": ["multi_tensor", "welford",
-                                  "layer_norm", "pipeline"],
+                                  "layer_norm", "pipeline", "fp8"],
     }
 
 
@@ -649,6 +675,129 @@ def sweep_reduce_decompose(cfg, noise_pct: float) -> list:
     return [rec]
 
 
+def sweep_fp8_cadence(cfg, noise_pct: float, outdir: str) -> list:
+    """fp8 scaling-cadence sweep (amax history length x scale-update
+    interval) through a full fp8 flat-AMP train step — fp8_matmul
+    forward, packed grad-side scale update, fused optimizer with fp8
+    weight slots.  The Fp8Policy defaults are the design default; a
+    candidate cadence must beat them beyond the noise floor (and,
+    where enabled, survive the device-timeline cross-check) before
+    the table steers ``amp.fp8.tuned_policy()``."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.amp import fp8 as fp8_mod
+    from apex_tpu.fused_dense import fp8_matmul
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.bucketing_bench import many_leaf_params
+    params = many_leaf_params(jax, jnp, cfg["fp8_layers"],
+                              cfg["fp8_hidden"])
+    x = jax.random.normal(jax.random.key(9),
+                          (cfg["fp8_batch"], cfg["fp8_hidden"]))
+    scaler = amp.LossScaleState.create(2.0 ** 12)
+    default = (fp8_mod.Fp8Policy.amax_history_len,
+               fp8_mod.Fp8Policy.interval)
+    cands = sorted(set(itertools.product(
+        cfg["fp8_hist_candidates"], cfg["fp8_interval_candidates"])
+        ) | {default})
+
+    times, steps = {}, {}
+    for hist, interval in cands:
+        policy = fp8_mod.Fp8Policy(amax_history_len=hist,
+                                   interval=interval)
+        opt = FusedAdam(params, lr=1e-3)
+        opt.enable_fp8(policy)
+        pipe = amp.FlatGradPipeline(optimizer=opt, fp8=policy)
+        f8 = pipe.fp8_init()
+        hypers = {k: jnp.asarray(v, jnp.float32)
+                  for k, v in opt.hypers.items()
+                  if isinstance(v, float)}
+
+        def loss_fn(p, scales, x, policy=policy):
+            h = x
+            for k in sorted(p):
+                h = jnp.tanh(fp8_matmul(h, p[k]["w"], policy=policy,
+                                        w_scale=scales[k]["w"])
+                             + p[k]["b"]) * p[k]["scale"] \
+                    + p[k]["shift"]
+            return jnp.mean(h ** 2)
+
+        def step(work, opt_state, f8, x, s, pipe=pipe, opt=opt,
+                 hypers=hypers, loss_fn=loss_fn):
+            scales = opt.fp8_scales(opt_state)
+            loss, flat, new_f8 = pipe.scaled_value_and_grad(
+                loss_fn, scaler, pipe.plan.unpack(work), scales, x,
+                fp8_state=f8)
+            new_w, _, new_s = opt._full_step_flat(
+                work, None, opt_state, flat.bufs, s, 1.0, hypers,
+                flat.found_inf)
+            return loss, new_w, new_s, new_f8
+
+        # each cadence is its own program (history shapes differ) by
+        # design (apexlint: disable-next=APX302)
+        times[(hist, interval)] = _time(
+            step, opt._param_bufs, opt.opt_state, f8, x,
+            jnp.int32(2), cfg=cfg)
+        steps[(hist, interval)] = (step, (opt._param_bufs,
+                                          opt.opt_state, f8, x,
+                                          jnp.int32(2)))
+
+    winner = min(times, key=times.get)
+    rec = {"space": "fp8.cadence", "family": "fp8",
+           "shape": f"{cfg['fp8_layers']}layers"
+                    f"x{cfg['fp8_hidden']}", "dtype": "e4m3/e5m2",
+           "noise_floor_pct": noise_pct,
+           "candidates_ms": {f"H{h}/N{n}": round(v, 4)
+                             for (h, n), v in times.items()}}
+    if winner != default and times[winner] \
+            < times[default] * (1.0 - noise_pct / 100.0):
+        if "fp8" in cfg["device_check_families"]:
+            check = device_event_check(
+                "fp8_cadence", fast=steps[winner],
+                slow=steps[default], outdir=outdir)
+            rec["device_check"] = check
+            if check.get("checked") and check["verdict"] == "rejected":
+                rec["rejected_as_noise"] = True
+                return [rec]
+        rec["decision"] = {"fp8": {"amax_history_len": winner[0],
+                                   "interval": winner[1]}}
+    return [rec]
+
+
+def sweep_quantization(cfg, noise_pct: float) -> list:
+    """int8 inference routing: dynamic full-int8 vs weight-only at one
+    GEMM shape.  Weight-only is the design default (activation
+    precision untouched); dynamic steers ``int8_matmul(dynamic=None)``
+    only when it wins beyond the noise floor on THIS topology."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.quantization import int8_matmul, quantize_int8
+    m, k, n = cfg["int8_mkn"]
+    x = jax.random.normal(jax.random.key(12), (m, k), jnp.bfloat16)
+    wq = quantize_int8(jax.random.normal(jax.random.key(13),
+                                         (k, n)) * 0.05)
+    times = {}
+    for mode, dyn in (("weight_only", False), ("dynamic", True)):
+        # the two modes are two programs by design
+        # (apexlint: disable-next=APX302)
+        times[mode] = _time(
+            lambda x, dyn=dyn: int8_matmul(x, wq, dynamic=dyn), x,
+            cfg=cfg)
+    rec = {"space": "quantization.int8_dynamic", "family":
+           "quantization", "shape": f"{m}x{k}x{n}", "dtype": "int8",
+           "noise_floor_pct": noise_pct,
+           "candidates_ms": {k_: round(v, 4)
+                             for k_, v in times.items()}}
+    if times["dynamic"] < times["weight_only"] * (1.0
+                                                  - noise_pct / 100.0):
+        rec["decision"] = {"quantization": {"int8_dynamic": True}}
+    return [rec]
+
+
 def measure_budget_rows(cfg) -> dict:
     """Sweep measurements that ground perf_budget rows (dotted metric
     path -> value).  grad_accum_n8_speedup comes from the same flat-vs-
@@ -671,6 +820,7 @@ def build_table(records, topology: dict, backend: str,
     """Fold sweep records into one schema-versioned per-topology prefs
     doc (the layout ops/_dispatch.py selects by runtime topology)."""
     prefer, caps, pipeline, speedups = {}, {}, {}, {}
+    fp8, quant = {}, {}
     for rec in records:
         if rec.get("space") == "routing" and rec.get("speedup") \
                 is not None:
@@ -682,6 +832,8 @@ def build_table(records, topology: dict, backend: str,
         prefer.update(dec.get("prefer_pallas", {}))
         caps.update(dec.get("attn_block_cap", {}))
         pipeline.update(dec.get("pipeline", {}))
+        fp8.update(dec.get("fp8", {}))
+        quant.update(dec.get("quantization", {}))
     return {
         "schema": SCHEMA_VERSION,
         "methodology": "amortized",
@@ -694,6 +846,8 @@ def build_table(records, topology: dict, backend: str,
         "prefer_pallas": prefer,
         "attn_block_cap": caps,
         "pipeline": pipeline,
+        "fp8": fp8,
+        "quantization": quant,
         "speedups": {k: sorted(v) for k, v in speedups.items()},
         "sweep": {"records": records},
     }
@@ -730,6 +884,11 @@ def demonstrate_decision_changes(doc) -> list:
                 "max_bucket_bytes")
             out["pipeline:reduce_decompose"] = _dispatch.pipeline_pref(
                 "reduce_decompose", "psum")
+            out["fp8:amax_history_len"] = _dispatch.fp8_pref(
+                "amax_history_len")
+            out["fp8:interval"] = _dispatch.fp8_pref("interval")
+            out["quantization:int8_dynamic"] = \
+                _dispatch.quantization_pref("int8_dynamic", False)
             return out
 
         before = snapshot()
@@ -778,6 +937,8 @@ def run_sweep(cfg, out_dir: str, budget_path: str,
         records += sweep_attn_caps(cfg, noise_pct)
         records += sweep_pipeline_chunk(cfg, noise_pct, out_dir)
         records += sweep_reduce_decompose(cfg, noise_pct)
+        records += sweep_fp8_cadence(cfg, noise_pct, out_dir)
+        records += sweep_quantization(cfg, noise_pct)
         budget_rows = measure_budget_rows(cfg)
     finally:
         if prev_pin is None:

@@ -1,15 +1,16 @@
 #!/usr/bin/env bash
-# The one-command correctness gate: AST tier + semantic tier (apexverify)
-# + baseline diff over the package, then the relaxed profile over
-# tests/, examples/ and tools/ (APX101/102 exempt inside test bodies —
-# a test syncing to assert a device value is the point of the test).
-# The semantic tier includes the watchdog.instrumented_step,
-# fleet.instrumented_step and fleet.autoscaled_step specs: a
+# The one-command correctness gate: AST tier (incl. APX204
+# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 21
+# specs) + baseline diff over the package, then the relaxed profile
+# over tests/, examples/ and tools/ (APX101/102 exempt inside test
+# bodies — a test syncing to assert a device value is the point of the
+# test).  The semantic tier includes the watchdog.instrumented_step,
+# fleet.instrumented_step and fleet.autoscaled_step specs (a
 # watchdog-attached / fleet-monitored / autoscale-controlled flat-AMP
-# step must contain zero transfer/callback primitives (self-healing
-# detectors are host-side window-cadence consumers; the fleet
-# liveness beacon is host-side and out-of-band; the autoscaler is a
-# host-side window-flush decision policy).
+# step must contain zero transfer/callback primitives) and the
+# amp.fp8_step spec (EXACT fp8 quantize-convert counts — precision
+# casts cannot silently multiply — with the packed fp8 scale state
+# donated/aliased like every other optimizer slot).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #

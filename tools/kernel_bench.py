@@ -306,6 +306,34 @@ def main():
             r["speedup"] = round(r["oracle_ms"] / r["kernel_ms"], 2)
         rows.append(r)
 
+    # fp8 matmul vs the bf16 baseline (fp8-capable MXUs run e4m3 dots
+    # at ~2x the bf16 rate; tools/perf_budget.json floors the speedup
+    # at 1.5 once a hardware round restamps it), plus the fused packed
+    # fp8 scale update vs the per-leaf amax oracle
+    from apex_tpu.amp.fp8_bench import (bench_fp8_matmul,
+                                        bench_fp8_scale_update)
+    rf8 = bench_fp8_matmul()
+    rf8["backend"] = backend
+    print(json.dumps(rf8), flush=True)
+    rows.append({
+        "kernel": "fp8_matmul",
+        "shape": rf8["fp8_matmul_shape"],
+        "dtype": "e4m3/e5m2" if rf8["fp8_compute"] else "bf16-oracle",
+        "kernel_ms": rf8["fp8_matmul_ms"],
+        "oracle_ms": rf8["bf16_matmul_ms"],
+        "speedup": rf8.get("fp8_matmul_speedup")})
+    rsu = bench_fp8_scale_update()
+    rsu["backend"] = backend
+    print(json.dumps(rsu), flush=True)
+    rows.append({
+        "kernel": "fp8_scale_update",
+        "shape": (f"{rsu['fp8_scale_leaves']}leaves/"
+                  f"H{rsu['fp8_scale_history']}"),
+        "dtype": "f32",
+        "kernel_ms": rsu["fp8_scale_fused_ms"],
+        "oracle_ms": rsu["fp8_scale_per_leaf_ms"],
+        "speedup": rsu.get("fp8_scale_update_speedup")})
+
     # flash geometry sweep: find the best sequence-block cap per shape
     # (re-jit per cap — the env knob is read at trace time), then
     # record the per-head-dim winner in dispatch_prefs.json so the
