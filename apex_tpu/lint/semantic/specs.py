@@ -425,13 +425,15 @@ def _build_scaled_value_and_grad():
 
 def _instrumented_step_jaxpr(with_watchdog: bool = False,
                              with_fleet: bool = False,
-                             with_controller: bool = False):
+                             with_controller: bool = False,
+                             with_exporter: bool = False):
     """The telemetry-instrumented flat-AMP step's jaxpr, optionally
-    with a resilience watchdog, a fleet monitor and/or a fleet
-    autoscale controller attached to the session — all are host-side
-    (window-cadence detectors; out-of-band beacons; window-flush
-    decision policy), so the traced program must be byte-for-byte free
-    of callbacks/transfers either way."""
+    with a resilience watchdog, a fleet monitor, a fleet autoscale
+    controller and/or a live MetricsServer attached to the session —
+    all are host-side (window-cadence detectors; out-of-band beacons;
+    window-flush decision policy; flush-time scrape republish), so the
+    traced program must be byte-for-byte free of callbacks/transfers
+    either way."""
     import jax
     import jax.numpy as jnp
     from apex_tpu import amp, telemetry
@@ -446,6 +448,7 @@ def _instrumented_step_jaxpr(with_watchdog: bool = False,
     wd = None
     mon = None
     ctrl = None
+    srv = None
     try:
         if with_watchdog:
             from apex_tpu.resilience.watchdog import Watchdog
@@ -463,6 +466,10 @@ def _instrumented_step_jaxpr(with_watchdog: bool = False,
                 telemetry=tel, step_time_high_s=60.0)
             ctrl.note_step(0, 0.1)        # host-side intake
             ctrl.decide(0, n_hosts=2)     # host-side decision
+        if with_exporter:
+            from apex_tpu.telemetry.export import MetricsServer
+            srv = MetricsServer(telemetry=tel, port=0)
+            tel.flush()                   # republish path exercised
 
         def train_step(work_bufs, opt_state, scaler, x, step):
             ptree = opt._plan.unpack_model(work_bufs)
@@ -478,6 +485,8 @@ def _instrumented_step_jaxpr(with_watchdog: bool = False,
             tel.buf, jnp.int32(0), opt._param_bufs, opt.opt_state,
             scaler, x, jnp.int32(1))
     finally:
+        if srv is not None:
+            srv.close()
         if ctrl is not None:
             ctrl.close()
         if mon is not None:
@@ -560,6 +569,27 @@ def _build_fleet_autoscaled_step():
     return {
         "jaxpr": _instrumented_step_jaxpr(with_fleet=True,
                                           with_controller=True),
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "dus_min": 1,             # the ring write, nothing more
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "telemetry.exported_step",
+    anchor="apex_tpu/telemetry/export.py",
+    description="live-exported instrumented flat AMP step: the "
+                "MetricsServer republishes FLUSHED host data only "
+                "(observer + hostmetrics sink + emitter fan-out), so "
+                "the traced step still contains ZERO "
+                "callback/transfer primitives — a /metrics scrape "
+                "surface adds no per-step device syncs")
+def _build_exported_step():
+    return {
+        "jaxpr": _instrumented_step_jaxpr(with_exporter=True),
         "expect": {
             "no_host_transfer": True,
             "no_f64": True,

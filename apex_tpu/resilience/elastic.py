@@ -248,6 +248,12 @@ def run_elastic(step_fn: Callable[[int], Any],
     if retry is None:
         retry = RetryPolicy(max_retries=max_restarts,
                             base_delay_s=backoff_s)
+    if watchdog is not None and fleet is not None:
+        # ONE incident register for the whole recovery stack: a
+        # watchdog anomaly during a fleet recovery (or vice versa)
+        # joins the open incident instead of forking a second id, and
+        # the shared ordinal sequence stays identical on every host
+        watchdog.incidents = fleet.incidents
     if params_like is None:
         # only the SHAPES are the template; holding the unpacked
         # pytree itself would pin a params-sized HBM copy all run
@@ -293,6 +299,10 @@ def run_elastic(step_fn: Callable[[int], Any],
     mesh_shrinks = 0
     mesh_grows = 0
     last_resize_step: Optional[int] = None
+    # (incident_id, failure step) of a rollback/resize replay in
+    # flight: when the loop passes the failure step again the chain is
+    # over — emit replay_complete and close the incident
+    pending_replay: Optional[Tuple[str, int]] = None
     try:
         def _extras() -> dict:
             return save_extras() if save_extras is not None else {}
@@ -374,6 +384,11 @@ def run_elastic(step_fn: Callable[[int], Any],
             bit-exact-replay guarantee is direction-independent."""
             tel = getattr(fleet, "telemetry", None) or (
                 watchdog.telemetry if watchdog is not None else None)
+            if watchdog is not None:
+                # before the rewind's flush: the closure test inside
+                # observe() must not resolve an incident whose replay
+                # is about to start (replay_complete owns it)
+                watchdog.disown_incident()
             if tel is not None:
                 tel.rewind(resumed)
             if watchdog is not None:
@@ -384,6 +399,28 @@ def run_elastic(step_fn: Callable[[int], Any],
             last_resize_step = step
             if autoscale is not None:
                 autoscale.note_resize(step)
+
+        def _note_replay(failed_step: int) -> None:
+            """Arm the replay-complete watermark: the open incident
+            closes (one ``replay_complete`` event carrying its id)
+            when the loop passes ``failed_step`` again.  A second
+            recovery joining the SAME open incident (a rollback during
+            a shrink's replay) keeps the FURTHEST watermark — the
+            chain is over only once the replay re-passes the original
+            failure step too."""
+            nonlocal pending_replay
+            log = (fleet.incidents if fleet is not None
+                   else watchdog.incidents
+                   if watchdog is not None else None)
+            if log is None or log.current is None:
+                return
+            if pending_replay is not None \
+                    and pending_replay[0] == log.current:
+                pending_replay = (log.current,
+                                  max(pending_replay[1],
+                                      int(failed_step)))
+            else:
+                pending_replay = (log.current, int(failed_step))
 
         def _shrink_recover(step: int) -> Optional[int]:
             """Agreement -> shrunk mesh -> reshard restore -> resume;
@@ -425,6 +462,7 @@ def run_elastic(step_fn: Callable[[int], Any],
             mesh_shrinks += 1
             _note_resize(step)
             fleet.note_shrink(step, epoch, survivors, dead, resumed)
+            _note_replay(step)
             return resumed
 
         def _grow_recover(step: int) -> Optional[int]:
@@ -478,6 +516,7 @@ def run_elastic(step_fn: Callable[[int], Any],
             mesh_grows += 1
             _note_resize(step)
             fleet.note_grow(step, epoch, members, admitted, resumed)
+            _note_replay(step)
             return resumed
 
         def _voluntary_shrink(step: int, decision) -> Optional[int]:
@@ -525,6 +564,7 @@ def run_elastic(step_fn: Callable[[int], Any],
             _note_resize(step)
             fleet.note_shrink(step, epoch, survivors, released,
                               resumed, reason="autoscale")
+            _note_replay(step)
             return resumed
 
         def _admission_and_autoscale(step: int) -> Optional[int]:
@@ -641,6 +681,20 @@ def run_elastic(step_fn: Callable[[int], Any],
                 last_done = resumed
                 step = resumed + 1
                 continue
+            if pending_replay is not None \
+                    and last_done >= pending_replay[1]:
+                # the replay caught back up to the step the incident
+                # opened at: the causal chain is over — one
+                # replay_complete event carries the id out, and the
+                # register is free for the next incident
+                iid, _ = pending_replay
+                pending_replay = None
+                if fleet is not None:
+                    fleet.note_replay_complete(last_done,
+                                               incident_id=iid)
+                elif watchdog is not None:
+                    watchdog.note_replay_complete(last_done,
+                                                  incident_id=iid)
             if watchdog is not None:
                 if saved_now:
                     # the save starts aging toward last-known-good;
@@ -686,6 +740,7 @@ def run_elastic(step_fn: Callable[[int], Any],
                     rollbacks += 1
                     watchdog.note_rollback(resumed, step,
                                            verdict.anomaly)
+                    _note_replay(step)
                     last_done = resumed
                     step = resumed + 1
                     continue

@@ -93,6 +93,7 @@ from typing import (Any, Callable, Dict, List, Mapping, Optional,
 
 from apex_tpu.resilience import faults as _faults
 from apex_tpu.telemetry import hostmetrics as _hostmetrics
+from apex_tpu.telemetry.incident import IncidentLog
 
 # peer liveness states
 HOST_LIVE = "live"
@@ -339,6 +340,7 @@ class FleetMonitor:
                  agreement_timeout_s: float = 30.0,
                  incarnation: Optional[int] = None,
                  telemetry=None,
+                 incidents: Optional[IncidentLog] = None,
                  clock: Callable[[], float] = time.time):
         import jax
         if (slow_after_s is None) != (dead_after_s is None):
@@ -367,6 +369,12 @@ class FleetMonitor:
                             else int(time.time() * 1e3) % (1 << 31))
         self._clock = clock
         self.epoch = 0
+        # the incident register: a peer death, step deadline or resize
+        # opens an incident whose id threads every resulting event
+        # record (telemetry/incident.py) — minted from replicated
+        # facts, so every survivor stamps the SAME id without talking
+        self.incidents = incidents if incidents is not None \
+            else IncidentLog()
         self.timeline: List[HostFailure] = []     # full event history
         self.events: List[dict] = []              # shrink/deadline too
         self._event_records: List[dict] = []      # queued for flush
@@ -593,8 +601,25 @@ class FleetMonitor:
         _hostmetrics.emit("fleet/beacon_gap_ms", worst_gap * 1e3)
         _hostmetrics.emit("fleet/beacon_lag_steps", worst_lag)
         for f in found:
+            # a peer death opens an incident (keyed on the DEAD peer's
+            # identity — the same on every survivor); a fresh
+            # incarnation's return opens the grow chain's.  Follow-on
+            # events (agreement, shrink/grow, replay) ride the id
+            if f.kind == "host_dead":
+                self.incidents.open(
+                    "host_dead", host=f.host,
+                    incarnation=self._dead_incarnation.get(f.host, -1),
+                    epoch=self.epoch)
+            elif f.kind == "host_return":
+                self.incidents.open(
+                    "host_return", host=f.host,
+                    incarnation=dict(f.evidence).get("incarnation"),
+                    epoch=self.epoch)
             self.timeline.append(f)
-            self._event_records.append(f.record())
+            rec = f.record()
+            rec["t"] = round(self._clock(), 3)
+            self.incidents.tag(rec)
+            self._event_records.append(rec)
         return found
 
     def beat(self, step: int) -> List[HostFailure]:
@@ -765,6 +790,8 @@ class FleetMonitor:
 
     # ---- action events (recorded by run_elastic) -------------------------
     def _event(self, rec: dict) -> None:
+        rec.setdefault("t", round(self._clock(), 3))
+        self.incidents.tag(rec)
         self.events.append(rec)
         self._event_records.append(rec)
 
@@ -772,6 +799,12 @@ class FleetMonitor:
                     survivors: Sequence[int], dead: Sequence[int],
                     restored_step: Optional[int],
                     reason: str = "failure") -> None:
+        if self.incidents.current is None:
+            # a resize is an incident opener in its own right (the
+            # autoscaler's voluntary release has no preceding death)
+            self.incidents.open(
+                "shrink", host=(int(dead[0]) if dead else None),
+                epoch=epoch)
         _hostmetrics.emit("fleet/mesh_shrinks", 1)
         self._event({
             "kind": "fleet", "event": "shrink", "step": int(step),
@@ -783,6 +816,10 @@ class FleetMonitor:
     def note_grow(self, step: int, epoch: int,
                   members: Sequence[int], admitted: Sequence[int],
                   restored_step: Optional[int]) -> None:
+        if self.incidents.current is None:
+            self.incidents.open(
+                "grow", host=(int(admitted[0]) if admitted else None),
+                epoch=epoch)
         _hostmetrics.emit("fleet/mesh_grows", 1)
         self._event({
             "kind": "fleet", "event": "grow", "step": int(step),
@@ -809,10 +846,28 @@ class FleetMonitor:
                 "incarnation": int(inc), "reason": reason})
 
     def note_deadline(self, exc: "StepDeadlineExceeded") -> None:
+        # subject-less opener: every survivor hits the same hung
+        # collective's deadline at the same step under the same epoch
+        self.incidents.open("deadline", epoch=self.epoch)
         self._event({
             "kind": "fleet", "event": "deadline_exceeded",
             "step": int(exc.step), "phase": exc.phase,
             "deadline_s": round(exc.deadline_s, 3)})
+
+    def note_replay_complete(self, step: int,
+                             incident_id: Optional[str] = None) -> None:
+        """The replay after a shrink/grow restore caught back up to
+        the failure step: the incident's causal chain is over.  Emits
+        the ``replay_complete`` event carrying the incident id and
+        closes it in the register."""
+        iid = incident_id if incident_id is not None \
+            else self.incidents.current
+        rec = {"kind": "fleet", "event": "replay_complete",
+               "step": int(step)}
+        if iid is not None:
+            rec["incident_id"] = iid
+        self._event(rec)
+        self.incidents.close(iid)
 
 
 # ---------------------------------------------------------------------
@@ -984,7 +1039,13 @@ class FleetController:
     - **queue depth** — a ring metric named by ``queue_metric`` (e.g.
       a data-loader backlog the trainer records per step), read from
       the telemetry session's window flushes when attached
-      (``telemetry=``); same high/low watermark shape.
+      (``telemetry=``); same high/low watermark shape.  An EXTERNAL
+      load signal — a serving admission queue, a scheduler backlog,
+      anything outside the training loop — rides the same window via
+      ``signal_source``: a zero-arg callable polled once per decision
+      (return None for "no sample"), so the live-telemetry registry
+      (``telemetry.export``) or any host-side producer can feed the
+      autoscaler without touching the ring schema.
     - **fleet health** — the ``fleet/hosts_slow`` counter riding the
       hostmetrics sinks: a degraded fleet holds every resize (growing
       into — or shrinking under — an infrastructure wobble just
@@ -1006,6 +1067,8 @@ class FleetController:
                  queue_metric: Optional[str] = None,
                  queue_high: Optional[float] = None,
                  queue_low: Optional[float] = None,
+                 signal_source: Optional[
+                     Callable[[], Optional[float]]] = None,
                  window: int = 32, patience: int = 2,
                  cooldown_steps: int = 100,
                  min_hosts: int = 1,
@@ -1014,9 +1077,11 @@ class FleetController:
         if step_time_high_s is None and queue_high is None:
             raise ValueError(
                 "configure at least one grow signal: step_time_high_s "
-                "or queue_metric + queue_high")
-        if queue_metric is None and queue_high is not None:
-            raise ValueError("queue_high needs queue_metric")
+                "or queue_high (with queue_metric or signal_source)")
+        if queue_metric is None and signal_source is None \
+                and queue_high is not None:
+            raise ValueError(
+                "queue_high needs queue_metric or signal_source")
         for lo, hi, what in ((step_time_low_s, step_time_high_s,
                               "step_time"),
                              (queue_low, queue_high, "queue")):
@@ -1031,6 +1096,7 @@ class FleetController:
         self.queue_metric = queue_metric
         self.queue_high = queue_high
         self.queue_low = queue_low
+        self.signal_source = signal_source
         self.patience = int(patience)
         self.cooldown_steps = int(cooldown_steps)
         self.min_hosts = int(min_hosts)
@@ -1134,6 +1200,19 @@ class FleetController:
         any the decision stays); ``incident``: whether the watchdog
         has an open incident (None consults ``incident_source``)."""
         step = int(step)
+        if self.signal_source is not None:
+            # external load sample (serving queue depth etc.): one
+            # poll per decision, riding the same hysteresis window as
+            # the ring metric
+            try:
+                v = self.signal_source()
+            except Exception:     # noqa: BLE001 — a broken gauge must
+                v = None          # not kill the supervisor loop
+            if v is not None:
+                try:
+                    self._queue.append(float(v))
+                except (TypeError, ValueError):
+                    pass
         if incident is None:
             incident = bool(self.incident_source()) \
                 if self.incident_source is not None else False

@@ -67,6 +67,7 @@ from typing import (Any, Callable, Deque, Dict, List, Mapping, NamedTuple,
                     Optional, Sequence, Tuple)
 
 from apex_tpu.resilience.retry import RetryPolicy
+from apex_tpu.telemetry.incident import IncidentLog
 
 SEVERITY_WARN = "warn"
 SEVERITY_CRITICAL = "critical"
@@ -109,15 +110,21 @@ class Anomaly:
     first_step: int             # oldest step of the evidence
     detector: str               # detector instance name
     evidence: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    # the causal-correlation key (telemetry/incident.py): stamped by
+    # the watchdog when this anomaly opens — or joins — an incident
+    incident_id: Optional[str] = None
 
     def record(self) -> dict:
         """The typed telemetry event (``kind: "anomaly"``) emitters
         write and ``telemetry summarize`` renders as a timeline row."""
-        return {"kind": "anomaly", "anomaly": self.kind,
-                "severity": self.severity, "step": self.step,
-                "first_step": self.first_step,
-                "detector": self.detector,
-                "evidence": dict(self.evidence)}
+        rec = {"kind": "anomaly", "anomaly": self.kind,
+               "severity": self.severity, "step": self.step,
+               "first_step": self.first_step,
+               "detector": self.detector,
+               "evidence": dict(self.evidence)}
+        if self.incident_id is not None:
+            rec["incident_id"] = self.incident_id
+        return rec
 
 
 class Verdict(NamedTuple):
@@ -493,11 +500,19 @@ class Watchdog:
                  telemetry=None,
                  clean_window: Optional[int] = None,
                  postmortem_dir: Optional[str] = None,
+                 incidents: Optional[IncidentLog] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.detectors: List[Detector] = (
             list(detectors) if detectors is not None
             else default_detectors())
         self.policy = policy or WatchdogPolicy()
+        # the incident register: quarantine-or-worse anomalies open an
+        # incident whose id threads every resulting event record.
+        # run_elastic shares the fleet monitor's log when both are
+        # attached so the ordinals interleave identically on every host
+        self.incidents = incidents if incidents is not None \
+            else IncidentLog()
+        self._own_iid: Optional[str] = None
         self.telemetry = telemetry
         if clean_window is None:
             clean_window = (telemetry.ring.window
@@ -558,8 +573,12 @@ class Watchdog:
     # ---- observation (window-flush cadence, host side) -------------------
     def _on_flush(self, records: Sequence[dict]) -> List[dict]:
         """Telemetry flush observer: detect, then hand the anomaly +
-        action event records back for the emitters to write."""
-        events = [a.record() for a in self.observe(records)]
+        action event records back for the emitters to write (wall
+        stamps ``t`` let the fleet timeline order events across
+        hosts)."""
+        now = round(time.time(), 3)
+        events = [{**a.record(), "t": now}
+                  for a in self.observe(records)]
         events += self._event_records
         self._event_records = []
         return events
@@ -575,7 +594,7 @@ class Watchdog:
         found: List[Anomaly] = []
         for det in self.detectors:
             found.extend(det.observe(step_records))
-        self._ingest(found)
+        found = self._ingest(found)
         newest = step_records[-1]["step"]
         # LKG aging: saves survive once a full clean window passed them
         # (any quarantine-grade anomaly above already voided them all)
@@ -590,19 +609,55 @@ class Watchdog:
                 newest >= self._last_anomaly_step + self.clean_window:
             self._quarantines.clear()
             self._last_anomaly_step = None
+        # a quarantine-grade incident this watchdog opened closes by
+        # surviving its clean window — but NEVER while an anomaly is
+        # still pending a verdict: one flush can both detect and span
+        # past the clean horizon (a late first detection in a wide
+        # window), and the verdict it drives (quarantine/rollback at
+        # the next boundary) must still ride the open incident, so the
+        # closure test runs on every flush once nothing is pending and
+        # the forgiveness watermark has aged out.  Rollback incidents
+        # are DISOWNED at rollback time (reset_after_external_rewind)
+        # and close via note_replay_complete instead
+        if not self._pending and self._last_anomaly_step is None \
+                and self._own_iid is not None:
+            if self.incidents.close(self._own_iid):
+                self._event({"kind": "watchdog",
+                             "action": "incident_resolved",
+                             "step": int(newest),
+                             "incident_id": self._own_iid})
+            self._own_iid = None
         return found
 
-    def _ingest(self, found: Sequence[Anomaly]) -> None:
+    def _ingest(self, found: Sequence[Anomaly]) -> List[Anomaly]:
+        """Fold newly-detected anomalies into the incident state;
+        returns them stamped with the open incident id (when one is
+        open) — callers must use the returned list."""
+        found = list(found)
         if not found:
-            return
-        self.timeline.extend(found)
-        self._pending.extend(found)
+            return found
         # incident state keys on quarantine-or-worse anomalies only: a
         # warn-grade straggler must neither void LKG candidates nor
         # hold the quarantine-forgiveness window open
         serious = [a for a in found
                    if _LADDER.index(self.policy.action_for(a))
                    >= _LADDER.index(ACTION_QUARANTINE)]
+        if serious:
+            # open (or join — a fleet recovery may already be live on a
+            # shared log) the incident; the id threads every record in
+            # the causal chain from here on
+            if self.incidents.current is None:
+                self._own_iid = self.incidents.open(serious[0].kind)
+            else:
+                self.incidents.open(serious[0].kind)
+        if self.incidents.current is not None:
+            found = [dataclasses.replace(
+                a, incident_id=self.incidents.current) for a in found]
+            serious = [a for a in found
+                       if _LADDER.index(self.policy.action_for(a))
+                       >= _LADDER.index(ACTION_QUARANTINE)]
+        self.timeline.extend(found)
+        self._pending.extend(found)
         if serious:
             self._last_anomaly_step = max(
                 [a.step for a in serious]
@@ -613,6 +668,7 @@ class Watchdog:
             for s in self._pending_saves:
                 self._resolved.append((s, False))
             self._pending_saves.clear()
+        return found
 
     # ---- supervisor surface (step-boundary cadence) ----------------------
     def open_incident(self, step: int) -> bool:
@@ -661,8 +717,9 @@ class Watchdog:
         if self._last_step_t is not None and self._time_det is not None:
             a = self._time_det.observe_time(step, now - self._last_step_t)
             if a is not None:
-                self._ingest([a])
-                self._event_records.append(a.record())
+                a = self._ingest([a])[0]
+                self._event_records.append(
+                    {**a.record(), "t": round(time.time(), 3)})
         self._last_step_t = now
         if not self._pending:
             return Verdict(ACTION_NONE, None)
@@ -688,6 +745,8 @@ class Watchdog:
 
     # ---- actions (called by run_elastic) ---------------------------------
     def _event(self, rec: dict) -> None:
+        rec.setdefault("t", round(time.time(), 3))
+        self.incidents.tag(rec)
         self.events.append(rec)
         self._event_records.append(rec)
 
@@ -709,9 +768,40 @@ class Watchdog:
             "step": int(step), "to_step": int(restored_step),
             "anomaly": anomaly.kind if anomaly else None,
             "rollbacks": self._rollbacks})
+        # disown BEFORE the rewind: rewind() flushes, the flush runs
+        # observe(), and an aged-out forgiveness watermark would let
+        # the clean-window closure resolve the incident mid-rollback —
+        # the replay-complete path owns closing it from here
+        self.disown_incident()
         if self.telemetry is not None:
             self.telemetry.rewind(restored_step)
         self.reset_after_external_rewind(restored_step)
+
+    def note_replay_complete(self, step: int,
+                             incident_id: Optional[str] = None) -> None:
+        """The replay after a rollback caught back up to the failure
+        step: the incident's causal chain is over.  Emits the
+        ``replay_complete`` event carrying the incident id and closes
+        it in the register (``run_elastic`` calls this when the loop
+        passes the step the incident opened at)."""
+        iid = incident_id if incident_id is not None \
+            else self.incidents.current
+        rec = {"kind": "watchdog", "action": "replay_complete",
+               "step": int(step)}
+        if iid is not None:
+            rec["incident_id"] = iid
+        self._event(rec)
+        self.incidents.close(iid)
+        if iid == self._own_iid:
+            self._own_iid = None
+
+    def disown_incident(self) -> None:
+        """Hand the open incident's closure to the replay-complete
+        path (rollback / fleet-resize recoveries): the clean-window
+        closure must never resolve an incident whose replay is still
+        in flight.  Called before any telemetry rewind whose flush
+        would run the closure test."""
+        self._own_iid = None
 
     def reset_after_external_rewind(self, restored_step: int) -> None:
         """The run was rewound to ``restored_step`` and the steps
@@ -734,6 +824,7 @@ class Watchdog:
         self._quarantines.clear()
         self._last_anomaly_step = None
         self._last_step_t = None             # restore time is not a step
+        self.disown_incident()   # replay-complete owns closing it now
 
     # ---- abort diagnostics -----------------------------------------------
     def write_postmortem(self, step: int,

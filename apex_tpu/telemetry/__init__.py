@@ -23,8 +23,17 @@ flags as APX101 (and whose runtime twin is APX102).  Core invariant:
 - :class:`RetraceCounter` (retrace.py): counts recompiles at run time
   via ``jax.monitoring`` (plus a per-function wrapper fallback) — the
   runtime companion to the APX30x static rules.
-- ``python -m apex_tpu.telemetry summarize <run_dir>`` (cli.py):
-  render a run's JSONL as step/span/retrace tables, stdlib-only.
+- ``python -m apex_tpu.telemetry summarize <run_dir>...`` (cli.py):
+  render a run's JSONL as step/span/retrace tables, stdlib-only
+  (several run dirs merge host-tagged).
+- :class:`MetricsServer` (export.py): live ``/metrics`` (Prometheus
+  text) + ``/healthz`` over the flushed host state — zero added
+  per-step device syncs.
+- :mod:`incident` + :mod:`timeline` + ``python -m apex_tpu.telemetry
+  timeline <dir>...``: one incident id threading a whole causal chain
+  (anomaly/death -> action -> resize -> replay-complete) across every
+  host's run dir, merged into one skew-corrected fleet timeline
+  (text / ``--json`` / ``--chrome-trace`` for Perfetto).
 - :mod:`profiler` (profiler/): the performance observatory — trace
   capture windows, device-time attribution (compute / collective /
   transfer / idle + overlap fraction), cost-model MFU, and
@@ -38,6 +47,8 @@ from apex_tpu.telemetry import profiler
 from apex_tpu.telemetry._tape import emit as emit_metric
 from apex_tpu.telemetry.emitters import (CsvEmitter, Emitter,
                                          JsonlEmitter, StepLogger)
+from apex_tpu.telemetry.export import MetricsServer
+from apex_tpu.telemetry.incident import IncidentLog
 from apex_tpu.telemetry.retrace import RetraceCounter
 from apex_tpu.telemetry.ring import MetricRing
 from apex_tpu.telemetry.session import DEFAULT_METRICS, Telemetry
@@ -46,5 +57,6 @@ from apex_tpu.telemetry.spans import span
 __all__ = [
     "MetricRing", "Telemetry", "DEFAULT_METRICS",
     "Emitter", "JsonlEmitter", "CsvEmitter", "StepLogger",
+    "MetricsServer", "IncidentLog",
     "RetraceCounter", "span", "emit_metric", "profiler",
 ]

@@ -1,8 +1,12 @@
-"""``python -m apex_tpu.telemetry summarize <run_dir>`` — render a
+"""``python -m apex_tpu.telemetry summarize <run_dir>...`` — render a
 training run's JSONL telemetry as a step table plus span/retrace
-summaries — and ``... profile <trace_dir>`` — render a captured
+summaries (multiple run dirs merge through the timeline front-end:
+host-tagged, steps deduped newest-per-(host, step)) — ``... timeline
+<run_dir>...`` — merge N hosts' run dirs into one ordered fleet
+timeline grouped by incident id (``--json`` / ``--chrome-trace`` for
+Perfetto) — and ``... profile <trace_dir>`` — render a captured
 profiler trace as the observatory report (step breakdown, collective
-overlap, MFU, top ops).  Both with no dependency beyond the standard
+overlap, MFU, top ops).  All with no dependency beyond the standard
 library (works on a login host with no jax installed)."""
 
 from __future__ import annotations
@@ -63,9 +67,14 @@ def _anomaly_row(r: dict) -> List[str]:
             detail.append(f"to_step={r['to_step']}")
         if r.get("rollbacks") is not None:
             detail.append(f"rollbacks={r['rollbacks']}")
+        if r.get("incident_id"):
+            detail.append(f"incident={r['incident_id']}")
         return [step, "action", action, " ".join(detail) or "-"]
     detail = " ".join(f"{k}={_fmt_cell(v)}" for k, v in
                       sorted((r.get("evidence") or {}).items()))
+    if r.get("incident_id"):
+        detail += (" " if detail else "") + \
+            f"incident={r['incident_id']}"
     return [step, r.get("anomaly", "-"), r.get("severity", "-"),
             detail or "-"]
 
@@ -84,12 +93,16 @@ def _fleet_row(r: dict) -> List[str]:
             detail += f" reason={r['reason']}"
         if r.get("to_step") is not None:
             detail += f" to_step={r['to_step']}"
+        if r.get("incident_id"):
+            detail += f" incident={r['incident_id']}"
         return [step, event, "-", detail]
     if event == "grow":
         detail = (f"members={r.get('members')} "
                   f"admitted={r.get('admitted')} epoch={r.get('epoch')}")
         if r.get("to_step") is not None:
             detail += f" to_step={r['to_step']}"
+        if r.get("incident_id"):
+            detail += f" incident={r['incident_id']}"
         return [step, event, "-", detail]
     if event == "admission_refused":
         return [step, event, str(r.get("host", "-")),
@@ -103,12 +116,17 @@ def _fleet_row(r: dict) -> List[str]:
         return [step, event, "-",
                 f"phase={r.get('phase')} "
                 f"deadline_s={_fmt_cell(r.get('deadline_s'))}"]
+    if event == "replay_complete":
+        return [step, event, "-",
+                f"incident={r.get('incident_id', '-')}"]
     detail = (f"gap_s={_fmt_cell(r.get('gap_s'))} "
               f"lag_steps={_fmt_cell(r.get('lag_steps'))} "
               f"peer_step={_fmt_cell(r.get('peer_step'))}")
     inc = (r.get("evidence") or {}).get("incarnation")
     if event == "host_return" and inc is not None:
         detail += f" incarnation={inc}"
+    if r.get("incident_id"):
+        detail += f" incident={r['incident_id']}"
     return [step, event, str(r.get("host", "-")), detail]
 
 
@@ -120,11 +138,20 @@ def _render_table(header: List[str], rows: List[List[str]], out) -> None:
         print("  ".join(c.rjust(w) for c, w in zip(r, widths)), file=out)
 
 
-def summarize(path: str, tail: int = 32, as_json: bool = False,
+def summarize(path, tail: int = 32, as_json: bool = False,
               out=None) -> int:
     """Render the run's telemetry; returns a process exit code (1 when
-    there is nothing to render — missing file or zero step records)."""
+    there is nothing to render — missing file or zero step records).
+    ``path`` may be one run dir (or its .jsonl) or a LIST of run dirs:
+    multiple dirs merge through the timeline front-end (host-tagged,
+    steps deduped newest-per-(host, step)) so a faked-multi-host chaos
+    run inspects in one command."""
     out = out or sys.stdout
+    if not isinstance(path, str):
+        paths = list(path)
+        if len(paths) != 1:
+            return _summarize_merged(paths, tail, as_json, out)
+        path = paths[0]
     resolved = _resolve(path)
     if resolved is None:
         print(f"no {JSONL_NAME} under {path!r} (run with telemetry on: "
@@ -248,6 +275,120 @@ def summarize(path: str, tail: int = 32, as_json: bool = False,
     return 0
 
 
+def _summarize_merged(paths: List[str], tail: int, as_json: bool,
+                      out) -> int:
+    """Multi-dir summarize: the timeline merge front-end feeding the
+    familiar tables, with a host column on everything per-host."""
+    from apex_tpu.telemetry import timeline as _timeline
+    merged = _timeline.merge_run_dirs(paths)
+    if merged is None:
+        print(f"no {JSONL_NAME} under any of: {' '.join(paths)} "
+              "(run with telemetry on: "
+              "apex_tpu.telemetry.Telemetry(run_dir=...))", file=out)
+        return 1
+    steps = merged["steps"]
+    spans, counters, retraces = {}, {}, {}
+    anomalies: List[dict] = []
+    fleet_events: List[dict] = []
+    for r in merged["records"]:
+        key = (r.get("host", 0), r.get("name", ""))
+        if r.get("kind") == "span":
+            spans[key] = r
+        elif r.get("kind") == "counter":
+            counters[key] = r
+        elif r.get("kind") == "retrace":
+            retraces[key] = r
+        elif r.get("kind") in ("anomaly", "watchdog", "incident"):
+            anomalies.append(r)
+        elif r.get("kind") == "fleet":
+            fleet_events.append(r)
+    if not steps:
+        print(f"{' '.join(merged['sources'])}: no step records",
+              file=out)
+        return 1
+    seen = {k for r in steps for k in r}
+    metrics = sorted(seen - {"step", "kind", "host"})
+    overflows = sum(1 for r in steps
+                    if (r.get("amp/found_inf") or 0) > 0)
+    if as_json:
+        json.dump({"sources": merged["sources"],
+                   "hosts": merged["hosts"],
+                   "offsets": merged["offsets"],
+                   "steps": steps, "overflow_steps": overflows,
+                   "anomalies": anomalies, "fleet": fleet_events,
+                   "spans": [spans[k] for k in sorted(spans)],
+                   "counters": [counters[k] for k in sorted(counters)],
+                   "retraces": [retraces[k]
+                                for k in sorted(retraces)]}, out)
+        out.write("\n")
+        return 0
+    print(f"telemetry: {len(merged['sources'])} run dirs merged, "
+          f"hosts {merged['hosts']}", file=out)
+    print(f"steps recorded: {len(steps)}   overflow steps: "
+          f"{overflows}", file=out)
+    print("", file=out)
+    show = steps[-tail:] if tail and tail > 0 else steps
+    header = ["host", "step"] + [m.rsplit("/", 1)[-1]
+                                 if m.count("/") else m
+                                 for m in metrics]
+    rows = [[str(r.get("host", "-")), str(r["step"])]
+            + [_fmt_cell(r.get(m)) for m in metrics] for r in show]
+    _render_table(header, rows, out)
+    if anomalies:
+        print("\nanomaly timeline:", file=out)
+        _render_table(
+            ["host", "step", "event", "severity/action", "detail"],
+            [[str(r.get("host", "-"))] + _anomaly_row(r)
+             for r in anomalies], out)
+    if fleet_events:
+        print("\nfleet timeline:", file=out)
+        _render_table(
+            ["host", "step", "event", "subject", "detail"],
+            [[str(r.get("host", "-"))] + _fleet_row(r)
+             for r in fleet_events], out)
+    if counters:
+        print("\ncounters (cumulative, per host):", file=out)
+        _render_table(
+            ["host", "name", "count", "total", "max", "last"],
+            [[str(h), n, str(c.get("count", "-")),
+              _fmt_cell(c.get("total")), _fmt_cell(c.get("max")),
+              _fmt_cell(c.get("last"))]
+             for (h, n), c in sorted(counters.items())], out)
+    return 0
+
+
+def timeline(paths: List[str], as_json: bool = False,
+             chrome_trace_path: Optional[str] = None,
+             out=None) -> int:
+    """Render the merged fleet timeline (incident-grouped) for N run
+    dirs; optionally export the Chrome trace for Perfetto.  Exit 1
+    when no run dir resolves to a JSONL file."""
+    from apex_tpu.telemetry import timeline as _timeline
+    out = out or sys.stdout
+    doc = _timeline.build(paths)
+    if doc is None:
+        print(f"no {JSONL_NAME} under any of: {' '.join(paths)}",
+              file=out)
+        return 1
+    if chrome_trace_path:
+        trace = _timeline.chrome_trace(doc)
+        if chrome_trace_path == "-":
+            json.dump(trace, out)
+            out.write("\n")
+        else:
+            with open(chrome_trace_path, "w", encoding="utf-8") as f:
+                json.dump(trace, f)
+            print(f"chrome trace written to {chrome_trace_path} "
+                  f"({len(trace['traceEvents'])} events) — load in "
+                  "Perfetto / chrome://tracing", file=out)
+    if as_json:
+        json.dump(doc, out)
+        out.write("\n")
+    elif chrome_trace_path != "-":
+        _timeline.render_text(doc, out)
+    return 0
+
+
 def profile(trace_dir: str, *, top: int = 12,
             steps: Optional[int] = None, as_json: bool = False,
             out=None) -> int:
@@ -271,12 +412,27 @@ def main(argv=None) -> int:
         description="training telemetry tooling")
     sub = ap.add_subparsers(dest="cmd", required=True)
     s = sub.add_parser("summarize",
-                       help="render a run's telemetry.jsonl as tables")
-    s.add_argument("run_dir", help="run directory (or the .jsonl itself)")
+                       help="render a run's telemetry.jsonl as tables "
+                            "(several run dirs merge host-tagged)")
+    s.add_argument("run_dir", nargs="+",
+                   help="run directory (or the .jsonl itself); "
+                        "several merge through the timeline front-end")
     s.add_argument("--tail", type=int, default=32,
                    help="show only the newest N steps (0 = all)")
     s.add_argument("--json", action="store_true",
                    help="machine-readable output")
+    t = sub.add_parser(
+        "timeline",
+        help="merge N hosts' run dirs into one ordered fleet "
+             "timeline grouped by incident id")
+    t.add_argument("run_dirs", nargs="+",
+                   help="run directories (or .jsonl files), one per "
+                        "host")
+    t.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    t.add_argument("--chrome-trace", metavar="PATH", default=None,
+                   help="also write a Chrome trace (Perfetto / "
+                        "chrome://tracing); '-' writes it to stdout")
     p = sub.add_parser(
         "profile",
         help="render a captured jax.profiler trace dir as the "
@@ -295,6 +451,9 @@ def main(argv=None) -> int:
         if args.cmd == "profile":
             return profile(args.trace_dir, top=args.top,
                            steps=args.steps, as_json=args.json)
+        if args.cmd == "timeline":
+            return timeline(args.run_dirs, as_json=args.json,
+                            chrome_trace_path=args.chrome_trace)
         return summarize(args.run_dir, tail=args.tail, as_json=args.json)
     except BrokenPipeError:
         return 0          # |head etc. closing the pipe is not an error

@@ -31,7 +31,13 @@ import sys
 import time
 from typing import List, Optional, Sequence
 
-SCHEMA_VERSION = 1
+# v2 (Live telemetry PR): step/event records may carry an
+# ``incident_id`` correlation key, the header carries ``host`` /
+# ``started_at`` so multi-dir merges can tag provenance, and flushes
+# append ``kind:"clock"`` (step, wall_time) sync points for the fleet
+# timeline's skew correction.  Readers (``summarize``/``timeline``)
+# accept v1 files unchanged — v1 simply has none of those fields.
+SCHEMA_VERSION = 2
 
 
 class Emitter:
@@ -50,17 +56,22 @@ class JsonlEmitter(Emitter):
     a single run.  NaN never reaches the file: the ring decodes
     non-finite cells to None/null upstream."""
 
-    def __init__(self, path: str, metrics: Sequence[str] = ()):
+    def __init__(self, path: str, metrics: Sequence[str] = (),
+                 header_extra: Optional[dict] = None):
         self.path = path
         self._f = None
         self._metrics = tuple(metrics)
+        # host / started_at provenance (the session passes them): what
+        # lets `telemetry timeline` tag a merged dir's records
+        self._header_extra = dict(header_extra or {})
 
     def _open(self):
         if self._f is None:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             self._f = open(self.path, "w", encoding="utf-8")
             self._write({"kind": "schema", "version": SCHEMA_VERSION,
-                         "metrics": list(self._metrics)})
+                         "metrics": list(self._metrics),
+                         **self._header_extra})
         return self._f
 
     def _write(self, rec: dict):

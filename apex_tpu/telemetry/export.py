@@ -1,0 +1,290 @@
+"""Live telemetry export: a ``/metrics`` scrape surface over the
+flushed host-side state.
+
+Everything the observability stack produces today lands in a
+rank-local run dir and is legible only AFTER the run (``summarize``).
+Production operations — and the :class:`~apex_tpu.resilience.fleet.
+FleetController`'s load signals — need the same numbers LIVE.  The
+:class:`MetricsServer` is the stdlib-only answer: a threaded
+``http.server`` exposing
+
+- ``GET /metrics`` — Prometheus text format (``# TYPE`` + one
+  ``apex_tpu_*`` gauge per line): the newest value of every ring
+  metric (loss, amp/*, optim/*, fp8/*), every hostmetrics counter
+  (ckpt/*, fleet/*, perf/*) as last-value gauge PLUS a monotonic
+  ``_total`` sum, watchdog / fleet / autoscaler event counts by kind,
+  the open-incident flag with its id as a label, and
+  ``apex_tpu_exported_step`` (the newest flushed step);
+- ``GET /healthz`` — a tiny JSON liveness document.
+
+**Zero added per-step device syncs** is the hard contract (the
+``telemetry.exported_step`` apexverify spec pins it): the server only
+ever reads data the host already holds —
+
+- ring metrics arrive through a session OBSERVER at window-flush time
+  (the one ``device_get`` per window the ring already pays);
+- host counters arrive through a :mod:`~apex_tpu.telemetry.
+  hostmetrics` sink the instant a producer emits (beat/save cadence,
+  host threads — so ``fleet_hosts_dead`` flips the moment the monitor
+  classifies, not a window later);
+- event records (anomalies, watchdog actions, fleet resizes,
+  autoscale decisions) arrive through the emitter fan-out at flush
+  time.
+
+Nothing here touches the traced program, and a scrape is answered
+from an in-memory snapshot under a lock — a slow scraper can never
+block a flush.
+
+>>> tel = telemetry.Telemetry(run_dir, window=64)
+>>> srv = telemetry.MetricsServer(telemetry=tel, port=9100)
+>>> ...train...                      # curl :9100/metrics any time
+>>> srv.close(); tel.close()
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.telemetry import hostmetrics as _hostmetrics
+from apex_tpu.telemetry.emitters import Emitter
+
+METRIC_PREFIX = "apex_tpu_"
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+# record kinds that close an incident (clear the open-incident gauge)
+_INCIDENT_CLOSERS = ("replay_complete", "incident_resolved")
+
+
+def metric_name(name: str, prefix: str = METRIC_PREFIX) -> str:
+    """``amp/grad_norm`` -> ``apex_tpu_amp_grad_norm`` (Prometheus
+    names allow only ``[a-zA-Z0-9_:]``)."""
+    return prefix + _NAME_RE.sub("_", name)
+
+
+def _fmt_value(v: float) -> str:
+    """Exposition-format a sample: integral values print exact (a
+    ``{:g}`` would truncate ``exported_step`` past 999999 — long
+    pretrains routinely cross 1e6 steps), floats keep 10 significant
+    digits."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return f"{f:.10g}"
+
+
+def render_prometheus(gauges: Dict[str, float],
+                      labeled: Dict[Tuple[str, Tuple[Tuple[str, str],
+                                                     ...]], float]
+                      ) -> str:
+    """The text exposition format, deterministically ordered."""
+    lines: List[str] = []
+    for name in sorted(gauges):
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt_value(gauges[name])}")
+    by_name: Dict[str, List[Tuple[Tuple[Tuple[str, str], ...], float]]]
+    by_name = {}
+    for (name, labels), v in labeled.items():
+        by_name.setdefault(name, []).append((labels, v))
+    for name in sorted(by_name):
+        lines.append(f"# TYPE {name} gauge")
+        for labels, v in sorted(by_name[name]):
+            lab = ",".join(f'{k}="{val}"' for k, val in labels)
+            lines.append(f"{name}{{{lab}}} {_fmt_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer(Emitter):
+    """Live ``/metrics`` + ``/healthz`` over a telemetry session
+    (module docstring).  ``port=0`` binds an ephemeral port (read it
+    back from :attr:`port`); ``telemetry=`` attaches immediately, or
+    call :meth:`attach` later.  Also an :class:`Emitter`, so the
+    session's flush fan-out hands it the event records."""
+
+    def __init__(self, telemetry=None, host: str = "127.0.0.1",
+                 port: int = 0, prefix: str = METRIC_PREFIX):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, float] = {}
+        self._labeled: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                            float] = {}
+        self._totals: Dict[str, float] = {}
+        self._exported_step = -1
+        self._publishes = 0
+        self._started = time.time()
+        self._telemetry = None
+        self._closed = False
+
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):     # no stderr per scrape
+                pass
+
+            def do_GET(self):
+                if self.path.split("?")[0] == "/metrics":
+                    body = server.render().encode("utf-8")
+                    ctype = ("text/plain; version=0.0.4; "
+                             "charset=utf-8")
+                elif self.path.split("?")[0] == "/healthz":
+                    body = (json.dumps(server.health(), sort_keys=True)
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="apex-tpu-metrics-server", daemon=True)
+        self._thread.start()
+        _hostmetrics.add_sink(self._on_counter)
+        if telemetry is not None:
+            self.attach(telemetry)
+
+    # ---- wiring ----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def attach(self, telemetry) -> "MetricsServer":
+        """Observer (step records, every rank) + emitter (event
+        records, writer rank) on one session."""
+        self._telemetry = telemetry
+        telemetry.add_observer(self._on_flush)
+        telemetry.add_emitter(self)
+        return self
+
+    def detach(self) -> None:
+        if self._telemetry is not None:
+            self._telemetry.remove_observer(self._on_flush)
+            self._telemetry.remove_emitter(self)
+            self._telemetry = None
+
+    def close(self) -> None:
+        """Stop serving and unhook (idempotent — the session's close
+        also calls this through the emitter fan-out)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.detach()
+        _hostmetrics.remove_sink(self._on_counter)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- intake (all host-side, never in the traced step) ----------------
+    def _set(self, name: str, value: float) -> None:
+        self._gauges[metric_name(name, self.prefix)] = float(value)
+
+    def _on_counter(self, name: str, value: float) -> None:
+        """hostmetrics sink: fires the instant a producer emits (the
+        fleet monitor's beat, the checkpoint worker, a profiler
+        capture) — so liveness gauges flip in real time, not a window
+        later.  ``_total`` is the monotonic running sum a scraper can
+        alert on without catching the flip itself."""
+        with self._lock:
+            self._set(name, value)
+            key = metric_name(name, self.prefix) + "_total"
+            self._totals[key] = self._totals.get(key, 0.0) \
+                + float(value)
+
+    def _on_flush(self, records) -> None:
+        """Session observer: republish the window's step metrics
+        (newest value per metric wins — these are gauges)."""
+        with self._lock:
+            self._publishes += 1
+            for r in records:
+                if r.get("kind", "step") != "step":
+                    continue
+                self._exported_step = max(self._exported_step,
+                                          int(r.get("step", -1)))
+                for k, v in r.items():
+                    if k in ("step", "kind") or v is None:
+                        continue
+                    if isinstance(v, (int, float)):
+                        self._set(k, v)
+        return None
+
+    def emit(self, records: List[dict]) -> None:
+        """Emitter fan-out: the EVENT records (anomalies, watchdog
+        actions, fleet resizes, autoscale decisions) that only exist
+        on this side of the flush.  Counts by kind, plus the
+        open-incident flag keyed by the correlation id."""
+        with self._lock:
+            for r in records:
+                kind = r.get("kind", "step")
+                if kind == "anomaly":
+                    self._bump(f"anomaly_{r.get('anomaly', 'unknown')}")
+                elif kind == "watchdog":
+                    self._bump(f"watchdog_{r.get('action', 'unknown')}")
+                elif kind == "fleet":
+                    ev = r.get("event", "unknown")
+                    if ev == "autoscale":
+                        self._bump(
+                            f"autoscale_{r.get('action', 'stay')}")
+                    else:
+                        self._bump(f"fleet_{ev}")
+                else:
+                    continue
+                iid = r.get("incident_id")
+                closer = (r.get("event") in _INCIDENT_CLOSERS
+                          or r.get("action") in _INCIDENT_CLOSERS)
+                if iid is not None:
+                    name = metric_name("incident_open", self.prefix)
+                    # bounded label cardinality: a scraper must see
+                    # the newest incident flip 1 -> 0, but a week of
+                    # incidents must not accumulate a label series
+                    # each — prune every OTHER already-closed id
+                    for key in [k for k, v in self._labeled.items()
+                                if k[0] == name and v == 0.0
+                                and k[1] != (("incident_id", iid),)]:
+                        del self._labeled[key]
+                    self._labeled[(name, (("incident_id", iid),))] = \
+                        0.0 if closer else 1.0
+
+    def _bump(self, slug: str) -> None:
+        key = metric_name(slug, self.prefix) + "_events_total"
+        self._totals[key] = self._totals.get(key, 0.0) + 1.0
+
+    # ---- render ----------------------------------------------------------
+    def render(self) -> str:
+        with self._lock:
+            gauges = dict(self._gauges)
+            gauges.update(self._totals)
+            gauges[self.prefix + "exported_step"] = \
+                float(self._exported_step)
+            gauges[self.prefix + "export_publishes_total"] = \
+                float(self._publishes)
+            gauges[self.prefix + "up"] = 1.0
+            labeled = dict(self._labeled)
+        return render_prometheus(gauges, labeled)
+
+    def health(self) -> dict:
+        with self._lock:
+            return {"status": "ok",
+                    "exported_step": self._exported_step,
+                    "publishes": self._publishes,
+                    "uptime_s": round(time.time() - self._started, 3)}

@@ -12,9 +12,12 @@ aggregate host-side and ride the session's next window flush as
 summarize`` next to the span tables.
 
 Producers call :func:`emit`; a :class:`~.session.Telemetry` session
-registers a :class:`CounterStats` sink.  With no session active,
-``emit`` is a list-truthiness no-op (the ``_tape`` discipline: library
-code never pays for telemetry that is off).
+registers a :class:`CounterStats` sink, and a live
+:class:`~apex_tpu.telemetry.export.MetricsServer` registers a second
+sink so ``/metrics`` gauges flip the instant a producer emits (beat
+cadence — e.g. ``fleet/hosts_dead`` — not a window later).  With no
+sink active, ``emit`` is a list-truthiness no-op (the ``_tape``
+discipline: library code never pays for telemetry that is off).
 """
 
 from __future__ import annotations

@@ -1,0 +1,98 @@
+"""Incident IDs: one correlation key for a whole causal chain.
+
+A multi-host incident (beacon gap -> agreement -> shrink -> restore ->
+replay) scatters its evidence across every subsystem's event records —
+and, on a real fleet, across N hosts' run dirs.  Before this module
+the only way to reconstruct "what happened at step 20" was to eyeball
+N timelines side by side.  An **incident ID** is minted exactly once,
+when the chain OPENS (a quarantine-or-worse anomaly, a step deadline,
+a peer death, or a mesh resize), and threaded through every resulting
+event record until the chain closes (``replay_complete`` after the
+rollback/resize replay catches up, or ``resolved`` after a quarantine
+incident's clean window) — so one key names the whole story and
+``python -m apex_tpu.telemetry timeline`` can group a fleet's merged
+records by it.
+
+Determinism across hosts is the design constraint: every surviving
+host must mint the SAME id for the same incident without talking to
+each other (the agreement round is what the incident is *about*).  The
+id is therefore a pure function of replicated facts:
+
+- ``ordinal`` — a monotonic count of incidents this log has opened.
+  The watchdog's detectors are deterministic functions of replicated
+  ring contents and the fleet's liveness verdicts are lockstep, so
+  every host opens the same incidents in the same order;
+- ``kind`` — the opening event's kind (``host_dead``, ``nan_streak``,
+  ``deadline``, ...);
+- the SUBJECT ``(host, incarnation)`` when the incident has one (the
+  dead or returning peer — the same peer on every survivor), omitted
+  for subject-less incidents (a replicated watchdog verdict, a step
+  deadline every survivor hits at once);
+- ``epoch`` — the fleet epoch at open time (0 without a fleet).
+
+``run_elastic`` shares ONE log between the watchdog and the fleet
+monitor so their ordinals interleave identically on every host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def mint(kind: str, ordinal: int, host: Optional[int] = None,
+         incarnation: Optional[int] = None, epoch: int = 0) -> str:
+    """Build an incident id from replicated facts (module docstring).
+
+    ``inc-<ordinal>-<kind>-h<host>.<incarnation>-e<epoch>`` with the
+    subject segment omitted when ``host`` is None (subject-less
+    incidents: replicated watchdog verdicts, step deadlines)."""
+    subject = ""
+    if host is not None:
+        subject = f"-h{int(host)}.{int(incarnation or 0)}"
+    return f"inc-{int(ordinal):03d}-{kind}{subject}-e{int(epoch)}"
+
+
+class IncidentLog:
+    """The open-incident register one recovery stack shares.
+
+    At most ONE incident is open at a time (a chain's follow-on events
+    — the shrink after the death, the replay after the restore — ride
+    the already-open id rather than minting their own; that is the
+    point).  ``open`` is idempotent while an incident is live;
+    ``close`` requires the id it is closing so two subsystems sharing
+    a log can never close each other's incident by accident.
+    """
+
+    def __init__(self):
+        self._ordinal = 0
+        self.current: Optional[str] = None
+        self.history: list = []        # every id ever minted, in order
+
+    def open(self, kind: str, host: Optional[int] = None,
+             incarnation: Optional[int] = None, epoch: int = 0) -> str:
+        """Mint a fresh id — or return the already-open one (a causal
+        chain keeps ONE key; the second subsystem to notice the same
+        incident joins it instead of forking it)."""
+        if self.current is None:
+            self._ordinal += 1
+            self.current = mint(kind, self._ordinal, host=host,
+                                incarnation=incarnation, epoch=epoch)
+            self.history.append(self.current)
+        return self.current
+
+    def close(self, incident_id: Optional[str]) -> bool:
+        """Close ``incident_id`` if it is the open one; a stale id (an
+        incident another subsystem already rolled forward past) is a
+        no-op so shared logs cannot cross-close."""
+        if incident_id is not None and incident_id == self.current:
+            self.current = None
+            return True
+        return False
+
+    def tag(self, record: dict) -> dict:
+        """Attach the open incident id to an event record (no-op when
+        nothing is open) — the one-line threading helper every event
+        queue calls."""
+        if self.current is not None:
+            record.setdefault("incident_id", self.current)
+        return record

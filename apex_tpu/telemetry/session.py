@@ -33,6 +33,7 @@ rank records into its local ring (cheap), only rank 0 ever writes.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -80,9 +81,16 @@ class Telemetry:
                  console: bool = False,
                  console_interval_s: float = 5.0,
                  rank0_only: bool = True,
-                 retrace: bool = True):
+                 retrace: bool = True,
+                 host: Optional[int] = None):
         self.ring = MetricRing(metrics, window=window)
         self.run_dir = run_dir
+        # host provenance for the JSONL header and the per-flush clock
+        # records: what lets `telemetry timeline` merge N run dirs and
+        # skew-correct their wall stamps.  Faked-fleet tests override
+        # it (every simulated host shares process_index 0)
+        self.host = jax.process_index() if host is None else int(host)
+        self.started_at = time.time()
         self._buf = self.ring.init()
         # donated: the ring updates in place, never two live copies
         self._commit = jax.jit(self.ring.record, donate_argnums=(0,))
@@ -99,7 +107,11 @@ class Telemetry:
                 os.makedirs(run_dir, exist_ok=True)
                 self._emitters = [
                     JsonlEmitter(os.path.join(run_dir, JSONL_NAME),
-                                 metrics=self.ring.metrics),
+                                 metrics=self.ring.metrics,
+                                 header_extra={
+                                     "host": self.host,
+                                     "started_at": round(
+                                         self.started_at, 3)}),
                     CsvEmitter(os.path.join(run_dir, CSV_NAME),
                                metrics=self.ring.metrics),
                 ]
@@ -215,6 +227,21 @@ class Telemetry:
         except ValueError:
             pass
 
+    def add_emitter(self, emitter: Emitter) -> None:
+        """Register an extra emitter mid-session (how the live
+        :class:`~apex_tpu.telemetry.export.MetricsServer` sees the
+        anomaly/fleet EVENT records that only exist on the emitter
+        side of the flush).  Like the built-ins it is fed at flush
+        time only and closed by :meth:`close`."""
+        self._emitters.append(emitter)
+
+    def remove_emitter(self, emitter: Emitter) -> None:
+        """Detach an emitter without closing it (the caller owns it)."""
+        try:
+            self._emitters.remove(emitter)
+        except ValueError:
+            pass
+
     def flush(self, upto_step: Optional[int] = None) -> List[dict]:
         """THE host sync: one ``device_get`` of the ring, decoded to
         records and handed to every emitter.  Returns the new step
@@ -239,7 +266,16 @@ class Telemetry:
                 events.extend(more)
         if not self._writer:
             return []
-        extras = self.spans.records(step=self._last_step)
+        # one clock sync point per flush: (step, wall_time) is what
+        # `telemetry timeline` aligns across hosts to estimate each
+        # host's clock offset (lockstep trainers hit the same step at
+        # the same true time, so the stamp difference IS the skew)
+        extras: List[dict] = []
+        if self._last_step >= 0:
+            extras.append({"kind": "clock", "host": self.host,
+                           "step": self._last_step,
+                           "wall_time": round(time.time(), 3)})
+        extras += self.spans.records(step=self._last_step)
         extras += self.counters.records(step=self._last_step)
         if self.retrace is not None:
             extras += self.retrace.records(step=self._last_step)
@@ -268,7 +304,10 @@ class Telemetry:
             return
         self._closed = True
         self.flush()
-        for e in self._emitters:
+        # snapshot: an emitter's close() may detach it (MetricsServer
+        # removes itself) — mutating the live list mid-iteration would
+        # silently skip the emitter registered after it
+        for e in list(self._emitters):
             e.close()
         remove_sink(self.spans.add)
         _hostmetrics.remove_sink(self.counters.add)

@@ -1,0 +1,339 @@
+"""Fleet-wide causal incident timeline: merge N hosts' run dirs into
+ONE ordered story, grouped by incident id.
+
+A multi-host incident (beacon gap -> agreement -> shrink -> restore ->
+replay) leaves one shard of evidence per surviving host's
+``telemetry.jsonl``.  Every event in the chain carries the SAME
+``incident_id`` (minted from replicated facts —
+:mod:`~apex_tpu.telemetry.incident`), so merging the dirs and grouping
+by that key reconstructs the whole causal chain in order:
+
+    python -m apex_tpu.telemetry timeline run/host0 run/host1 ...
+        [--json] [--chrome-trace out.json]
+
+Merging rules (stdlib only — runs on a login host with no jax):
+
+- **host tagging** — each record is stamped with its dir's host id
+  (the v2 schema header carries it; v1 dirs fall back to enumeration
+  order, so old run dirs keep rendering);
+- **clock skew correction** — each session flushes ``kind:"clock"``
+  records (step, wall_time).  Lockstep trainers hit the same step at
+  the same true time, so for each host the median difference of its
+  step-aligned stamps against the reference host's IS its clock
+  offset; every wall stamp ``t`` is corrected by it before ordering.
+  The stamps derive from the same host clocks the liveness beacons
+  publish, which is exactly the comparability the fleet monitor
+  already assumes (clocks comparable to within the slow/dead slack);
+- **step-record dedupe** — newest per ``(host, step)`` wins (a replay
+  re-records the steps it replays; the newest write is the surviving
+  timeline), shared with multi-dir ``summarize``;
+- **ordering** — events sort by step, then corrected wall time, then
+  host: the causal order a single operator console would have shown.
+
+``--chrome-trace`` exports the merged timeline as a Chrome trace
+(one process per host, one span per incident, one instant per event)
+so host-side incidents load into Perfetto NEXT TO the PR-8 device
+captures — step time collapse and the beacon gap that caused it on
+one screen.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# one loader/formatter surface: the low-level pieces live in cli.py
+# (stdlib-only like this module; cli imports timeline lazily, so no
+# cycle) — duplicating them here would let the two renderers drift
+from apex_tpu.telemetry.cli import (JSONL_NAME, _fmt_cell as _fmt,
+                                    _render_table, _resolve,
+                                    load_jsonl)
+
+# record kinds that are timeline EVENTS (everything else is steps /
+# cumulative gauges / clock sync points)
+EVENT_KINDS = ("anomaly", "watchdog", "fleet", "incident")
+_CLOSERS = ("replay_complete", "incident_resolved")
+
+
+def load_run_dir(path: str) -> Optional[dict]:
+    """One dir (or .jsonl) -> ``{"path", "host", "schema",
+    "records"}``; None when there is nothing to read.  ``host`` is the
+    v2 schema header's claim (None on v1 files — the merge assigns a
+    fallback)."""
+    resolved = _resolve(path)
+    if resolved is None:
+        return None
+    schema, records = load_jsonl(resolved)
+    host = None
+    if schema is not None and isinstance(schema.get("host"), int):
+        host = int(schema["host"])
+    return {"path": resolved, "host": host, "schema": schema,
+            "records": records}
+
+
+def _assign_hosts(runs: List[dict]) -> None:
+    """Every run gets a distinct host id: the header's claim when
+    unique, else the first free integer (v1 files, or two dirs from
+    the same faked host)."""
+    used = set()
+    for r in runs:
+        if r["host"] is not None and r["host"] not in used:
+            used.add(r["host"])
+        else:
+            r["host"] = None
+    free = 0
+    for r in runs:
+        if r["host"] is None:
+            while free in used:
+                free += 1
+            r["host"] = free
+            used.add(free)
+
+
+def _clock_points(records: Sequence[dict]) -> Dict[int, float]:
+    """step -> wall_time from the run's ``kind:"clock"`` sync records
+    (last wins per step)."""
+    out: Dict[int, float] = {}
+    for r in records:
+        if r.get("kind") == "clock":
+            try:
+                out[int(r["step"])] = float(r["wall_time"])
+            except (KeyError, TypeError, ValueError):
+                continue
+    return out
+
+
+def _median(values: Sequence[float]) -> float:
+    vals = sorted(values)
+    return vals[len(vals) // 2] if vals else 0.0
+
+
+def estimate_offsets(runs: List[dict]) -> Dict[int, float]:
+    """Per-host clock offset (seconds to SUBTRACT from that host's
+    wall stamps) against the lowest-host reference, from step-aligned
+    clock records.  Hosts with no common steps (or v1 files with no
+    clock records) get offset 0."""
+    clocks = {r["host"]: _clock_points(r["records"]) for r in runs}
+    hosts = sorted(clocks)
+    if not hosts:
+        return {}
+    ref = clocks[hosts[0]]
+    offsets = {hosts[0]: 0.0}
+    for h in hosts[1:]:
+        common = sorted(set(ref) & set(clocks[h]))
+        offsets[h] = _median([clocks[h][s] - ref[s] for s in common]) \
+            if common else 0.0
+    return offsets
+
+
+def _interp_wall(points: Dict[int, float], step: int
+                 ) -> Optional[float]:
+    """Piecewise-linear step -> wall estimate from a host's clock
+    points (for events without their own ``t`` stamp)."""
+    if not points:
+        return None
+    steps = sorted(points)
+    if step <= steps[0]:
+        return points[steps[0]]
+    if step >= steps[-1]:
+        return points[steps[-1]]
+    import bisect
+    i = bisect.bisect_left(steps, step)
+    s0, s1 = steps[i - 1], steps[i]
+    f = (step - s0) / (s1 - s0)
+    return points[s0] + f * (points[s1] - points[s0])
+
+
+def merge_run_dirs(paths: Sequence[str]) -> Optional[dict]:
+    """Merge N run dirs (module docstring): returns ``{"sources",
+    "hosts", "offsets", "records", "steps"}`` — ``records`` host-
+    tagged and ordered, ``steps`` deduped newest-per-(host, step) —
+    or None when NO dir resolved."""
+    runs = [r for r in (load_run_dir(p) for p in paths)
+            if r is not None]
+    if not runs:
+        return None
+    _assign_hosts(runs)
+    offsets = estimate_offsets(runs)
+    merged: List[dict] = []
+    steps_by_key: Dict[Tuple[int, int], dict] = {}
+    for run in runs:
+        host = run["host"]
+        off = offsets.get(host, 0.0)
+        clock = _clock_points(run["records"])
+        for idx, rec in enumerate(run["records"]):
+            rec = dict(rec)
+            rec["host"] = host
+            kind = rec.get("kind", "step")
+            if kind == "step":
+                # newest per (host, step): a replay re-records the
+                # steps it replays, the newest write survives
+                steps_by_key[(host, int(rec["step"]))] = rec
+                continue
+            if "t" in rec:
+                try:
+                    rec["t"] = round(float(rec["t"]) - off, 3)
+                except (TypeError, ValueError):
+                    rec.pop("t", None)
+            elif kind in EVENT_KINDS and "step" in rec:
+                est = _interp_wall(clock, int(rec["step"]))
+                if est is not None:
+                    rec["t"] = round(est - off, 3)
+            rec["_seq"] = idx            # stable within-host order
+            merged.append(rec)
+    steps = [steps_by_key[k] for k in sorted(steps_by_key,
+                                             key=lambda k: (k[1], k[0]))]
+    merged.sort(key=lambda r: (r.get("step", -1),
+                               r.get("t", float("inf")),
+                               r.get("host", 0), r.get("_seq", 0)))
+    for r in merged:
+        r.pop("_seq", None)
+    return {"sources": [r["path"] for r in runs],
+            "hosts": sorted(r["host"] for r in runs),
+            "offsets": {str(h): round(o, 3)
+                        for h, o in sorted(offsets.items())},
+            "records": merged, "steps": steps}
+
+
+def _event_label(rec: dict) -> str:
+    kind = rec.get("kind")
+    if kind == "anomaly":
+        return f"anomaly:{rec.get('anomaly', '?')}"
+    if kind == "watchdog":
+        return f"watchdog:{rec.get('action', '?')}"
+    if kind == "fleet":
+        return f"fleet:{rec.get('event', '?')}"
+    return f"{kind}:{rec.get('event', rec.get('action', '?'))}"
+
+
+def build(paths: Sequence[str]) -> Optional[dict]:
+    """The timeline document: the merge plus incident grouping.
+    ``incidents`` is ordered by first appearance; events carrying no
+    incident id land in ``ungrouped``."""
+    merged = merge_run_dirs(paths)
+    if merged is None:
+        return None
+    events = [r for r in merged["records"]
+              if r.get("kind") in EVENT_KINDS]
+    incidents: Dict[str, dict] = {}
+    ungrouped: List[dict] = []
+    for r in events:
+        iid = r.get("incident_id")
+        if iid is None:
+            ungrouped.append(r)
+            continue
+        inc = incidents.setdefault(iid, {
+            "incident_id": iid, "events": [], "hosts": set(),
+            "first_step": None, "last_step": None, "closed": False})
+        inc["events"].append(r)
+        inc["hosts"].add(r.get("host", 0))
+        s = r.get("step")
+        if isinstance(s, (int, float)):
+            s = int(s)
+            inc["first_step"] = s if inc["first_step"] is None \
+                else min(inc["first_step"], s)
+            inc["last_step"] = s if inc["last_step"] is None \
+                else max(inc["last_step"], s)
+        if r.get("event") in _CLOSERS or r.get("action") in _CLOSERS:
+            inc["closed"] = True
+    for inc in incidents.values():
+        inc["hosts"] = sorted(inc["hosts"])
+        inc["opened_by"] = _event_label(inc["events"][0])
+    return {"sources": merged["sources"], "hosts": merged["hosts"],
+            "offsets": merged["offsets"],
+            "n_steps": len(merged["steps"]),
+            "incidents": list(incidents.values()),
+            "ungrouped": ungrouped}
+
+
+# ---------------------------------------------------------------------
+# Renderers
+# ---------------------------------------------------------------------
+
+def _row(rec: dict) -> List[str]:
+    detail_keys = [k for k in sorted(rec)
+                   if k not in ("kind", "step", "host", "t",
+                                "incident_id", "event", "action",
+                                "anomaly", "evidence")]
+    detail = " ".join(f"{k}={_fmt(rec[k])}" for k in detail_keys)
+    ev = dict(rec.get("evidence") or {})
+    if ev:
+        detail += (" " if detail else "") + " ".join(
+            f"{k}={_fmt(v)}" for k, v in sorted(ev.items()))
+    return [_fmt(rec.get("step")), str(rec.get("host", "-")),
+            _event_label(rec), detail or "-"]
+
+
+def render_text(doc: dict, out) -> None:
+    print(f"fleet timeline: {len(doc['sources'])} run dir(s), hosts "
+          f"{doc['hosts']}, {doc['n_steps']} step records", file=out)
+    nontrivial = {h: o for h, o in doc["offsets"].items() if o}
+    if nontrivial:
+        print(f"clock offsets vs host {doc['hosts'][0]} (s): "
+              f"{nontrivial}", file=out)
+    if not doc["incidents"] and not doc["ungrouped"]:
+        print("no incidents, no events — a quiet run", file=out)
+        return
+    for inc in doc["incidents"]:
+        span = f"steps {inc['first_step']}..{inc['last_step']}"
+        state = "closed" if inc["closed"] else "OPEN"
+        print(f"\nincident {inc['incident_id']}  [{state}]  {span}  "
+              f"hosts {inc['hosts']}  opened by {inc['opened_by']}",
+              file=out)
+        _render_table(["step", "host", "event", "detail"],
+                      [_row(r) for r in inc["events"]], out)
+    if doc["ungrouped"]:
+        print("\nevents outside any incident:", file=out)
+        _render_table(["step", "host", "event", "detail"],
+                      [_row(r) for r in doc["ungrouped"]], out)
+
+
+def chrome_trace(doc: dict) -> dict:
+    """The merged timeline as a Chrome trace document (one process
+    per host, an ``X`` span per incident per host, an instant per
+    event) — loads in Perfetto/chrome://tracing next to the PR-8
+    device captures."""
+    stamps = [r["t"] for inc in doc["incidents"]
+              for r in inc["events"] if "t" in r]
+    stamps += [r["t"] for r in doc["ungrouped"] if "t" in r]
+    t0 = min(stamps) if stamps else 0.0
+
+    def ts(rec: dict) -> float:
+        # corrected wall time when known, else step-scaled (1 ms per
+        # step keeps relative order legible for t-less v1 events)
+        if "t" in rec:
+            return (rec["t"] - t0) * 1e6
+        return float(rec.get("step", 0)) * 1e3
+
+    events: List[dict] = []
+    for h in doc["hosts"]:
+        events.append({"name": "process_name", "ph": "M", "pid": h,
+                       "tid": 0, "args": {"name": f"host {h}"}})
+    for inc in doc["incidents"]:
+        per_host: Dict[int, List[dict]] = {}
+        for r in inc["events"]:
+            per_host.setdefault(r.get("host", 0), []).append(r)
+        for h, recs in sorted(per_host.items()):
+            tss = [ts(r) for r in recs]
+            events.append({
+                "name": inc["incident_id"], "ph": "X", "cat": "incident",
+                "pid": h, "tid": 0, "ts": min(tss),
+                "dur": max(max(tss) - min(tss), 1.0),
+                "args": {"opened_by": inc["opened_by"],
+                         "closed": inc["closed"],
+                         "hosts": inc["hosts"]}})
+        for r in inc["events"]:
+            events.append({
+                "name": _event_label(r), "ph": "i", "s": "t",
+                "cat": "incident", "pid": r.get("host", 0), "tid": 0,
+                "ts": ts(r),
+                "args": {k: v for k, v in r.items()
+                         if k not in ("kind", "host")}})
+    for r in doc["ungrouped"]:
+        events.append({
+            "name": _event_label(r), "ph": "i", "s": "t",
+            "cat": "event", "pid": r.get("host", 0), "tid": 0,
+            "ts": ts(r),
+            "args": {k: v for k, v in r.items()
+                     if k not in ("kind", "host")}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -7,7 +7,12 @@ skip), FusedLayerNorm — and optional run telemetry: pass
 ``--telemetry-dir DIR`` (or set APEX_TPU_TELEMETRY_DIR) to record
 loss / grad norm / loss scale / overflow into a device-side metric
 ring, flushed to ``DIR/telemetry.jsonl`` once per window and rendered
-afterwards by ``python -m apex_tpu.telemetry summarize DIR``.
+afterwards by ``python -m apex_tpu.telemetry summarize DIR``.  Add
+``--serve-metrics PORT`` for LIVE observability: a Prometheus-format
+``/metrics`` endpoint (plus ``/healthz``) republishing every window
+flush while the run is still going — scrape it mid-run and watch the
+fleet/watchdog gauges move; afterwards ``python -m apex_tpu.telemetry
+timeline DIR`` groups the run's recovery events by incident id.
 
 Elastic resilience (the acceptance flow a preemptible-fleet user
 copies): ``--checkpoint-dir DIR`` drives the loop through
@@ -81,6 +86,12 @@ def parse_args(argv=None):
                    default=os.environ.get("APEX_TPU_TELEMETRY_DIR")
                    or None,
                    help="record run telemetry under this directory")
+    p.add_argument("--serve-metrics", type=int, default=None,
+                   metavar="PORT",
+                   help="live observability: serve /metrics "
+                        "(Prometheus text) + /healthz on this port "
+                        "while training (0 = ephemeral; needs "
+                        "--telemetry-dir)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="rotating resilient checkpoints (run_elastic); "
                         "rerun with the same dir to resume")
@@ -140,6 +151,16 @@ def main(argv=None):
 
     tel = telemetry.Telemetry(args.telemetry_dir, window=16) \
         if args.telemetry_dir else None
+
+    metrics_srv = None
+    if args.serve_metrics is not None:
+        if tel is None:
+            raise SystemExit("--serve-metrics needs --telemetry-dir "
+                             "(the exporter republishes the telemetry "
+                             "session's window flushes)")
+        metrics_srv = telemetry.MetricsServer(telemetry=tel,
+                                              port=args.serve_metrics)
+        print(f"serving live metrics at {metrics_srv.url}/metrics")
 
     xk, yk = jax.random.split(jax.random.key(1))
     x = jax.random.normal(xk, (256, 64))
@@ -291,7 +312,9 @@ def main(argv=None):
         with telemetry.span("toy/final_eval"):
             final_loss = float(loss_fn(opt.params, x, y))
         print(f"final eval loss {final_loss:.4f}")
-        tel.close()
+        tel.close()                 # also stops the metrics server
+        if metrics_srv is not None:
+            metrics_srv.close()     # idempotent
         print(f"telemetry written to {args.telemetry_dir} — inspect "
               f"with: python -m apex_tpu.telemetry summarize "
               f"{args.telemetry_dir}")

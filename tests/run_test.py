@@ -54,7 +54,10 @@ SUITES = {
     "run_checkpoint": ["tests/test_native_checkpoint.py",
                        "tests/test_resilience.py",
                        "tests/test_fleet.py",
-                       "tests/test_fleet_grow.py"],
+                       "tests/test_fleet_grow.py",
+                       # incident-id correlation + the merged fleet
+                       # timeline (telemetry timeline CLI)
+                       "tests/test_incident_timeline.py"],
     "run_models": ["tests/test_models.py"],
     "run_examples": ["tests/test_examples_smoke.py"],
     "run_data": ["tests/test_data.py"],
@@ -71,8 +74,10 @@ SUITES = {
     # entry points + the findings-baseline diff gate (tools/check.sh)
     "run_lint_semantic": ["tests/test_lint_semantic.py"],
     # run-time training telemetry (metric ring, emitters, spans,
-    # retrace counter) + the pyprof nvtx/prof satellites
-    "run_telemetry": ["tests/test_telemetry.py"],
+    # retrace counter) + the pyprof nvtx/prof satellites + the live
+    # /metrics exporter
+    "run_telemetry": ["tests/test_telemetry.py",
+                      "tests/test_export.py"],
     # the performance observatory: trace parsing, attribution/overlap,
     # cost-model MFU, report CLI, and the perf regression gate
     "run_profiler": ["tests/test_profiler.py"],

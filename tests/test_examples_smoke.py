@@ -127,6 +127,102 @@ def test_train_toy_fleet_kill_one_host_shrinks_and_recovers(tmp_path,
     assert "fleet/hosts_dead" in out          # counters table rows
 
 
+def test_train_toy_live_metrics_scrape_and_incident_timeline(
+        tmp_path, capsys):
+    """The live-observability acceptance flow: train with
+    --serve-metrics while a background scraper polls /metrics.  The
+    fleet death + the injected NaN storm must FLIP the exported
+    gauges mid-run (fleet_hosts_dead / watchdog rollback totals go
+    0 -> >=1, monotone so the scraper cannot miss them), and
+    afterwards the whole beacon-gap -> agreement -> shrink -> replay
+    chain must share ONE incident_id — rendered by ``telemetry
+    timeline`` as a single closed incident."""
+    import json as _json
+    import socket
+    import threading
+    import urllib.request
+    import warnings as _warnings
+
+    ckpt = str(tmp_path / "ckpt")
+    tel = str(tmp_path / "telemetry")
+    with socket.socket() as s:                # pick a free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    samples, stop = [], threading.Event()
+
+    def scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1) as r:
+                    body = r.read().decode()
+                g = {}
+                for line in body.splitlines():
+                    if not line.startswith("#") and " " in line:
+                        n, v = line.rsplit(" ", 1)
+                        g[n] = float(v)
+                samples.append(g)
+            except OSError:
+                pass                          # server not up/gone yet
+            stop.wait(0.005)
+
+    t = threading.Thread(target=scrape, daemon=True)
+    t.start()
+    try:
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")  # the recoveries warn
+            _run("examples/simple/train_toy.py",
+                 ["--steps", "64", "--save-every", "6",
+                  "--checkpoint-dir", ckpt, "--telemetry-dir", tel,
+                  "--fleet", "--kill-host-at", "40",
+                  "--watchdog", "--inject-nan-at", "18",
+                  "--serve-metrics", str(port)])
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    out = capsys.readouterr().out
+    assert f"serving live metrics at http://127.0.0.1:{port}" in out
+    assert "shrank to healthy mesh" in out
+    assert "run self-healed" in out
+    assert len(samples) > 2                   # genuinely scraped live
+    # the gauges FLIPPED mid-run: an early scrape predates both
+    # incidents, a later one carries them (totals are monotone)
+    dead = [g.get("apex_tpu_fleet_hosts_dead_total", 0.0)
+            for g in samples]
+    assert dead[0] == 0.0 and max(dead) >= 1.0
+    last = samples[-1]
+    assert last.get("apex_tpu_fleet_mesh_shrinks_total", 0) >= 1
+    assert last.get("apex_tpu_watchdog_rollback_events_total", 0) >= 1
+    assert last.get("apex_tpu_anomaly_nan_streak_events_total", 0) >= 1
+    assert last.get("apex_tpu_exported_step", -1) > 0
+    # the shrink chain shares ONE incident_id end to end
+    recs = []
+    with open(tmp_path / "telemetry" / "telemetry.jsonl",
+              encoding="utf-8") as f:
+        for line in f:
+            recs.append(_json.loads(line))
+    by_ev = {}
+    for r in recs:
+        if r.get("kind") == "fleet" and "incident_id" in r:
+            by_ev.setdefault(r["event"], set()).add(r["incident_id"])
+    assert by_ev["host_dead"] == by_ev["shrink"] \
+        == by_ev["replay_complete"]
+    assert len(by_ev["shrink"]) == 1
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["timeline", tel, "--json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    shrink_incs = [i for i in doc["incidents"]
+                   if any(e.get("event") == "shrink"
+                          for e in i["events"])]
+    assert len(shrink_incs) == 1
+    inc = shrink_incs[0]
+    assert inc["closed"] and inc["opened_by"] == "fleet:host_dead"
+    evs = [e.get("event") or e.get("action") for e in inc["events"]]
+    assert "host_dead" in evs and "shrink" in evs \
+        and "replay_complete" in evs
+
+
 def test_train_toy_revive_host_admits_and_grows(tmp_path, capsys):
     """The elastic scale-UP acceptance flow, end to end: kill ->
     shrink -> return -> admit -> grow.  The killed peer comes back

@@ -1,0 +1,267 @@
+"""Live telemetry export: the MetricsServer scrape surface
+(telemetry/export.py) — /metrics Prometheus text, /healthz, the
+observer/sink/emitter intake paths, the zero-added-sync contract's
+runtime smoke, and the exporter-overhead bench harness."""
+
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import hostmetrics
+from apex_tpu.telemetry.export import (MetricsServer, metric_name,
+                                       render_prometheus)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def _gauges(body):
+    out = {}
+    for line in body.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, val = line.rsplit(" ", 1)
+        out[name] = float(val)
+    return out
+
+
+def test_metric_name_sanitization():
+    assert metric_name("loss") == "apex_tpu_loss"
+    assert metric_name("amp/grad_norm") == "apex_tpu_amp_grad_norm"
+    assert metric_name("fleet/hosts-dead") == "apex_tpu_fleet_hosts_dead"
+
+
+def test_render_prometheus_deterministic():
+    body = render_prometheus({"b": 2.0, "a": 1.0},
+                             {("c", (("k", "v"),)): 3.0})
+    assert body.splitlines() == [
+        "# TYPE a gauge", "a 1", "# TYPE b gauge", "b 2",
+        "# TYPE c gauge", 'c{k="v"} 3']
+
+
+def test_serves_metrics_and_healthz_and_404():
+    with telemetry.Telemetry(run_dir=None, window=4,
+                             retrace=False) as tel, \
+            MetricsServer(telemetry=tel, port=0) as srv:
+        for s in range(1, 4):
+            tel.record({"loss": jnp.float32(2.0 - 0.5 * s)}, s)
+        tel.flush()
+        status, body = _get(f"{srv.url}/metrics")
+        assert status == 200
+        g = _gauges(body)
+        # newest step's value wins (gauges), and the watermark gauge
+        # says how fresh the scrape is
+        assert g["apex_tpu_loss"] == 0.5
+        assert g["apex_tpu_exported_step"] == 3
+        assert g["apex_tpu_up"] == 1
+        status, body = _get(f"{srv.url}/healthz")
+        assert status == 200
+        h = json.loads(body)
+        assert h["status"] == "ok" and h["exported_step"] == 3
+        with pytest.raises(urllib.error.HTTPError):
+            _get(f"{srv.url}/nope")
+
+
+def test_hostmetrics_sink_flips_live_without_a_flush():
+    """The liveness gauges must flip the instant the producer emits
+    (beat cadence), NOT a window later: fleet/hosts_dead rides the
+    hostmetrics sink straight into the snapshot, and the monotone
+    _total lets a scraper that missed the flip still see it."""
+    with telemetry.Telemetry(run_dir=None, window=64,
+                             retrace=False) as tel, \
+            MetricsServer(telemetry=tel, port=0) as srv:
+        hostmetrics.emit("fleet/hosts_dead", 0)
+        g = _gauges(_get(f"{srv.url}/metrics")[1])
+        assert g["apex_tpu_fleet_hosts_dead"] == 0
+        assert g["apex_tpu_fleet_hosts_dead_total"] == 0
+        hostmetrics.emit("fleet/hosts_dead", 1)   # no flush happened
+        g = _gauges(_get(f"{srv.url}/metrics")[1])
+        assert g["apex_tpu_fleet_hosts_dead"] == 1
+        assert g["apex_tpu_fleet_hosts_dead_total"] == 1
+        hostmetrics.emit("fleet/hosts_dead", 0)   # shrink recovered
+        g = _gauges(_get(f"{srv.url}/metrics")[1])
+        assert g["apex_tpu_fleet_hosts_dead"] == 0
+        assert g["apex_tpu_fleet_hosts_dead_total"] == 1  # monotone
+
+
+def test_event_records_count_by_kind_and_incident_gauge():
+    """The emitter fan-out hands the exporter the EVENT records; it
+    counts them by kind and keeps the open-incident flag keyed by the
+    correlation id (1 while open, 0 once the chain closes)."""
+    with telemetry.Telemetry(run_dir=None, window=4,
+                             retrace=False) as tel, \
+            MetricsServer(telemetry=tel, port=0) as srv:
+        srv.emit([
+            {"kind": "anomaly", "anomaly": "nan_streak", "step": 5,
+             "incident_id": "inc-001-nan_streak-e0"},
+            {"kind": "watchdog", "action": "rollback", "step": 5,
+             "incident_id": "inc-001-nan_streak-e0"},
+            {"kind": "fleet", "event": "shrink", "step": 7},
+            {"kind": "fleet", "event": "autoscale", "action": "grow",
+             "step": 9},
+        ])
+        g = _gauges(_get(f"{srv.url}/metrics")[1])
+        assert g["apex_tpu_anomaly_nan_streak_events_total"] == 1
+        assert g["apex_tpu_watchdog_rollback_events_total"] == 1
+        assert g["apex_tpu_fleet_shrink_events_total"] == 1
+        assert g["apex_tpu_autoscale_grow_events_total"] == 1
+        body = _get(f"{srv.url}/metrics")[1]
+        assert ('apex_tpu_incident_open'
+                '{incident_id="inc-001-nan_streak-e0"} 1') in body
+        srv.emit([{"kind": "watchdog", "action": "replay_complete",
+                   "step": 9,
+                   "incident_id": "inc-001-nan_streak-e0"}])
+        body = _get(f"{srv.url}/metrics")[1]
+        assert ('apex_tpu_incident_open'
+                '{incident_id="inc-001-nan_streak-e0"} 0') in body
+
+
+def test_close_is_idempotent_and_detaches():
+    tel = telemetry.Telemetry(run_dir=None, window=4, retrace=False)
+    srv = MetricsServer(telemetry=tel, port=0)
+    url = srv.url
+    tel.record({"loss": jnp.float32(1.0)}, 1)
+    tel.flush()
+    assert _get(f"{url}/metrics")[0] == 200
+    srv.close()
+    srv.close()                              # idempotent
+    # detached: a later flush must not touch the dead server
+    tel.record({"loss": jnp.float32(2.0)}, 2)
+    tel.flush()
+    with pytest.raises(OSError):
+        _get(f"{url}/metrics")
+    tel.close()                              # emitter close: no raise
+
+
+def test_large_integer_gauges_render_exact():
+    """{:g} would truncate exported_step past 999999 (long pretrains
+    cross 1e6 steps routinely) — integral samples must print exact."""
+    from apex_tpu.telemetry.export import render_prometheus
+    body = render_prometheus({"apex_tpu_exported_step": 1234567.0,
+                              "apex_tpu_loss": 0.123456789012}, {})
+    assert "apex_tpu_exported_step 1234567" in body
+    assert "1.23457e" not in body
+    assert "apex_tpu_loss 0.123456789" in body
+
+
+def test_closed_incident_labels_are_pruned_bounded():
+    """Label cardinality stays bounded: the newest closed incident is
+    kept (a scraper must see the 1 -> 0 flip) but older closed ids are
+    pruned — a week of incidents must not grow a label series each."""
+    with telemetry.Telemetry(run_dir=None, window=4,
+                             retrace=False) as tel, \
+            MetricsServer(telemetry=tel, port=0) as srv:
+        for n in range(1, 4):
+            iid = f"inc-{n:03d}-host_dead-h2.{n}-e0"
+            srv.emit([{"kind": "fleet", "event": "host_dead",
+                       "step": n, "incident_id": iid}])
+            srv.emit([{"kind": "fleet", "event": "replay_complete",
+                       "step": n, "incident_id": iid}])
+        body = _get(f"{srv.url}/metrics")[1]
+        open_lines = [l for l in body.splitlines()
+                      if l.startswith("apex_tpu_incident_open{")]
+        assert open_lines == [
+            'apex_tpu_incident_open'
+            '{incident_id="inc-003-host_dead-h2.3-e0"} 0']
+
+
+def test_two_servers_on_one_session_both_close_with_it():
+    """Telemetry.close() iterates a snapshot: an emitter whose close
+    detaches it (the server) must not make the one registered after
+    it skip its own close."""
+    tel = telemetry.Telemetry(run_dir=None, window=4, retrace=False)
+    a = MetricsServer(telemetry=tel, port=0)
+    b = MetricsServer(telemetry=tel, port=0)
+    url_a, url_b = a.url, b.url
+    tel.close()
+    for url in (url_a, url_b):
+        with pytest.raises(OSError):
+            _get(f"{url}/metrics")
+    assert a._closed and b._closed
+
+
+def test_session_close_also_closes_attached_server():
+    tel = telemetry.Telemetry(run_dir=None, window=4, retrace=False)
+    srv = MetricsServer(telemetry=tel, port=0)
+    url = srv.url
+    tel.close()                 # emitter fan-out closes the server
+    with pytest.raises(OSError):
+        _get(f"{url}/metrics")
+
+
+def test_exported_instrumented_step_adds_no_device_sync():
+    """The runtime twin of the telemetry.exported_step apexverify
+    spec: an instrumented step with the exporter attached traces to
+    the SAME jaxpr as without it — the scrape surface reads flushed
+    host data only."""
+    import jax
+
+    def step(x):
+        telemetry.emit_metric("loss", x.sum())
+        return x * 2.0
+
+    tel = telemetry.Telemetry(run_dir=None, window=4, retrace=False)
+    x = jnp.ones((4,))
+    bare = jax.make_jaxpr(tel.instrument(step))(tel.buf,
+                                                jnp.int32(0), x)
+    srv = MetricsServer(telemetry=tel, port=0)
+    exported = jax.make_jaxpr(tel.instrument(step))(tel.buf,
+                                                    jnp.int32(0), x)
+    assert str(bare) == str(exported)
+    srv.close()
+    tel.close()
+
+
+def test_exported_step_spec_registered():
+    from apex_tpu.lint import semantic
+    names = [s.name for s in semantic.all_specs()]
+    assert "telemetry.exported_step" in names
+
+
+def test_exporter_overhead_bench_smoke():
+    from apex_tpu.telemetry.bench import bench_exporter_overhead
+    r = bench_exporter_overhead(layers=2, hidden=16, window=8,
+                                iters=2, reps=1)
+    assert r["exporter_on_ms"] > 0 and r["exporter_off_ms"] > 0
+    assert r["export_publish_ms"] >= 0
+    assert r["exporter_window"] == 8
+
+
+def test_controller_signal_source_feeds_queue_window():
+    """FleetController(signal_source=): an external load signal (a
+    serving admission queue, anything outside the ring schema) rides
+    the same hysteresis window as the queue metric — the PR-12
+    follow-up."""
+    from apex_tpu.resilience.fleet import FleetController
+    box = {"depth": 100.0}
+    ctrl = FleetController(queue_high=10.0, queue_low=1.0,
+                           signal_source=lambda: box["depth"],
+                           window=4, patience=2, cooldown_steps=0)
+    try:
+        d1 = ctrl.decide(1, n_hosts=2, candidates=1)
+        assert d1.action == "stay" and d1.reason == "patience"
+        d2 = ctrl.decide(2, n_hosts=2, candidates=1)
+        assert d2.action == "grow" and d2.reason == "queue_depth"
+        assert d2.signal == 100.0
+        # the source may return None (no sample) and may even raise —
+        # a broken gauge must never kill the supervisor loop
+        box["depth"] = None
+        ctrl.decide(3, n_hosts=2, candidates=1)
+
+        def boom():
+            raise RuntimeError("gauge down")
+        ctrl.signal_source = boom
+        ctrl.decide(4, n_hosts=2, candidates=1)
+    finally:
+        ctrl.close()
+
+
+def test_controller_still_requires_some_queue_carrier():
+    from apex_tpu.resilience.fleet import FleetController
+    with pytest.raises(ValueError, match="signal_source"):
+        FleetController(queue_high=10.0)

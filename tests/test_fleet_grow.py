@@ -305,7 +305,15 @@ def test_kill_shrink_return_admit_grow_replays_bit_exact(
     kinds = [f.kind for f in job.mon.timeline]
     assert "host_dead" in kinds and "host_return" in kinds
     events = [(e.get("event"), e.get("step")) for e in job.mon.events]
-    assert [ev for ev, _ in events] == ["shrink", "grow"]
+    # each resize's replay closes its incident: two causal chains,
+    # each ending in a replay_complete carrying the chain's id
+    assert [ev for ev, _ in events] == \
+        ["shrink", "replay_complete", "grow", "replay_complete"]
+    chains = [e.get("incident_id") for e in job.mon.events]
+    assert chains[0] == chains[1] and chains[2] == chains[3]
+    assert chains[0] != chains[2]             # two distinct incidents
+    assert chains[0].startswith("inc-") and "host_dead" in chains[0]
+    assert "host_return" in chains[2]
     grow = next(e for e in job.mon.events if e.get("event") == "grow")
     assert grow["admitted"] == [2] and grow["members"] == [0, 1, 2]
     assert grow["to_step"] is not None

@@ -1,0 +1,388 @@
+"""Causal incident correlation + the fleet-wide timeline: incident-id
+minting (telemetry/incident.py), its threading through the watchdog
+and fleet event records, the multi-run-dir merge front-end with
+beacon-clock skew correction (telemetry/timeline.py), the
+``telemetry timeline`` CLI (text / --json / --chrome-trace), and the
+v1-schema regression contract (old run dirs keep rendering)."""
+
+import io
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.resilience import fleet as fleet_mod
+from apex_tpu.resilience.watchdog import (NanStreakDetector, Watchdog)
+from apex_tpu.telemetry import timeline as timeline_mod
+from apex_tpu.telemetry.cli import summarize
+from apex_tpu.telemetry.cli import timeline as timeline_cli
+from apex_tpu.telemetry.incident import IncidentLog, mint
+
+_FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "timeline_fixtures")
+
+
+# ---------------------------------------------------------------------
+# IncidentLog
+# ---------------------------------------------------------------------
+
+def test_mint_is_a_pure_function_of_replicated_facts():
+    assert mint("host_dead", 1, host=2, incarnation=7, epoch=3) == \
+        "inc-001-host_dead-h2.7-e3"
+    # subject-less incidents (replicated watchdog verdicts, deadlines)
+    assert mint("nan_streak", 12) == "inc-012-nan_streak-e0"
+
+
+def test_incident_log_open_is_idempotent_until_closed():
+    log = IncidentLog()
+    a = log.open("host_dead", host=2, incarnation=1)
+    # the second subsystem to notice JOINS the chain, never forks it
+    assert log.open("nan_streak") == a
+    assert log.close("inc-999-bogus-e0") is False   # stale: no-op
+    assert log.current == a
+    assert log.close(a) is True and log.current is None
+    b = log.open("deadline", epoch=2)
+    assert b != a and b == "inc-002-deadline-e2"
+    assert log.history == [a, b]
+
+
+def test_incident_log_tag_threads_only_while_open():
+    log = IncidentLog()
+    rec = log.tag({"kind": "fleet", "event": "host_slow"})
+    assert "incident_id" not in rec
+    iid = log.open("host_dead", host=1, incarnation=1)
+    assert log.tag({"kind": "fleet"})["incident_id"] == iid
+
+
+# ---------------------------------------------------------------------
+# Watchdog threading
+# ---------------------------------------------------------------------
+
+def _overflow_window(lo, hi, bad=()):
+    return [{"step": s, "amp/found_inf": 1.0 if s in bad else 0.0}
+            for s in range(lo, hi)]
+
+
+def test_watchdog_anomaly_opens_incident_and_threads_records():
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  clean_window=4)
+    found = wd.observe(_overflow_window(1, 4, bad=(1, 2)))
+    assert len(found) == 1 and found[0].kind == "nan_streak"
+    iid = found[0].incident_id
+    assert iid is not None and iid.startswith("inc-001-nan_streak")
+    assert wd.incidents.current == iid
+    assert found[0].record()["incident_id"] == iid
+    # rollback + replay: the action events carry the id out, and the
+    # replay catching up closes the chain with one replay_complete
+    wd.note_rollback(0, 3, found[0])
+    wd.note_replay_complete(4)
+    actions = [(e["action"], e.get("incident_id")) for e in wd.events]
+    assert actions == [("rollback", iid), ("replay_complete", iid)]
+    assert wd.incidents.current is None
+    wd.close()
+
+
+def test_watchdog_quarantine_incident_resolves_after_clean_window():
+    from apex_tpu.resilience.watchdog import WatchdogPolicy
+    wd = Watchdog(detectors=[NanStreakDetector(streak=2)],
+                  policy=WatchdogPolicy(
+                      actions={"nan_streak": "quarantine"}),
+                  clean_window=3)
+    found = wd.observe(_overflow_window(1, 3, bad=(1, 2)))
+    iid = found[0].incident_id
+    assert wd.incidents.current == iid
+    # the verdict must be TAKEN before a clean window may resolve the
+    # incident (run_elastic's check() at the next step boundary) — an
+    # un-adjudicated anomaly holds the incident open
+    wd.observe(_overflow_window(3, 10))
+    assert wd.incidents.current == iid        # still pending a verdict
+    assert wd.check(10).action == "quarantine"
+    wd.note_quarantine(10, found[0])
+    wd.observe(_overflow_window(10, 16))      # clean window ages out
+    assert wd.incidents.current is None
+    resolved = [e for e in wd.events
+                if e["action"] == "incident_resolved"]
+    assert len(resolved) == 1 and resolved[0]["incident_id"] == iid
+    assert wd.events[0]["action"] == "quarantine" \
+        and wd.events[0]["incident_id"] == iid
+    wd.close()
+
+
+# ---------------------------------------------------------------------
+# Fleet threading: determinism across hosts
+# ---------------------------------------------------------------------
+
+def _lag_monitor(ch, host, n_hosts, tel=None):
+    return fleet_mod.FleetMonitor(
+        channel=ch, host=host, n_hosts=n_hosts,
+        slow_after_steps=2, dead_after_steps=4,
+        slow_after_s=None, dead_after_s=None,
+        agreement_timeout_s=0.1, telemetry=tel)
+
+
+def _drive_fleet_pair(d0, d1):
+    """Two REAL monitors (own sessions, own run dirs) on one channel;
+    host 2 beacons twice then goes silent -> both monitors detect the
+    death, agree, shrink, and complete the replay."""
+    ch = fleet_mod.LocalChannel()
+    tel0 = telemetry.Telemetry(d0, window=4, retrace=False, host=0)
+    tel1 = telemetry.Telemetry(d1, window=4, retrace=False, host=1)
+    m0 = _lag_monitor(ch, 0, 3, tel0)
+    m1 = _lag_monitor(ch, 1, 3, tel1)
+    # m0's agreement round needs host 1's verdict published while m0
+    # polls (single thread): the spin hook publishes m1's live view
+    m0.add_spin_hook(lambda epoch: ch.put(
+        f"verdict/{epoch}/1", {"host": 1, "epoch": epoch,
+                               "survivors": [0, 1]}))
+    for step in range(1, 9):
+        if step <= 2:
+            ch.put("beacon/2", {"host": 2, "step": step,
+                                "wall_time": time.time(),
+                                "incarnation": 1})
+        for host, (tel, mon) in enumerate(((tel0, m0), (tel1, m1))):
+            tel.record({"loss": jnp.float32(1.0 / step)}, step)
+            dead = [f for f in mon.beat(step)
+                    if f.kind == "host_dead"]
+            if dead:
+                epoch, survivors = mon.agree_survivors(
+                    step, timeout_s=0.2)
+                mon.note_shrink(step, epoch, survivors, [2],
+                                step - 1)
+                mon.note_replay_complete(step)
+    for tel, mon in ((tel0, m0), (tel1, m1)):
+        mon.close()
+        tel.close()
+    return m0, m1
+
+
+def test_surviving_hosts_mint_the_same_incident_id(tmp_path):
+    """THE correlation contract: every survivor stamps the SAME id
+    for the same peer death without any extra coordination — the id
+    is a pure function of replicated facts (dead peer's identity,
+    epoch, incident ordinal)."""
+    m0, m1 = _drive_fleet_pair(str(tmp_path / "h0"),
+                               str(tmp_path / "h1"))
+    assert m0.incidents.history == m1.incidents.history
+    assert len(m0.incidents.history) == 1
+    iid = m0.incidents.history[0]
+    assert iid.startswith("inc-001-host_dead-h2.1-e")
+    assert m0.incidents.current is None     # replay closed it
+    for mon in (m0, m1):
+        chain = [(e["event"], e.get("incident_id"))
+                 for e in mon.events]
+        assert chain == [("shrink", iid), ("replay_complete", iid)]
+
+
+def test_fleet_chain_renders_as_single_incident_across_run_dirs(
+        tmp_path, capsys):
+    """The acceptance flow: kill one host of a faked fleet -> the
+    beacon-gap/agreement/shrink/replay chain shares ONE incident_id
+    across the surviving hosts' run dirs, and ``telemetry timeline``
+    renders it as a single ordered incident — text, --json, and a
+    valid Chrome trace."""
+    d0, d1 = str(tmp_path / "h0"), str(tmp_path / "h1")
+    _drive_fleet_pair(d0, d1)
+    # text
+    buf = io.StringIO()
+    assert timeline_cli([d0, d1], out=buf) == 0
+    text = buf.getvalue()
+    assert text.count("incident inc-001-host_dead-h2.1-e") == 1
+    assert "[closed]" in text and "hosts [0, 1]" in text
+    for label in ("fleet:host_dead", "fleet:shrink",
+                  "fleet:replay_complete"):
+        assert label in text
+    # --json: one incident carrying the whole chain from BOTH hosts
+    buf = io.StringIO()
+    assert timeline_cli([d0, d1], as_json=True, out=buf) == 0
+    doc = json.loads(buf.getvalue())
+    assert len(doc["incidents"]) == 1
+    inc = doc["incidents"][0]
+    assert inc["hosts"] == [0, 1] and inc["closed"]
+    assert inc["opened_by"] == "fleet:host_dead"
+    kinds = [(r.get("event"), r["host"]) for r in inc["events"]]
+    for ev in ("host_dead", "shrink", "replay_complete"):
+        assert (ev, 0) in kinds and (ev, 1) in kinds
+    # events are ordered: the dead-detections precede the shrinks
+    # precede the replay-completes
+    order = [r.get("event") for r in inc["events"]]
+    assert order.index("host_dead") < order.index("shrink") \
+        < order.index("replay_complete")
+    # --chrome-trace: a valid trace document Perfetto can load
+    trace_path = str(tmp_path / "trace.json")
+    buf = io.StringIO()
+    assert timeline_cli([d0, d1], chrome_trace_path=trace_path,
+                        out=buf) == 0
+    with open(trace_path, encoding="utf-8") as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert "ph" in e and "pid" in e and "name" in e
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}   # one span per host
+    assert all(e["name"].startswith("inc-001-host_dead")
+               for e in spans)
+
+
+# ---------------------------------------------------------------------
+# Merge front-end: dedupe, skew, fixtures
+# ---------------------------------------------------------------------
+
+def _write_jsonl(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+def test_merge_dedupes_newest_per_host_and_step(tmp_path):
+    """The dedupe rule: a replay re-records the steps it replays —
+    the NEWEST record per (host, step) survives, while the same step
+    on ANOTHER host is a different row entirely."""
+    d0, d1 = str(tmp_path / "h0"), str(tmp_path / "h1")
+    _write_jsonl(os.path.join(d0, "telemetry.jsonl"), [
+        {"kind": "schema", "version": 2, "metrics": ["loss"],
+         "host": 0},
+        {"step": 5, "loss": 9.0},       # pre-rollback value
+        {"step": 6, "loss": 8.0},
+        {"step": 5, "loss": 1.0},       # the replay's re-record
+    ])
+    _write_jsonl(os.path.join(d1, "telemetry.jsonl"), [
+        {"kind": "schema", "version": 2, "metrics": ["loss"],
+         "host": 1},
+        {"step": 5, "loss": 2.0},
+    ])
+    merged = timeline_mod.merge_run_dirs([d0, d1])
+    steps = {(r["host"], r["step"]): r["loss"]
+             for r in merged["steps"]}
+    assert steps == {(0, 5): 1.0, (0, 6): 8.0, (1, 5): 2.0}
+    # the multi-dir summarize front-end applies the same rule
+    buf = io.StringIO()
+    assert summarize([d0, d1], as_json=True, out=buf) == 0
+    doc = json.loads(buf.getvalue())
+    assert doc["hosts"] == [0, 1]
+    got = {(r["host"], r["step"]): r["loss"] for r in doc["steps"]}
+    assert got == steps
+
+
+def test_offsets_estimated_from_step_aligned_clock_records():
+    """The checked-in two-host fixture has host 1's wall clock 120 s
+    ahead: the step-aligned clock records expose exactly that offset,
+    and the corrected stamps interleave the two hosts' events."""
+    merged = timeline_mod.merge_run_dirs(
+        [os.path.join(_FIXDIR, "host0"),
+         os.path.join(_FIXDIR, "host1")])
+    assert merged["offsets"] == {"0": 0.0, "1": 120.0}
+    dead = [r for r in merged["records"]
+            if r.get("event") == "host_dead"]
+    # corrected: host 1's 1127.1 stamp reads as 1007.1 — within a
+    # fraction of a second of host 0's 1007.0, not 120 s later
+    ts = {r["host"]: r["t"] for r in dead}
+    assert abs(ts[1] - ts[0]) < 1.0
+
+
+def test_checked_in_fixture_renders_one_closed_incident(capsys):
+    """tools/check.sh smoke's contract, pinned as a test: the fixture
+    renders one closed incident spanning both hosts, --json parses,
+    and the chrome trace is valid."""
+    dirs = [os.path.join(_FIXDIR, "host0"),
+            os.path.join(_FIXDIR, "host1")]
+    buf = io.StringIO()
+    assert timeline_cli(dirs, as_json=True, out=buf) == 0
+    doc = json.loads(buf.getvalue())
+    assert len(doc["incidents"]) == 1
+    inc = doc["incidents"][0]
+    assert inc["incident_id"] == "inc-001-host_dead-h2.1-e0"
+    assert inc["hosts"] == [0, 1] and inc["closed"]
+    buf = io.StringIO()
+    assert timeline_cli(dirs, chrome_trace_path="-", out=buf) == 0
+    trace = json.loads(buf.getvalue())
+    assert len(trace["traceEvents"]) > 0
+
+
+def test_timeline_missing_dirs_exit_1(tmp_path, capsys):
+    buf = io.StringIO()
+    assert timeline_cli([str(tmp_path / "nope")], out=buf) == 1
+    assert "no telemetry.jsonl" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------
+# v1 schema regression: old run dirs keep rendering
+# ---------------------------------------------------------------------
+
+_V1_RECORDS = [
+    {"kind": "schema", "version": 1, "metrics": ["loss"]},
+    {"step": 1, "loss": 2.0},
+    {"step": 2, "loss": 1.5},
+    # v1 fleet event: no incident_id, no t, no host anywhere
+    {"kind": "fleet", "event": "host_dead", "host": 2, "step": 2,
+     "peer_step": 1, "gap_s": 4.0, "lag_steps": 1},
+    {"kind": "fleet", "event": "shrink", "step": 2, "epoch": 1,
+     "survivors": [0, 1], "dead": [2], "reason": "failure",
+     "to_step": 1},
+    {"kind": "counter", "name": "fleet/mesh_shrinks", "count": 1,
+     "total": 1.0, "max": 1.0, "last": 1.0, "step": 2},
+]
+
+
+def test_v1_run_dir_still_summarizes(tmp_path):
+    d = str(tmp_path / "v1run")
+    _write_jsonl(os.path.join(d, "telemetry.jsonl"), _V1_RECORDS)
+    buf = io.StringIO()
+    assert summarize(d, out=buf) == 0
+    out = buf.getvalue()
+    assert "host_dead" in out and "shrink" in out
+    buf = io.StringIO()
+    assert summarize(d, as_json=True, out=buf) == 0
+    json.loads(buf.getvalue())
+
+
+def test_v1_run_dirs_still_merge_into_a_timeline(tmp_path):
+    """A v1 dir has no host header, no clock records and no incident
+    ids: the merge assigns fallback hosts, skips skew correction and
+    lists the events ungrouped — it must never crash or drop them."""
+    d0, d1 = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_jsonl(os.path.join(d0, "telemetry.jsonl"), _V1_RECORDS)
+    _write_jsonl(os.path.join(d1, "telemetry.jsonl"), _V1_RECORDS)
+    buf = io.StringIO()
+    assert timeline_cli([d0, d1], as_json=True, out=buf) == 0
+    doc = json.loads(buf.getvalue())
+    assert doc["hosts"] == [0, 1]           # fallback enumeration
+    assert doc["incidents"] == []
+    labels = {(_r["host"], _r.get("event"))
+              for _r in doc["ungrouped"]}
+    assert (0, "host_dead") in labels and (1, "shrink") in labels
+    # text + chrome trace stay renderable without any wall stamps
+    buf = io.StringIO()
+    assert timeline_cli([d0, d1], out=buf) == 0
+    assert "events outside any incident" in buf.getvalue()
+    buf = io.StringIO()
+    assert timeline_cli([d0, d1], chrome_trace_path="-",
+                        out=buf) == 0
+    json.loads(buf.getvalue())
+
+
+def test_mixed_v1_and_v2_dirs_merge(tmp_path):
+    """A fleet mid-upgrade: one host still writes v1, another v2 —
+    the merge keeps the v2 host's claimed id and gives the v1 dir a
+    free one."""
+    d0, d1 = str(tmp_path / "old"), str(tmp_path / "new")
+    _write_jsonl(os.path.join(d0, "telemetry.jsonl"), _V1_RECORDS)
+    _write_jsonl(os.path.join(d1, "telemetry.jsonl"), [
+        {"kind": "schema", "version": 2, "metrics": ["loss"],
+         "host": 0},
+        {"step": 1, "loss": 2.0},
+        {"kind": "fleet", "event": "host_dead", "host": 2, "step": 2,
+         "peer_step": 1, "gap_s": 4.0, "lag_steps": 1, "t": 1002.0,
+         "incident_id": "inc-001-host_dead-h2.1-e0"},
+    ])
+    merged = timeline_mod.merge_run_dirs([d0, d1])
+    assert merged["hosts"] == [0, 1]
+    hosts_with_incident = {r["host"] for r in merged["records"]
+                           if r.get("incident_id")}
+    assert hosts_with_incident == {0}       # the v2 dir claimed host 0

@@ -548,6 +548,32 @@ def test_gate_no_data_metric(tmp_path):
     assert verdicts[0]["status"] == "no-data"
 
 
+def test_gate_empty_trajectory_grades_no_rounds(tmp_path, capsys):
+    """An EMPTY BENCH trajectory is its own explicit verdict: one
+    ``no-rounds`` line with the reason, exit 0 in auto/report mode —
+    never the generic metric-by-metric cannot-compare chorus.  A
+    forced --gate exits 1 (nothing on record can defend a budget)."""
+    budget = tmp_path / "budget.json"
+    budget.write_text(json.dumps(
+        _budget({"value": {"floor": 2000.0, "noise_pct": 5.0}})))
+    assert perf_gate.main(["--budget", str(budget),
+                           "--root", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "no-rounds" in out and "empty" in out
+    assert "no hardware round reports" not in out   # not the chorus
+    assert out.count("\n") == 1                     # one line, done
+    assert perf_gate.main(["--budget", str(budget),
+                           "--root", str(tmp_path), "--report"]) == 0
+    capsys.readouterr()
+    assert perf_gate.main(["--budget", str(budget),
+                           "--root", str(tmp_path), "--gate"]) == 1
+    capsys.readouterr()
+    assert perf_gate.main(["--budget", str(budget),
+                           "--root", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "no-rounds" and doc["verdicts"] == []
+
+
 def test_gate_main_exit_codes_and_report_mode(tmp_path, capsys):
     budget = tmp_path / "budget.json"
     budget.write_text(json.dumps(

@@ -1737,7 +1737,13 @@ def test_single_loss_spike_is_quarantined_not_rolled_back(
         assert inj.fired
     assert res.step == _WD_TOTAL and res.rollbacks == 0
     assert job.quarantined == ["loss_spike"]
-    assert [e["action"] for e in job.wd.events] == ["quarantine"]
+    # the quarantine opens an incident; surviving its clean window
+    # closes it with an incident_resolved event sharing the same id
+    assert [e["action"] for e in job.wd.events] == \
+        ["quarantine", "incident_resolved"]
+    iids = {e.get("incident_id") for e in job.wd.events}
+    assert len(iids) == 1 and iids == {job.wd.incidents.history[0]}
+    assert job.wd.incidents.current is None   # closed
     # re-anchor happened: scale back at the configured operating point
     # at quarantine time (and grows normally afterwards)
     assert float(job.scaler.loss_scale()) >= 2.0 ** 2

@@ -1,16 +1,17 @@
 #!/usr/bin/env bash
 # The one-command correctness gate: AST tier (incl. APX204
-# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 21
+# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 22
 # specs) + baseline diff over the package, then the relaxed profile
 # over tests/, examples/ and tools/ (APX101/102 exempt inside test
 # bodies — a test syncing to assert a device value is the point of the
 # test).  The semantic tier includes the watchdog.instrumented_step,
-# fleet.instrumented_step and fleet.autoscaled_step specs (a
-# watchdog-attached / fleet-monitored / autoscale-controlled flat-AMP
-# step must contain zero transfer/callback primitives) and the
-# amp.fp8_step spec (EXACT fp8 quantize-convert counts — precision
-# casts cannot silently multiply — with the packed fp8 scale state
-# donated/aliased like every other optimizer slot).
+# fleet.instrumented_step, fleet.autoscaled_step and
+# telemetry.exported_step specs (a watchdog-attached / fleet-monitored
+# / autoscale-controlled / live-exported flat-AMP step must contain
+# zero transfer/callback primitives) and the amp.fp8_step spec (EXACT
+# fp8 quantize-convert counts — precision casts cannot silently
+# multiply — with the packed fp8 scale state donated/aliased like
+# every other optimizer slot).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #
@@ -32,6 +33,14 @@ echo "== dispatch prefs: schema-validate shipped dispatch_prefs*.json"
 # import (the ops/_dispatch.py tolerance would fall back to design
 # defaults with only a RuntimeWarning); stdlib-only, milliseconds
 python tools/autotune.py --validate
+
+echo "== telemetry timeline: two-host fixture smoke"
+# the merged fleet timeline must keep rendering the checked-in
+# two-host incident fixture (one incident id across both dirs, valid
+# --json); stdlib-only, milliseconds
+python -m apex_tpu.telemetry timeline \
+    tests/timeline_fixtures/host0 tests/timeline_fixtures/host1 \
+    --json > /dev/null
 
 echo "== perf_gate: BENCH trajectory vs tools/perf_budget.json"
 # auto mode: gates exactly when the newest BENCH round is a hardware

@@ -544,6 +544,29 @@ def main():
         "speedup": (round(rp["profiler_off_ms"] / rp["profiler_on_ms"],
                           2) if rp["profiler_on_ms"] else None)})
 
+    # exporter overhead: the same instrumented step with the live
+    # MetricsServer attached vs the bare step ("kernel" = exported,
+    # "oracle" = bare — ~1.0 IS the pass condition: /metrics
+    # republishes already-flushed host data only; the flush-time
+    # republish cost shows up separately as export_publish_ms.  The
+    # telemetry.exported_step apexverify spec proves the same fact
+    # structurally)
+    from apex_tpu.telemetry.bench import bench_exporter_overhead
+    rex = bench_exporter_overhead()
+    rex["backend"] = backend
+    print(json.dumps(rex), flush=True)
+    rows.append({
+        "kernel": "exporter_overhead",
+        "shape": (f"{rex['exporter_leaves']}leaves/"
+                  f"w{rex['exporter_window']}x"
+                  f"{rex['exporter_metrics']}"),
+        "dtype": "f32",
+        "kernel_ms": rex["exporter_on_ms"],
+        "oracle_ms": rex["exporter_off_ms"],
+        "speedup": (round(rex["exporter_off_ms"]
+                          / rex["exporter_on_ms"], 2)
+                    if rex["exporter_on_ms"] else None)})
+
     # watchdog overhead: the same instrumented step with the anomaly
     # watchdog attached vs the bare step ("kernel" = watchdog-attached,
     # "oracle" = bare — ~1.0 IS the pass condition: detectors are

@@ -37,6 +37,11 @@ positive value): the CPU-fallback liveness lines prove the harness,
 not performance, and a cached round re-served across windows compares
 equal to itself (no false regression while the tunnel is down).
 
+An **empty trajectory** (no ``BENCH_r*.json`` with a parsed bench
+line at all) grades ``no-rounds`` explicitly: one line saying there is
+nothing to grade, exit 0 in auto/report mode (a forced ``--gate``
+exits 1 — an empty record cannot defend a budget).
+
 **Gating is automatic**: with neither ``--report`` nor ``--gate``, the
 gate flips on exactly when the newest BENCH round is a hardware round
 measured AFTER the budget's ``stamped_at`` date — fresh hardware
@@ -245,6 +250,24 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rounds = load_rounds(args.root)
+    if not rounds:
+        # an EMPTY trajectory is its own explicit verdict, not an
+        # N-way "no hardware round reports this metric" chorus: there
+        # is literally nothing to grade, say so in one line and exit
+        # clean (auto/report — a forced --gate still refuses to pass
+        # silently, there is nothing defending the budget)
+        reason = ("no-rounds: BENCH trajectory is empty (no "
+                  "BENCH_r*.json with a parsed bench line under "
+                  f"{args.root}) — nothing to grade; run bench.py on "
+                  "hardware to start the trajectory")
+        if args.json:
+            print(json.dumps({"verdicts": [], "hardware_rounds": [],
+                              "regressions": 0, "gating": args.gate,
+                              "status": "no-rounds",
+                              "mode_reason": reason}))
+        else:
+            print(f"perf_gate: {reason}")
+        return 1 if args.gate else 0
     if args.report:
         gating, reason = False, "report-only: forced by --report"
     elif args.gate:
