@@ -25,7 +25,11 @@ inputs and pins the structural facts earlier PRs proved ad hoc:
   step with a resilience Watchdog attached (detectors are host-side,
   window-cadence only: self-healing adds no per-step syncs);
 * ``all_reduce_flat_buffers`` under shard_map — exactly one psum per
-  bucket, every collective bound to the declared axis, none dead.
+  bucket, every collective bound to the declared axis, none dead;
+* the serving engine's AOT programs — the decode window free of
+  host traffic with the arena + slot-state donation pinned as exact
+  lowered-HLO alias counts, and the per-bucket prefill running one
+  flash ``pallas_call`` per decoder layer into the donated arena.
 
 Expected Pallas counts adapt to the dispatch gate
 (``ops._dispatch.op_enabled``): when the multi_tensor family is
@@ -639,6 +643,86 @@ def _build_profiler_annotated_step():
             "no_orphan_collectives": True,
         },
     }
+
+
+def _serving_fixture():
+    """Tiny serving geometry shared by the two serving specs."""
+    import jax
+    from apex_tpu import serving
+    cfg = serving.DecoderConfig(vocab_size=32, hidden=8, n_layers=2,
+                                n_heads=2, n_kv_heads=2, ffn=16,
+                                max_seq=16, eos_token=1)
+    params = serving.init_params(jax.random.key(3), cfg)
+    spec = serving.ArenaSpec(n_layers=cfg.n_layers,
+                             n_kv_heads=cfg.n_kv_heads,
+                             head_dim=cfg.head_dim, page_size=4,
+                             n_pages=8, max_slots=2, pages_per_slot=4)
+    return cfg, params, spec, serving.KVArena(spec)
+
+
+@register_spec(
+    "serving.decode_step",
+    anchor="apex_tpu/serving/steps.py",
+    description="AOT decode window: a continuously-batched greedy "
+                "decode step over the paged KV arena lowers with ZERO "
+                "transfer/callback primitives (admission/eviction "
+                "state rides device-side slots, read once per flush "
+                "window) and the arena + slot-state donation is "
+                "pinned as tf.aliasing_output in the lowered HLO — "
+                "exactly every carry buffer the step UPDATES (the "
+                "two pass-through leaves, page_table and active, are "
+                "host-written at admission events only)")
+def _build_serving_decode_step():
+    import jax
+    from apex_tpu import serving
+    cfg, params, spec, arena = _serving_fixture()
+    state = serving.init_state(arena, window=2)
+    fn = serving.decode_window_fn(cfg, spec, window=2)
+    # k, v, seq_lens, last_token, budget, out_tokens, n_out, done
+    # update in the window; page_table and active pass through
+    updated = len(jax.tree_util.tree_leaves(state)) - 2
+    return {
+        "fn": fn, "args": (params, state),
+        "jit_kwargs": {"donate_argnums": (1,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "donated_aliases": updated,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "serving.prefill_step",
+    anchor="apex_tpu/serving/steps.py",
+    description="AOT per-bucket prefill: one flash-attention "
+                "pallas_call per decoder layer over the padded "
+                "prompt, K/V pages scattered into the DONATED arena "
+                "(both arena buffers aliased in the lowered HLO), "
+                "zero host traffic")
+def _build_serving_prefill_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import serving
+    from apex_tpu.ops._dispatch import op_enabled
+    cfg, params, spec, arena = _serving_fixture()
+    bucket = 8
+    fn = serving.prefill_fn(cfg, spec, bucket)
+    args = (params, arena.k, arena.v,
+            jnp.zeros((bucket // spec.page_size,), jnp.int32),
+            jnp.zeros((bucket,), jnp.int32), jnp.int32(5))
+    expect = {
+        "no_host_transfer": True,
+        "no_f64": True,
+        "donated_aliases": 2,       # the K and V arenas, nothing else
+        "no_orphan_collectives": True,
+    }
+    if op_enabled("attention_f32"):   # dispatch-gate aware, like optim
+        expect["pallas_calls"] = cfg.n_layers
+    return {"fn": fn, "args": args,
+            "jit_kwargs": {"donate_argnums": (1, 2)},
+            "expect": expect}
 
 
 @register_spec(

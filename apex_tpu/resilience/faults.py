@@ -63,6 +63,33 @@ kind                 what happens
                      watchdog has an OPEN incident — the admission
                      must be refused (``admission_refused`` timeline
                      event) until the incident closes
+``hung_decode``      advisory (serving): the engine's next decode
+                     dispatch stalls ``delay_s`` seconds inside the
+                     deadline-armed thunk's prologue — the wedged
+                     compile/dispatch shape that converts into a typed
+                     ``DecodeDeadlineExceeded`` and evicts only the
+                     suspect requests, never the process
+``slow_request``     advisory (serving): the targeted in-flight
+                     request (slot ``target``, default the lowest
+                     active slot) is treated as past its per-request
+                     deadline — evicted with the typed
+                     ``deadline_exceeded`` verdict, everyone else
+                     untouched
+``replica_death``    advisory (serving): the targeted peer REPLICA
+                     stops beaconing — detected by the fleet monitor,
+                     opens an incident, and the surviving replica
+                     re-admits the dead peer's published queue under
+                     that incident id
+``queue_storm``      advisory (serving): a burst of synthetic requests
+                     floods the engine's admission queue each window
+                     the budget covers — the bounded queue must shed
+                     with typed ``backpressure``/``queue_full``
+                     verdicts under watermark hysteresis, zero
+                     requests dropped without a verdict
+``oom_admission``    advisory (serving): one synthetic request whose
+                     prompt + budget exceeds a slot's page capacity —
+                     admission must shed it immediately with the typed
+                     ``oom_admission`` reason (queueing cannot help)
 ===================  ======================================================
 
 The injector subclasses :class:`apex_tpu.checkpoint.CheckpointIO` and
@@ -147,6 +174,16 @@ def fleet_fault(step: int) -> Optional[FaultSpec]:
     return None
 
 
+def serving_fault(step: int) -> Optional[FaultSpec]:
+    """The serving fault the decode engine should apply at serve
+    window ``step``, if any (a no-op None unless a FaultInjector is
+    installed).  Consumes one unit of the fault's ``n_steps`` budget
+    per call — the engine asks exactly once per window."""
+    if _ACTIVE is not None:
+        return _ACTIVE.serving_fault(step)
+    return None
+
+
 class FaultInjector(_ckpt.CheckpointIO):
     """Checkpoint-IO implementation that injects the scheduled faults.
 
@@ -159,19 +196,27 @@ class FaultInjector(_ckpt.CheckpointIO):
              "crash_before_publish", "disk_full",
              "nan_grads", "loss_spike", "scale_collapse", "straggler",
              "peer_death", "peer_hang", "slow_network",
-             "host_return", "flapping_host", "grow_during_incident")
+             "host_return", "flapping_host", "grow_during_incident",
+             "hung_decode", "slow_request", "replica_death",
+             "queue_storm", "oom_admission")
     # step-keyed kinds delivered through notify_step/training_fault
     STEP_KINDS = ("preempt", "nan_grads", "loss_spike",
                   "scale_collapse", "straggler",
                   "peer_death", "peer_hang", "slow_network",
                   "host_return", "flapping_host",
-                  "grow_during_incident")
+                  "grow_during_incident",
+                  "hung_decode", "slow_request", "replica_death",
+                  "queue_storm", "oom_admission")
     # advisory kinds the TRAINING LOOP applies (training_fault)
     TRAINING_KINDS = ("nan_grads", "loss_spike", "scale_collapse")
     # advisory kinds the FLEET beacon simulation applies (fleet_fault)
     FLEET_KINDS = ("peer_death", "peer_hang", "slow_network",
                    "host_return", "flapping_host",
                    "grow_during_incident")
+    # advisory kinds the SERVING engine applies (serving_fault) —
+    # at_step is the serve-loop WINDOW ordinal, not a training step
+    SERVING_KINDS = ("hung_decode", "slow_request", "replica_death",
+                     "queue_storm", "oom_admission")
 
     def __init__(self, faults: Sequence[FaultSpec]):
         for f in faults:
@@ -307,6 +352,11 @@ class FaultInjector(_ckpt.CheckpointIO):
         """The advisory fleet fault the beacon simulation should apply
         at ``step`` (one budget unit consumed per call)."""
         return self._draw_step_fault(step, self.FLEET_KINDS)
+
+    def serving_fault(self, step: int) -> Optional[FaultSpec]:
+        """The advisory serving fault the decode engine should apply
+        at window ``step`` (one budget unit consumed per call)."""
+        return self._draw_step_fault(step, self.SERVING_KINDS)
 
     # ---- CheckpointIO overrides -----------------------------------------
     def open(self, path: str, mode: str = "wb"):

@@ -645,6 +645,12 @@ class FleetMonitor:
     def dead_hosts(self) -> List[int]:
         return [h for h in self.hosts if self.status(h) == HOST_DEAD]
 
+    def peer_incarnation(self, host: int) -> int:
+        """A peer's last observed beacon incarnation (0 before any
+        beacon) — the public key for exactly-once-per-life claims
+        (e.g. ``serving.ReplicaSet.claim_dead_queue``)."""
+        return int(self._peer_incarnation.get(host, 0))
+
     # ---- agreement -------------------------------------------------------
     def _agreement_round(self, epoch: int, proposal: Sequence[int],
                          timeout_s: Optional[float]) -> Set[int]:

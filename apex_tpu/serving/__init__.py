@@ -1,0 +1,26 @@
+"""apex_tpu.serving — AOT-compiled, continuously-batched decode with
+request-level robustness (docs/serving.md).
+
+The serving fault domain is the REQUEST: a hung decode evicts its
+suspects (typed :class:`DecodeDeadlineExceeded`) and the survivors
+continue from their KV pages; overload sheds with a typed verdict
+under watermark hysteresis; SIGTERM drains; a replica death re-admits
+its queue on survivors under one shared incident id.  Everything
+reuses the training resilience/telemetry substrate — deadline
+runners, fleet beacons, incident logs, hostmetrics, ``/metrics``.
+"""
+
+from apex_tpu.serving.admission import (AdmissionController,  # noqa: F401
+                                        AdmissionVerdict, COMPLETED,
+                                        DRAINED, EVICTED, FAILED, SHED)
+from apex_tpu.serving.arena import ArenaSpec, KVArena  # noqa: F401
+from apex_tpu.serving.engine import (DecodeDeadlineExceeded,  # noqa: F401
+                                     Engine, Request, RequestResult)
+from apex_tpu.serving.model import (DecoderConfig,  # noqa: F401
+                                    decode_forward, init_params,
+                                    prefill_forward)
+from apex_tpu.serving.replica import ReplicaSet  # noqa: F401
+from apex_tpu.serving.steps import (DecodeState,  # noqa: F401
+                                    ServingPrograms, cached_programs,
+                                    decode_one, decode_window_fn,
+                                    init_state, prefill_fn)

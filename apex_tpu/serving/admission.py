@@ -1,0 +1,120 @@
+"""Admission control: bounded queues, typed verdicts, watermark
+hysteresis.
+
+Every request the engine ever sees ends in exactly ONE typed verdict —
+the zero-dropped-without-a-verdict contract the serving chaos matrix
+asserts.  Admission itself is a three-way decision:
+
+``admit``
+    a slot and enough pages are free, the engine is not draining, and
+    the shed latch is open — the request prefills now.
+``queue``
+    capacity is busy but the request FITS the arena and the bounded
+    queue has room — it waits (FIFO) for a slot.
+``shed``
+    typed load-shedding: the queue is over its high watermark (and
+    stays shed until depth falls back under the LOW watermark — the
+    same hysteresis discipline as
+    :class:`~apex_tpu.resilience.fleet.FleetController`, so a queue
+    hovering at the boundary cannot flap admit/shed per request), the
+    queue is simply full, the engine is draining, or the request can
+    NEVER fit (``oom_admission``: prompt + budget exceeds a slot's
+    page capacity — queueing cannot help, reject it now with the
+    reason attached).
+
+Terminal request verdicts (the engine assigns these; admission only
+produces ``shed``):
+
+====================  ==================================================
+``completed``          generation finished (EOS or token budget)
+``shed``               typed load-shed at admission (reason attached)
+``evicted``            removed mid-flight (``hung_decode`` suspect or
+                       per-request ``deadline_exceeded``)
+``drained``            returned un-served at SIGTERM drain (the client
+                       retries elsewhere; nothing silently vanishes)
+``failed``             decode raised a non-deadline error
+====================  ==================================================
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+# terminal verdicts
+COMPLETED = "completed"
+SHED = "shed"
+EVICTED = "evicted"
+DRAINED = "drained"
+FAILED = "failed"
+
+# shed reasons
+REASON_QUEUE_FULL = "queue_full"
+REASON_BACKPRESSURE = "backpressure"    # hysteresis latch closed
+REASON_OOM = "oom_admission"
+REASON_DRAINING = "draining"
+
+# eviction reasons
+REASON_HUNG_DECODE = "hung_decode"
+REASON_DEADLINE = "deadline_exceeded"
+
+
+class AdmissionVerdict(NamedTuple):
+    action: str                  # "admit" | "queue" | "shed"
+    reason: str = ""
+
+
+class AdmissionController:
+    """The bounded-queue policy (module docstring).
+
+    ``queue_high`` / ``queue_low``: the shed watermarks.  Depth at or
+    above ``queue_high`` closes the latch (every new request sheds
+    with ``backpressure``); the latch re-opens only once depth falls
+    to ``queue_low`` or below."""
+
+    def __init__(self, max_queue: int = 64,
+                 queue_high: Optional[int] = None,
+                 queue_low: Optional[int] = None):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if (queue_high is None) != (queue_low is None):
+            raise ValueError("set both shed watermarks or neither")
+        if queue_high is not None and not \
+                (0 <= queue_low < queue_high <= max_queue):
+            raise ValueError(
+                f"need 0 <= queue_low < queue_high <= max_queue, got "
+                f"low={queue_low} high={queue_high} max={max_queue}")
+        self.max_queue = int(max_queue)
+        self.queue_high = queue_high
+        self.queue_low = queue_low
+        self.shedding = False        # the hysteresis latch
+        self.shed_count = 0
+
+    def note_depth(self, depth: int) -> bool:
+        """Update the latch from the current queue depth; returns the
+        latch state.  Called once per engine window (and per decide)."""
+        if self.queue_high is None:
+            return False
+        if depth >= self.queue_high:
+            self.shedding = True
+        elif depth <= self.queue_low:
+            self.shedding = False
+        return self.shedding
+
+    def decide(self, total_tokens: int, fits_ever: bool,
+               fits_now: bool, queue_depth: int,
+               draining: bool = False) -> AdmissionVerdict:
+        """One request's admission verdict (module docstring)."""
+        if draining:
+            v = AdmissionVerdict("shed", REASON_DRAINING)
+        elif not fits_ever:
+            v = AdmissionVerdict("shed", REASON_OOM)
+        elif self.note_depth(queue_depth) and not fits_now:
+            v = AdmissionVerdict("shed", REASON_BACKPRESSURE)
+        elif fits_now:
+            return AdmissionVerdict("admit")
+        elif queue_depth >= self.max_queue:
+            v = AdmissionVerdict("shed", REASON_QUEUE_FULL)
+        else:
+            return AdmissionVerdict("queue")
+        self.shed_count += 1
+        return v

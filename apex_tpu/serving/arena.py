@@ -1,0 +1,173 @@
+"""Paged KV cache as one flat device arena, packed once.
+
+The serving analogue of the optimizer ``BucketPlan`` discipline
+(``multi_tensor_apply/packer.py``): the layout is computed ONCE at
+engine build — a single flat K buffer and a single flat V buffer whose
+unit is the *page* (``page_size`` consecutive tokens of one sequence,
+all layers and KV heads together, so a page gather is one contiguous
+read) — and the buffers then stay resident and DONATED through every
+prefill/decode program.  Nothing re-concatenates or re-allocates per
+token; growth is a page-table edit.
+
+Layout (``n_pages + 1`` pages — the extra last page is the TRASH page
+inactive slots' masked writes are steered into, the device-side-slot
+trick that keeps the decode program branch-free)::
+
+    k, v : (n_pages + 1, page_size, n_layers, n_kv_heads, head_dim)
+    page_table : (max_slots, pages_per_slot) i32  — page index per
+        slot-local page; unused entries point at the trash page
+
+Page ACCOUNTING is host-side (a free list): the host owns admission
+and eviction, so it owns which pages are free — no device round-trip
+decides placement.  The device only ever consumes the page table the
+host last installed, and the slot-state arrays (``seq_lens``,
+``active``, ...) ride the decode program as donated carry so the host
+reads them back once per flush window (the ``telemetry/ring.py``
+read-once-per-window pattern), never per token.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ArenaSpec(NamedTuple):
+    """Static arena geometry (the pack-once layout record)."""
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    page_size: int = 8       # tokens per page
+    n_pages: int = 64        # real pages (trash page is extra)
+    max_slots: int = 4       # concurrent sequences
+    pages_per_slot: int = 8  # slot token capacity / page_size
+
+    @property
+    def trash_page(self) -> int:
+        return self.n_pages
+
+    @property
+    def slot_tokens(self) -> int:
+        """Token capacity of one slot (context length ceiling)."""
+        return self.pages_per_slot * self.page_size
+
+    def validate(self) -> "ArenaSpec":
+        if self.page_size < 1 or self.n_pages < 1:
+            raise ValueError(f"bad arena geometry: {self}")
+        if self.pages_per_slot < 1 or self.max_slots < 1:
+            raise ValueError(f"bad arena geometry: {self}")
+        if self.pages_per_slot > self.n_pages:
+            raise ValueError(
+                f"pages_per_slot ({self.pages_per_slot}) exceeds the "
+                f"arena ({self.n_pages} pages) — one full slot could "
+                "never be placed")
+        return self
+
+
+class KVArena:
+    """Device buffers + the host-side page/slot free lists."""
+
+    def __init__(self, spec: ArenaSpec, dtype=jnp.float32):
+        self.spec = spec.validate()
+        self.dtype = jnp.dtype(dtype)
+        s = self.spec
+        shape = (s.n_pages + 1, s.page_size, s.n_layers,
+                 s.n_kv_heads, s.head_dim)
+        # the one-time pack: both arenas and the page table are
+        # allocated HERE and only ever flow through donated programs
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self.page_table = jnp.full((s.max_slots, s.pages_per_slot),
+                                   s.trash_page, jnp.int32)
+        self._free_pages: List[int] = list(range(s.n_pages))
+        self._free_slots: List[int] = list(range(s.max_slots))
+        # host mirror of each slot's page row (release without a
+        # device read — the host handed the pages out, it knows them)
+        self._slot_pages: List[Optional[List[int]]] = \
+            [None] * s.max_slots
+
+    # ---- host-side accounting -------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_slots)
+
+    def pages_needed(self, total_tokens: int) -> int:
+        """Pages a sequence of ``total_tokens`` (prompt + generation
+        budget) occupies."""
+        return -(-int(total_tokens) // self.spec.page_size)
+
+    def fits_ever(self, total_tokens: int) -> bool:
+        """Could this sequence EVER be placed (slot capacity)?  False
+        is the typed ``oom_admission`` shed — queueing cannot help."""
+        return self.pages_needed(total_tokens) <= self.spec.pages_per_slot
+
+    def fits_now(self, total_tokens: int) -> bool:
+        return (self._free_slots
+                and self.pages_needed(total_tokens)
+                <= len(self._free_pages))
+
+    def acquire(self, total_tokens: int) -> tuple:
+        """Allocate ``(slot, pages)`` for a sequence of
+        ``total_tokens``.  Purely host accounting: the engine owns the
+        LIVE page table (it is part of the donated decode carry) and
+        installs :meth:`slot_row` itself — a small host->device update
+        at ADMISSION time; the per-token path never calls this."""
+        if not self.fits_now(total_tokens):
+            raise RuntimeError("acquire() without fits_now() — the "
+                               "admission controller owns that check")
+        n = self.pages_needed(total_tokens)
+        slot = self._free_slots.pop(0)
+        pages = [self._free_pages.pop(0) for _ in range(n)]
+        self._slot_pages[slot] = list(pages)
+        return slot, pages
+
+    def release(self, slot: int) -> None:
+        """Return a slot's pages to the free list (eviction /
+        completion).  Purely host-side — the host handed the pages
+        out, it knows them; the engine resets the live page-table row
+        to trash so a stale gather can never read another request's
+        pages."""
+        pages = self._slot_pages[slot]
+        if pages is None:
+            return
+        self._slot_pages[slot] = None
+        self._free_pages.extend(pages)
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+
+    def slot_row(self, slot: int) -> jax.Array:
+        """The slot's full page-table row (allocated pages first,
+        trash for the unused tail) — what the engine installs into the
+        live table at admission, and all-trash after release."""
+        pages = self._slot_pages[slot] or []
+        row = np.full((self.spec.pages_per_slot,), self.spec.trash_page,
+                      np.int32)
+        row[:len(pages)] = pages
+        return jnp.asarray(row)
+
+    def page_row(self, prompt_bucket: int, pages: List[int]
+                 ) -> jax.Array:
+        """The per-page index vector a prefill program scatters
+        through: ``prompt_bucket // page_size`` entries, real pages
+        first, trash for the fully-padded tail."""
+        n = prompt_bucket // self.spec.page_size
+        row = np.full((n,), self.spec.trash_page, np.int32)
+        row[:min(len(pages), n)] = pages[:n]
+        return jnp.asarray(row)
+
+    def describe(self) -> dict:
+        """JSON-able layout summary (bench/docs surface)."""
+        s = self.spec
+        return {"pages": s.n_pages, "page_size": s.page_size,
+                "max_slots": s.max_slots,
+                "pages_per_slot": s.pages_per_slot,
+                "slot_tokens": s.slot_tokens,
+                "kv_bytes": int(2 * self.k.size * self.k.dtype.itemsize),
+                "dtype": self.dtype.name}

@@ -1,0 +1,150 @@
+"""Serving microbenches: the paged decode step and the end-to-end
+engine throughput.
+
+``bench_decode_step`` times ONE compiled decode window over the paged
+arena against a contiguous-cache oracle (same model, same slot count,
+the cache held as one dense ``(B, C, ...)`` buffer with no page
+indirection) — the paging overhead must stay near 1.0x, which is the
+point of the flat-arena layout.  ``bench_serving`` runs a real
+:class:`~apex_tpu.serving.engine.Engine` over a synthetic request
+stream and reports ``decode_tokens_per_sec`` and ``serving_p99_ms``,
+the two ``tools/perf_budget.json`` rows (graded no-data until a live
+TPU window restamps them).
+
+Shared by tools/kernel_bench.py (the ``decode_step`` row), bench.py's
+serving TPU extra, and the tier-1 smoke test (tiny shapes on CPU:
+proves the harness, not performance).
+"""
+
+from __future__ import annotations
+
+
+def _tiny_setup(jax, jnp, n_layers, hidden, n_heads, max_slots,
+                page_size, pages_per_slot, window):
+    from apex_tpu import serving
+    cfg = serving.DecoderConfig(
+        vocab_size=128, hidden=hidden, n_layers=n_layers,
+        n_heads=n_heads, n_kv_heads=n_heads, ffn=2 * hidden,
+        max_seq=page_size * pages_per_slot, eos_token=1)
+    params = serving.init_params(jax.random.key(0), cfg)
+    spec = serving.ArenaSpec(
+        n_layers=n_layers, n_kv_heads=n_heads, head_dim=cfg.head_dim,
+        page_size=page_size, n_pages=max_slots * pages_per_slot,
+        max_slots=max_slots, pages_per_slot=pages_per_slot)
+    arena = serving.KVArena(spec)
+    state = serving.init_state(arena, window)
+    # mid-generation occupancy: every slot active at half capacity
+    half = spec.slot_tokens // 2
+    import numpy as np
+    table = np.arange(max_slots * pages_per_slot,
+                      dtype=np.int32).reshape(max_slots, pages_per_slot)
+    state = state._replace(
+        page_table=jnp.asarray(table),
+        seq_lens=jnp.full((max_slots,), half, jnp.int32),
+        active=jnp.ones((max_slots,), jnp.int32),
+        last_token=jnp.full((max_slots,), 7, jnp.int32),
+        budget=jnp.full((max_slots,), 10_000, jnp.int32))
+    return cfg, params, spec, state
+
+
+def bench_decode_step(n_layers: int = 2, hidden: int = 64,
+                      n_heads: int = 4, max_slots: int = 4,
+                      page_size: int = 8, pages_per_slot: int = 4,
+                      window: int = 8, iters: int = 10, reps: int = 3):
+    """Paged decode window vs contiguous-cache oracle (docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import serving
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.serving.model import decode_forward
+
+    cfg, params, spec, state = _tiny_setup(
+        jax, jnp, n_layers, hidden, n_heads, max_slots, page_size,
+        pages_per_slot, window)
+    paged = serving.decode_window_fn(cfg, spec, window)
+    out = {"decode_slots": max_slots, "decode_window": window,
+           "decode_page_size": page_size,
+           "decode_ctx": spec.slot_tokens}
+    # two programs by design (paged vs contiguous oracle)
+    # apexlint: disable-next=APX302
+    paged_ms = timeit(jax.jit(paged), params, state,
+                      iters=iters, reps=reps)
+    out["decode_step_paged_ms"] = round(paged_ms, 4)
+
+    # contiguous oracle: the same window loop over ONE dense cache
+    # buffer per side — no page gather/scatter
+    b, ctx = max_slots, spec.slot_tokens
+
+    def oracle(params, k, v, seq_lens, last, col_unused):
+        def body(i, carry):
+            k, v, seq_lens, last = carry
+            pos = jnp.clip(seq_lens, 0, ctx - 1)
+            visible = jnp.arange(ctx)[None, :] <= pos[:, None]
+            logits, k_new, v_new = decode_forward(
+                params, cfg, last, pos, k, v, visible)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            k = k.at[:, jnp.arange(b), pos].set(k_new)
+            v = v.at[:, jnp.arange(b), pos].set(v_new)
+            return k, v, seq_lens + 1, nxt
+        return jax.lax.fori_loop(0, window, body,
+                                 (k, v, seq_lens, last))
+
+    kd = jnp.zeros((n_layers, b, ctx, n_heads, cfg.head_dim))
+    # apexlint: disable-next=APX302
+    dense_ms = timeit(jax.jit(oracle), params, kd, kd,
+                      state.seq_lens, state.last_token, 0,
+                      iters=iters, reps=reps)
+    out["decode_step_dense_ms"] = round(dense_ms, 4)
+    out["decode_step_paging_overhead"] = round(
+        paged_ms / max(dense_ms, 1e-9), 3)
+    out["decode_step_tokens_per_sec"] = round(
+        max_slots * window / (paged_ms / 1e3), 1)
+    return out
+
+
+def bench_serving(n_requests: int = 8, n_layers: int = 2,
+                  hidden: int = 64, n_heads: int = 4,
+                  max_slots: int = 4, page_size: int = 8,
+                  pages_per_slot: int = 4, window: int = 8,
+                  max_new_tokens: int = 16):
+    """End-to-end engine throughput: the perf-budget rows
+    ``extra.decode_tokens_per_sec`` / ``extra.serving_p99_ms``."""
+    import time
+
+    import jax
+
+    from apex_tpu import serving
+
+    cfg, params, spec, _ = _tiny_setup(
+        jax, jax.numpy, n_layers, hidden, n_heads, max_slots,
+        page_size, pages_per_slot, window)
+    eng = serving.Engine(
+        params, cfg, page_size=page_size,
+        n_pages=spec.n_pages, max_slots=max_slots,
+        pages_per_slot=pages_per_slot, window=window,
+        max_queue=max(n_requests, 8))
+    # keep every request placeable at THIS geometry: the bench
+    # measures throughput, not the oom-shed path
+    max_new = max(1, min(max_new_tokens, spec.slot_tokens - 4))
+    for i in range(n_requests):
+        eng.submit(serving.Request(
+            id=f"bench-{i}", prompt=[2 + (i % 5), 3, 4],
+            max_new_tokens=max_new))
+    t0 = time.time()
+    results = eng.serve()
+    wall = time.time() - t0
+    tokens = sum(len(r.tokens) for r in results.values())
+    lat = sorted(eng._token_ms) or [0.0]
+    out = {
+        "decode_tokens_per_sec": round(tokens / max(wall, 1e-9), 1),
+        "serving_p99_ms": round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "serving_p50_ms": round(lat[len(lat) // 2], 3),
+        "serving_requests": n_requests,
+        "serving_completed": sum(
+            1 for r in results.values()
+            if r.verdict == serving.COMPLETED),
+    }
+    eng.close()
+    return out

@@ -1,0 +1,853 @@
+"""The continuous-batching serve loop with request-level robustness.
+
+``Engine.serve()`` is a HOST loop over fixed-shape device windows: per
+iteration it (1) applies any scheduled chaos, (2) checks the
+preemption guard (drain), (3) evicts requests past their own
+deadlines, (4) admits from the bounded queue (per-bucket AOT prefill),
+(5) runs ONE compiled decode window under a deadline-armed runner, (6)
+reads the slot state back with ONE ``device_get`` and resolves
+finished requests, (7) beats the replica monitor and (8) publishes
+``serving/*`` host counters.  Inside a window there is zero host
+traffic (the ``serving.decode_step`` apexverify spec pins the traced
+program free of callbacks/transfers); between windows every host
+action is an admission/eviction EVENT, not per-token bookkeeping.
+
+Robustness reuses the training substrate (the point of this module):
+
+- **hung decode** — the decode dispatch runs on a
+  :class:`~apex_tpu.resilience.fleet.DeadlineRunner` worker with a
+  join deadline; expiry converts into typed
+  :class:`DecodeDeadlineExceeded` and evicts only the SUSPECT
+  requests (those admitted in the hung window — fresh admissions are
+  the usual compile/shape offenders — else the longest-context
+  request).  Recovery is two-tier: a PRE-dispatch wedge (the thunk
+  re-checks the runner generation after its blocking prologue, the
+  ``run_elastic`` step pattern) never consumed the donated arena, so
+  survivors continue from their untouched KV pages bit-exactly; a
+  POST-dispatch hang lost the arena to the abandoned call, so the
+  engine rebuilds a fresh one and re-places survivors from their
+  prompt + emitted tokens (``_recover_lost_arena``).  Never a
+  process kill.
+- **admission control** — bounded queue + watermark-hysteresis
+  load shedding (:mod:`~apex_tpu.serving.admission`); every request
+  ends in exactly one typed verdict.
+- **graceful drain** — a :class:`~apex_tpu.resilience.preemption.
+  PreemptionGuard` notice stops admission, finishes in-flight
+  requests, returns the queued remainder as ``drained``.
+- **replica failover** — a :class:`~apex_tpu.serving.replica.
+  ReplicaSet` peer death opens an incident (id minted from replicated
+  facts by the shared :class:`~apex_tpu.telemetry.incident.
+  IncidentLog`) and the agreed lowest-rank survivor re-admits the
+  dead replica's published queue under that id.
+
+Observability: ``serving/*`` host counters ride the hostmetrics sinks
+(live on ``/metrics`` the moment they are emitted), ``kind:"serving"``
+event records ride the telemetry session's flush into the JSONL and
+the merged incident timeline, and prefill/decode wall time is
+attributed through :func:`telemetry.span` (the PR-8 profiler surface).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import telemetry as _telemetry
+from apex_tpu.resilience import faults as _faults
+from apex_tpu.resilience.fleet import (DeadlineRunner,
+                                       StepDeadlineExceeded)
+from apex_tpu.serving import admission as adm
+from apex_tpu.serving.arena import ArenaSpec, KVArena
+from apex_tpu.serving.model import DecoderConfig
+from apex_tpu.serving.steps import init_state
+from apex_tpu.telemetry import hostmetrics as _hostmetrics
+from apex_tpu.telemetry.incident import IncidentLog
+
+
+class DecodeDeadlineExceeded(RuntimeError):
+    """A decode (or prefill) window did not materialize within its
+    deadline — the serving face of a hung collective / pathological
+    compile.  Typed so the engine can convert it into request-level
+    eviction instead of a process kill."""
+
+    def __init__(self, message: str, window: int = -1,
+                 phase: str = "decode", deadline_s: float = 0.0,
+                 suspects: Sequence[str] = (),
+                 dispatched: bool = False):
+        super().__init__(message)
+        self.window = int(window)
+        self.phase = phase
+        self.deadline_s = float(deadline_s)
+        self.suspects = list(suspects)
+        # True when the worker had already handed the donated arena to
+        # the executable before the deadline fired: the buffers are
+        # consumed (and the abandoned call may still write them), so
+        # recovery must REBUILD, never reuse, the device state
+        self.dispatched = bool(dispatched)
+
+
+@dataclass
+class Request:
+    """One generation request."""
+    id: str
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    deadline_s: Optional[float] = None   # per-request wall deadline
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + int(self.max_new_tokens)
+
+    def ledger_record(self) -> dict:
+        """JSON-able form for the replica queue ledger."""
+        return {"id": self.id, "prompt": [int(t) for t in self.prompt],
+                "max_new_tokens": int(self.max_new_tokens),
+                **({"deadline_s": self.deadline_s}
+                   if self.deadline_s is not None else {})}
+
+    @classmethod
+    def from_ledger(cls, rec: dict) -> "Request":
+        return cls(id=str(rec["id"]), prompt=list(rec["prompt"]),
+                   max_new_tokens=int(rec.get("max_new_tokens", 16)),
+                   deadline_s=rec.get("deadline_s"))
+
+
+@dataclass
+class RequestResult:
+    """The one typed verdict every request ends in."""
+    id: str
+    verdict: str                       # admission.COMPLETED / ...
+    tokens: List[int] = field(default_factory=list)
+    reason: str = ""
+    incident_id: Optional[str] = None
+    readmitted_from: Optional[int] = None
+
+
+@dataclass
+class _Active:
+    """Host mirror of one in-flight request."""
+    req: Request
+    slot: int
+    tokens: List[int]
+    admitted_t: float
+    admitted_window: int
+    deadline_forced: bool = False
+    readmitted_from: Optional[int] = None
+
+
+class Engine:
+    """AOT-compiled continuously-batched decode engine (module
+    docstring).
+
+    ``page_size`` / ``window`` default to the autotuner's measured
+    serving geometry for this topology
+    (``ops._dispatch.serving_pref``), falling back to the design
+    defaults when no table steers."""
+
+    def __init__(self, params, cfg: DecoderConfig,
+                 page_size: Optional[int] = None,
+                 n_pages: int = 64, max_slots: int = 4,
+                 pages_per_slot: Optional[int] = None,
+                 window: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 kv_dtype=jnp.float32,
+                 max_queue: int = 64,
+                 queue_high: Optional[int] = None,
+                 queue_low: Optional[int] = None,
+                 decode_deadline_s: Union[float, Callable[[], float]]
+                 = 30.0,
+                 telemetry=None, replica=None, controller=None,
+                 guard=None, incidents: Optional[IncidentLog] = None,
+                 flush_every: int = 4,
+                 results_cap: int = 65536):
+        from apex_tpu.ops import _dispatch
+        if page_size is None:
+            page_size = int(_dispatch.serving_pref("page_size", 8))
+        if window is None:
+            window = int(_dispatch.serving_pref("decode_window", 8))
+        if pages_per_slot is None:
+            pages_per_slot = max(1, min(n_pages // max(max_slots, 1),
+                                        cfg.max_seq // page_size))
+        spec = ArenaSpec(
+            n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, page_size=int(page_size),
+            n_pages=int(n_pages), max_slots=int(max_slots),
+            pages_per_slot=int(pages_per_slot))
+        if spec.slot_tokens > cfg.max_seq:
+            raise ValueError(
+                f"slot capacity ({spec.slot_tokens} tokens) exceeds "
+                f"the model's position table (max_seq={cfg.max_seq})")
+        self.params = params
+        self.cfg = cfg
+        self.arena = KVArena(spec, dtype=kv_dtype)
+        # AOT: every program this engine will ever run compiles HERE
+        # (memoized — a rebuilt engine over the same params object and
+        # geometry reuses the compiled set)
+        from apex_tpu.serving.steps import cached_programs
+        self.programs = cached_programs(
+            params, cfg, self.arena, window=int(window),
+            prefill_buckets=prefill_buckets)
+        self.window = self.programs.window
+        self.state = init_state(self.arena, self.window)
+        self.admission = adm.AdmissionController(
+            max_queue=max_queue, queue_high=queue_high,
+            queue_low=queue_low)
+        self.decode_deadline_s = decode_deadline_s
+        self.runner = DeadlineRunner()
+        self.guard = guard
+        self.replica = replica
+        self.controller = controller
+        self.telemetry = telemetry
+        self.flush_every = max(1, int(flush_every))
+        self.incidents = (replica.incidents if replica is not None
+                          else (incidents or IncidentLog()))
+        self.queue: collections.deque = collections.deque()
+        # every verdict is retained for the caller, but only up to
+        # results_cap: a long-lived server must not hold the full
+        # token list of every request it ever served (oldest terminal
+        # verdicts fall off; their ids become reusable)
+        self.results_cap = max(1, int(results_cap))
+        self.results: Dict[str, RequestResult] = {}
+        self._active: Dict[int, _Active] = {}
+        # bounded: with a session attached the flush drains this every
+        # few windows; WITHOUT one (bare engines, benches) a sustained
+        # shed storm must not grow host memory forever
+        self._event_records: collections.deque = collections.deque(
+            maxlen=4096)
+        self._admitted_this_window: List[int] = []   # slots
+        self._readmitted_pending: set = set()
+        self._incident_cause: Optional[str] = None
+        self._pending_stall = 0.0
+        self._draining = False
+        self._drain_reported = False
+        self._token_ms = collections.deque(maxlen=512)
+        self._windows = 0
+        self._tokens_total = 0
+        self._attached = False
+        if telemetry is not None:
+            telemetry.add_observer(self._on_flush)
+            self._attached = True
+
+    # ---- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        if self._attached and self.telemetry is not None:
+            if self._event_records:
+                try:
+                    self.telemetry.flush()
+                except Exception:   # noqa: BLE001 — teardown path
+                    pass
+            self.telemetry.remove_observer(self._on_flush)
+            self._attached = False
+        self.runner.close()
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _on_flush(self, records) -> List[dict]:
+        out = list(self._event_records)
+        self._event_records.clear()
+        return out
+
+    def _event(self, event: str, **fields) -> None:
+        rec = {"kind": "serving", "event": event,
+               "step": self._windows, "t": round(time.time(), 3),
+               **fields}
+        self.incidents.tag(rec)
+        self._event_records.append(rec)
+
+    # ---- intake ----------------------------------------------------------
+    def queue_depth(self) -> int:
+        """Live queue depth — the load signal a
+        ``FleetController(signal_source=engine.queue_depth)`` polls."""
+        return len(self.queue)
+
+    def submit(self, req: Request,
+               readmitted_from: Optional[int] = None) -> str:
+        """Enqueue one request; sheds (typed) instead of queueing when
+        the bounded-queue policy says so.  Returns the verdict action
+        (``queue`` or ``shed``)."""
+        if req.id in self.results or any(
+                a.req.id == req.id for a in self._active.values()) \
+                or any(r.id == req.id for r in self.queue):
+            raise ValueError(f"duplicate request id {req.id!r}")
+        if readmitted_from is not None:
+            # provenance FIRST: a re-admitted request that sheds must
+            # still render inside the failover incident and count as
+            # resolved toward its closure
+            self._readmitted_pending.add(req.id)
+            self._event("request_readmitted", id=req.id,
+                        from_host=readmitted_from)
+            _hostmetrics.emit("serving/readmitted", 1)
+            req._readmitted_from = readmitted_from  # type: ignore
+        # placeable = fits a slot's pages AND a compiled prefill
+        # bucket covers the prompt (custom bucket lists may stop short
+        # of slot capacity) — either failure is the typed oom shed,
+        # because queueing can help with neither
+        placeable = self.arena.fits_ever(req.total_tokens) \
+            and self.programs.bucket_for(len(req.prompt)) is not None
+        v = self.admission.decide(
+            req.total_tokens, fits_ever=placeable,
+            fits_now=False, queue_depth=len(self.queue),
+            draining=self._draining)
+        if v.action == "shed":
+            self.results[req.id] = RequestResult(
+                req.id, adm.SHED, reason=v.reason,
+                incident_id=self.incidents.current,
+                readmitted_from=readmitted_from)
+            self._event("request_shed", id=req.id, reason=v.reason)
+            _hostmetrics.emit("serving/shed", 1)
+            self._note_terminal(req.id)
+            return "shed"
+        self.queue.append(req)
+        _hostmetrics.emit("serving/queue_depth", len(self.queue))
+        return "queue"
+
+    # ---- the serve loop --------------------------------------------------
+    def serve(self, max_windows: int = 10_000,
+              min_windows: int = 0) -> Dict[str, RequestResult]:
+        """Run windows until every submitted request has a verdict (or
+        a drain completes).  Safe to call repeatedly — new submissions
+        between calls just extend the run.  ``min_windows`` keeps the
+        loop beating through idle windows (replica liveness detection
+        needs beats even with no local work — a dead peer's queue can
+        only be claimed by an engine that is still looking)."""
+        for i in range(int(max_windows)):
+            if i >= int(min_windows) and not self._active \
+                    and not self.queue:
+                break
+            self.step_window()
+            if self._draining and not self._active:
+                break
+        self._finish_drain()
+        if self.telemetry is not None:
+            try:
+                self.telemetry.flush()
+            except Exception:   # noqa: BLE001 — reporting must not kill
+                pass
+        return dict(self.results)
+
+    def step_window(self) -> None:
+        """One serve-loop iteration (module docstring's 8 phases)."""
+        self._windows += 1
+        w = self._windows
+        t0 = time.time()
+        self._apply_fault(_faults.serving_fault(w))
+        if self.guard is not None and not self._draining \
+                and self.guard.check(w):
+            self._begin_drain()
+        self._evict_expired()
+        self._admit(w)
+        emitted = self._decode(w)
+        self._replica_beat(w)
+        if self.controller is not None:
+            live = (len(self.replica.monitor.live_hosts())
+                    if self.replica is not None else 1)
+            self.controller.decide(w, n_hosts=live)
+        self._publish_metrics(w, emitted, time.time() - t0)
+        if self.telemetry is not None and w % self.flush_every == 0:
+            self.telemetry.flush()
+
+    # ---- chaos -----------------------------------------------------------
+    def _apply_fault(self, f) -> None:
+        if f is None:
+            return
+        if f.kind == "hung_decode":
+            # the stall lands in the deadline-armed thunk's PROLOGUE
+            # (before dispatch), the shape of a wedged compile/dispatch
+            self._pending_stall = max(self._pending_stall, f.delay_s)
+        elif f.kind == "slow_request":
+            target = self._fault_target_slot(f.target)
+            if target is not None:
+                self._active[target].deadline_forced = True
+        elif f.kind == "replica_death":
+            if self.replica is not None:
+                peers = [h for h in self.replica.monitor.hosts
+                         if h != self.replica.host]
+                victim = f.target if f.target is not None \
+                    else (peers[-1] if peers else None)
+                if victim is not None:
+                    self.replica.kill_peer(victim)
+        elif f.kind == "queue_storm":
+            for i in range(8):
+                self.submit(Request(
+                    id=f"storm-{self._windows}-{i}",
+                    prompt=[2, 3], max_new_tokens=4))
+        elif f.kind == "oom_admission":
+            self.submit(Request(
+                id=f"oom-{self._windows}",
+                prompt=[2] * (self.arena.spec.slot_tokens + 1),
+                max_new_tokens=1))
+
+    def _fault_target_slot(self, target) -> Optional[int]:
+        if not self._active:
+            return None
+        if target is not None and target in self._active:
+            return target
+        return sorted(self._active)[0]
+
+    # ---- drain -----------------------------------------------------------
+    def _begin_drain(self) -> None:
+        self._draining = True
+        self._event("drain_begin", in_flight=len(self._active),
+                    queued=len(self.queue))
+
+    def _finish_drain(self) -> None:
+        if not self._draining:
+            return
+        while self.queue:
+            req = self.queue.popleft()
+            self.results[req.id] = RequestResult(
+                req.id, adm.DRAINED, reason=adm.REASON_DRAINING,
+                readmitted_from=getattr(req, "_readmitted_from",
+                                        None))
+            self._event("request_drained", id=req.id)
+            self._note_terminal(req.id)
+        if not self._drain_reported:
+            self._drain_reported = True
+            self._event("drain_complete",
+                        served=sum(1 for r in self.results.values()
+                                   if r.verdict == adm.COMPLETED))
+        if not self._active and self.incidents.current is not None:
+            # the drain emptied the engine with an incident still open
+            # (e.g. a hung eviction whose queued survivors were then
+            # drained): nothing is left to prove recovery with — close
+            self._resolve_incident()
+
+    # ---- eviction --------------------------------------------------------
+    def _evict_expired(self) -> None:
+        now = time.time()
+        for slot in sorted(self._active):
+            a = self._active[slot]
+            if a.deadline_forced or (
+                    a.req.deadline_s is not None
+                    and now - a.admitted_t > a.req.deadline_s):
+                self._evict(slot, adm.REASON_DEADLINE)
+
+    def _record_evicted(self, rid: str, reason: str, tokens,
+                        readmitted_from: Optional[int]) -> None:
+        """THE eviction verdict: result + event + counter + incident
+        bookkeeping, shared by every eviction path so the fields
+        cannot drift between them."""
+        self.results[rid] = RequestResult(
+            rid, adm.EVICTED, tokens=list(tokens), reason=reason,
+            incident_id=self.incidents.current,
+            readmitted_from=readmitted_from)
+        self._event("request_evicted", id=rid, reason=reason,
+                    tokens_done=len(tokens))
+        _hostmetrics.emit("serving/evictions", 1)
+        self._note_terminal(rid)
+
+    def _clear_slot(self, slot: int) -> None:
+        """Release a slot's pages and reset its device row — the one
+        slot-clearing invariant, shared by eviction and completion."""
+        self.arena.release(slot)
+        self.state = self.state._replace(
+            active=self.state.active.at[slot].set(0),
+            done=self.state.done.at[slot].set(0),
+            page_table=self.state.page_table.at[slot].set(
+                self.arena.slot_row(slot)))
+
+    def _evict(self, slot: int, reason: str) -> None:
+        a = self._active.pop(slot)
+        self._clear_slot(slot)
+        self._record_evicted(a.req.id, reason, a.tokens,
+                             a.readmitted_from)
+
+    # ---- admission -------------------------------------------------------
+    def _admit(self, w: int) -> None:
+        self._admitted_this_window = []
+        while self.queue and not self._draining:
+            req = self.queue[0]
+            if not self.arena.fits_now(req.total_tokens):
+                break
+            self.queue.popleft()
+            slot, pages = self.arena.acquire(req.total_tokens)
+            bucket = self.programs.bucket_for(len(req.prompt))
+            assert bucket is not None     # fits_ever gated at submit
+            plen = len(req.prompt)
+            tokens = np.zeros((bucket,), np.int32)
+            tokens[:plen] = np.asarray(list(req.prompt), np.int32)
+            t0 = time.time()
+            try:
+                with _telemetry.span("serving/prefill"):
+                    k, v, first = self._deadline_run(
+                        lambda: self.programs.prefill[bucket](
+                            self.params, self.state.k, self.state.v,
+                            self.arena.page_row(bucket, pages),
+                            jnp.asarray(tokens), jnp.int32(plen)),
+                        w, phase="prefill")
+            except DecodeDeadlineExceeded as e:
+                # a wedged PREFILL names its own suspect: the request
+                # being admitted — evict it, leave everyone else alone
+                self.incidents.open("hung_decode")
+                if not (self._incident_cause == "replica_death"
+                        and self._readmitted_pending):
+                    # same cause-preservation rule as
+                    # _handle_hung_decode: an unresolved failover
+                    # chain keeps its closure semantics
+                    self._incident_cause = "hung_decode"
+                e.suspects = [req.id]
+                self._event("hung_decode", deadline_s=e.deadline_s,
+                            phase="prefill", suspects=e.suspects,
+                            dispatched=e.dispatched)
+                _hostmetrics.emit("serving/hung_decode", 1)
+                self._record_evicted(
+                    req.id, adm.REASON_HUNG_DECODE, [],
+                    getattr(req, "_readmitted_from", None))
+                if e.dispatched:
+                    # the arenas were consumed by the abandoned
+                    # prefill: rebuild and re-place the in-flight batch
+                    self._recover_lost_arena([])
+                else:
+                    self.arena.release(slot)
+                if not self._active and not self.queue:
+                    self._resolve_incident()
+                break
+            except Exception:
+                # a non-deadline prefill failure: the request was
+                # already popped and its slot acquired — type it and
+                # free the slot before the error surfaces, so nothing
+                # vanishes without a verdict and nothing leaks
+                # (the decode path's handler, mirrored)
+                self.arena.release(slot)
+                self.results[req.id] = RequestResult(
+                    req.id, adm.FAILED, reason="prefill_error",
+                    readmitted_from=getattr(req, "_readmitted_from",
+                                            None))
+                self._note_terminal(req.id)
+                raise
+            _hostmetrics.emit("serving/prefill_ms",
+                              (time.time() - t0) * 1e3)
+            first = int(first)    # one sync per ADMISSION (documented)
+            st = self.state._replace(k=k, v=v)
+            done_now = (first == self.cfg.eos_token
+                        or req.max_new_tokens <= 1)
+            a = _Active(req=req, slot=slot, tokens=[first],
+                        admitted_t=time.time(), admitted_window=w,
+                        readmitted_from=getattr(
+                            req, "_readmitted_from", None))
+            self.state = st._replace(
+                page_table=st.page_table.at[slot].set(
+                    self.arena.slot_row(slot)),
+                seq_lens=st.seq_lens.at[slot].set(plen),
+                active=st.active.at[slot].set(0 if done_now else 1),
+                last_token=st.last_token.at[slot].set(first),
+                budget=st.budget.at[slot].set(
+                    max(req.max_new_tokens - 1, 0)),
+                done=st.done.at[slot].set(0))
+            self._active[slot] = a
+            self._admitted_this_window.append(slot)
+            _hostmetrics.emit("serving/admitted", 1)
+            self._tokens_total += 1
+            if done_now:
+                self._complete(slot)
+        _hostmetrics.emit("serving/queue_depth", len(self.queue))
+        self.admission.note_depth(len(self.queue))
+
+    # ---- decode ----------------------------------------------------------
+    def _decode(self, w: int) -> int:
+        if not self._active:
+            return 0
+        t0 = time.time()
+        try:
+            with _telemetry.span("serving/decode_window"):
+                new_state = self._deadline_run(
+                    lambda: self.programs.decode(self.params,
+                                                 self.state),
+                    w, phase="decode")
+        except DecodeDeadlineExceeded as e:
+            self._handle_hung_decode(e)
+            return 0
+        except Exception:
+            # a non-deadline decode failure: nothing may vanish
+            # without a verdict — type every in-flight request, then
+            # let the error surface
+            for slot in sorted(self._active):
+                a = self._active.pop(slot)
+                self.arena.release(slot)
+                self.results[a.req.id] = RequestResult(
+                    a.req.id, adm.FAILED, tokens=list(a.tokens),
+                    reason="decode_error",
+                    readmitted_from=a.readmitted_from)
+                self._note_terminal(a.req.id)
+            raise
+        self.state = new_state
+        _hostmetrics.emit("serving/decode_ms",
+                          (time.time() - t0) * 1e3)
+        self._admitted_this_window = []
+        if self._incident_cause == "hung_decode":
+            self._resolve_incident()
+        # THE window read-back: one device_get of the slot state
+        out_tokens, n_out, done = jax.device_get(
+            (self.state.out_tokens, self.state.n_out,
+             self.state.done))   # apexlint: disable=APX101
+        emitted = 0
+        for slot in sorted(self._active):
+            a = self._active[slot]
+            n = int(n_out[slot])
+            emitted += n
+            a.tokens.extend(int(t) for t in out_tokens[slot, :n]
+                            if t >= 0)
+            if int(done[slot]):
+                self._complete(slot)
+        return emitted
+
+    def _deadline_run(self, dispatch, w: int, phase: str):
+        gen = self.runner.generation
+        stall = 0.0
+        if phase == "decode":
+            # the injected hung_decode stall models a wedged DECODE
+            # dispatch; prefill is deadline-armed too but the chaos
+            # hook does not stall it
+            stall, self._pending_stall = self._pending_stall, 0.0
+        abandoned = object()
+        # conservatively marked BEFORE the generation re-check: a
+        # timeout that races the check may see dispatched=True for a
+        # call that then aborted (harmless heavy recovery), but never
+        # dispatched=False for a call that went on to consume the
+        # donated arena (which would corrupt it)
+        flag = {"dispatched": False}
+
+        def thunk():
+            if stall:
+                time.sleep(stall)
+            flag["dispatched"] = True
+            if self.runner.generation != gen:
+                flag["dispatched"] = False
+                return abandoned      # never touch the donated arena
+            out = dispatch()
+            jax.block_until_ready(out)
+            return out
+
+        deadline = (self.decode_deadline_s()
+                    if callable(self.decode_deadline_s)
+                    else float(self.decode_deadline_s))
+        try:
+            out = self.runner.run(thunk, deadline, step=w, phase=phase)
+        except StepDeadlineExceeded as e:
+            raise DecodeDeadlineExceeded(
+                str(e), window=w, phase=phase, deadline_s=deadline,
+                dispatched=flag["dispatched"]) from e
+        assert out is not abandoned
+        return out
+
+    def _handle_hung_decode(self, e: DecodeDeadlineExceeded) -> None:
+        suspects = list(self._admitted_this_window)
+        if not suspects and self._active:
+            # no fresh admission to blame: the longest context is the
+            # likeliest collective/memory offender
+            suspects = [max(
+                self._active,
+                key=lambda s: len(self._active[s].req.prompt)
+                + len(self._active[s].tokens))]
+        self.incidents.open("hung_decode")
+        if not (self._incident_cause == "replica_death"
+                and self._readmitted_pending):
+            # a hang during an unresolved failover chain rides the
+            # SAME incident (open is idempotent); the cause — and with
+            # it the closure rule, every re-admitted verdict in —
+            # stays the failover's
+            self._incident_cause = "hung_decode"
+        e.suspects = [self._active[s].req.id for s in suspects
+                      if s in self._active]
+        self._event("hung_decode", deadline_s=e.deadline_s,
+                    phase=e.phase, suspects=e.suspects,
+                    dispatched=e.dispatched)
+        _hostmetrics.emit("serving/hung_decode", 1)
+        if e.dispatched:
+            # the donated arena was consumed by the abandoned call
+            # (which may still write it): rebuild, never reuse
+            self._recover_lost_arena(suspects)
+        else:
+            for slot in suspects:
+                if slot in self._active:
+                    self._evict(slot, adm.REASON_HUNG_DECODE)
+        self._admitted_this_window = []
+        if not self._active and not self.queue:
+            # nothing left to prove recovery with: close the incident
+            # now — a later unrelated failure must mint its own id
+            self._resolve_incident()
+
+    def _evict_host_only(self, slot: int, reason: str) -> None:
+        """Eviction bookkeeping WITHOUT device-state writes — the
+        lost-arena path, where the old carry buffers are poisoned and
+        the whole device state is about to be rebuilt."""
+        a = self._active.pop(slot)
+        self._record_evicted(a.req.id, reason, a.tokens,
+                             a.readmitted_from)
+
+    def _recover_lost_arena(self, suspect_slots) -> None:
+        """A deadline expired AFTER the arena was handed to the
+        executable: the donated buffers are gone (and the abandoned
+        call may still complete into them), so the engine allocates a
+        FRESH arena + carry, evicts the suspects, and re-places every
+        survivor from its prompt + already-emitted tokens (emitted
+        tokens stand; the prefix KV recomputes through the bucketed
+        prefill).  Heavier than the prologue path — which keeps
+        survivors' pages untouched and bit-exact — but still
+        request-level recovery, never a process kill."""
+        for slot in sorted(suspect_slots):
+            if slot in self._active:
+                self._evict_host_only(slot, adm.REASON_HUNG_DECODE)
+        survivors = [self._active[s] for s in sorted(self._active)]
+        self._active = {}
+        self.arena = KVArena(self.arena.spec, dtype=self.arena.dtype)
+        self.state = init_state(self.arena, self.window)
+        self._event("arena_rebuilt", survivors=len(survivors))
+        _hostmetrics.emit("serving/arena_rebuilds", 1)
+        for a in survivors:
+            self._replay_request(a)
+
+    def _replay_request(self, a: _Active) -> None:
+        """Re-place one surviving request into the fresh arena.  The
+        prefix (prompt + all emitted tokens but the pending last one)
+        re-prefills; generation continues at the same position with
+        the same remaining budget.  Runs the compiled program directly
+        — recovery must not recurse into the deadline runner."""
+        req = a.req
+        prefix = list(req.prompt) + [int(t) for t in a.tokens[:-1]]
+        remaining = req.max_new_tokens - len(a.tokens)
+        bucket = self.programs.bucket_for(len(prefix))
+        if bucket is None or not self.arena.fits_now(req.total_tokens):
+            # cannot re-place (bucket list stops short of this prefix):
+            # typed eviction, never a silent drop
+            self._record_evicted(req.id, adm.REASON_HUNG_DECODE,
+                                 a.tokens, a.readmitted_from)
+            return
+        slot, pages = self.arena.acquire(req.total_tokens)
+        tokens = np.zeros((bucket,), np.int32)
+        tokens[:len(prefix)] = np.asarray(prefix, np.int32)
+        k, v, _first = self.programs.prefill[bucket](
+            self.params, self.state.k, self.state.v,
+            self.arena.page_row(bucket, pages), jnp.asarray(tokens),
+            jnp.int32(len(prefix)))
+        st = self.state._replace(k=k, v=v)
+        self.state = st._replace(
+            page_table=st.page_table.at[slot].set(
+                self.arena.slot_row(slot)),
+            seq_lens=st.seq_lens.at[slot].set(len(prefix)),
+            active=st.active.at[slot].set(1 if remaining > 0 else 0),
+            last_token=st.last_token.at[slot].set(int(a.tokens[-1])),
+            budget=st.budget.at[slot].set(max(remaining, 0)),
+            done=st.done.at[slot].set(0))
+        self._active[slot] = _Active(
+            req=req, slot=slot, tokens=list(a.tokens),
+            admitted_t=a.admitted_t, admitted_window=self._windows,
+            readmitted_from=a.readmitted_from)
+        if remaining <= 0:
+            self._complete(slot)
+
+    def _resolve_incident(self) -> None:
+        if self._readmitted_pending:
+            # a failover chain is still re-admitting: the shared
+            # incident must not close until every re-admitted request
+            # has its verdict — whatever else tried to resolve it
+            return
+        iid = self.incidents.current
+        if iid is None:
+            self._incident_cause = None
+            return
+        self._event("incident_resolved", cause=self._incident_cause)
+        self.incidents.close(iid)
+        self._incident_cause = None
+
+    # ---- completion ------------------------------------------------------
+    def _complete(self, slot: int) -> None:
+        a = self._active.pop(slot)
+        self._clear_slot(slot)
+        self.results[a.req.id] = RequestResult(
+            a.req.id, adm.COMPLETED, tokens=list(a.tokens),
+            readmitted_from=a.readmitted_from,
+            incident_id=(self.incidents.current
+                         if a.readmitted_from is not None else None))
+        _hostmetrics.emit("serving/completed", 1)
+        self._note_terminal(a.req.id)
+
+    def _note_terminal(self, rid: str) -> None:
+        """Terminal-verdict bookkeeping, called by EVERY path that
+        records a result: a replica-failover incident closes once all
+        re-admitted requests have verdicts, and the results ledger is
+        pruned oldest-first past ``results_cap``."""
+        self._readmitted_pending.discard(rid)
+        if self._incident_cause == "replica_death" \
+                and not self._readmitted_pending:
+            self._resolve_incident()
+        while len(self.results) > self.results_cap:
+            self.results.pop(next(iter(self.results)))
+
+    # ---- replica failover ------------------------------------------------
+    def _replica_beat(self, w: int) -> None:
+        if self.replica is None:
+            return
+        self.replica.publish_queue(
+            [r.ledger_record() for r in self.queue])
+        events = self.replica.beat(w)
+        for ev in events:
+            if ev.get("event") != "host_dead":
+                continue
+            dead = ev["host"]
+            if not self.replica.is_claimant():
+                # the failover chain (claim, re-admissions, resolution)
+                # belongs to the lowest-rank survivor alone — a
+                # non-claimant stamping incident_resolved at death time
+                # would close the merged timeline's incident while the
+                # claimant is still re-admitting.  Close only the LOCAL
+                # log (quietly, no resolved event) so later local
+                # events stop riding an incident this replica plays no
+                # part in.
+                self.incidents.close(self.incidents.current)
+                continue
+            self._incident_cause = "replica_death"
+            claimed = self.replica.claim_dead_queue(dead)
+            self._event("replica_failover", dead_host=dead,
+                        claimed=len(claimed))
+            _hostmetrics.emit("serving/replica_failover", 1)
+            reqs = []
+            for rec in claimed:
+                try:
+                    reqs.append(Request.from_ledger(rec))
+                except (KeyError, TypeError, ValueError):
+                    continue      # torn ledger entry
+            # register the WHOLE claim as pending up front: the first
+            # request's shed/completion must not resolve the incident
+            # while its siblings are still unsubmitted
+            for r in reqs:
+                if r.id not in self.results:
+                    self._readmitted_pending.add(r.id)
+            for r in reqs:
+                try:
+                    self.submit(r, readmitted_from=dead)
+                except ValueError:
+                    self._readmitted_pending.discard(r.id)
+            if not self._readmitted_pending:
+                # nothing to re-admit: the incident is just the death
+                self._resolve_incident()
+
+    # ---- metrics ---------------------------------------------------------
+    def _publish_metrics(self, w: int, emitted: int,
+                         wall_s: float) -> None:
+        self._tokens_total += emitted
+        if emitted > 0 and wall_s > 0:
+            per_tok = wall_s * 1e3 / emitted
+            self._token_ms.extend([per_tok] * min(emitted, 32))
+            _hostmetrics.emit("serving/tokens_per_sec",
+                              emitted / wall_s)
+        if self._token_ms:
+            lat = sorted(self._token_ms)
+            _hostmetrics.emit("serving/p50_token_ms",
+                              lat[len(lat) // 2])
+            _hostmetrics.emit("serving/p99_token_ms",
+                              lat[min(len(lat) - 1,
+                                      int(len(lat) * 0.99))])
+        _hostmetrics.emit("serving/tokens_total", self._tokens_total)
+        _hostmetrics.emit("serving/active_slots", len(self._active))
+        _hostmetrics.emit("serving/queue_depth", len(self.queue))

@@ -1,0 +1,198 @@
+"""The serving decoder: one causal-transformer forward in two shapes.
+
+Serving needs the SAME math twice — once over a whole padded prompt
+(prefill: compute every position's K/V and the first generated token)
+and once per generated token (decode: one query against the cached
+context).  The two paths here are written against one parameter
+layout so their numerics agree: a token's hidden state computed
+incrementally from cached K/V is the same computation the prefill
+pass would have run at that position (per-row layer norms, per-batch-
+element matmuls — nothing couples batch rows, which is what makes a
+continuously-batched engine's outputs independent of batch
+composition and lets an eviction re-admit survivors bit-exactly).
+
+Prefill runs causal attention through the existing flash kernel
+(:func:`apex_tpu.ops.attention.flash_attention`) — one ``pallas_call``
+per layer, pinned by the ``serving.prefill_step`` apexverify spec —
+with the padded tail masked through ``segment_ids`` (padding rows
+attend nowhere).  Decode is a dense single-query attention over the
+slot's gathered pages: the query length is 1, so there is no score
+matrix to tile and the masked-dense form is the natural XLA program
+(the ``serving.decode_step`` spec pins it free of host traffic).
+
+Parameters are a plain pytree (no framework module): the engine AOT-
+lowers both steps at build time, and a plain dict of arrays keeps the
+lowering surface minimal.  The LM head is tied to the embedding.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention, packed_segment_ids
+
+
+class DecoderConfig(NamedTuple):
+    """Static decoder geometry (hashable: lowering keys carry it)."""
+    vocab_size: int = 256
+    hidden: int = 32
+    n_layers: int = 2
+    n_heads: int = 2
+    n_kv_heads: int = 2      # GQA: n_heads % n_kv_heads == 0
+    ffn: int = 64
+    max_seq: int = 64        # position-table length (arena may be less)
+    eos_token: int = 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+
+def init_params(key, cfg: DecoderConfig) -> dict:
+    """Deterministic tiny-init parameter pytree for ``cfg``."""
+    if cfg.hidden % cfg.n_heads:
+        raise ValueError(f"hidden ({cfg.hidden}) must divide by "
+                         f"n_heads ({cfg.n_heads})")
+    if cfg.n_heads % cfg.n_kv_heads:
+        raise ValueError(f"n_heads ({cfg.n_heads}) must be a multiple "
+                         f"of n_kv_heads ({cfg.n_kv_heads})")
+    hd = cfg.head_dim
+    keys = jax.random.split(key, 2 + 6 * cfg.n_layers)
+    p = {
+        "embed": jax.random.normal(
+            keys[0], (cfg.vocab_size, cfg.hidden)) * 0.05,
+        "pos": jax.random.normal(
+            keys[1], (cfg.max_seq, cfg.hidden)) * 0.02,
+        "lnf_w": jnp.ones((cfg.hidden,)),
+        "lnf_b": jnp.zeros((cfg.hidden,)),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        k = keys[2 + 6 * i: 8 + 6 * i]
+        p["layers"].append({
+            "ln1_w": jnp.ones((cfg.hidden,)),
+            "ln1_b": jnp.zeros((cfg.hidden,)),
+            "wq": jax.random.normal(
+                k[0], (cfg.hidden, cfg.n_heads * hd)) * 0.05,
+            "wk": jax.random.normal(
+                k[1], (cfg.hidden, cfg.n_kv_heads * hd)) * 0.05,
+            "wv": jax.random.normal(
+                k[2], (cfg.hidden, cfg.n_kv_heads * hd)) * 0.05,
+            "wo": jax.random.normal(
+                k[3], (cfg.n_heads * hd, cfg.hidden)) * 0.05,
+            "ln2_w": jnp.ones((cfg.hidden,)),
+            "ln2_b": jnp.zeros((cfg.hidden,)),
+            "w1": jax.random.normal(k[4], (cfg.hidden, cfg.ffn)) * 0.05,
+            "b1": jnp.zeros((cfg.ffn,)),
+            "w2": jax.random.normal(k[5], (cfg.ffn, cfg.hidden)) * 0.05,
+            "b2": jnp.zeros((cfg.hidden,)),
+        })
+    return p
+
+
+def _ln(x, w, b):
+    """Plain f32 layer norm over the last axis (shared by both paths —
+    the prefill/decode numerics contract starts here)."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
+
+
+def _mlp(lp, h):
+    return jax.nn.gelu(h @ lp["w1"] + lp["b1"],
+                       approximate=True) @ lp["w2"] + lp["b2"]
+
+
+# ---------------------------------------------------------------------
+# prefill: whole padded prompt, flash attention, K/V out
+# ---------------------------------------------------------------------
+
+def prefill_forward(params, cfg: DecoderConfig, tokens, lengths):
+    """``tokens (B, S)`` + ``lengths (B,)`` -> ``(logits_last (B, V),
+    k (L, B, S, KV, D), v (L, B, S, KV, D))``.
+
+    Causal attention through the flash kernel with the padded tail
+    masked out via ``segment_ids`` (pad rows output exact zeros);
+    ``logits_last`` is each row's logits at its LAST real position —
+    the distribution the first generated token samples from."""
+    b, s = tokens.shape
+    hd = cfg.head_dim
+    seg = (jnp.arange(s)[None, :] < lengths[:, None]).astype(jnp.int32)
+    x = params["embed"][tokens] + params["pos"][:s][None]   # (B, S, H)
+    ks, vs = [], []
+    for lp in params["layers"]:
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"])
+        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+        ks.append(k)
+        vs.append(v)
+        attn = flash_attention(
+            jnp.transpose(q, (0, 2, 1, 3)),
+            jnp.transpose(k, (0, 2, 1, 3)),
+            jnp.transpose(v, (0, 2, 1, 3)),
+            causal=True, segment_ids=packed_segment_ids(seg))
+        attn = jnp.transpose(attn, (0, 2, 1, 3)).reshape(b, s, -1)
+        x = x + attn @ lp["wo"]
+        x = x + _mlp(lp, _ln(x, lp["ln2_w"], lp["ln2_b"]))
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    logits = x @ params["embed"].T                          # (B, S, V)
+    last = jnp.clip(lengths - 1, 0, s - 1)
+    logits_last = jnp.take_along_axis(
+        logits, last[:, None, None], axis=1)[:, 0]          # (B, V)
+    return (logits_last,
+            jnp.stack(ks),                                  # (L,B,S,KV,D)
+            jnp.stack(vs))
+
+
+# ---------------------------------------------------------------------
+# decode: one query token against the gathered cache
+# ---------------------------------------------------------------------
+
+def decode_forward(params, cfg: DecoderConfig, tokens, positions,
+                   k_ctx, v_ctx, visible):
+    """One decode step for every slot.
+
+    ``tokens (B,)`` / ``positions (B,)``: the token each slot feeds in
+    and its absolute position.  ``k_ctx``/``v_ctx`` ``(L, B, C, KV,
+    D)``: the gathered per-slot context (C = slot token capacity) with
+    this step's OWN K/V already inserted at ``positions`` (causal
+    self-attention includes the current token).  ``visible (B, C)``
+    bool: which context entries this token may attend to.
+
+    Returns ``(logits (B, V) f32, k_new (L, B, KV, D), v_new)`` —
+    the caller scatters ``k_new``/``v_new`` into the paged arena."""
+    b = tokens.shape[0]
+    hd = cfg.head_dim
+    groups = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / (hd ** 0.5)
+    x = params["embed"][tokens] + params["pos"][
+        jnp.clip(positions, 0, cfg.max_seq - 1)]            # (B, H)
+    k_news, v_news = [], []
+    for li, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"])
+        q = (h @ lp["wq"]).reshape(b, cfg.n_kv_heads, groups, hd)
+        k_new = (h @ lp["wk"]).reshape(b, cfg.n_kv_heads, hd)
+        v_new = (h @ lp["wv"]).reshape(b, cfg.n_kv_heads, hd)
+        k_news.append(k_new)
+        v_news.append(v_new)
+        kk = k_ctx[li]                                      # (B,C,KV,D)
+        vv = v_ctx[li]
+        # insert the current token's K/V at its own position so the
+        # causal self term is present (the arena write happens after)
+        kk = kk.at[jnp.arange(b), positions].set(k_new)
+        vv = vv.at[jnp.arange(b), positions].set(v_new)
+        scores = jnp.einsum("bkgd,bckd->bkgc", q, kk) * scale
+        scores = jnp.where(visible[:, None, None, :], scores,
+                           jnp.float32(-1e30))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bkgc,bckd->bkgd", probs, vv)
+        x = x + out.reshape(b, -1) @ lp["wo"]
+        x = x + _mlp(lp, _ln(x, lp["ln2_w"], lp["ln2_b"]))
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    logits = x @ params["embed"].T                          # (B, V) f32
+    return logits, jnp.stack(k_news), jnp.stack(v_news)

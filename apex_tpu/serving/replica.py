@@ -1,0 +1,118 @@
+"""Replica failover: beacon-detected replica death with queued-request
+re-admission on survivors.
+
+A serving fleet's failure domain is the REPLICA: when one dies, its
+in-flight requests are lost with the process but its QUEUED requests
+(accepted, never started) need not be — every replica publishes its
+queue ledger out-of-band, so survivors can re-admit a dead peer's
+backlog.  Everything rides the training stack's existing fleet
+machinery rather than reinventing it:
+
+- liveness is :class:`~apex_tpu.resilience.fleet.FleetMonitor`
+  beacons on a :class:`~apex_tpu.resilience.fleet.BeaconChannel`
+  (the KV / file / in-process transports all work);
+- a death opens an incident through the monitor's shared
+  :class:`~apex_tpu.telemetry.incident.IncidentLog` — the id is a
+  pure function of replicated facts, so EVERY surviving replica
+  stamps the same id on its re-admission events with zero extra
+  coordination, and ``telemetry timeline`` renders the whole chain
+  (host_dead -> readmissions -> resolved) as one incident;
+- the queue ledger is one channel key per replica
+  (``serving_queue/<host>``), refreshed at beat cadence; the AGREED
+  lowest-rank survivor claims a dead peer's ledger (the
+  dead-host-``.tmp``-sweep rule from checkpoint GC: exactly one
+  claimant, deterministically chosen).
+
+Faked multi-replica chaos uses the same
+:class:`~apex_tpu.resilience.fleet.SimulatedPeers` harness the
+training fleet tests use — ``kill_peer`` is the seam the
+``replica_death`` fault kind drives.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from apex_tpu.resilience import fleet as _fleet
+
+
+class ReplicaSet:
+    """One serving replica's view of the fleet (module docstring).
+
+    ``monitor``: a configured :class:`FleetMonitor` (the engine calls
+    :meth:`beat` at window boundaries — detection cadence is the flush
+    window, zero per-token cost)."""
+
+    def __init__(self, monitor: _fleet.FleetMonitor):
+        self.monitor = monitor
+        self.incidents = monitor.incidents
+        self._claimed: set = set()      # (host, incarnation) ledgers
+        self._sims: List = []           # attached SimulatedPeers
+
+    @property
+    def host(self) -> int:
+        return self.monitor.host
+
+    def attach_simulation(self, sim) -> "ReplicaSet":
+        """Register the chaos simulation ``kill_peer`` forwards to."""
+        self._sims.append(sim)
+        return self
+
+    def kill_peer(self, host: int) -> None:
+        """The ``replica_death`` fault seam: stop the target's beacons
+        (forwarded to every attached simulation; a no-op on a real
+        fleet, where death needs no injection)."""
+        for sim in self._sims:
+            sim.kill(host)
+
+    # ---- queue ledger ----------------------------------------------------
+    def publish_queue(self, request_records: List[dict]) -> None:
+        """Publish this replica's queued-request ledger (JSON-able
+        request records — id / tokens / budget, nothing device-side).
+        Refreshed every beat alongside the liveness beacon; a publish
+        failure degrades exactly like a missed beacon."""
+        try:
+            self.monitor.channel.put(
+                f"serving_queue/{self.host}",
+                {"host": self.host, "requests": list(request_records)})
+        except OSError:
+            pass        # a torn ledger read is skipped by get_all
+
+    def peer_queue(self, host: int) -> List[dict]:
+        """Read a peer's last published ledger (empty when absent)."""
+        try:
+            docs = self.monitor.channel.get_all("serving_queue/")
+        except OSError:
+            return []
+        for rec in docs.values():
+            if rec.get("host") == host:
+                return list(rec.get("requests", []))
+        return []
+
+    def beat(self, step: int) -> List[dict]:
+        """Step-boundary liveness poll.  Returns the NEW failure event
+        records (``kind:"fleet"``, incident-tagged by the monitor)."""
+        failures = self.monitor.beat(step)
+        return [f.record() for f in failures]
+
+    def is_claimant(self) -> bool:
+        """True when THIS replica is the agreed lowest-rank survivor —
+        the one that owns a dead peer's failover chain (claim,
+        re-admissions, incident resolution)."""
+        live = self.monitor.live_hosts()
+        return bool(live) and min(live) == self.host
+
+    def claim_dead_queue(self, host: int) -> List[dict]:
+        """The failover claim: if THIS replica is the agreed lowest-
+        rank survivor, take the dead peer's ledger (exactly once per
+        (host, incarnation)); everyone else gets [] — one claimant,
+        deterministically, no coordination beyond the liveness verdict
+        every survivor already shares."""
+        if not self.is_claimant():
+            return []
+        inc = self.monitor.peer_incarnation(host)
+        key = (host, inc)
+        if key in self._claimed:
+            return []
+        self._claimed.add(key)
+        return self.peer_queue(host)

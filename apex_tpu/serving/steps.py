@@ -1,0 +1,245 @@
+"""AOT-lowered prefill + decode programs over the paged arena.
+
+Two programs, both compiled AT ENGINE BUILD (``jax.jit(...).lower()
+.compile()`` — the pjit AOT surface), so the serve loop never traces:
+
+**Prefill** (one program per prompt-length shape bucket): run the full
+causal forward over one padded prompt through the flash-attention
+kernels, scatter the prompt's K/V into the slot's pages, and return
+the first generated token.  Buckets are multiples of ``page_size``;
+the admission path picks the smallest bucket that fits, so a new
+prompt length is a table lookup, never a compile.
+
+**Decode window** (one program): ``window`` continuously-batched
+greedy decode steps over EVERY slot inside one ``lax.fori_loop`` —
+gather each slot's pages, one dense single-query attention per layer,
+append the token's K/V back into the arena, advance the slot-state
+carry.  Admission/eviction state (``seq_lens``, ``active``, ``done``,
+the per-window token ring) rides the carry as device-side slots: the
+host reads it back with ONE ``device_get`` per window (the
+``telemetry/ring.py`` pattern), never per token, and writes it only
+at admission/eviction events.  Inactive or finished slots stay in the
+batch with their writes steered into the arena's trash page —
+branch-free, so the program is one fixed shape regardless of load.
+
+Both programs DONATE the arena and the slot-state carry
+(``donate_argnums``), pinned as ``tf.aliasing_output`` in the lowered
+HLO by the ``serving.decode_step`` / ``serving.prefill_step``
+apexverify specs: KV never holds two live copies.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.serving.arena import ArenaSpec, KVArena
+from apex_tpu.serving.model import (DecoderConfig, decode_forward,
+                                    prefill_forward)
+
+
+class DecodeState(NamedTuple):
+    """The donated decode carry: arenas + device-side slot state."""
+    k: jax.Array            # (P+1, psz, L, KV, D)
+    v: jax.Array
+    page_table: jax.Array   # (B, pps) i32
+    seq_lens: jax.Array     # (B,) i32  — tokens currently CACHED
+    active: jax.Array       # (B,) i32  — slot occupied
+    last_token: jax.Array   # (B,) i32  — token at position seq_lens
+    budget: jax.Array       # (B,) i32  — tokens still allowed out
+    out_tokens: jax.Array   # (B, W) i32 — this window's emissions
+    n_out: jax.Array        # (B,) i32  — emissions this window
+    done: jax.Array         # (B,) i32  — EOS / budget exhausted
+
+
+def init_state(arena: KVArena, window: int) -> DecodeState:
+    s = arena.spec
+    zi = jnp.zeros((s.max_slots,), jnp.int32)
+    return DecodeState(
+        k=arena.k, v=arena.v, page_table=arena.page_table,
+        seq_lens=zi, active=zi, last_token=zi, budget=zi,
+        out_tokens=jnp.full((s.max_slots, int(window)), -1, jnp.int32),
+        n_out=zi, done=zi)
+
+
+# ---------------------------------------------------------------------
+# the pure step functions (what the specs trace)
+# ---------------------------------------------------------------------
+
+def decode_one(params, cfg: DecoderConfig, spec: ArenaSpec,
+               state: DecodeState, col) -> DecodeState:
+    """One continuously-batched greedy decode step (module docstring).
+    ``col``: which window column this step's emissions land in."""
+    s = spec
+    b, ctx = s.max_slots, s.slot_tokens
+    live = (state.active == 1) & (state.done == 0) \
+        & (state.seq_lens < ctx)
+    pos = jnp.clip(state.seq_lens, 0, ctx - 1)
+    # page gather: one contiguous read per page, reshaped back into
+    # each slot's linear context
+    kk = state.k[state.page_table]         # (B, pps, psz, L, KV, D)
+    vv = state.v[state.page_table]
+    kk = kk.reshape(b, ctx, s.n_layers, s.n_kv_heads, s.head_dim)
+    vv = vv.reshape(b, ctx, s.n_layers, s.n_kv_heads, s.head_dim)
+    k_ctx = jnp.moveaxis(kk, 2, 0)         # (L, B, C, KV, D)
+    v_ctx = jnp.moveaxis(vv, 2, 0)
+    visible = jnp.arange(ctx)[None, :] <= pos[:, None]
+    logits, k_new, v_new = decode_forward(
+        params, cfg, state.last_token, pos, k_ctx, v_ctx, visible)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # append the CURRENT token's K/V at (page, offset); dead slots
+    # write into the trash page (branch-free masking)
+    page = jnp.take_along_axis(
+        state.page_table,
+        jnp.clip(pos // s.page_size, 0, s.pages_per_slot - 1)[:, None],
+        axis=1)[:, 0]
+    page = jnp.where(live, page, s.trash_page)
+    off = pos % s.page_size
+    k = state.k.at[page, off].set(
+        jnp.moveaxis(k_new, 0, 1).astype(state.k.dtype))
+    v = state.v.at[page, off].set(
+        jnp.moveaxis(v_new, 0, 1).astype(state.v.dtype))
+    emitted = live.astype(jnp.int32)
+    new_budget = state.budget - emitted
+    finished = live & ((nxt == cfg.eos_token) | (new_budget <= 0))
+    return DecodeState(
+        k=k, v=v, page_table=state.page_table,
+        seq_lens=state.seq_lens + emitted,
+        active=state.active,
+        last_token=jnp.where(live, nxt, state.last_token),
+        budget=new_budget,
+        out_tokens=jax.lax.dynamic_update_slice(
+            state.out_tokens,
+            jnp.where(live, nxt, -1)[:, None], (0, col)),
+        n_out=state.n_out + emitted,
+        done=state.done | finished.astype(jnp.int32))
+
+
+def decode_window_fn(cfg: DecoderConfig, spec: ArenaSpec, window: int):
+    """The jittable window program: reset the emission ring, run
+    ``window`` steps in one ``fori_loop``."""
+    def run(params, state: DecodeState) -> DecodeState:
+        state = state._replace(
+            out_tokens=jnp.full_like(state.out_tokens, -1),
+            n_out=jnp.zeros_like(state.n_out))
+        return jax.lax.fori_loop(
+            0, int(window),
+            lambda i, st: decode_one(params, cfg, spec, st, i), state)
+    return run
+
+
+def prefill_fn(cfg: DecoderConfig, spec: ArenaSpec, bucket: int):
+    """The jittable per-bucket prefill program: forward the padded
+    prompt, scatter its K/V pages, return the first greedy token."""
+    if bucket % spec.page_size:
+        raise ValueError(f"prefill bucket {bucket} must be a multiple "
+                         f"of page_size {spec.page_size}")
+    n_pg = bucket // spec.page_size
+
+    def run(params, k, v, pages, tokens, length):
+        logits, kp, vp = prefill_forward(params, cfg, tokens[None],
+                                         length[None])
+        first = jnp.argmax(logits[0]).astype(jnp.int32)
+        def paged(t):                       # (L,1,S,KV,D) -> pages
+            t = jnp.transpose(t[:, 0], (1, 0, 2, 3))
+            return t.reshape(n_pg, spec.page_size, spec.n_layers,
+                             spec.n_kv_heads, spec.head_dim)
+        k = k.at[pages].set(paged(kp).astype(k.dtype))
+        v = v.at[pages].set(paged(vp).astype(v.dtype))
+        return k, v, first
+    return run
+
+
+# ---------------------------------------------------------------------
+# AOT compilation
+# ---------------------------------------------------------------------
+
+def _sds(x):
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(jnp.shape(l),
+                                       jnp.asarray(l).dtype), x)
+
+
+class ServingPrograms:
+    """The engine's compiled program set: ONE decode-window executable
+    plus one prefill executable per shape bucket, all lowered and
+    compiled at build time (``serve()`` never traces)."""
+
+    def __init__(self, params, cfg: DecoderConfig, arena: KVArena,
+                 window: int,
+                 prefill_buckets: Optional[Sequence[int]] = None):
+        spec = arena.spec
+        self.cfg = cfg
+        self.spec = spec
+        self.window = int(window)
+        if prefill_buckets is None:
+            # powers-of-two multiples of page_size up to slot capacity
+            prefill_buckets, b = [], spec.page_size
+            while b < spec.slot_tokens:
+                prefill_buckets.append(b)
+                b *= 2
+            prefill_buckets.append(spec.slot_tokens)
+        self.prefill_buckets: Tuple[int, ...] = tuple(
+            sorted(set(int(b) for b in prefill_buckets)))
+        for bk in self.prefill_buckets:
+            if bk % spec.page_size or bk > spec.slot_tokens:
+                raise ValueError(
+                    f"prefill bucket {bk}: must be a multiple of "
+                    f"page_size ({spec.page_size}) within slot "
+                    f"capacity ({spec.slot_tokens})")
+        p_sds = _sds(params)
+        state_sds = _sds(init_state(arena, self.window))
+        # decode: donate the whole carry (arg 1) — arenas + slot state
+        self.decode = jax.jit(
+            decode_window_fn(cfg, spec, self.window),
+            donate_argnums=(1,)).lower(p_sds, state_sds).compile()
+        self.prefill: Dict[int, object] = {}
+        for bk in self.prefill_buckets:
+            fn = prefill_fn(cfg, spec, bk)
+            # one AOT compile per shape bucket, ONCE at engine build —
+            # this loop IS the ahead-of-time surface, not a hot path
+            # apexlint: disable-next=APX302
+            self.prefill[bk] = jax.jit(
+                fn, donate_argnums=(1, 2)).lower(
+                p_sds, _sds(arena.k), _sds(arena.v),
+                jax.ShapeDtypeStruct((bk // spec.page_size,),
+                                     jnp.int32),
+                jax.ShapeDtypeStruct((bk,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32)).compile()
+
+    def bucket_for(self, prompt_len: int) -> Optional[int]:
+        for bk in self.prefill_buckets:
+            if prompt_len <= bk:
+                return bk
+        return None
+
+
+# ---- compiled-program cache -------------------------------------------------
+# ServingPrograms is stateless (executables + static geometry), so two
+# engines over the SAME params object and geometry can share one
+# program set — repeated engine builds (tests, respawned replicas)
+# skip the AOT compiles.  Keyed on params IDENTITY deliberately: value
+# equality over a whole pytree costs more than the compile it saves,
+# and a params reload is exactly the case that must recompile.
+_PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_MAX = 8
+
+
+def cached_programs(params, cfg: DecoderConfig, arena: KVArena,
+                    window: int,
+                    prefill_buckets: Optional[Sequence[int]] = None
+                    ) -> ServingPrograms:
+    """Memoized :class:`ServingPrograms` (module comment above)."""
+    key = (id(params), cfg, arena.spec, str(arena.dtype), int(window),
+           tuple(prefill_buckets) if prefill_buckets is not None
+           else None)
+    progs = _PROGRAM_CACHE.get(key)
+    if progs is None:
+        if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.clear()
+        progs = ServingPrograms(params, cfg, arena, window=window,
+                                prefill_buckets=prefill_buckets)
+        _PROGRAM_CACHE[key] = progs
+    return progs

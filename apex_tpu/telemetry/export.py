@@ -247,6 +247,11 @@ class MetricsServer(Emitter):
                             f"autoscale_{r.get('action', 'stay')}")
                     else:
                         self._bump(f"fleet_{ev}")
+                elif kind == "serving":
+                    # decode-engine events (shed / eviction / hung
+                    # decode / drain / failover) count by kind like
+                    # the fleet's, and thread the same incident gauge
+                    self._bump(f"serving_{r.get('event', 'unknown')}")
                 else:
                     continue
                 iid = r.get("incident_id")

@@ -51,7 +51,7 @@ from apex_tpu.telemetry.cli import (JSONL_NAME, _fmt_cell as _fmt,
 
 # record kinds that are timeline EVENTS (everything else is steps /
 # cumulative gauges / clock sync points)
-EVENT_KINDS = ("anomaly", "watchdog", "fleet", "incident")
+EVENT_KINDS = ("anomaly", "watchdog", "fleet", "incident", "serving")
 _CLOSERS = ("replay_complete", "incident_resolved")
 
 

@@ -720,6 +720,19 @@ def run_child(backend):
 
         print(_dump(out), flush=True)
         try:
+            # serving engine end-to-end: grounds the
+            # extra.decode_tokens_per_sec / extra.serving_p99_ms
+            # perf-budget rows (graded no-data until this lands)
+            from apex_tpu.serving.bench import bench_serving
+            out["extra"].update(bench_serving(
+                n_requests=16, n_layers=4, hidden=256, n_heads=8,
+                max_slots=8, page_size=16, pages_per_slot=8,
+                window=16, max_new_tokens=64))
+        except Exception as e:
+            out["extra"]["serving_error"] = repr(e)[:200]
+
+        print(_dump(out), flush=True)
+        try:
             # BERT-L at b32: the throughput/MFU story (b8 ran at MFU
             # 0.34; larger batches amortize fixed per-step work)
             r32 = _bert_lamb_one_batch(jax, jnp, True, 32, 512, 20,

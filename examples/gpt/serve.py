@@ -1,0 +1,162 @@
+"""Minimal apex_tpu.serving engine demo — the serving acceptance flow.
+
+A tiny GPT-style decoder behind the AOT-compiled, continuously-batched
+:class:`~apex_tpu.serving.Engine`: a batch of requests streams through
+the bounded admission queue, prefills into the paged KV arena through
+per-bucket compiled programs, and decodes in fixed-shape windows with
+zero per-token host syncs.  The request-level robustness story is the
+point:
+
+- ``--port PORT`` serves LIVE ``/metrics`` (Prometheus text) +
+  ``/healthz`` while requests decode — scrape it mid-run and watch
+  ``apex_tpu_serving_*`` gauges (queue depth, tokens/sec, p50/p99
+  token latency, evictions) move;
+- ``--inject-hung-decode-at W`` wedges the decode dispatch of serve
+  window W: the deadline-armed runner converts the hang into a typed
+  ``DecodeDeadlineExceeded``, the engine evicts ONLY the suspect
+  request, the survivors continue from their KV pages bit-exactly,
+  and the demo then re-submits the evicted request (detect -> evict
+  -> re-admit) — the whole chain lands under one incident id,
+  rendered afterwards by ``python -m apex_tpu.telemetry timeline
+  DIR`` as a single closed incident.
+
+Run it::
+
+    python examples/gpt/serve.py --requests 6 \
+        --telemetry-dir /tmp/serve_run --port 0 \
+        --inject-hung-decode-at 3
+"""
+
+import argparse
+import os
+
+import jax
+
+import apex_tpu
+from apex_tpu import serving, telemetry
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=6,
+                   help="synthetic request count")
+    p.add_argument("--max-new-tokens", type=int, default=12)
+    p.add_argument("--telemetry-dir",
+                   default=os.environ.get("APEX_TPU_TELEMETRY_DIR")
+                   or None,
+                   help="record serving telemetry (events + counters) "
+                        "under this directory; inspect with "
+                        "`python -m apex_tpu.telemetry timeline DIR`")
+    p.add_argument("--port", type=int, default=None, metavar="PORT",
+                   help="serve live /metrics + /healthz on this port "
+                        "while decoding (0 = ephemeral; needs "
+                        "--telemetry-dir)")
+    p.add_argument("--inject-hung-decode-at", type=int, default=None,
+                   metavar="W",
+                   help="chaos: wedge the decode dispatch of serve "
+                        "window W (detect -> evict suspect -> "
+                        "survivors continue -> re-admit)")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="decode-window deadline (default 30, or 0.2 "
+                        "when injecting the hang)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from apex_tpu.platform import select_platform
+    select_platform()          # honor APEX_TPU_PLATFORM (e.g. cpu)
+    print(f"apex_tpu {apex_tpu.__version__} serving on "
+          f"{jax.default_backend()}")
+
+    cfg = serving.DecoderConfig(vocab_size=128, hidden=32, n_layers=2,
+                                n_heads=2, n_kv_heads=2, ffn=64,
+                                max_seq=64, eos_token=1)
+    params = serving.init_params(jax.random.key(0), cfg)
+
+    tel = telemetry.Telemetry(args.telemetry_dir, window=8,
+                              retrace=False) \
+        if args.telemetry_dir else None
+    metrics_srv = None
+    if args.port is not None:
+        if tel is None:
+            raise SystemExit("--port needs --telemetry-dir (the "
+                             "exporter republishes the telemetry "
+                             "session's flushes)")
+        metrics_srv = telemetry.MetricsServer(telemetry=tel,
+                                              port=args.port)
+        print(f"serving live metrics at {metrics_srv.url}/metrics")
+
+    deadline = args.deadline_s if args.deadline_s is not None else (
+        0.2 if args.inject_hung_decode_at is not None else 30.0)
+    eng = serving.Engine(params, cfg, page_size=4, n_pages=32,
+                         max_slots=2, pages_per_slot=8, window=4,
+                         telemetry=tel, decode_deadline_s=deadline,
+                         flush_every=1)
+    print(f"engine: {eng.arena.describe()}  "
+          f"prefill buckets {eng.programs.prefill_buckets}  "
+          f"decode window {eng.window}")
+
+    injector = None
+    if args.inject_hung_decode_at is not None:
+        from apex_tpu.resilience.faults import FaultInjector, FaultSpec
+        injector = FaultInjector([FaultSpec(
+            "hung_decode", at_step=args.inject_hung_decode_at,
+            delay_s=max(0.5, 3 * deadline))]).install()
+
+    for i in range(args.requests):
+        eng.submit(serving.Request(
+            id=f"req-{i}", prompt=[2 + (i % 7), 3 + (i % 5), 4],
+            max_new_tokens=args.max_new_tokens))
+    results = eng.serve()
+
+    evicted = [r for r in results.values()
+               if r.verdict == serving.EVICTED]
+    for r in evicted:
+        # detect -> evict -> RE-ADMIT: the evicted request retries and
+        # completes once the wedge has cleared
+        rid = f"{r.id}-retry"
+        print(f"re-admitting evicted request {r.id} as {rid} "
+              f"(incident {r.incident_id})")
+        eng.submit(serving.Request(
+            id=rid, prompt=[2, 3, 4],
+            max_new_tokens=args.max_new_tokens))
+    if evicted:
+        results = eng.serve()
+
+    if injector is not None:
+        injector.uninstall()
+
+    counts = {}
+    for r in results.values():
+        counts[r.verdict] = counts.get(r.verdict, 0) + 1
+    tokens = sum(len(r.tokens) for r in results.values())
+    print(f"served {len(results)} request(s): "
+          + ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+          + f", {tokens} tokens")
+    for rid in sorted(results):
+        r = results[rid]
+        inc = f"  incident={r.incident_id}" if r.incident_id else ""
+        print(f"  {rid}: {r.verdict} "
+              f"({len(r.tokens)} tokens){inc}")
+    if eng.incidents.history:
+        state = ("closed" if eng.incidents.current is None
+                 else "OPEN")
+        print(f"incident chain: {eng.incidents.history[0]} [{state}]")
+
+    eng.close()
+    if tel is not None:
+        tel.close()                  # also stops the metrics server
+        if metrics_srv is not None:
+            metrics_srv.close()      # idempotent
+        print(f"telemetry written to {args.telemetry_dir} — inspect "
+              f"with: python -m apex_tpu.telemetry timeline "
+              f"{args.telemetry_dir}")
+
+    completed = counts.get(serving.COMPLETED, 0)
+    assert completed >= args.requests - 1, counts
+    print(f"OK: {completed} completed")
+
+
+if __name__ == "__main__":
+    main()

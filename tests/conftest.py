@@ -142,6 +142,7 @@ def _neutral_dispatch(monkeypatch):
     monkeypatch.setattr(_dispatch, "_PIPELINE", {})
     monkeypatch.setattr(_dispatch, "_FP8", {})
     monkeypatch.setattr(_dispatch, "_QUANT", {})
+    monkeypatch.setattr(_dispatch, "_SERVING", {})
     monkeypatch.setattr(_dispatch, "_INSTALLED", None)
     monkeypatch.delenv("APEX_TPU_PREFER_PALLAS", raising=False)
     monkeypatch.delenv("APEX_TPU_PREFER_XLA", raising=False)

@@ -73,6 +73,10 @@ SUITES = {
     # apexverify: jaxpr-level invariant specs over the public jitted
     # entry points + the findings-baseline diff gate (tools/check.sh)
     "run_lint_semantic": ["tests/test_lint_semantic.py"],
+    # the serving path: paged KV arena, AOT prefill/decode programs,
+    # the continuous-batching engine and its chaos matrix (hung
+    # decode, shed, drain, replica failover)
+    "run_serving": ["tests/test_serving.py"],
     # run-time training telemetry (metric ring, emitters, spans,
     # retrace counter) + the pyprof nvtx/prof satellites + the live
     # /metrics exporter

@@ -254,6 +254,84 @@ def test_train_toy_revive_host_admits_and_grows(tmp_path, capsys):
     assert "fleet/mesh_grows" in out          # counters table row
 
 
+def test_serve_gpt_chaos_scrape_and_incident_timeline(tmp_path,
+                                                      capsys):
+    """The serving acceptance flow: the engine demo decodes with
+    --port while a background scraper polls /metrics, and
+    --inject-hung-decode-at drives detect -> evict -> re-admit.  A
+    mid-run scrape must carry the ``serving_*`` gauges, and the whole
+    failover chain (hung_decode -> eviction -> resolution) must share
+    ONE incident id rendered by ``telemetry timeline --json`` as a
+    single closed incident."""
+    import json as _json
+    import socket
+    import threading
+    import urllib.request
+
+    tel = str(tmp_path / "telemetry")
+    with socket.socket() as s:                # pick a free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    samples, stop = [], threading.Event()
+
+    def scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1) as r:
+                    body = r.read().decode()
+                g = {}
+                for line in body.splitlines():
+                    if not line.startswith("#") and " " in line \
+                            and "{" not in line:
+                        n, v = line.rsplit(" ", 1)
+                        g[n] = float(v)
+                samples.append(g)
+            except OSError:
+                pass                          # server not up/gone yet
+            stop.wait(0.005)
+
+    t = threading.Thread(target=scrape, daemon=True)
+    t.start()
+    try:
+        _run("examples/gpt/serve.py",
+             ["--requests", "5", "--max-new-tokens", "10",
+              "--telemetry-dir", tel, "--port", str(port),
+              "--inject-hung-decode-at", "3"])
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    out = capsys.readouterr().out
+    assert f"serving live metrics at http://127.0.0.1:{port}" in out
+    assert "re-admitting evicted request" in out
+    assert "incident chain: inc-001-hung_decode-e0 [closed]" in out
+    assert "OK:" in out
+    assert len(samples) > 2                   # genuinely scraped live
+    # a MID-RUN scrape carries the serving gauges
+    mid = [g for g in samples
+           if "apex_tpu_serving_queue_depth" in g]
+    assert mid, "no scrape saw serving gauges"
+    last = samples[-1]
+    assert last.get("apex_tpu_serving_completed_total", 0) >= 4
+    assert last.get("apex_tpu_serving_evictions_total", 0) >= 1
+    assert last.get(
+        "apex_tpu_serving_hung_decode_events_total", 0) >= 1
+    assert "apex_tpu_serving_p99_token_ms" in last
+    # the failover chain shares ONE incident id end to end
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["timeline", tel, "--json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert len(doc["incidents"]) == 1
+    inc = doc["incidents"][0]
+    assert inc["incident_id"] == "inc-001-hung_decode-e0"
+    assert inc["closed"]
+    assert inc["opened_by"] == "serving:hung_decode"
+    evs = [e.get("event") for e in inc["events"]]
+    assert "hung_decode" in evs and "request_evicted" in evs \
+        and "incident_resolved" in evs
+
+
 def test_imagenet_preempt_and_resume(tmp_path, capsys):
     """The imagenet example's save path rides the same resilience
     manager: --checkpoint-dir rotates bucket-native checkpoints and a

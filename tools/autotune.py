@@ -162,6 +162,17 @@ def validate_table(doc, *, per_topology: bool, path: str = "") -> list:
         err(f"quantization.int8_dynamic must be a JSON boolean, "
             f"found {quant['int8_dynamic']!r}")
 
+    srv = doc.get("serving", {})
+    if not isinstance(srv, dict):
+        err("serving must be an object")
+    else:
+        for k in ("page_size", "decode_window"):
+            if k in srv and (not isinstance(srv[k], int)
+                             or isinstance(srv[k], bool)
+                             or srv[k] <= 0):
+                err(f"serving.{k} must be a positive integer, "
+                    f"found {srv[k]!r}")
+
     topo = doc.get("topology")
     if topo is not None:
         if not isinstance(topo, dict) or not isinstance(
@@ -280,6 +291,10 @@ def smoke_config() -> dict:
         "fp8_interval_candidates": [1, 4],
         "fp8_layers": 4, "fp8_hidden": 32, "fp8_batch": 8,
         "int8_mkn": (64, 64, 64),
+        "serving_page_candidates": [4, 8],
+        "serving_window_candidates": [4, 8],
+        "serving_layers": 2, "serving_hidden": 32,
+        "serving_heads": 2, "serving_slots": 2, "serving_ctx": 16,
         "device_check_families": ["multi_tensor"],
     }
 
@@ -305,6 +320,10 @@ def full_config() -> dict:
         "fp8_interval_candidates": [1, 4, 16],
         "fp8_layers": 24, "fp8_hidden": 512, "fp8_batch": 64,
         "int8_mkn": (4096, 4096, 4096),
+        "serving_page_candidates": [8, 16, 32, 64],
+        "serving_window_candidates": [8, 16, 32],
+        "serving_layers": 8, "serving_hidden": 512,
+        "serving_heads": 8, "serving_slots": 16, "serving_ctx": 1024,
         "device_check_families": ["multi_tensor", "welford",
                                   "layer_norm", "pipeline", "fp8"],
     }
@@ -798,16 +817,72 @@ def sweep_quantization(cfg, noise_pct: float) -> list:
     return [rec]
 
 
+def sweep_serving_geometry(cfg, noise_pct: float) -> list:
+    """Serving decode shape-bucket geometry: (page_size x decode
+    window) through one compiled decode window at mid-generation
+    occupancy, normalized to ms per emitted token (a bigger window
+    amortizes dispatch but holds admission longer — the sweep only
+    weighs device cost; the engine's latency SLO stays a caller
+    knob).  (8, 8) is the design default; a candidate must beat it
+    beyond the noise floor before the table steers
+    ``serving.Engine``'s defaults via ``_dispatch.serving_pref``."""
+    import itertools
+
+    import jax
+
+    from apex_tpu.serving.bench import bench_decode_step
+
+    default = (8, 8)
+    cands = sorted(set(itertools.product(
+        cfg["serving_page_candidates"],
+        cfg["serving_window_candidates"])) | {default})
+    times = {}
+    for page, window in cands:
+        r = bench_decode_step(
+            n_layers=cfg["serving_layers"],
+            hidden=cfg["serving_hidden"],
+            n_heads=cfg["serving_heads"],
+            max_slots=cfg["serving_slots"], page_size=page,
+            pages_per_slot=max(1, cfg["serving_ctx"] // page),
+            window=window, iters=cfg["iters"], reps=cfg["reps"])
+        times[(page, window)] = (r["decode_step_paged_ms"]
+                                 / (cfg["serving_slots"] * window))
+    winner = min(times, key=times.get)
+    rec = {"space": "serving.decode_geometry", "family": "serving",
+           "shape": f"b{cfg['serving_slots']}ctx{cfg['serving_ctx']}"
+                    f"x{cfg['serving_layers']}L",
+           "dtype": "f32", "noise_floor_pct": noise_pct,
+           "candidates_ms_per_token": {
+               f"p{p}/w{w}": round(v, 5)
+               for (p, w), v in sorted(times.items())}}
+    if winner != default and times[winner] \
+            < times[default] * (1.0 - noise_pct / 100.0):
+        rec["decision"] = {"serving": {"page_size": winner[0],
+                                       "decode_window": winner[1]}}
+    return [rec]
+
+
 def measure_budget_rows(cfg) -> dict:
     """Sweep measurements that ground perf_budget rows (dotted metric
     path -> value).  grad_accum_n8_speedup comes from the same flat-vs-
     per-leaf accumulation legs bench.py reports, at this config's
-    scale."""
+    scale; the serving rows come from the same end-to-end engine
+    bench.py's serving extra runs — autotune --full is the designated
+    restamp vehicle for both (they grade no-data until then)."""
     from apex_tpu.optimizers.bucketing_bench import bench_grad_accum
+    from apex_tpu.serving.bench import bench_serving
     r = bench_grad_accum(**cfg["accum"])
     out = {}
     if "grad_accum_n8_speedup" in r:
         out["extra.grad_accum_n8_speedup"] = r["grad_accum_n8_speedup"]
+    s = bench_serving(
+        n_requests=2 * cfg["serving_slots"],
+        n_layers=cfg["serving_layers"], hidden=cfg["serving_hidden"],
+        n_heads=cfg["serving_heads"], max_slots=cfg["serving_slots"],
+        page_size=8, pages_per_slot=max(1, cfg["serving_ctx"] // 8),
+        window=8)
+    out["extra.decode_tokens_per_sec"] = s["decode_tokens_per_sec"]
+    out["extra.serving_p99_ms"] = s["serving_p99_ms"]
     return out
 
 
@@ -820,7 +895,7 @@ def build_table(records, topology: dict, backend: str,
     """Fold sweep records into one schema-versioned per-topology prefs
     doc (the layout ops/_dispatch.py selects by runtime topology)."""
     prefer, caps, pipeline, speedups = {}, {}, {}, {}
-    fp8, quant = {}, {}
+    fp8, quant, srv = {}, {}, {}
     for rec in records:
         if rec.get("space") == "routing" and rec.get("speedup") \
                 is not None:
@@ -834,6 +909,7 @@ def build_table(records, topology: dict, backend: str,
         pipeline.update(dec.get("pipeline", {}))
         fp8.update(dec.get("fp8", {}))
         quant.update(dec.get("quantization", {}))
+        srv.update(dec.get("serving", {}))
     return {
         "schema": SCHEMA_VERSION,
         "methodology": "amortized",
@@ -848,6 +924,7 @@ def build_table(records, topology: dict, backend: str,
         "pipeline": pipeline,
         "fp8": fp8,
         "quantization": quant,
+        "serving": srv,
         "speedups": {k: sorted(v) for k, v in speedups.items()},
         "sweep": {"records": records},
     }
@@ -889,6 +966,10 @@ def demonstrate_decision_changes(doc) -> list:
             out["fp8:interval"] = _dispatch.fp8_pref("interval")
             out["quantization:int8_dynamic"] = \
                 _dispatch.quantization_pref("int8_dynamic", False)
+            out["serving:page_size"] = _dispatch.serving_pref(
+                "page_size")
+            out["serving:decode_window"] = _dispatch.serving_pref(
+                "decode_window")
             return out
 
         before = snapshot()
@@ -939,6 +1020,7 @@ def run_sweep(cfg, out_dir: str, budget_path: str,
         records += sweep_reduce_decompose(cfg, noise_pct)
         records += sweep_fp8_cadence(cfg, noise_pct, out_dir)
         records += sweep_quantization(cfg, noise_pct)
+        records += sweep_serving_geometry(cfg, noise_pct)
         budget_rows = measure_budget_rows(cfg)
     finally:
         if prev_pin is None:

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # The one-command correctness gate: AST tier (incl. APX204
-# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 22
+# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 24
 # specs) + baseline diff over the package, then the relaxed profile
 # over tests/, examples/ and tools/ (APX101/102 exempt inside test
 # bodies — a test syncing to assert a device value is the point of the
@@ -8,10 +8,13 @@
 # fleet.instrumented_step, fleet.autoscaled_step and
 # telemetry.exported_step specs (a watchdog-attached / fleet-monitored
 # / autoscale-controlled / live-exported flat-AMP step must contain
-# zero transfer/callback primitives) and the amp.fp8_step spec (EXACT
+# zero transfer/callback primitives), the amp.fp8_step spec (EXACT
 # fp8 quantize-convert counts — precision casts cannot silently
 # multiply — with the packed fp8 scale state donated/aliased like
-# every other optimizer slot).
+# every other optimizer slot), and the serving.decode_step /
+# serving.prefill_step specs (the AOT decode window lowers with zero
+# host traffic and exact KV-arena donation alias counts; prefill runs
+# one flash pallas_call per decoder layer).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #
@@ -24,6 +27,16 @@ cd "$(dirname "$0")/.."
 
 echo "== apexlint + apexverify: apex_tpu/ (baseline-gated)"
 python -m apex_tpu.lint --semantic apex_tpu/
+
+echo "== apexverify spec count: exactly 24 registered"
+# the spec-count gate: a PR that deletes or fails to register an
+# invariant spec must fail HERE, not silently verify less
+python -c "
+from apex_tpu.lint import semantic
+n = len(semantic.all_specs())
+assert n == 24, f'expected 24 apexverify specs, found {n}'
+print(f'{n} specs registered')
+"
 
 echo "== apexlint relaxed profile: tests/ examples/ tools/"
 python -m apex_tpu.lint --relax-test-bodies tests/ examples/ tools/

@@ -334,6 +334,28 @@ def main():
         "oracle_ms": rsu["fp8_scale_per_leaf_ms"],
         "speedup": rsu.get("fp8_scale_update_speedup")})
 
+    # serving decode step: the paged-arena decode window vs the
+    # contiguous-cache oracle ("kernel" = paged, "oracle" = dense —
+    # near-1.0 IS the pass condition: the flat-arena page indirection
+    # must not tax the decode hot path; tokens/sec rides along for
+    # the perf-budget serving rows)
+    from apex_tpu.serving.bench import bench_decode_step
+    rd = bench_decode_step(n_layers=4, hidden=256, n_heads=8,
+                           max_slots=8, page_size=16,
+                           pages_per_slot=8, window=16)
+    rd["backend"] = backend
+    print(json.dumps(rd), flush=True)
+    rows.append({
+        "kernel": "decode_step",
+        "shape": (f"b{rd['decode_slots']}w{rd['decode_window']}"
+                  f"ctx{rd['decode_ctx']}p{rd['decode_page_size']}"),
+        "dtype": "f32",
+        "kernel_ms": rd["decode_step_paged_ms"],
+        "oracle_ms": rd["decode_step_dense_ms"],
+        "speedup": (round(rd["decode_step_dense_ms"]
+                          / rd["decode_step_paged_ms"], 2)
+                    if rd["decode_step_paged_ms"] else None)})
+
     # flash geometry sweep: find the best sequence-block cap per shape
     # (re-jit per cap — the env knob is read at trace time), then
     # record the per-head-dim winner in dispatch_prefs.json so the
