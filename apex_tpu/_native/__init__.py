@@ -72,7 +72,11 @@ def lib() -> Optional[ctypes.CDLL]:
         return _lib
     with _lock:
         if _lib is None and not _tried:
-            _tried = True
+            # double-checked locking: writes happen under _lock;
+            # the unlocked fast-path READ above is a GIL-atomic
+            # reference check whose worst case is blocking on
+            # _lock like everyone else
+            _tried = True   # apexlint: disable=APX1001
             so = _build()
             if so:
                 try:
@@ -90,7 +94,7 @@ def lib() -> Optional[ctypes.CDLL]:
                     l.apex_c_l2norm_sq_f32.argtypes = [
                         ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
                         ctypes.c_int64]
-                    _lib = l
+                    _lib = l   # apexlint: disable=APX1001
                 except OSError:
                     _lib = None
     return _lib

@@ -102,7 +102,11 @@ def set_io(io: Optional[CheckpointIO]) -> CheckpointIO:
     returns the previous one so callers can restore it."""
     global _io
     prev = _io
-    _io = io if io is not None else CheckpointIO()
+    # install/uninstall run on the main thread before a run arms its
+    # workers (FaultInjector.install precedes run_elastic) or between
+    # joined saves; the async writer only ever READS the reference,
+    # which is a GIL-atomic load
+    _io = io if io is not None else CheckpointIO()   # apexlint: disable=APX1001
     return prev
 
 

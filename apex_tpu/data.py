@@ -80,7 +80,10 @@ class DevicePrefetcher:
                 if not self._put_or_stop(self._put_device(batch)):
                     return
         except BaseException as e:  # surfaced on the consumer side
-            self._err = e
+            # the sentinel put below is the release barrier: __next__
+            # reads _err only AFTER q.get() returns the sentinel, and
+            # queue.Queue's internal lock orders the two
+            self._err = e   # apexlint: disable=APX1001
         finally:
             self._put_or_stop(_SENTINEL)
 

@@ -11,6 +11,15 @@ tiers' findings pass through the findings baseline
 (``--baseline``/``--write-baseline``, default
 apex_tpu/lint/semantic/baseline.json) so a new rule family can land
 without blocking while CI gates on the diff.
+
+``--concurrency`` additionally runs apexrace (the concurrency tier):
+whole-project thread-root discovery, shared-mutable-state and
+lock-domain analysis (APX1001-APX1005).  Its findings diff against the
+shipped apex_tpu/lint/concurrency/baseline.json; an explicit
+``--baseline FILE`` overrides BOTH tiers' defaults.  With
+``--write-baseline``, exactly one tier flag (or an explicit file) must
+name the target — anything ambiguous exits 2 rather than guessing
+which shipped baseline to overwrite.
 """
 
 from __future__ import annotations
@@ -50,6 +59,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--list-specs", action="store_true",
                    help="print the semantic invariant-spec registry "
                         "and exit")
+    p.add_argument("--concurrency", action="store_true",
+                   help="also run apexrace: interprocedural thread-"
+                        "root / shared-state / lock-domain analysis "
+                        "(APX1001-APX1005) after the AST tier")
     p.add_argument("--baseline", default=None, metavar="FILE",
                    help="findings baseline JSON (default: the shipped "
                         "apex_tpu/lint/semantic/baseline.json when "
@@ -91,6 +104,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     known = {rid.upper() for rid, _, _ in rule_catalog()}
     known |= {"APX901", "APX902"}   # semantic tier (apexverify)
+    from apex_tpu.lint import concurrency as _conc
+    known |= {i.upper() for i in _conc.rule_ids()}   # apexrace
     for flag, ids in (("--select", _csv(args.select)),
                       ("--ignore", _csv(args.ignore))):
         bad = {i.upper() for i in ids or ()} - known
@@ -127,33 +142,81 @@ def main(argv: Optional[List[str]] = None) -> int:
                           key=lambda f: (f.path, f.line, f.col,
                                          f.rule_id))
 
-    baseline_path = args.baseline
-    if baseline_path is None and args.semantic:
-        from apex_tpu.lint.semantic.baseline import DEFAULT_BASELINE
-        baseline_path = DEFAULT_BASELINE
+    if args.concurrency:
+        conc_findings, _ = _conc.run_concurrency(
+            files, select=_csv(args.select), ignore=_csv(args.ignore))
+        findings = sorted(findings + conc_findings,
+                          key=lambda f: (f.path, f.line, f.col,
+                                         f.rule_id))
+
+    from apex_tpu.lint.semantic import baseline as bl
 
     if args.write_baseline:
-        if baseline_path is None:
-            # never default here: an AST-only run would silently
-            # overwrite the SHIPPED package baseline
-            print("apexlint: --write-baseline requires --baseline FILE "
-                  "(or --semantic, which targets the shipped baseline)",
-                  file=sys.stderr)
+        if args.baseline is not None:
+            bl.save(args.baseline, findings)
+            print(f"apexlint: wrote {len(findings)} finding(s) to "
+                  f"baseline {args.baseline}")
+            return 0
+        if args.semantic and args.concurrency:
+            # two shipped baselines would both be candidate targets;
+            # refuse to guess which package file to overwrite
+            print("apexlint: --write-baseline with both --semantic "
+                  "and --concurrency requires an explicit "
+                  "--baseline FILE", file=sys.stderr)
             return 2
-        from apex_tpu.lint.semantic import baseline as bl
-        bl.save(baseline_path, findings)
-        print(f"apexlint: wrote {len(findings)} finding(s) to "
-              f"baseline {baseline_path}")
-        return 0
+        if args.semantic:
+            from apex_tpu.lint.semantic.baseline import DEFAULT_BASELINE
+            bl.save(DEFAULT_BASELINE, findings)
+            print(f"apexlint: wrote {len(findings)} finding(s) to "
+                  f"baseline {DEFAULT_BASELINE}")
+            return 0
+        if args.concurrency:
+            ids = _conc.rule_ids()
+            subset = [f for f in findings if f.rule_id in ids]
+            bl.save(_conc.DEFAULT_BASELINE, subset)
+            print(f"apexlint: wrote {len(subset)} finding(s) to "
+                  f"baseline {_conc.DEFAULT_BASELINE}")
+            return 0
+        # never default here: an AST-only run would silently
+        # overwrite a SHIPPED package baseline
+        print("apexlint: --write-baseline requires --baseline FILE "
+              "(or exactly one of --semantic/--concurrency, which "
+              "targets that tier's shipped baseline)", file=sys.stderr)
+        return 2
 
-    baselined: list = []
-    if baseline_path and os.path.exists(baseline_path):
-        from apex_tpu.lint.semantic import baseline as bl
-        findings, baselined, stale = bl.split(findings,
-                                              bl.load(baseline_path))
+    def _note_stale(stale):
         for key in sorted(stale):
             print(f"apexlint: note: stale baseline entry (already "
                   f"fixed): {key[0]} {key[1]}", file=sys.stderr)
+
+    baselined: list = []
+    if args.baseline is not None:
+        if os.path.exists(args.baseline):
+            findings, baselined, stale = bl.split(
+                findings, bl.load(args.baseline))
+            _note_stale(stale)
+    else:
+        # per-tier defaults: APX1xxx findings diff against the shipped
+        # concurrency baseline, everything else against the semantic
+        # one — each tier's debt lives in its own package file
+        if args.concurrency and os.path.exists(_conc.DEFAULT_BASELINE):
+            ids = _conc.rule_ids()
+            part = [f for f in findings if f.rule_id in ids]
+            findings = [f for f in findings if f.rule_id not in ids]
+            part, old, stale = bl.split(part,
+                                        bl.load(_conc.DEFAULT_BASELINE))
+            baselined.extend(old)
+            _note_stale(stale)
+            findings = sorted(findings + part,
+                              key=lambda f: (f.path, f.line, f.col,
+                                             f.rule_id))
+        if args.semantic:
+            from apex_tpu.lint.semantic.baseline import DEFAULT_BASELINE
+            if os.path.exists(DEFAULT_BASELINE):
+                findings, old, stale = bl.split(findings,
+                                                bl.load(DEFAULT_BASELINE))
+                baselined.extend(old)
+                _note_stale(stale)
 
     render = render_json if args.json else render_text
     print(render(findings, len(files), specs_checked=specs_checked,

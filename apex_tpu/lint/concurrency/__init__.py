@@ -1,0 +1,94 @@
+"""apexrace: the concurrency tier (host thread/signal-safety analysis).
+
+Third analysis tier next to the AST rules and apexverify: builds ONE
+whole-project model (``model.py``), discovers thread roots through the
+stdlib and the project's own registration seams (``roots.py``), infers
+shared mutable state and lock domains (``state.py``/``locks.py``), and
+runs the APX1001-APX1005 families (``rules.py``).  Same operational
+machinery as the other tiers: pragmas suppress, fixtures pair
+``bad_*``/``good_*``, the ``(path, rule, message)`` baseline makes the
+tier land non-blocking, and ``python -m apex_tpu.lint --concurrency``
+wires it into tools/check.sh.  docs/lint.md has the catalog.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Set, Tuple
+
+from apex_tpu.lint import engine
+from apex_tpu.lint.concurrency.model import Model, build_model
+from apex_tpu.lint.concurrency.rules import (ConcurrencyRule, all_rules)
+from apex_tpu.lint.findings import Finding, sort_key
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "baseline.json")
+
+__all__ = ["DEFAULT_BASELINE", "Model", "all_rules", "build_model",
+           "rule_catalog", "rule_ids", "run_concurrency",
+           "lint_concurrency_source"]
+
+
+def rule_catalog() -> List[Tuple[str, str, str]]:
+    return [(r.id, r.name, r.description) for r in all_rules()]
+
+
+def rule_ids() -> Set[str]:
+    return {r.id for r in all_rules()}
+
+
+def _active(select: Optional[Set[str]],
+            ignore: Optional[Set[str]]) -> List[ConcurrencyRule]:
+    rules = all_rules()
+    if select:
+        sel = {s.upper() for s in select}
+        rules = [r for r in rules if r.id.upper() in sel]
+    if ignore:
+        ign = {s.upper() for s in ignore}
+        rules = [r for r in rules if r.id.upper() not in ign]
+    return rules
+
+
+def _run(parsed, rules: Sequence[ConcurrencyRule]) -> List[Finding]:
+    model = build_model([ctx for ctx, _ in parsed])
+    per_file = {ctx.path: per_line for ctx, per_line in parsed}
+    findings = [f for rule in rules for f in rule.run(model)]
+    findings = [f for f in findings
+                if not engine._suppressed(f, per_file.get(f.path, {}))]
+    return sorted(findings, key=sort_key)
+
+
+def run_concurrency(paths: Sequence[str],
+                    select: Optional[Set[str]] = None,
+                    ignore: Optional[Set[str]] = None,
+                    ) -> Tuple[List[Finding], int]:
+    """Run the concurrency tier over files/directories.
+
+    Returns ``(findings, files_checked)``.  Unparseable and skip-file
+    sources contribute no model (the AST tier owns APX000 reporting).
+    """
+    files = engine.collect_files(paths)
+    parsed = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        one = engine._parse_file(src, path)
+        if one is None or isinstance(one, Finding):
+            continue
+        parsed.append(one)
+    return _run(parsed, _active(select, ignore)), len(files)
+
+
+def lint_concurrency_source(src: str, path: str,
+                            rules: Optional[Sequence[ConcurrencyRule]]
+                            = None) -> List[Finding]:
+    """Single in-memory source through the full tier — the fixture
+    matrix's entry point, sharing pragma/suppression semantics with
+    :func:`run_concurrency` by construction."""
+    one = engine._parse_file(src, path)
+    if one is None or isinstance(one, Finding):
+        return []
+    return _run([one], list(rules) if rules is not None else all_rules())

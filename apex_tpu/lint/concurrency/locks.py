@@ -1,0 +1,142 @@
+"""Lock-domain analysis: order inversions and blocking-while-locked.
+
+Built entirely from the :class:`~apex_tpu.lint.concurrency.model.Model`
+side tables — ``acquisitions`` (every ``with <lock>:`` entry plus the
+locks already held lexically at that point) and ``calls`` (every call
+site plus the locks held around it).
+
+* **Inversion** (APX1002): the *acquired-while-holding* graph has an
+  edge ``A -> B`` for every acquisition of ``B`` under ``A``.  Any
+  cycle means two threads can each hold one lock of the cycle and wait
+  forever for the next.
+* **Blocking under a lock** (APX1003): a call that can park the thread
+  (device sync, thread join, socket/file I/O, sleep, queue get,
+  future result) executed while a lock is held turns every other
+  acquirer of that lock into a hostage of the slow operation.  The
+  repo-sanctioned shape is SinkRegistry.emit's: snapshot under the
+  lock, do the slow work outside it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from apex_tpu.lint.concurrency.model import Model, display_name
+
+
+def lock_name(lid: tuple) -> str:
+    """Stable human spelling of a LockId for messages/baselines."""
+    if lid[0] == "attr":
+        return f"{lid[2]}.{lid[3]}"
+    if lid[0] == "global":
+        return f"{lid[1]}.{lid[2]}"
+    return f"{display_name(lid[1])}:{lid[2]}"      # local
+
+
+# calls that can block regardless of receiver
+_BLOCKING_QUALS = {
+    "time.sleep": "time.sleep",
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+    "open": "open",
+    "urllib.request.urlopen": "urlopen",
+    "socket.create_connection": "socket.create_connection",
+}
+
+# method names that block on their receiver (thread join, future
+# result, socket ops, http server lifecycle)
+_BLOCKING_ATTRS = {
+    "join": "join", "result": "result", "sleep": "sleep",
+    "device_get": "device_get", "block_until_ready": "block_until_ready",
+    "recv": "recv", "accept": "accept", "connect": "connect",
+    "sendall": "sendall", "getresponse": "getresponse",
+    "urlopen": "urlopen", "serve_forever": "serve_forever",
+    "shutdown": "shutdown",
+}
+
+
+def classify_blocking(model: Model, rec) -> Optional[str]:
+    """Short description if this call site can block, else None."""
+    qual = rec.qual or ""
+    if qual in _BLOCKING_QUALS:
+        return _BLOCKING_QUALS[qual]
+    if rec.attr in _BLOCKING_ATTRS:
+        # `.get(...)` blocks only on queues; plain dict.get is fine
+        return _BLOCKING_ATTRS[rec.attr]
+    if rec.attr == "get" and rec.recv_type == ("sync", "queue"):
+        return "queue.get"
+    if rec.attr == "wait" and rec.recv_type == ("sync", "event"):
+        # Event.wait parks the thread; Condition.wait releases its own
+        # lock and is modelled as ("sync", "lock"), so it stays exempt
+        return "event.wait"
+    return None
+
+
+def order_graph(model: Model) -> Tuple[Dict[tuple, Set[tuple]],
+                                       Dict[Tuple[tuple, tuple], object]]:
+    """acquired-while-holding edges + a representative site per edge."""
+    edges: Dict[tuple, Set[tuple]] = {}
+    sites: Dict[Tuple[tuple, tuple], object] = {}
+    for acq in model.acquisitions:
+        for held in acq.held:
+            if held == acq.lock:
+                continue                       # re-entrant RLock idiom
+            edges.setdefault(held, set()).add(acq.lock)
+            sites.setdefault((held, acq.lock), acq)
+    return edges, sites
+
+
+def _reaches(edges: Dict[tuple, Set[tuple]], src: tuple,
+             dst: tuple) -> bool:
+    seen: Set[tuple] = set()
+    stack = [src]
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(edges.get(cur, ()))
+    return False
+
+
+def inversions(model: Model) -> List[Tuple[tuple, tuple, object]]:
+    """(lock_a, lock_b, acquisition site) per order inversion: ``b``
+    acquired under ``a`` somewhere while ``a`` is also reachable from
+    ``b`` in the order graph.  One report per unordered pair."""
+    edges, sites = order_graph(model)
+    out = []
+    seen_pairs: Set[frozenset] = set()
+    for (a, b), site in sorted(sites.items(), key=lambda kv: (
+            kv[1].path, kv[1].line, lock_name(kv[0][0]),
+            lock_name(kv[0][1]))):
+        pair = frozenset((a, b))
+        if pair in seen_pairs:
+            continue
+        if _reaches(edges, b, a):
+            seen_pairs.add(pair)
+            out.append((a, b, site))
+    return out
+
+
+def blocking_under_lock(model: Model) -> List[Tuple[object, str]]:
+    """(call record, blocking-op description) for every call that can
+    block while at least one lock is lexically held."""
+    out = []
+    for rec in model.calls:
+        if not rec.held:
+            continue
+        desc = classify_blocking(model, rec)
+        if desc is not None:
+            out.append((rec, desc))
+    return out
+
+
+def call_spelling(rec) -> str:
+    """Stable spelling of a call site for messages."""
+    try:
+        return ast.unparse(rec.node.func)
+    except Exception:                           # pragma: no cover
+        return rec.qual or rec.attr or "<call>"

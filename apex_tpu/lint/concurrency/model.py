@@ -1,0 +1,772 @@
+"""apexrace program model: functions, types, calls, locks, accesses.
+
+The concurrency tier needs a finer-grained view than the hot-path
+tiers: per-FUNCTION nodes (nested defs and lambdas are where thread
+bodies live), a light nominal type inference (``self.runner =
+DeadlineRunner()`` is what lets ``self.runner.run(thunk, ...)``
+resolve to the project's deadline-runner seam), one level of
+higher-order parameter binding (the callable passed into
+``_deadline_run(dispatch, ...)`` is what ``dispatch()`` calls inside
+the worker thunk), and, for every state access and call, the set of
+locks lexically held (``with <lock>:`` scopes).
+
+Everything is the usual apexlint static over/under-approximation:
+precision beats recall, nothing imports the analyzed code, and
+anything unresolvable simply contributes no edges (docs/lint.md).
+
+Vocabulary used by the rest of the package:
+
+``FuncKey``
+    ``(module, qualpath)`` — qualpath is the dotted nesting path,
+    ``"Engine._decode"``, ``"run_elastic._armed_step.thunk"``,
+    lambdas as ``"<lambda:LINE:COL>"`` segments, and the synthetic
+    ``"<module>"`` node for import-time statements.
+``TypeRef``
+    ``("class", ClassKey)`` for a project class, or ``("sync", kind)``
+    for a recognized synchronization primitive (kind in ``lock``,
+    ``event``, ``queue``, ``deque``) — sync-typed attributes are
+    exempt from the shared-state rule because they ARE the
+    synchronization.
+``LockId``
+    ``("attr", module, class_qual, attr)`` for ``with self._lock:``,
+    ``("global", module, name)`` for a module-level lock,
+    ``("local", FuncKey, name)`` for a function-local one.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from apex_tpu.lint import _ast_util, dataflow
+from apex_tpu.lint.callgraph import module_name_for
+
+FuncKey = Tuple[str, str]
+ClassKey = Tuple[str, str]
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_SCOPE_NODES = _FUNC_NODES + (ast.ClassDef,)
+
+# canonical ctor spellings -> sync kind (attributes of these types are
+# thread-safe by construction and exempt from APX1001; "lock" kinds
+# additionally define lock domains)
+SYNC_TYPES = {
+    "threading.Lock": "lock", "threading.RLock": "lock",
+    "threading.Condition": "lock", "threading.Semaphore": "lock",
+    "threading.BoundedSemaphore": "lock", "threading.Event": "event",
+    "threading.local": "event",          # thread-local: private per root
+    "queue.Queue": "queue", "queue.SimpleQueue": "queue",
+    "queue.LifoQueue": "queue", "queue.PriorityQueue": "queue",
+    "collections.deque": "deque",        # GIL-atomic append/popleft
+}
+
+# attribute names that look like locks even without a typed ctor
+# (fixtures and third-party lock objects)
+_LOCKISH = ("lock", "mutex", "rlock")
+
+
+def _is_lockish(name: str) -> bool:
+    n = name.lower().lstrip("_")
+    return n in _LOCKISH or any(n.endswith("_" + s) for s in _LOCKISH)
+
+
+def display_name(key: FuncKey) -> str:
+    """Stable human name for messages: lambdas lose their line/col tag
+    so a baseline entry survives unrelated edits above it."""
+    mod, qual = key
+    parts = [p.split(":")[0] + ">" if p.startswith("<lambda") else p
+             for p in qual.split(".")]
+    return ".".join(parts)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    key: FuncKey
+    node: ast.AST
+    name: str
+    module: str
+    ctx: _ast_util.FileContext
+    cls: Optional[ClassKey] = None           # nearest enclosing class
+    enclosing: Optional[FuncKey] = None      # nearest enclosing function
+    params: List[str] = dataclasses.field(default_factory=list)
+    local_types: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    self_aliases: Dict[str, ClassKey] = dataclasses.field(
+        default_factory=dict)
+    assigned_locals: Set[str] = dataclasses.field(default_factory=set)
+    globals_declared: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    key: ClassKey
+    node: ast.ClassDef
+    module: str
+    name: str
+    base_names: List[str] = dataclasses.field(default_factory=list)
+    methods: Dict[str, FuncKey] = dataclasses.field(default_factory=dict)
+    attr_types: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    # attr -> list of Access
+    accesses: Dict[str, list] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    ctx: _ast_util.FileContext
+    functions: Dict[str, FuncKey] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, ClassKey] = dataclasses.field(default_factory=dict)
+    global_types: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    # module-level bindings (any value) + mutable-container subset
+    global_slots: Dict[str, int] = dataclasses.field(default_factory=dict)
+    mutable_globals: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    # name -> list of Access (module globals)
+    global_accesses: Dict[str, list] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    func: FuncKey
+    path: str
+    line: int
+    col: int
+    is_write: bool
+    held: frozenset
+
+
+@dataclasses.dataclass
+class CallRec:
+    """One call site: what the rules need to classify it later."""
+    caller: FuncKey
+    node: ast.Call
+    held: frozenset
+    qual: Optional[str] = None          # canonical dotted target, if any
+    attr: Optional[str] = None          # last attribute segment
+    recv_name: Optional[str] = None     # receiver spelling (x in x.m())
+    recv_type: Optional[tuple] = None   # TypeRef of the receiver
+    targets: List[FuncKey] = dataclasses.field(default_factory=list)
+    param_of: Optional[Tuple[FuncKey, str]] = None  # call through a param
+
+
+@dataclasses.dataclass
+class Acquisition:
+    """One ``with <lock>:`` entry and what was already held there."""
+    func: FuncKey
+    lock: tuple
+    held: frozenset
+    path: str
+    line: int
+    col: int
+
+
+class Model:
+    """The project-wide concurrency model (module docstring)."""
+
+    def __init__(self, contexts: Sequence[_ast_util.FileContext]):
+        self.contexts = list(contexts)
+        self.funcs: Dict[FuncKey, FuncInfo] = {}
+        self.classes: Dict[ClassKey, ClassInfo] = {}
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.calls: List[CallRec] = []
+        self.acquisitions: List[Acquisition] = []
+        self.edges: Dict[FuncKey, Set[FuncKey]] = {}
+        self.bindings: Dict[Tuple[FuncKey, str], Set[FuncKey]] = {}
+        self._lambda_keys: Dict[int, FuncKey] = {}   # id(node) -> key
+        self.roots: list = []                        # filled by roots.py
+        self.reaching: Dict[FuncKey, Set[int]] = {}  # func -> root idxs
+        self.main_reachable: Set[FuncKey] = set()
+        for ctx in self.contexts:
+            self._collect_scopes(ctx)
+        for minfo in self.modules.values():
+            self._collect_globals(minfo)
+        for fi in list(self.funcs.values()):
+            self._collect_types(fi)
+        for fi in list(self.funcs.values()):
+            self._walk_body(fi)
+        self._resolve_calls()
+        from apex_tpu.lint.concurrency import roots as _roots
+        self.roots = _roots.discover(self)
+        self._compute_reachability()
+
+    # ---- pass A: scopes, functions, classes ------------------------------
+    def _collect_scopes(self, ctx: _ast_util.FileContext) -> None:
+        mod = module_name_for(ctx.path)
+        if mod in self.modules:            # ambiguous stem: keep first
+            return
+        minfo = ModuleInfo(mod, ctx)
+        self.modules[mod] = minfo
+        minfo.mutable_globals = dataflow.module_level_mutables(ctx)
+
+        # the synthetic import-time function: module-level statements
+        # run on the importing (main) thread and can register roots
+        top = FuncInfo((mod, "<module>"), ctx.tree, "<module>", mod, ctx)
+        self.funcs[top.key] = top
+
+        def walk(node, scope: List[str], cls: Optional[ClassKey],
+                 encl: Optional[FuncKey]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    ck = (mod, ".".join(scope + [child.name]))
+                    ci = ClassInfo(ck, child, mod, child.name)
+                    ci.base_names = [ctx.qualname(b) or "" for b in
+                                     child.bases]
+                    self.classes[ck] = ci
+                    if not scope:
+                        minfo.classes[child.name] = ck
+                    walk(child, scope + [child.name], ck, encl)
+                elif isinstance(child, _FUNC_NODES):
+                    if isinstance(child, ast.Lambda):
+                        name = f"<lambda:{child.lineno}:{child.col_offset}>"
+                    else:
+                        name = child.name
+                    key = (mod, ".".join(scope + [name]))
+                    fi = FuncInfo(key, child, name, mod, ctx, cls=cls,
+                                  enclosing=encl)
+                    a = child.args
+                    fi.params = [p.arg for p in
+                                 a.posonlyargs + a.args + a.kwonlyargs]
+                    self.funcs[key] = fi
+                    if isinstance(child, ast.Lambda):
+                        self._lambda_keys[id(child)] = key
+                    if not scope:
+                        minfo.functions[name] = key
+                    if cls is not None and not isinstance(
+                            child, ast.Lambda):
+                        owner = self.classes[cls]
+                        # direct methods only: the class is the nearest
+                        # enclosing scope
+                        if ".".join(scope) == cls[1]:
+                            owner.methods.setdefault(name, key)
+                    walk(child, scope + [name], cls, key)
+                else:
+                    walk(child, scope, cls, encl)
+
+        walk(ctx.tree, [], None, None)
+
+    def _collect_globals(self, minfo: ModuleInfo) -> None:
+        """Module-level slots and their inferred types.  Runs AFTER
+        every module's scope pass so ``x = SomeClass()`` resolves
+        project classes regardless of declaration/file order."""
+        ctx = minfo.ctx
+        for stmt in ctx.tree.body:
+            names: List[str] = []
+            value = ann = None
+            if isinstance(stmt, ast.Assign):
+                value = stmt.value
+                for t in stmt.targets:
+                    names.extend(dataflow.assigned_names(t))
+            elif isinstance(stmt, ast.AnnAssign):
+                names.extend(dataflow.assigned_names(stmt.target))
+                value, ann = stmt.value, stmt.annotation
+            for n in names:
+                minfo.global_slots.setdefault(n, stmt.lineno)
+                t = (self._type_of_expr(ctx, None, value)
+                     or self._type_of_annotation(ctx, ann))
+                if t is not None:
+                    minfo.global_types[n] = t
+
+    # ---- type inference ---------------------------------------------------
+    def _resolve_class(self, qual: Optional[str]) -> Optional[ClassKey]:
+        if not qual:
+            return None
+        mod, _, cls = qual.rpartition(".")
+        if mod and mod in self.modules and cls in self.modules[mod].classes:
+            return self.modules[mod].classes[cls]
+        if not mod:
+            # bare name: a class in SOME analyzed module, unambiguous
+            hits = [m.classes[qual] for m in self.modules.values()
+                    if qual in m.classes]
+            if len(hits) == 1:
+                return hits[0]
+        return None
+
+    def _type_of_expr(self, ctx, fi: Optional[FuncInfo],
+                      expr) -> Optional[tuple]:
+        if isinstance(expr, ast.Call):
+            qual = ctx.qualname(expr.func)
+            if qual is None and isinstance(expr.func, ast.Name):
+                qual = expr.func.id      # bare local class name
+            if qual in SYNC_TYPES:
+                return ("sync", SYNC_TYPES[qual])
+            ck = self._resolve_class(qual)
+            if ck is not None:
+                return ("class", ck)
+        return None
+
+    def _type_of_annotation(self, ctx, ann) -> Optional[tuple]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Subscript):       # Optional[X] / Final[X]
+            return self._type_of_annotation(ctx, ann.slice)
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return ("class", self._resolve_class(ann.value)) \
+                if self._resolve_class(ann.value) else None
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            qual = ctx.qualname(ann)
+            if qual is None and isinstance(ann, ast.Name):
+                qual = ann.id            # bare local class name
+            if qual in SYNC_TYPES:
+                return ("sync", SYNC_TYPES[qual])
+            ck = self._resolve_class(qual)
+            if ck is not None:
+                return ("class", ck)
+        return None
+
+    def _collect_types(self, fi: FuncInfo) -> None:
+        """Locals, self aliases and ``self.attr = Ctor()`` class-attr
+        types, from one function's own scope."""
+        if fi.name == "<module>":
+            return
+        fi.globals_declared = {
+            n for node in dataflow.walk_scope(fi.node)
+            if isinstance(node, ast.Global) for n in node.names}
+        if fi.cls is not None and fi.params and not isinstance(
+                fi.node, ast.Lambda):
+            first = fi.params[0]
+            if first in ("self", "cls") and first == "self":
+                fi.self_aliases["self"] = fi.cls
+        # annotated params type their names
+        args = getattr(fi.node, "args", None)
+        if args is not None and not isinstance(fi.node, ast.Lambda):
+            for p in args.posonlyargs + args.args + args.kwonlyargs:
+                t = self._type_of_annotation(fi.ctx, p.annotation)
+                if t is not None:
+                    fi.local_types[p.arg] = t
+        fi.assigned_locals = set(fi.params)
+        for node in dataflow.walk_scope(fi.node):
+            names: List[str] = []
+            value = ann = None
+            if isinstance(node, ast.Assign):
+                value = node.value
+                for t in node.targets:
+                    names.extend(dataflow.assigned_names(t))
+            elif isinstance(node, ast.AnnAssign):
+                value, ann = node.value, node.annotation
+                names.extend(dataflow.assigned_names(node.target))
+            elif isinstance(node, (ast.For, ast.withitem, ast.NamedExpr)):
+                tgt = getattr(node, "target",
+                              getattr(node, "optional_vars", None))
+                if tgt is not None:
+                    fi.assigned_locals.update(dataflow.assigned_names(tgt))
+                continue
+            else:
+                continue
+            fi.assigned_locals.update(n for n in names
+                                      if n not in fi.globals_declared)
+            t = (self._type_of_expr(fi.ctx, fi, value)
+                 or self._type_of_annotation(fi.ctx, ann))
+            # plain-name targets: local types + self aliases
+            for n in names:
+                if t is not None:
+                    fi.local_types[n] = t
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Name):
+                owner = self._self_class(fi, node.value.id)
+                if owner is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            fi.self_aliases[tgt.id] = owner
+            # `self.x = Ctor()` / `self.x: T` -> class attr type
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in tgts:
+                if isinstance(tgt, ast.Attribute) and isinstance(
+                        tgt.value, ast.Name):
+                    owner = self._self_class(fi, tgt.value.id)
+                    if owner is not None and t is not None:
+                        self.classes[owner].attr_types.setdefault(
+                            tgt.attr, t)
+
+    def _self_class(self, fi: FuncInfo, name: str) -> Optional[ClassKey]:
+        """Class whose instance ``name`` aliases here, following the
+        enclosing-function chain (``server = self`` in ``__init__``
+        read from a nested handler class's methods)."""
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            if name in cur.self_aliases:
+                return cur.self_aliases[name]
+            if name in cur.assigned_locals:
+                return None                      # shadowed
+            cur = self.funcs.get(cur.enclosing) if cur.enclosing else None
+        return None
+
+    def _local_type(self, fi: FuncInfo, name: str) -> Optional[tuple]:
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            if name in cur.local_types:
+                return cur.local_types[name]
+            if name in cur.assigned_locals and name not in cur.local_types:
+                return None
+            cur = self.funcs.get(cur.enclosing) if cur.enclosing else None
+        minfo = self.modules.get(fi.module)
+        if minfo is not None:
+            return minfo.global_types.get(name)
+        return None
+
+    def _expr_type(self, fi: FuncInfo, expr) -> Optional[tuple]:
+        if isinstance(expr, ast.Name):
+            owner = self._self_class(fi, expr.id)
+            if owner is not None:
+                return ("class", owner)
+            return self._local_type(fi, expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            owner = self._self_class(fi, expr.value.id)
+            if owner is not None:
+                return self.classes[owner].attr_types.get(expr.attr)
+        return None
+
+    # ---- pass B: accesses, calls, locks ----------------------------------
+    def _lock_id(self, fi: FuncInfo, expr) -> Optional[tuple]:
+        t = self._expr_type(fi, expr)
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            owner = self._self_class(fi, expr.value.id)
+            if owner is not None:
+                at = self.classes[owner].attr_types.get(expr.attr)
+                if (at == ("sync", "lock")) or (
+                        at is None and _is_lockish(expr.attr)):
+                    return ("attr", owner[0], owner[1], expr.attr)
+                return None
+            rt = self._expr_type(fi, expr.value)
+            if rt is not None and rt[0] == "class":
+                at = self.classes[rt[1]].attr_types.get(expr.attr)
+                if (at == ("sync", "lock")) or (
+                        at is None and _is_lockish(expr.attr)):
+                    return ("attr", rt[1][0], rt[1][1], expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            if t == ("sync", "lock"):
+                minfo = self.modules.get(fi.module)
+                if minfo and minfo.global_types.get(expr.id) == t \
+                        and expr.id not in fi.assigned_locals:
+                    return ("global", fi.module, expr.id)
+                return ("local", fi.key, expr.id)
+            if _is_lockish(expr.id) and t is None:
+                return ("local", fi.key, expr.id)
+        return None
+
+    def _record_attr(self, fi: FuncInfo, owner: ClassKey, attr: str,
+                     node, is_write: bool, held: frozenset) -> None:
+        ci = self.classes[owner]
+        ci.accesses.setdefault(attr, []).append(Access(
+            fi.key, fi.ctx.path, node.lineno, node.col_offset + 1,
+            is_write, held))
+
+    def _record_global(self, fi: FuncInfo, name: str, node,
+                       is_write: bool, held: frozenset) -> None:
+        minfo = self.modules[fi.module]
+        minfo.global_accesses.setdefault(name, []).append(Access(
+            fi.key, fi.ctx.path, node.lineno, node.col_offset + 1,
+            is_write, held))
+
+    def _is_module_global(self, fi: FuncInfo, name: str) -> bool:
+        minfo = self.modules.get(fi.module)
+        if minfo is None or name not in minfo.global_slots:
+            return False
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            if name in cur.globals_declared:
+                return True
+            if name in cur.assigned_locals or name in cur.self_aliases:
+                return False
+            cur = self.funcs.get(cur.enclosing) if cur.enclosing else None
+        return True
+
+    def _walk_body(self, fi: FuncInfo) -> None:
+        skip_reads: Set[int] = set()     # Attribute nodes in call position
+
+        def handle(node, held: frozenset) -> None:
+            if isinstance(node, ast.Call):
+                self._handle_call(fi, node, held, skip_reads)
+            elif isinstance(node, ast.Attribute):
+                if id(node) in skip_reads:
+                    return
+                if isinstance(node.value, ast.Name):
+                    owner = self._self_class(fi, node.value.id)
+                    if owner is not None:
+                        self._record_attr(
+                            fi, owner, node.attr, node,
+                            isinstance(node.ctx, (ast.Store, ast.Del)),
+                            held)
+            elif isinstance(node, ast.Name) and fi.name != "<module>":
+                if self._is_module_global(fi, node.id):
+                    is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+                    self._record_global(fi, node.id, node, is_write, held)
+            elif isinstance(node, ast.Subscript):
+                # self.a[k] = v mutates a; a[k] reads it (both recorded
+                # through the inner Attribute/Name, but the STORE ctx
+                # lives on the Subscript)
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    inner = node.value
+                    if isinstance(inner, ast.Attribute) and isinstance(
+                            inner.value, ast.Name):
+                        owner = self._self_class(fi, inner.value.id)
+                        if owner is not None:
+                            self._record_attr(fi, owner, inner.attr,
+                                              inner, True, held)
+                            skip_reads.add(id(inner))
+                    elif isinstance(inner, ast.Name) \
+                            and fi.name != "<module>" \
+                            and self._is_module_global(fi, inner.id):
+                        self._record_global(fi, inner.id, inner, True,
+                                            held)
+
+        def visit(node, held: frozenset) -> None:
+            if isinstance(node, _SCOPE_NODES):
+                return                   # separate FuncInfo / class
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new = []
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    lid = self._lock_id(fi, item.context_expr)
+                    if lid is not None:
+                        self.acquisitions.append(Acquisition(
+                            fi.key, lid, held | frozenset(new),
+                            fi.ctx.path, node.lineno,
+                            node.col_offset + 1))
+                        new.append(lid)
+                inner = held | frozenset(new)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            handle(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        if fi.name == "<module>":
+            # import-time statements only (no function/class bodies)
+            for stmt in fi.node.body:
+                visit(stmt, frozenset())
+            return
+        body = fi.node.body if not isinstance(fi.node, ast.Lambda) \
+            else [fi.node.body]
+        for stmt in body:
+            visit(stmt, frozenset())
+
+    def _handle_call(self, fi: FuncInfo, node: ast.Call,
+                     held: frozenset, skip_reads: Set[int]) -> None:
+        rec = CallRec(fi.key, node, held)
+        fn = node.func
+        rec.qual = fi.ctx.qualname(fn)
+        if isinstance(fn, ast.Attribute):
+            rec.attr = fn.attr
+            v = fn.value
+            if isinstance(v, ast.Name):
+                rec.recv_name = v.id
+                # a direct method call `self.m()` is a call edge, not a
+                # state access on attribute `m`
+                if self._self_class(fi, v.id) is not None:
+                    skip_reads.add(id(fn))
+            elif isinstance(v, ast.Attribute):
+                rec.recv_name = v.attr
+            rec.recv_type = self._expr_type(fi, v)
+            # `self.a.append(x)` and friends mutate `self.a`
+            if fn.attr in dataflow._MUTATING_METHODS and isinstance(
+                    v, ast.Attribute) and isinstance(v.value, ast.Name):
+                owner = self._self_class(fi, v.value.id)
+                if owner is not None:
+                    self._record_attr(fi, owner, v.attr, v, True, held)
+                    skip_reads.add(id(v))
+            if fn.attr in dataflow._MUTATING_METHODS and isinstance(
+                    v, ast.Name) and fi.name != "<module>" \
+                    and self._is_module_global(fi, v.id):
+                self._record_global(fi, v.id, v, True, held)
+        self.calls.append(rec)
+
+    # ---- call resolution --------------------------------------------------
+    def callable_target(self, fi: FuncInfo, expr) -> Optional[FuncKey]:
+        """Resolve an expression used AS a callable value (thread
+        target, submitted fn, registered callback, bound argument)."""
+        if isinstance(expr, ast.Lambda):
+            return self._lambda_keys.get(id(expr))
+        if isinstance(expr, ast.Name):
+            hit = self._resolve_name_func(fi, expr.id)
+            if hit is not None:
+                return hit
+            return self._resolve_qual_func(fi.ctx.qualname(expr))
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            owner = self._self_class(fi, expr.value.id)
+            if owner is None:
+                rt = self._expr_type(fi, expr.value)
+                owner = rt[1] if rt is not None and rt[0] == "class" \
+                    else None
+            if owner is not None:
+                return self.classes[owner].methods.get(expr.attr)
+            return self._resolve_qual_func(fi.ctx.qualname(expr))
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Attribute):
+            rt = self._expr_type(fi, expr.value)
+            if rt is not None and rt[0] == "class":
+                return self.classes[rt[1]].methods.get(expr.attr)
+        return None
+
+    def _resolve_name_func(self, fi: FuncInfo,
+                           name: str) -> Optional[FuncKey]:
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:           # nested def along the chain
+            cand = (cur.module, f"{cur.key[1]}.{name}")
+            if cand in self.funcs:
+                return cand
+            cur = self.funcs.get(cur.enclosing) if cur.enclosing else None
+        minfo = self.modules.get(fi.module)
+        if minfo is not None and name in minfo.functions:
+            return minfo.functions[name]
+        return None
+
+    def _resolve_qual_func(self, qual: Optional[str]) -> Optional[FuncKey]:
+        if not qual or "." not in qual:
+            return None
+        mod, _, name = qual.rpartition(".")
+        minfo = self.modules.get(mod)
+        if minfo is not None and name in minfo.functions:
+            return minfo.functions[name]
+        # pkg.mod.Class.method spelling
+        m2, _, cls = mod.rpartition(".")
+        minfo = self.modules.get(m2)
+        if minfo is not None and cls in minfo.classes:
+            return self.classes[minfo.classes[cls]].methods.get(name)
+        return None
+
+    def _resolve_calls(self) -> None:
+        param_calls: List[CallRec] = []
+        for rec in self.calls:
+            fi = self.funcs[rec.caller]
+            fn = rec.node.func
+            targets: List[FuncKey] = []
+            if isinstance(fn, ast.Name):
+                hit = self._resolve_name_func(fi, fn.id)
+                if hit is not None:
+                    targets.append(hit)
+                else:
+                    pk = self._param_owner(fi, fn.id)
+                    if pk is not None:
+                        rec.param_of = pk
+                        param_calls.append(rec)
+                    else:
+                        q = self._resolve_qual_func(rec.qual)
+                        if q is not None:
+                            targets.append(q)
+            elif isinstance(fn, ast.Attribute):
+                v = fn.value
+                owner = None
+                if isinstance(v, ast.Name):
+                    owner = self._self_class(fi, v.id)
+                if owner is None:
+                    rt = self._expr_type(fi, v)
+                    owner = rt[1] if rt is not None and rt[0] == "class" \
+                        else None
+                if owner is not None:
+                    m = self.classes[owner].methods.get(fn.attr)
+                    if m is not None:
+                        targets.append(m)
+                else:
+                    q = self._resolve_qual_func(rec.qual)
+                    if q is not None:
+                        targets.append(q)
+            rec.targets = targets
+            for t in targets:
+                self.edges.setdefault(rec.caller, set()).add(t)
+            # callable arguments -> parameter bindings on the target
+            self._bind_callable_args(fi, rec)
+        # round 2: calls through a bound parameter
+        for rec in param_calls:
+            bound = self.bindings.get(rec.param_of, set())
+            rec.targets = sorted(bound)
+            for t in bound:
+                self.edges.setdefault(rec.caller, set()).add(t)
+
+    def _param_owner(self, fi: FuncInfo,
+                     name: str) -> Optional[Tuple[FuncKey, str]]:
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            if name in cur.params:
+                return (cur.key, name)
+            if name in cur.assigned_locals:
+                return None
+            cur = self.funcs.get(cur.enclosing) if cur.enclosing else None
+        return None
+
+    def _bind_callable_args(self, fi: FuncInfo, rec: CallRec) -> None:
+        if not rec.targets:
+            return
+        args = [(i, a) for i, a in enumerate(rec.node.args)]
+        kwargs = [(kw.arg, kw.value) for kw in rec.node.keywords
+                  if kw.arg]
+        for t in rec.targets:
+            ti = self.funcs.get(t)
+            if ti is None:
+                continue
+            # instance-method calls consume params[0] as self
+            offset = 1 if (ti.cls is not None and ti.params
+                           and ti.params[0] == "self"
+                           and isinstance(rec.node.func,
+                                          ast.Attribute)) else 0
+            for i, a in args:
+                ct = self.callable_target(fi, a)
+                if ct is None:
+                    continue
+                pi = i + offset
+                if pi < len(ti.params):
+                    self.bindings.setdefault(
+                        (t, ti.params[pi]), set()).add(ct)
+            for name, a in kwargs:
+                ct = self.callable_target(fi, a)
+                if ct is not None and name in ti.params:
+                    self.bindings.setdefault((t, name), set()).add(ct)
+
+    # ---- reachability -----------------------------------------------------
+    def reach_from(self, key: FuncKey) -> Set[FuncKey]:
+        seen: Set[FuncKey] = set()
+        stack = [key]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.edges.get(cur, ()))
+        return seen
+
+    def _compute_reachability(self) -> None:
+        for idx, root in enumerate(self.roots):
+            if root.target is None:
+                continue
+            for k in self.reach_from(root.target):
+                self.reaching.setdefault(k, set()).add(idx)
+        # the main domain: everything callable from outside — public
+        # functions/methods, constructors/context dunders, import-time
+        # statements — closed over the call graph
+        seeds: Set[FuncKey] = set()
+        for key, fi in self.funcs.items():
+            base = fi.name
+            if base == "<module>":
+                seeds.add(key)
+            elif not base.startswith("_"):
+                seeds.add(key)
+            elif base in ("__init__", "__enter__", "__exit__",
+                          "__call__", "__iter__", "__next__", "__del__"):
+                seeds.add(key)
+        seen: Set[FuncKey] = set()
+        stack = list(seeds)
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.edges.get(cur, ()))
+        self.main_reachable = seen
+
+    def domains_of(self, key: FuncKey) -> Set[str]:
+        """Execution domains that can run ``key``: ``"root:<idx>"`` per
+        discovered root whose closure contains it, plus ``"main"``."""
+        out = {f"root:{i}" for i in self.reaching.get(key, ())}
+        if key in self.main_reachable:
+            out.add("main")
+        return out
+
+
+def build_model(contexts: Sequence[_ast_util.FileContext]) -> Model:
+    return Model(contexts)

@@ -1,0 +1,186 @@
+"""Thread-root discovery: where concurrent control flow ENTERS code.
+
+A *root* is a function that some mechanism other than the ordinary
+main-thread call stack may invoke.  The analyzer recognizes the stdlib
+entry points and the project's own registration seams:
+
+=============  =========================================  ===========
+kind           registration site                          preemptive
+=============  =========================================  ===========
+thread         ``threading.Thread(target=fn)``            yes
+executor       ``pool.submit(fn, ...)``                   yes
+http           ``do_*`` methods of a                      yes
+               ``BaseHTTPRequestHandler`` subclass
+signal         ``signal.signal(SIG, fn)``                 yes
+runner         ``runner.run(thunk, ...)`` on a            yes
+               :class:`~apex_tpu.resilience.fleet.
+               DeadlineRunner` (the thunk executes on the
+               persistent worker thread)
+sink           ``hostmetrics.add_sink(fn)`` /             yes
+               ``SinkRegistry.add(fn)`` (producers emit
+               from arbitrary host threads)
+monitor        ``jax.monitoring.                          yes
+               register_event_duration_secs_listener``
+               (fires from compile/dispatch threads)
+atexit         ``atexit.register(fn)``                    no
+observer       ``Telemetry.add_observer(fn)``             no
+emitter        ``Telemetry.add_emitter(obj)`` (the        no
+               session calls ``obj.emit`` / ``obj.close``
+               at flush/close time)
+=============  =========================================  ===========
+
+*Preemptive* roots can interleave with the main thread at any bytecode
+boundary — only they create APX1001 shared-state domains.  Observer /
+emitter callbacks run synchronously inside ``Telemetry.flush`` on the
+flushing thread: they are tracked (APX1005 re-entrancy, root-finder
+tests, docs) but do not by themselves make state multi-threaded.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import List, Optional
+
+from apex_tpu.lint.concurrency import model as model_mod
+from apex_tpu.lint.concurrency.model import FuncKey, Model
+
+PREEMPTIVE_KINDS = {"thread", "executor", "http", "signal", "runner",
+                    "sink", "monitor"}
+
+# the deadline-runner seam: `<recv>.run(thunk)` hands the thunk to a
+# persistent worker thread.  Typed receivers are matched by class
+# name; untyped ones by the project's naming convention.
+_RUNNER_CLASS = "DeadlineRunner"
+_RUNNER_NAMES = ("runner",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Root:
+    kind: str
+    target: Optional[FuncKey]     # None when the callable is external
+    label: str                    # human description for messages/tests
+    path: str
+    line: int
+
+    @property
+    def preemptive(self) -> bool:
+        return self.kind in PREEMPTIVE_KINDS
+
+
+def _kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def discover(model: Model) -> List[Root]:
+    roots: List[Root] = []
+
+    def add(kind, fi, node, expr, label=None):
+        target = model.callable_target(fi, expr) \
+            if expr is not None else None
+        name = label
+        if name is None:
+            if isinstance(expr, ast.Lambda) and target is not None:
+                name = model_mod.display_name(target)
+            elif expr is not None:
+                name = ast.unparse(expr)
+            else:
+                name = "<external>"
+        roots.append(Root(kind, target, name, fi.ctx.path, node.lineno))
+
+    for rec in model.calls:
+        fi = model.funcs[rec.caller]
+        call = rec.node
+        qual = rec.qual or ""
+        if qual == "threading.Thread" or qual.endswith(".Thread") \
+                or qual == "Thread":
+            tgt = _kwarg(call, "target")
+            if tgt is not None:
+                add("thread", fi, call, tgt)
+        elif rec.attr == "submit" and call.args:
+            add("executor", fi, call, call.args[0])
+        elif qual in ("signal.signal", "signal.signal.signal") \
+                and len(call.args) >= 2:
+            add("signal", fi, call, call.args[1])
+        elif qual == "atexit.register" and call.args:
+            add("atexit", fi, call, call.args[0])
+        elif (rec.attr == "register_event_duration_secs_listener"
+              or qual.endswith("register_event_duration_secs_listener")) \
+                and call.args:
+            add("monitor", fi, call, call.args[0])
+        elif (rec.attr == "add_sink" or qual.endswith(".add_sink")
+              or qual == "add_sink") and call.args:
+            add("sink", fi, call, call.args[0])
+        elif rec.attr == "add" and call.args \
+                and rec.recv_type is not None \
+                and rec.recv_type[0] == "class" \
+                and _is_registry(model, rec.recv_type[1]):
+            add("sink", fi, call, call.args[0])
+        elif rec.attr == "add_observer" and call.args:
+            add("observer", fi, call, call.args[0])
+        elif rec.attr == "add_emitter" and call.args:
+            _add_emitter(model, roots, fi, call)
+        elif rec.attr == "run" and call.args and _is_runner(model, rec):
+            add("runner", fi, call, call.args[0])
+
+    # http.server handlers: every do_* method of a handler subclass
+    for ck, ci in sorted(model.classes.items()):
+        if not any(b.endswith("BaseHTTPRequestHandler")
+                   for b in ci.base_names):
+            continue
+        for name, mkey in sorted(ci.methods.items()):
+            if name.startswith("do_"):
+                fi = model.funcs[mkey]
+                roots.append(Root("http", mkey, f"{ci.name}.{name}",
+                                  fi.ctx.path, fi.node.lineno))
+    return roots
+
+
+def _is_registry(model: Model, ck) -> bool:
+    """SinkRegistry-shaped: registers callables via ``add`` and fans
+    them out via ``emit``."""
+    ci = model.classes.get(ck)
+    return ci is not None and "add" in ci.methods and "emit" in ci.methods
+
+
+def _is_runner(model: Model, rec) -> bool:
+    if rec.recv_type is not None and rec.recv_type[0] == "class" \
+            and rec.recv_type[1][1].split(".")[-1] == _RUNNER_CLASS:
+        return True
+    if rec.recv_type is None and rec.recv_name is not None:
+        n = rec.recv_name.lstrip("_").lower()
+        return n in _RUNNER_NAMES or n.endswith("_runner")
+    return False
+
+
+def _add_emitter(model: Model, roots: List[Root], fi,
+                 call: ast.Call) -> None:
+    """``add_emitter(x)``: the session later calls ``x.emit(records)``
+    and ``x.close()`` — register both methods of x's class as roots.
+    A plain callable argument registers directly."""
+    arg = call.args[0]
+    direct = model.callable_target(fi, arg)
+    if direct is not None:
+        roots.append(Root("emitter", direct, ast.unparse(arg),
+                          fi.ctx.path, call.lineno))
+        return
+    t = model._expr_type(fi, arg)
+    if isinstance(arg, ast.Name):
+        owner = model._self_class(fi, arg.id)
+        if owner is not None:
+            t = ("class", owner)
+    if t is not None and t[0] == "class":
+        ci = model.classes.get(t[1])
+        if ci is None:
+            return
+        for m in ("emit", "close"):
+            mk = ci.methods.get(m)
+            if mk is not None:
+                roots.append(Root("emitter", mk, f"{ci.name}.{m}",
+                                  fi.ctx.path, call.lineno))
+        return
+    roots.append(Root("emitter", None, ast.unparse(arg),
+                      fi.ctx.path, call.lineno))

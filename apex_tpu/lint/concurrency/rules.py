@@ -1,0 +1,236 @@
+"""apexrace rule families APX1001-APX1005.
+
+Unlike the AST tier's per-file :class:`~apex_tpu.lint.engine.Rule`,
+concurrency rules run over the whole-project
+:class:`~apex_tpu.lint.concurrency.model.Model`: each ``run(model)``
+returns findings anchored at real file/line positions, so the standard
+suppression pragmas and the ``(path, rule, message)`` baseline apply
+unchanged.  Messages avoid line numbers and lambda coordinates on
+purpose — a baseline entry must survive unrelated edits above it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from apex_tpu.lint.concurrency import locks as _locks
+from apex_tpu.lint.concurrency import state as _state
+from apex_tpu.lint.concurrency.model import Model, display_name
+from apex_tpu.lint.findings import ERROR, WARNING, Finding
+
+
+class ConcurrencyRule:
+    """One concurrency hazard family (project-model scope)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    severity: str = WARNING
+
+    def run(self, model: Model) -> List[Finding]:
+        raise NotImplementedError
+
+    def at(self, path: str, line: int, col: int,
+           message: str) -> Finding:
+        return Finding(path=path, line=line, col=col, rule_id=self.id,
+                       rule_name=self.name, message=message,
+                       severity=self.severity)
+
+
+class SharedStateRule(ConcurrencyRule):
+    id = "APX1001"
+    name = "unsynchronized-shared-state"
+    description = ("mutable state written and read across >=2 execution "
+                   "domains (main thread / thread roots) with no common "
+                   "lock; at least one domain is preemptive")
+    severity = ERROR
+
+    def run(self, model: Model) -> List[Finding]:
+        out = []
+        for rep in _state.shared_state_hazards(model):
+            msg = (f"unsynchronized shared state '{rep.name}' accessed "
+                   f"across [{', '.join(rep.domains)}] with no common "
+                   f"lock")
+            a = rep.anchor
+            out.append(self.at(a.path, a.line, a.col, msg))
+        return out
+
+
+class LockOrderRule(ConcurrencyRule):
+    id = "APX1002"
+    name = "lock-order-inversion"
+    description = ("cycle in the acquired-while-holding graph: two "
+                   "locks are taken in both orders on different paths "
+                   "(classic ABBA deadlock)")
+    severity = ERROR
+
+    def run(self, model: Model) -> List[Finding]:
+        out = []
+        for a, b, site in _locks.inversions(model):
+            na, nb = sorted((_locks.lock_name(a), _locks.lock_name(b)))
+            msg = (f"lock-order inversion between '{na}' and '{nb}': "
+                   f"both acquisition orders occur")
+            out.append(self.at(site.path, site.line, site.col, msg))
+        return out
+
+
+class BlockingInLockRule(ConcurrencyRule):
+    id = "APX1003"
+    name = "blocking-call-under-lock"
+    description = ("call that can park the thread (device sync, join, "
+                   "sleep, socket/file I/O, queue get) while holding a "
+                   "lock; snapshot under the lock, block outside it")
+    severity = WARNING
+
+    def run(self, model: Model) -> List[Finding]:
+        out = []
+        for rec, desc in _locks.blocking_under_lock(model):
+            names = ", ".join(sorted(
+                _locks.lock_name(l) for l in rec.held))
+            msg = (f"blocking call '{_locks.call_spelling(rec)}' "
+                   f"({desc}) while holding [{names}]")
+            out.append(self.at(
+                model.funcs[rec.caller].ctx.path, rec.node.lineno,
+                rec.node.col_offset + 1, msg))
+        return out
+
+
+class SignalSafetyRule(ConcurrencyRule):
+    id = "APX1004"
+    name = "signal-handler-unsafety"
+    description = ("code reachable from a signal.signal handler "
+                   "acquires locks or performs blocking/file I/O; the "
+                   "recorded idiom is a near-empty handler that only "
+                   "sets a flag/Event")
+    severity = ERROR
+
+    # plain-qual calls unsafe in handler context even when not blocking
+    _UNSAFE_QUALS = {"open", "print"}
+
+    def run(self, model: Model) -> List[Finding]:
+        out = []
+        seen: Set[Tuple[str, int, str]] = set()
+        acq_by_func: Dict[tuple, list] = {}
+        for acq in model.acquisitions:
+            acq_by_func.setdefault(acq.func, []).append(acq)
+        calls_by_func: Dict[tuple, list] = {}
+        for rec in model.calls:
+            calls_by_func.setdefault(rec.caller, []).append(rec)
+        for root in model.roots:
+            if root.kind != "signal" or root.target is None:
+                continue
+            for fk in sorted(model.reach_from(root.target)):
+                for acq in acq_by_func.get(fk, ()):
+                    msg = (f"signal handler '{root.label}' acquires "
+                           f"lock '{_locks.lock_name(acq.lock)}'; "
+                           f"handlers must only set a flag")
+                    key = (acq.path, acq.line, msg)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(self.at(acq.path, acq.line, acq.col,
+                                           msg))
+                for rec in calls_by_func.get(fk, ()):
+                    desc = _locks.classify_blocking(model, rec)
+                    if desc is None and (rec.qual or "") \
+                            in self._UNSAFE_QUALS:
+                        desc = rec.qual
+                    if desc is None:
+                        continue
+                    msg = (f"signal handler '{root.label}' performs "
+                           f"'{_locks.call_spelling(rec)}' ({desc}); "
+                           f"handlers must only set a flag")
+                    key = (model.funcs[fk].ctx.path,
+                           rec.node.lineno, msg)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(self.at(key[0], rec.node.lineno,
+                                           rec.node.col_offset + 1, msg))
+        return out
+
+
+_REG_ATTRS = {"add_observer", "add_emitter", "add_sink", "add"}
+_DISPATCHERS = ("flush", "emit")
+
+
+class ReentrancyRule(ConcurrencyRule):
+    id = "APX1005"
+    name = "callback-reentrancy"
+    description = ("an observer/emitter/sink callback transitively "
+                   "calls its own registry's flush/emit dispatcher — "
+                   "unbounded recursion through the telemetry fan-out")
+    severity = WARNING
+
+    def run(self, model: Model) -> List[Finding]:
+        from apex_tpu.lint.concurrency.roots import _is_registry
+        out = []
+        seen: Set[Tuple[str, int, str]] = set()
+        for rec in model.calls:
+            if rec.attr not in _REG_ATTRS or not rec.node.args:
+                continue
+            fi = model.funcs[rec.caller]
+            ck = self._receiver_class(model, fi, rec)
+            if ck is None or ck not in model.classes:
+                continue
+            if rec.attr == "add" and not _is_registry(model, ck):
+                continue
+            ci = model.classes[ck]
+            dispatchers = [(n, ci.methods[n]) for n in _DISPATCHERS
+                           if n in ci.methods]
+            if not dispatchers:
+                continue
+            for cb in self._callbacks(model, fi, rec):
+                reach = model.reach_from(cb)
+                for dname, dkey in dispatchers:
+                    if dkey not in reach:
+                        continue
+                    msg = (f"callback '{display_name(cb)}' registered "
+                           f"on '{ci.name}' can re-enter "
+                           f"'{ci.name}.{dname}'")
+                    key = (fi.ctx.path, rec.node.lineno, msg)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(self.at(
+                            fi.ctx.path, rec.node.lineno,
+                            rec.node.col_offset + 1, msg))
+        return out
+
+    @staticmethod
+    def _receiver_class(model: Model, fi, rec):
+        fn = rec.node.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        v = fn.value
+        if isinstance(v, ast.Name):
+            owner = model._self_class(fi, v.id)
+            if owner is not None:
+                return owner
+        t = model._expr_type(fi, v)
+        if t is not None and t[0] == "class":
+            return t[1]
+        return None
+
+    @staticmethod
+    def _callbacks(model: Model, fi, rec) -> List[tuple]:
+        arg = rec.node.args[0]
+        direct = model.callable_target(fi, arg)
+        if direct is not None:
+            return [direct]
+        if rec.attr != "add_emitter":
+            return []
+        # an emitter INSTANCE: the registry later calls .emit/.close
+        t = model._expr_type(fi, arg)
+        if isinstance(arg, ast.Name):
+            owner = model._self_class(fi, arg.id)
+            if owner is not None:
+                t = ("class", owner)
+        if t is None or t[0] != "class" or t[1] not in model.classes:
+            return []
+        ci = model.classes[t[1]]
+        return [ci.methods[m] for m in ("emit", "close")
+                if m in ci.methods]
+
+
+def all_rules() -> List[ConcurrencyRule]:
+    return [SharedStateRule(), LockOrderRule(), BlockingInLockRule(),
+            SignalSafetyRule(), ReentrancyRule()]

@@ -1,0 +1,123 @@
+"""Shared-mutable-state inference for APX1001.
+
+A piece of state is *shared* when accesses to it are reachable from
+more than one execution domain — the main thread plus any discovered
+root, or two different roots.  It is a *hazard* when
+
+* at least one access is a post-``__init__`` write,
+* the union of domains spans >= 2 domains and at least one of them is
+  **preemptive** (thread/executor/http/signal/runner/sink/monitor —
+  observer and emitter callbacks run synchronously on the flushing
+  thread and never preempt anybody), and
+* the accesses do not all hold one common lock.
+
+Exemptions keep the rule quiet on sound code:
+
+* attributes/globals whose inferred type is a synchronization
+  primitive (Lock/Event/Queue/deque, ``threading.local``) — they ARE
+  the synchronization;
+* lock-ish attribute names (``_lock``, ``run_mutex``) without a typed
+  ctor;
+* writes inside the owning class's ``__init__`` — construction
+  happens-before every thread start / registration in this codebase;
+* module-level (import-time) statements — never recorded as accesses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from apex_tpu.lint.concurrency import model as model_mod
+from apex_tpu.lint.concurrency.model import Access, Model, display_name
+
+
+@dataclasses.dataclass
+class StateReport:
+    """One APX1001 hazard, ready to render."""
+    name: str                 # "Engine.state" / "faults._ACTIVE"
+    kind: str                 # "attr" | "global"
+    domains: List[str]        # sorted stable labels
+    writes: List[Access]
+    reads: List[Access]
+    anchor: Access            # where to report (first post-init write)
+
+
+def domain_label(model: Model, dom: str) -> str:
+    """Stable label for a domain id: ``main`` or ``kind(label)``."""
+    if dom == "main":
+        return "main"
+    root = model.roots[int(dom.split(":", 1)[1])]
+    return f"{root.kind}({root.label})"
+
+
+def _init_keys(model: Model, ck) -> Set:
+    ci = model.classes[ck]
+    out = set()
+    for name in ("__init__", "__post_init__"):
+        mk = ci.methods.get(name)
+        if mk is not None:
+            out.add(mk)
+    return out
+
+
+def _evaluate(model: Model, name: str, kind: str,
+              accesses: List[Access],
+              exempt_funcs: Set) -> Optional[StateReport]:
+    relevant = [a for a in accesses if a.func not in exempt_funcs]
+    writes = sorted((a for a in relevant if a.is_write),
+                    key=lambda a: (a.path, a.line, a.col))
+    if not writes:
+        return None
+    reads = [a for a in relevant if not a.is_write]
+    domains: Set[str] = set()
+    preemptive = False
+    for a in relevant:
+        for d in model.domains_of(a.func):
+            domains.add(d)
+            if d != "main" and model.roots[int(d.split(":")[1])].preemptive:
+                preemptive = True
+    if len(domains) < 2 or not preemptive:
+        return None
+    common = set(relevant[0].held)
+    for a in relevant[1:]:
+        common &= set(a.held)
+        if not common:
+            break
+    if common:
+        return None
+    labels = sorted({domain_label(model, d) for d in domains})
+    anchor = writes[0]
+    return StateReport(name, kind, labels, writes, reads, anchor)
+
+
+def shared_state_hazards(model: Model) -> List[StateReport]:
+    out: List[StateReport] = []
+    for ck in sorted(model.classes):
+        ci = model.classes[ck]
+        init_keys = _init_keys(model, ck)
+        for attr in sorted(ci.accesses):
+            if attr in ci.methods:
+                continue                     # bound-method references
+            at = ci.attr_types.get(attr)
+            if at is not None and at[0] == "sync":
+                continue
+            if model_mod._is_lockish(attr):
+                continue
+            rep = _evaluate(model, f"{ci.name}.{attr}", "attr",
+                            ci.accesses[attr], init_keys)
+            if rep is not None:
+                out.append(rep)
+    for mod in sorted(model.modules):
+        minfo = model.modules[mod]
+        for name in sorted(minfo.global_accesses):
+            gt = minfo.global_types.get(name)
+            if gt is not None and gt[0] == "sync":
+                continue
+            if model_mod._is_lockish(name):
+                continue
+            rep = _evaluate(model, f"{mod}.{name}", "global",
+                            minfo.global_accesses[name], set())
+            if rep is not None:
+                out.append(rep)
+    return out

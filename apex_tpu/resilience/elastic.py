@@ -340,10 +340,20 @@ def run_elastic(step_fn: Callable[[int], Any],
             if runner is None:
                 return manager.maybe_save(step, optimizer=optimizer,
                                           **extras)
-            return runner.run(
-                lambda: manager.maybe_save(step, optimizer=optimizer,
-                                           **extras),
-                _deadline_s(), step=step, phase="save")
+            gen = runner.generation
+
+            def save_thunk():
+                # same abandonment guard as _armed_step: a save thunk
+                # still queued when the deadline respawns the worker
+                # must not touch the manager's rotation/pin state
+                # concurrently with the recovery path's own saves
+                if runner.generation != gen:
+                    return False
+                return manager.maybe_save(step, optimizer=optimizer,
+                                          **extras)
+
+            return runner.run(save_thunk, _deadline_s(), step=step,
+                              phase="save")
 
         def _restore(restore_fn=None, sharding=None) -> Optional[int]:
             out = (restore_fn or manager.restore_latest)(

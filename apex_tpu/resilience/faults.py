@@ -241,9 +241,12 @@ class FaultInjector(_ckpt.CheckpointIO):
 
     def _mark_fired(self, idx: int) -> None:
         """Record fault ``idx`` as fired (caller holds the lock)."""
+        # every caller sits inside `with self._lock:` (the hooks'
+        # shared discipline); the helper itself stays lock-free so it
+        # can be called mid-critical-section without deadlocking
         if idx not in self._fired_idx:
-            self._fired_idx.add(idx)
-            self.fired.append(self.faults[idx])
+            self._fired_idx.add(idx)   # apexlint: disable=APX1001
+            self.fired.append(self.faults[idx])   # apexlint: disable=APX1001
 
     @classmethod
     def seeded(cls, seed: int, n_saves: int = 8,
@@ -268,7 +271,10 @@ class FaultInjector(_ckpt.CheckpointIO):
     def install(self) -> "FaultInjector":
         global _ACTIVE
         self._prev = _ckpt.set_io(self)
-        _ACTIVE = self
+        # rebound on the main thread before run_elastic arms its
+        # worker; notify_step and the fault hooks do one GIL-atomic
+        # reference read and tolerate None at any point
+        _ACTIVE = self   # apexlint: disable=APX1001
         return self
 
     def uninstall(self) -> None:

@@ -1111,6 +1111,11 @@ class FleetController:
         import collections
         self._times = collections.deque(maxlen=int(window))
         self._queue = collections.deque(maxlen=int(window))
+        # written by the hostmetrics sink (which fires on whatever
+        # thread emits fleet/hosts_slow — the monitor beat, a
+        # checkpoint worker) and read by decide() on the training
+        # thread: every touch takes the lock (APX1001)
+        self._beat_lock = threading.Lock()
         self._hosts_slow = 0.0
         self._grow_streak = 0
         self._shrink_streak = 0
@@ -1147,7 +1152,8 @@ class FleetController:
 
     def _on_counter(self, name: str, value: float) -> None:
         if name == "fleet/hosts_slow":
-            self._hosts_slow = float(value)
+            with self._beat_lock:
+                self._hosts_slow = float(value)
 
     def _on_flush(self, records) -> List[dict]:
         self.observe(records)
@@ -1227,10 +1233,12 @@ class FleetController:
         if incident:
             self._grow_streak = self._shrink_streak = 0
             return self._decision("stay", step, "open_incident", None)
-        if self._hosts_slow > 0:
+        with self._beat_lock:
+            hosts_slow = self._hosts_slow
+        if hosts_slow > 0:
             self._grow_streak = self._shrink_streak = 0
             return self._decision("stay", step, "fleet_degraded",
-                                  self._hosts_slow)
+                                  hosts_slow)
         if self._last_resize is not None and \
                 step - self._last_resize < self.cooldown_steps:
             self._grow_streak = self._shrink_streak = 0

@@ -477,12 +477,20 @@ class Engine:
             tokens = np.zeros((bucket,), np.int32)
             tokens[:plen] = np.asarray(list(req.prompt), np.int32)
             t0 = time.time()
+            # bind the dispatch operands NOW, not inside the lambda: an
+            # abandoned worker evaluates the thunk AFTER a timeout may
+            # have rebuilt self.state/self.arena (_recover_lost_arena),
+            # and a late `self.state` read there would hand the stale
+            # dispatch the FRESH donated arena — the exact corruption
+            # the dispatched flag exists to prevent
+            prefill = self.programs.prefill[bucket]
+            params, st = self.params, self.state
+            page_row = self.arena.page_row(bucket, pages)
             try:
                 with _telemetry.span("serving/prefill"):
                     k, v, first = self._deadline_run(
-                        lambda: self.programs.prefill[bucket](
-                            self.params, self.state.k, self.state.v,
-                            self.arena.page_row(bucket, pages),
+                        lambda: prefill(
+                            params, st.k, st.v, page_row,
                             jnp.asarray(tokens), jnp.int32(plen)),
                         w, phase="prefill")
             except DecodeDeadlineExceeded as e:
@@ -558,12 +566,14 @@ class Engine:
         if not self._active:
             return 0
         t0 = time.time()
+        # bind at arm time (see _admit): the worker thunk must never
+        # read self.state/self.params after recovery replaced them
+        decode = self.programs.decode
+        params, st = self.params, self.state
         try:
             with _telemetry.span("serving/decode_window"):
                 new_state = self._deadline_run(
-                    lambda: self.programs.decode(self.params,
-                                                 self.state),
-                    w, phase="decode")
+                    lambda: decode(params, st), w, phase="decode")
         except DecodeDeadlineExceeded as e:
             self._handle_hung_decode(e)
             return 0

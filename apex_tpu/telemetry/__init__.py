@@ -23,6 +23,10 @@ flags as APX101 (and whose runtime twin is APX102).  Core invariant:
 - :class:`RetraceCounter` (retrace.py): counts recompiles at run time
   via ``jax.monitoring`` (plus a per-function wrapper fallback) — the
   runtime companion to the APX30x static rules.
+- :class:`WatchedLock` (lockwatch.py): opt-in lock wrapper emitting
+  ``lock/<name>/wait_ms`` / ``held_ms`` hostmetrics — the runtime
+  companion to apexrace's APX100x lock-domain rules, free when no
+  sink is registered.
 - ``python -m apex_tpu.telemetry summarize <run_dir>...`` (cli.py):
   render a run's JSONL as step/span/retrace tables, stdlib-only
   (several run dirs merge host-tagged).
@@ -49,6 +53,7 @@ from apex_tpu.telemetry.emitters import (CsvEmitter, Emitter,
                                          JsonlEmitter, StepLogger)
 from apex_tpu.telemetry.export import MetricsServer
 from apex_tpu.telemetry.incident import IncidentLog
+from apex_tpu.telemetry.lockwatch import WatchedLock
 from apex_tpu.telemetry.retrace import RetraceCounter
 from apex_tpu.telemetry.ring import MetricRing
 from apex_tpu.telemetry.session import DEFAULT_METRICS, Telemetry
@@ -58,5 +63,6 @@ __all__ = [
     "MetricRing", "Telemetry", "DEFAULT_METRICS",
     "Emitter", "JsonlEmitter", "CsvEmitter", "StepLogger",
     "MetricsServer", "IncidentLog",
-    "RetraceCounter", "span", "emit_metric", "profiler",
+    "RetraceCounter", "WatchedLock", "span", "emit_metric",
+    "profiler",
 ]

@@ -26,7 +26,10 @@ class SinkRegistry:
 
     def add(self, fn: Callable[[str, float], None]) -> None:
         with self._lock:
-            self._sinks.append(fn)
+            # all mutation happens under _lock; the one unlocked
+            # access is emit's truthiness fast path, a deliberate
+            # GIL-atomic read so disabled telemetry costs nothing
+            self._sinks.append(fn)   # apexlint: disable=APX1001
 
     def remove(self, fn: Callable[[str, float], None]) -> None:
         with self._lock:

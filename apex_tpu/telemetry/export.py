@@ -197,7 +197,10 @@ class MetricsServer(Emitter):
 
     # ---- intake (all host-side, never in the traced step) ----------------
     def _set(self, name: str, value: float) -> None:
-        self._gauges[metric_name(name, self.prefix)] = float(value)
+        # callers-hold-lock helper: every caller (_on_counter,
+        # _on_flush, emit) sits inside `with self._lock:`, and the
+        # render/health readers snapshot under the same lock
+        self._gauges[metric_name(name, self.prefix)] = float(value)   # apexlint: disable=APX1001
 
     def _on_counter(self, name: str, value: float) -> None:
         """hostmetrics sink: fires the instant a producer emits (the
@@ -208,6 +211,9 @@ class MetricsServer(Emitter):
         with self._lock:
             self._set(name, value)
             key = metric_name(name, self.prefix) + "_total"
+            # _totals shares _set's discipline: the lock-free writer
+            # _bump is only reached from emit's locked section
+            # apexlint: disable-next=APX1001
             self._totals[key] = self._totals.get(key, 0.0) \
                 + float(value)
 

@@ -38,6 +38,14 @@ def emit(name: str, value: float) -> None:
     _registry.emit(name, float(value))
 
 
+def active() -> bool:
+    """True when at least one sink is registered — the same
+    GIL-atomic truthiness read ``emit`` uses, exposed so a producer
+    whose *measurement* costs something (lockwatch's clock reads) can
+    skip it entirely while telemetry is off."""
+    return bool(_registry._sinks)
+
+
 class CounterStats:
     """Per-name aggregate a session keeps between flushes: count,
     total, max and the LAST value (``ckpt/restore_step`` is a

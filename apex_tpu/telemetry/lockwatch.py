@@ -1,0 +1,115 @@
+"""Lock contention telemetry: hold/wait times for host-side locks.
+
+The runtime companion to apexrace's static lock-domain analysis, the
+way :class:`~apex_tpu.telemetry.retrace.RetraceCounter` is the runtime
+companion to the APX30x retrace rules: APX1003 flags a blocking call
+under a lock *structurally*; :class:`WatchedLock` measures what the
+lock actually costs at run time — how long callers waited to get in
+(``lock/<name>/wait_ms``) and how long each holder kept everyone else
+out (``lock/<name>/held_ms``).
+
+Both numbers ride :mod:`~apex_tpu.telemetry.hostmetrics` — the same
+SinkRegistry the checkpoint worker and fleet monitor publish through —
+so they aggregate in the session's :class:`CounterStats`, flush as
+``kind: "counter"`` records, render in ``python -m apex_tpu.telemetry
+summarize`` next to ``ckpt/*`` and ``fleet/*``, and flip live
+``/metrics`` gauges when a :class:`MetricsServer` is up.  Nothing new
+is wired anywhere.
+
+Opt-in by construction (the ``_tape`` discipline): wrap only the locks
+you suspect —
+
+>>> self._lock = lockwatch.WatchedLock("export")      # was Lock()
+>>> with self._lock: ...                              # unchanged
+
+With no hostmetrics sink registered the wrapper skips its clock reads
+entirely (one GIL-atomic ``hostmetrics.active()`` truthiness check per
+acquire, the same fast path ``emit`` itself uses), so an unobserved
+watched lock costs only its Python-level ``acquire``/``release``
+dispatch; the ``lockwatch_overhead`` kernel_bench row holds that to
+~1.0x on a flush-shaped critical section.
+
+Timing discipline: wait is measured *around* the acquire; hold is
+measured acquire-to-release but emitted AFTER the release, so the
+emit's own sink fan-out never extends the critical section it is
+reporting on (the exporter's ``_on_counter`` takes its own lock — a
+watched lock emitting while held would nest them and hand apexrace an
+APX1002 ordering edge for free).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from apex_tpu.telemetry import hostmetrics as _hostmetrics
+
+
+class WatchedLock:
+    """Context-manager lock proxy emitting ``lock/<name>/wait_ms`` and
+    ``lock/<name>/held_ms`` hostmetrics per outermost acquire/release.
+
+    Wraps a fresh ``threading.Lock`` by default; pass ``lock=`` to
+    watch an existing ``Lock``/``RLock`` (reentrant acquires are
+    depth-counted — one wait/held pair per outermost cycle, since the
+    inner acquires neither wait nor exclude anyone)."""
+
+    def __init__(self, name: str, lock: Optional[object] = None):
+        self.name = str(name)
+        self._lock = lock if lock is not None else threading.Lock()
+        # metric names are per-acquire hot-path strings: built once
+        self._wait_name = f"lock/{self.name}/wait_ms"
+        self._held_name = f"lock/{self.name}/held_ms"
+        # both fields are written only while self._lock is held, so
+        # the watched lock is its own guard; _t_acquired < 0 marks a
+        # cycle whose acquire ran with telemetry off (no emit then)
+        self._depth = 0
+        self._t_acquired = -1.0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not _hostmetrics.active():
+            # telemetry off: skip the clock reads too, not just the
+            # emits — the sentinel keeps a sink registered mid-hold
+            # from charging this cycle a bogus held time
+            ok = self._lock.acquire(blocking, timeout)
+            if ok:
+                self._depth += 1
+                if self._depth == 1:
+                    self._t_acquired = -1.0
+            return ok
+        t0 = time.perf_counter()
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            now = time.perf_counter()
+            self._depth += 1
+            if self._depth == 1:
+                self._t_acquired = now
+                _hostmetrics.emit(self._wait_name, (now - t0) * 1e3)
+        return ok
+
+    def release(self) -> None:
+        self._depth -= 1
+        if self._depth == 0 and self._t_acquired >= 0.0:
+            held_ms = (time.perf_counter() - self._t_acquired) * 1e3
+            self._lock.release()
+            # emitted after release: the fan-out must never extend the
+            # critical section it measures (module docstring)
+            _hostmetrics.emit(self._held_name, held_ms)
+        else:
+            self._lock.release()
+
+    def locked(self) -> bool:
+        probe = getattr(self._lock, "locked", None)
+        return bool(probe()) if probe is not None else self._depth > 0
+
+    def __enter__(self) -> "WatchedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return (f"WatchedLock({self.name!r}, "
+                f"depth={self._depth})")

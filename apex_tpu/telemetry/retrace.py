@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import threading
 from typing import Dict, List, Optional
 
 COMPILE_EVENT_PREFIX = "/jax/core/compile"
@@ -37,6 +38,12 @@ class RetraceCounter:
         self.events: Dict[str, int] = collections.Counter()
         self.compile_secs: float = 0.0
         self._listener = None
+        # the monitoring listener fires on whatever thread triggers a
+        # compile (a DeadlineRunner worker arming a dispatch, an async
+        # checkpoint writer's first device_get) while the reporting
+        # side reads from the flush thread — every counter touch takes
+        # this lock (APX1001)
+        self._lock = threading.Lock()
 
     # ---- jax.monitoring hook --------------------------------------------
     def install(self) -> bool:
@@ -52,8 +59,9 @@ class RetraceCounter:
 
         def _on_duration(event, duration, **kwargs):
             if event.startswith(COMPILE_EVENT_PREFIX):
-                self.events[event] += 1
-                self.compile_secs += float(duration)
+                with self._lock:
+                    self.events[event] += 1
+                    self.compile_secs += float(duration)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
         self._listener = _on_duration
@@ -81,7 +89,8 @@ class RetraceCounter:
 
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
-            self.counts[label] += 1
+            with self._lock:
+                self.counts[label] += 1
             return fn(*args, **kwargs)
 
         return wrapped
@@ -89,21 +98,29 @@ class RetraceCounter:
     # ---- reporting --------------------------------------------------------
     def traces(self) -> int:
         """Process-wide trace count seen via jax.monitoring."""
-        return self.events.get(
-            COMPILE_EVENT_PREFIX + "/jaxpr_trace_duration", 0)
+        with self._lock:
+            return self.events.get(
+                COMPILE_EVENT_PREFIX + "/jaxpr_trace_duration", 0)
 
     def retraces(self) -> Dict[str, int]:
         """Per wrapped function: traces beyond the expected first."""
-        return {k: v - 1 for k, v in sorted(self.counts.items()) if v > 1}
+        with self._lock:
+            counts = dict(self.counts)
+        return {k: v - 1 for k, v in sorted(counts.items()) if v > 1}
 
     def records(self, step=None) -> List[dict]:
         out = []
         base = {"step": step} if step is not None else {}
+        with self._lock:
+            counts = dict(self.counts)
+            compile_secs = self.compile_secs
+            traces = self.events.get(
+                COMPILE_EVENT_PREFIX + "/jaxpr_trace_duration", 0)
         if self._listener is not None:
             out.append({"kind": "retrace", "name": "<process>",
-                        "traces": self.traces(),
-                        "compile_s": round(self.compile_secs, 3), **base})
-        for name, n in sorted(self.counts.items()):
+                        "traces": traces,
+                        "compile_s": round(compile_secs, 3), **base})
+        for name, n in sorted(counts.items()):
             out.append({"kind": "retrace", "name": name, "traces": n,
                         "retraces": n - 1, **base})
         return out

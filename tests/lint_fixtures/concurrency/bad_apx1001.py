@@ -1,0 +1,20 @@
+"""APX1001: the worker thread and the main path both touch
+``self.total`` with no common lock."""
+import threading
+
+
+class Accumulator:
+    def __init__(self):
+        self.total = 0
+
+    def _work(self):
+        for _ in range(100):
+            self.total += 1
+
+    def start(self):
+        t = threading.Thread(target=self._work)
+        t.start()
+        return t
+
+    def report(self):
+        return self.total

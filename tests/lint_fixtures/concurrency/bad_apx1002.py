@@ -1,0 +1,24 @@
+"""APX1002: ``_a`` then ``_b`` on the worker, ``_b`` then ``_a`` on
+the main path — a lock-order inversion that deadlocks under load."""
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def _worker():
+    with _a:
+        with _b:
+            pass
+
+
+def main_path():
+    with _b:
+        with _a:
+            pass
+
+
+def start():
+    t = threading.Thread(target=_worker)
+    t.start()
+    return t

@@ -1,0 +1,13 @@
+"""APX1003: ``time.sleep`` inside the critical section — every other
+flush waits out the nap."""
+import threading
+import time
+
+_lock = threading.Lock()
+_pending = []
+
+
+def flush():
+    with _lock:
+        time.sleep(0.1)
+        _pending.clear()

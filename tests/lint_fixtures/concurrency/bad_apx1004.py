@@ -1,0 +1,12 @@
+"""APX1004: the SIGTERM handler does I/O — ``open`` is not
+async-signal-safe and can re-enter malloc mid-interrupt."""
+import signal
+
+
+def _on_term(signum, frame):
+    with open("/tmp/dying", "w") as fh:
+        fh.write("terminated\n")
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

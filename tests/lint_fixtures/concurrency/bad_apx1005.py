@@ -1,0 +1,30 @@
+"""APX1005: a registered callback calls back into the registry's own
+dispatcher — re-entrant fan-out (and a deadlock if the dispatcher ever
+takes a lock around the callback loop)."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._subs = []
+        self._lock = threading.Lock()
+
+    def add(self, fn):
+        with self._lock:
+            self._subs.append(fn)
+
+    def emit(self, value):
+        with self._lock:
+            subs = list(self._subs)
+        for fn in subs:
+            fn(value)
+
+
+broadcast = Registry()
+
+
+def naughty_cb(value):
+    broadcast.emit(value)
+
+
+broadcast.add(naughty_cb)

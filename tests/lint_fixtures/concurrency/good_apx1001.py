@@ -1,0 +1,22 @@
+"""Clean twin: every ``self.total`` touch holds ``self._lock``."""
+import threading
+
+
+class Accumulator:
+    def __init__(self):
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def _work(self):
+        for _ in range(100):
+            with self._lock:
+                self.total += 1
+
+    def start(self):
+        t = threading.Thread(target=self._work)
+        t.start()
+        return t
+
+    def report(self):
+        with self._lock:
+            return self.total

@@ -1,0 +1,24 @@
+"""Clean twin: both paths acquire in the same ``_a`` -> ``_b``
+order, so the wait-for graph stays acyclic."""
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def _worker():
+    with _a:
+        with _b:
+            pass
+
+
+def main_path():
+    with _a:
+        with _b:
+            pass
+
+
+def start():
+    t = threading.Thread(target=_worker)
+    t.start()
+    return t

@@ -1,0 +1,13 @@
+"""Clean twin: the blocking call runs before the lock is taken; the
+critical section only mutates the shared list."""
+import threading
+import time
+
+_lock = threading.Lock()
+_pending = []
+
+
+def flush():
+    time.sleep(0.1)
+    with _lock:
+        _pending.clear()

@@ -1,0 +1,14 @@
+"""Clean twin: the handler only sets an Event; the main loop does the
+I/O at its next safe point."""
+import signal
+import threading
+
+_stop = threading.Event()
+
+
+def _on_term(signum, frame):
+    _stop.set()
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

@@ -1,0 +1,29 @@
+"""Clean twin: the callback observes the value and returns — it never
+re-enters the registry that dispatched it."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._subs = []
+        self._lock = threading.Lock()
+
+    def add(self, fn):
+        with self._lock:
+            self._subs.append(fn)
+
+    def emit(self, value):
+        with self._lock:
+            subs = list(self._subs)
+        for fn in subs:
+            fn(value)
+
+
+broadcast = Registry()
+
+
+def polite_cb(value):
+    return value + 1
+
+
+broadcast.add(polite_cb)

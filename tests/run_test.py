@@ -73,6 +73,9 @@ SUITES = {
     # apexverify: jaxpr-level invariant specs over the public jitted
     # entry points + the findings-baseline diff gate (tools/check.sh)
     "run_lint_semantic": ["tests/test_lint_semantic.py"],
+    # apexrace: thread-root/shared-state/lock-domain analysis over the
+    # whole package + the races it surfaced (regression tests)
+    "run_lint_concurrency": ["tests/test_lint_concurrency.py"],
     # the serving path: paged KV arena, AOT prefill/decode programs,
     # the continuous-batching engine and its chaos matrix (hung
     # decode, shed, drain, replica failover)

@@ -677,6 +677,8 @@ def test_controller_queue_grow_with_patience_and_candidates():
     c2.observe(_queue_records(500.0))
     d = c2.decide(1, n_hosts=2, candidates=0)
     assert (d.action, d.reason) == ("stay", "grow_wanted_no_candidates")
+    c.close()
+    c2.close()
 
 
 def test_controller_shrink_on_low_queue_respects_min_hosts():
@@ -693,6 +695,8 @@ def test_controller_shrink_on_low_queue_respects_min_hosts():
                          queue_low=5.0, patience=1, min_hosts=2)
     c3.observe(_queue_records(1.0))
     assert c3.decide(1, n_hosts=2).reason == "at_min_hosts"
+    c.close()
+    c3.close()
 
 
 def test_controller_step_time_signal():
@@ -706,6 +710,8 @@ def test_controller_step_time_signal():
     for s in range(8):
         c2.note_step(s, 0.001)
     assert c2.decide(9, n_hosts=2).action == "shrink"
+    c.close()
+    c2.close()
 
 
 def test_controller_cooldown_after_any_resize():
@@ -720,6 +726,7 @@ def test_controller_cooldown_after_any_resize():
     assert (d.action, d.reason) == ("stay", "cooldown")
     d = c.decide(11, n_hosts=3, candidates=1)
     assert d.action == "grow"                 # cooldown expired
+    c.close()
 
 
 def test_controller_never_resizes_inside_open_incident():
@@ -734,6 +741,8 @@ def test_controller_never_resizes_inside_open_incident():
     c2.observe(_queue_records(500.0))
     assert c2.decide(1, n_hosts=2, candidates=1).reason == \
         "open_incident"
+    c.close()
+    c2.close()
 
 
 def test_controller_holds_while_fleet_degraded():
@@ -761,6 +770,7 @@ def test_controller_max_hosts_caps_grow():
     assert c.decide(1, n_hosts=3, candidates=1).reason == \
         "at_max_hosts"
     assert c.decide(2, n_hosts=2, candidates=1).action == "grow"
+    c.close()
 
 
 def test_controller_decisions_ride_session_flush(tmp_path):
@@ -797,6 +807,7 @@ def test_autoscale_requires_fleet(tmp_path):
     with pytest.raises(ValueError, match="fleet"):
         run_elastic(job.step_fn, job.mgr, job.opt, total_steps=2,
                     params_like=job.template, autoscale=c)
+    c.close()
     job.close()
 
 

@@ -1,0 +1,459 @@
+"""apexrace: fixture matrix, root discovery over the repo's own
+registration seams, suppression + CLI + baseline contract, and
+regression tests for the real races the tier surfaced in the shipped
+tree (serving engine late-binding, retrace counter lock, fleet beat
+lock, elastic save-thunk generation guard).
+
+Fixtures in tests/lint_fixtures/concurrency/ are linted as text, never
+imported — the bad ones contain deliberate hazards.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from apex_tpu.lint import engine
+from apex_tpu.lint.concurrency import (DEFAULT_BASELINE, all_rules,
+                                       build_model,
+                                       lint_concurrency_source,
+                                       rule_catalog, rule_ids,
+                                       run_concurrency)
+from apex_tpu.lint.concurrency import roots as roots_mod
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures", "concurrency")
+REPO = os.path.dirname(HERE)
+
+# fixture file -> exactly the rule ids it must (and may) trigger —
+# equality keeps each fixture family-pure (test_lint.py's contract)
+BAD_FIXTURES = {
+    "bad_apx1001.py": {"APX1001"},
+    "bad_apx1002.py": {"APX1002"},
+    "bad_apx1003.py": {"APX1003"},
+    "bad_apx1004.py": {"APX1004"},
+    "bad_apx1005.py": {"APX1005"},
+}
+GOOD_FIXTURES = [
+    "good_apx1001.py", "good_apx1002.py", "good_apx1003.py",
+    "good_apx1004.py", "good_apx1005.py",
+]
+
+
+def _lint_fixture(name):
+    path = os.path.join(FIXTURES, name)
+    with open(path, encoding="utf-8") as fh:
+        return lint_concurrency_source(fh.read(), path)
+
+
+# ---------------------------------------------------------------------------
+# fixture matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,expected", sorted(BAD_FIXTURES.items()))
+def test_bad_fixture_flags_its_family(name, expected):
+    findings = _lint_fixture(name)
+    assert {f.rule_id for f in findings} == expected
+    for f in findings:
+        assert f.line > 0 and f.message and f.path.endswith(name)
+
+
+@pytest.mark.parametrize("name", GOOD_FIXTURES)
+def test_good_fixture_is_clean(name):
+    findings = _lint_fixture(name)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_fixture_matrix_completeness_auto_discovered():
+    """Meta-test (no hand-kept list): EVERY registered APX1xxx rule id
+    must fire from at least one bad_* fixture, and every bad_* fixture
+    must have a good_* twin that lints clean."""
+    bad = sorted(n for n in os.listdir(FIXTURES) if n.startswith("bad_"))
+    good = {n for n in os.listdir(FIXTURES) if n.startswith("good_")}
+    triggered = set()
+    for name in bad:
+        triggered |= {f.rule_id for f in _lint_fixture(name)}
+        twin = "good_" + name[len("bad_"):]
+        assert twin in good, f"{name} lacks its clean twin {twin}"
+        assert _lint_fixture(twin) == [], twin
+    missing = rule_ids() - triggered
+    assert not missing, (
+        f"registered rule id(s) with no bad_* fixture coverage: "
+        f"{sorted(missing)} — add a fixture pair before shipping the "
+        "rule (docs/lint.md 'Extending')")
+
+
+def test_rule_catalog_shape():
+    ids = sorted(r.id for r in all_rules())
+    assert ids == ["APX1001", "APX1002", "APX1003", "APX1004",
+                   "APX1005"]
+    for rid, name, desc in rule_catalog():
+        assert rid.startswith("APX1") and name and desc
+
+
+# ---------------------------------------------------------------------------
+# root discovery over the repo's own seams
+# ---------------------------------------------------------------------------
+
+def _roots_of(*relpaths):
+    parsed = []
+    for rel in relpaths:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            one = engine._parse_file(fh.read(), rel)
+        assert one is not None and not hasattr(one, "rule_id"), rel
+        parsed.append(one[0])
+    return roots_mod.discover(build_model(parsed))
+
+
+def test_root_finder_sees_preemption_guard_signal_handler():
+    kinds = {(r.kind, r.label)
+             for r in _roots_of("apex_tpu/resilience/preemption.py")}
+    assert ("signal", "self._on_signal") in kinds
+
+
+def test_root_finder_sees_metrics_server_seams():
+    """export.py alone carries four seams: the threaded http server,
+    its handler class, the hostmetrics sink, and the
+    Telemetry.add_observer registration."""
+    rs = _roots_of("apex_tpu/telemetry/export.py")
+    pairs = {(r.kind, r.label) for r in rs}
+    assert ("http", "_Handler.do_GET") in pairs
+    assert ("thread", "self._httpd.serve_forever") in pairs
+    assert ("sink", "self._on_counter") in pairs
+    assert ("observer", "self._on_flush") in pairs   # add_observer seam
+
+
+def test_root_finder_sees_deadline_runner_thunks():
+    rs = _roots_of("apex_tpu/resilience/elastic.py",
+                   "apex_tpu/resilience/fleet.py")
+    runner_labels = {r.label for r in rs if r.kind == "runner"}
+    assert {"thunk", "save_thunk"} <= runner_labels
+    # the runner's persistent worker loop is itself a thread root
+    assert any(r.kind == "thread" and r.label == "loop" for r in rs)
+
+
+def test_root_finder_sees_engine_deadline_and_executor():
+    rs = _roots_of("apex_tpu/serving/engine.py")
+    assert any(r.kind == "runner" and r.label == "thunk" for r in rs)
+    assert any(r.kind == "executor" for r in rs)
+
+
+def test_root_preemptive_partition():
+    """Observer/emitter/atexit callbacks run on the flush (main)
+    thread — they widen reachability but are not preemptive; every
+    true concurrency source is."""
+    mk = lambda kind: roots_mod.Root(kind=kind, target=None,
+                                     label="x", path="p.py", line=1)
+    for kind in sorted(roots_mod.PREEMPTIVE_KINDS):
+        assert mk(kind).preemptive, kind
+    for kind in ("observer", "emitter", "atexit"):
+        assert not mk(kind).preemptive, kind
+
+
+# ---------------------------------------------------------------------------
+# suppression semantics (shared with the AST tier's pragma parser)
+# ---------------------------------------------------------------------------
+
+def _bad_src(name="bad_apx1001.py"):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_suppress_same_line():
+    src = _bad_src().replace(
+        "self.total += 1",
+        "self.total += 1   # apexlint: disable=APX1001")
+    assert lint_concurrency_source(src, "t.py") == []
+
+
+def test_suppress_next_line():
+    src = _bad_src().replace(
+        "            self.total += 1",
+        "            # apexlint: disable-next=APX1001\n"
+        "            self.total += 1")
+    assert lint_concurrency_source(src, "t.py") == []
+
+
+def test_wrong_rule_id_does_not_suppress():
+    src = _bad_src().replace(
+        "self.total += 1",
+        "self.total += 1   # apexlint: disable=APX1002")
+    assert [f.rule_id for f in
+            lint_concurrency_source(src, "t.py")] == ["APX1001"]
+
+
+# ---------------------------------------------------------------------------
+# CLI + baseline contract
+# ---------------------------------------------------------------------------
+
+def _cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "apex_tpu.lint"] + args,
+        capture_output=True, text=True, cwd=cwd, timeout=120)
+
+
+@pytest.fixture
+def bad_tree(tmp_path):
+    """A lintable copy of the bad APX1001 fixture, outside any
+    lint_fixtures/ dir (collect_files prunes those)."""
+    work = tmp_path / "pkg"
+    work.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "bad_apx1001.py"),
+                work / "mod.py")
+    return work
+
+
+def test_cli_concurrency_finds_and_filters(bad_tree):
+    proc = _cli(["--concurrency", str(bad_tree)])
+    assert proc.returncode == 1
+    assert "APX1001" in proc.stdout
+
+    assert _cli(["--concurrency", "--ignore", "APX1001",
+                 str(bad_tree)]).returncode == 0
+    assert _cli(["--concurrency", "--select", "APX1002",
+                 str(bad_tree)]).returncode == 0
+    sel = _cli(["--concurrency", "--select", "APX1001", str(bad_tree)])
+    assert sel.returncode == 1 and "APX1001" in sel.stdout
+    # unknown APX1xxx-looking id is a usage error, not silence
+    assert _cli(["--concurrency", "--select", "APX1099",
+                 str(bad_tree)]).returncode == 2
+
+
+def test_cli_concurrency_json(bad_tree):
+    proc = _cli(["--concurrency", "--json", str(bad_tree)])
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert any(f["rule_id"] == "APX1001" for f in payload["findings"])
+
+
+def test_cli_write_baseline_guards(bad_tree, tmp_path):
+    """--write-baseline must name exactly one target: explicit file
+    always wins; a bare run or an ambiguous two-tier run exits 2
+    rather than guessing which SHIPPED baseline to overwrite."""
+    bl = tmp_path / "bl.json"
+    proc = _cli(["--concurrency", "--write-baseline",
+                 "--baseline", str(bl), str(bad_tree)])
+    assert proc.returncode == 0 and bl.exists()
+    keys = json.load(open(bl))["findings"]
+    assert any(k["rule_id"] == "APX1001" for k in keys)
+
+    # no tier, no file: refuse
+    assert _cli(["--write-baseline", str(bad_tree)]).returncode == 2
+    # both tiers, no file: ambiguous, refuse
+    assert _cli(["--semantic", "--concurrency", "--write-baseline",
+                 str(bad_tree)]).returncode == 2
+
+    # the written baseline makes the same run exit 0, rendered
+    # [baselined] — found, reported, never gating
+    proc = _cli(["--concurrency", "--baseline", str(bl),
+                 str(bad_tree)])
+    assert proc.returncode == 0
+    assert "[baselined]" in proc.stdout
+
+
+def test_shipped_tree_concurrency_gate_and_budget():
+    """The acceptance criterion + the tier's share of the tools/
+    check.sh wall-clock budget: `--concurrency apex_tpu/` exits 0 on
+    the shipped tree, renders every baselined finding `[baselined]`,
+    and rounds in well under the 60 s full-gate budget on one CPU
+    core."""
+    t0 = time.monotonic()
+    proc = _cli(["--concurrency", "apex_tpu/"])
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    shipped = json.load(open(DEFAULT_BASELINE))["findings"]
+    assert shipped, "shipped concurrency baseline unexpectedly empty"
+    assert proc.stdout.count("[baselined]") == len(shipped)
+    assert elapsed < 60.0, f"concurrency gate took {elapsed:.1f}s"
+
+
+def test_run_concurrency_prunes_fixture_dirs():
+    """Walking tests/ (the relaxed-profile gate's shape) never
+    descends into the deliberately-hazardous lint_fixtures tree, so
+    the bad_apx* fixtures cannot leak findings into a real run."""
+    files = engine.collect_files([HERE])
+    assert files and not [p for p in files if "lint_fixtures" in p]
+    findings, _ = run_concurrency([HERE])
+    assert not [f for f in findings if "lint_fixtures" in f.path]
+
+
+# ---------------------------------------------------------------------------
+# regressions: the real races apexrace surfaced in the shipped tree
+# ---------------------------------------------------------------------------
+
+def test_retrace_counter_concurrent_bumps_lose_nothing():
+    """APX1001 fix (telemetry/retrace.py): the monitoring listener and
+    wrapped-function bumps fire on arbitrary threads while the flush
+    thread reads — every counter touch now takes the lock.  Without
+    it, `Counter[label] += 1` is a read-modify-write that drops
+    increments under thread switches."""
+    from apex_tpu.telemetry import RetraceCounter
+
+    c = RetraceCounter()
+    wrapped = c.wrap(lambda: None, name="hot")
+    n_threads, per_thread = 4, 20_000
+    # parties: the bumpers, the reader, and main releasing the race
+    barrier = threading.Barrier(n_threads + 2)
+    done = threading.Event()
+    snapshots = []
+
+    def bumper():
+        barrier.wait()
+        for _ in range(per_thread):
+            wrapped()
+
+    def reader():
+        barrier.wait()
+        while not done.is_set():
+            snapshots.append(c.records())
+
+    threads = [threading.Thread(target=bumper)
+               for _ in range(n_threads)]
+    rd = threading.Thread(target=reader)
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)       # force frequent thread switches
+    try:
+        for t in threads:
+            t.start()
+        rd.start()
+        barrier.wait()
+        for t in threads:
+            t.join()
+    finally:
+        done.set()
+        rd.join()
+        sys.setswitchinterval(old)
+    assert c.counts["hot"] == n_threads * per_thread
+    assert snapshots                  # the reader really raced the bumps
+
+
+def test_fleet_controller_beat_intake_is_synchronized():
+    """APX1001 fix (resilience/fleet.py): the `fleet/hosts_slow`
+    hostmetrics sink fires on monitor/worker threads while decide()
+    reads on the supervisor thread — both sides now hold _beat_lock.
+    Writers and a decide() reader race behind a barrier; the last
+    write must be visible and nothing may throw."""
+    from apex_tpu.resilience import fleet as fleet_mod
+    from apex_tpu.telemetry import hostmetrics
+
+    ctrl = fleet_mod.FleetController(step_time_high_s=1e9,
+                                     cooldown_steps=0)
+    n_threads, per_thread = 4, 2_000
+    # parties: the writers, the decider, and main releasing the race
+    barrier = threading.Barrier(n_threads + 2)
+    errors = []
+
+    def writer(v):
+        barrier.wait()
+        for _ in range(per_thread):
+            hostmetrics.emit("fleet/hosts_slow", v)
+
+    def decider():
+        barrier.wait()
+        try:
+            for step in range(per_thread):
+                ctrl.decide(step, n_hosts=4)
+        except BaseException as e:    # noqa: BLE001 — reported below
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=writer, args=(float(i),))
+                   for i in range(n_threads)]
+        threads.append(threading.Thread(target=decider))
+        for t in threads:
+            t.start()
+        barrier.wait()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # quiesced: one more beat through the public path is visible
+        hostmetrics.emit("fleet/hosts_slow", 2.0)
+        with ctrl._beat_lock:
+            assert ctrl._hosts_slow == 2.0
+    finally:
+        ctrl.close()
+
+
+def test_engine_deadline_thunks_bind_state_before_submission():
+    """APX1001 fix (serving/engine.py): the deadline-runner thunks
+    must capture programs/params/state BEFORE submission — a thunk
+    reading `self.*` late can race replica-failover recovery swapping
+    those attributes and execute half-old, half-new state.  Pin the
+    closure shape: no lambda under _admit/_decode closes over self."""
+    import types
+
+    from apex_tpu.serving.engine import Engine
+
+    def lambdas_of(code):
+        out = []
+        for k in code.co_consts:
+            if isinstance(k, types.CodeType):
+                if k.co_name == "<lambda>":
+                    out.append(k)
+                out.extend(lambdas_of(k))
+        return out
+
+    for meth, want in (("_admit", {"prefill", "params", "st"}),
+                       ("_decode", {"decode", "params", "st"})):
+        lams = lambdas_of(getattr(Engine, meth).__code__)
+        assert lams, f"{meth} lost its deadline thunk"
+        for lam in lams:
+            free = set(lam.co_freevars)
+            assert "self" not in free, (
+                f"{meth} deadline thunk captures self again: {free}")
+        assert any(want <= set(lam.co_freevars) for lam in lams), (
+            f"{meth} thunk no longer pre-binds {want}")
+
+
+def test_elastic_save_thunk_rechecks_generation(tmp_path, monkeypatch):
+    """APX1001 fix (resilience/elastic.py): a save thunk executed by a
+    worker the deadline machinery already abandoned must skip
+    manager.maybe_save — the recovery path owns the manager's
+    rotation/pin state now.  Simulate exactly that interleaving by
+    bumping runner.generation between the closure's capture and its
+    execution; the guard must return False without saving."""
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.resilience import run_elastic
+    from apex_tpu.resilience import fleet as fleet_mod
+    from apex_tpu.resilience.manager import CheckpointManager
+
+    stale_saves = []
+    real_run = fleet_mod.DeadlineRunner.run
+
+    def hijack(self, fn, deadline_s, step=-1, phase="step"):
+        if phase == "save":
+            self.generation += 1      # "abandoned after capture"
+            stale_saves.append(fn())  # the stale worker runs it anyway
+            return False
+        return real_run(self, fn, deadline_s, step=step, phase=phase)
+
+    monkeypatch.setattr(fleet_mod.DeadlineRunner, "run", hijack)
+
+    tree = {"w": jnp.ones((8,), jnp.float32)}
+    opt = FusedAdam(tree, lr=1e-2)
+    g = {"w": jnp.full((8,), 0.01, jnp.float32)}
+    mgr = CheckpointManager(str(tmp_path), keep=2, every=2)
+    real_saves = []
+    orig_maybe_save = mgr.maybe_save
+    monkeypatch.setattr(
+        mgr, "maybe_save",
+        lambda *a, **k: real_saves.append(a) or orig_maybe_save(*a, **k))
+    try:
+        res = run_elastic(lambda step: opt.step(g), mgr, opt,
+                          total_steps=4, step_deadline=30.0,
+                          backoff_s=0.0)
+    finally:
+        mgr.close()
+    assert res.step == 4 and not res.preempted
+    assert stale_saves and all(v is False for v in stale_saves), (
+        "stale save thunk ran manager.maybe_save instead of "
+        f"skipping: {stale_saves}")
+    assert real_saves == [], (
+        "abandoned-generation save thunk still reached the manager")

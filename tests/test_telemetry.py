@@ -517,6 +517,91 @@ def test_retrace_counter_monitoring_hook_counts_compiles():
 
 
 # ---------------------------------------------------------------------------
+# lockwatch (the RetraceCounter pattern for locks)
+# ---------------------------------------------------------------------------
+
+def test_watched_lock_counters_ride_flush_and_summarize(tmp_path,
+                                                        capsys):
+    d = str(tmp_path / "run")
+    with telemetry.Telemetry(d, window=2, retrace=False) as tel:
+        lk = telemetry.WatchedLock("export")
+        for step in (1, 2):
+            with lk:
+                pass
+            tel.record({"loss": 1.0 / step}, step)
+        recs = {r["name"]: r for r in tel.counters.records()}
+        assert recs["lock/export/held_ms"]["count"] == 2
+        assert recs["lock/export/wait_ms"]["count"] == 2
+        assert recs["lock/export/held_ms"]["total"] >= 0.0
+    # the lock/* counters render next to ckpt/* in summarize
+    assert telemetry_cli(["summarize", d]) == 0
+    out = capsys.readouterr().out
+    assert "counters (cumulative):" in out
+    assert "lock/export/held_ms" in out and "lock/export/wait_ms" in out
+
+
+def test_watched_lock_rlock_reentrancy_one_pair_per_cycle():
+    tel = telemetry.Telemetry(run_dir=None, metrics=("loss",),
+                              retrace=False)
+    rl = telemetry.WatchedLock("nested", lock=threading.RLock())
+    with rl:
+        with rl:                      # inner acquire: no wait, no emit
+            assert rl.locked()
+    pairs = {r["name"]: r["count"] for r in tel.counters.records()}
+    assert pairs == {"lock/nested/wait_ms": 1,
+                     "lock/nested/held_ms": 1}
+    tel.close()
+
+
+def test_watched_lock_off_path_and_mid_hold_sink_registration():
+    """With no sink the wrapper emits nothing; a sink registered
+    MID-hold must not be charged a bogus held time for a cycle whose
+    acquire ran untimed (the sentinel guard)."""
+    # the premise is "telemetry off": a sink leaked by an earlier test
+    # anywhere in the suite would turn the first acquire into a timed
+    # cycle and break it, so assert the suite-hygiene contract here
+    from apex_tpu.telemetry import hostmetrics
+    assert not hostmetrics.active(), \
+        "hostmetrics sink leaked by an earlier test"
+    lk = telemetry.WatchedLock("race")
+    lk.acquire()                      # telemetry off: untimed cycle
+    tel = telemetry.Telemetry(run_dir=None, metrics=("loss",),
+                              retrace=False)
+    lk.release()
+    assert tel.counters.records() == []
+    with lk:                          # fully-observed cycle: one pair
+        pass
+    pairs = {r["name"]: r["count"] for r in tel.counters.records()}
+    assert pairs == {"lock/race/wait_ms": 1, "lock/race/held_ms": 1}
+    tel.close()
+
+
+def test_watched_lock_actually_excludes():
+    """The proxy is a real lock: racing increments through it lose
+    nothing (barrier start, exact final count)."""
+    lk = telemetry.WatchedLock("mutex")
+    n_threads, per_thread = 4, 5_000
+    state = {"n": 0}
+    barrier = threading.Barrier(n_threads + 1)
+
+    def worker():
+        barrier.wait()
+        for _ in range(per_thread):
+            with lk:
+                state["n"] += 1
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    for t in threads:
+        t.join()
+    assert state["n"] == n_threads * per_thread
+    assert not lk.locked()
+
+
+# ---------------------------------------------------------------------------
 # CLI summarize
 # ---------------------------------------------------------------------------
 
@@ -564,6 +649,16 @@ def test_telemetry_overhead_bench_smoke():
     assert r["telemetry_on_ms"] > 0
     assert "telemetry_overhead_pct" in r
     assert r["telemetry_flush_ms"] >= 0
+
+
+def test_lockwatch_overhead_bench_smoke():
+    from apex_tpu.telemetry.bench import bench_lockwatch_overhead
+    r = bench_lockwatch_overhead(window=8, n_metrics=4, iters=5,
+                                 reps=2)
+    assert r["lockwatch_off_ms"] > 0
+    assert r["lockwatch_on_ms"] > 0
+    assert "lockwatch_overhead_pct" in r
+    assert r["lockwatch_acquire_ns"] >= 0
 
 
 # ---------------------------------------------------------------------------

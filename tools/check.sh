@@ -28,6 +28,21 @@ cd "$(dirname "$0")/.."
 echo "== apexlint + apexverify: apex_tpu/ (baseline-gated)"
 python -m apex_tpu.lint --semantic apex_tpu/
 
+echo "== apexrace: concurrency tier over apex_tpu/ (baseline-gated)"
+# thread-root reachability + shared-state + lock-domain analysis
+# (APX1001-APX1005); gates on the diff against the shipped
+# lint/concurrency/baseline.json, same contract as the semantic tier
+python -m apex_tpu.lint --concurrency apex_tpu/
+
+echo "== apexrace rule catalog: all five families registered"
+python -c "
+from apex_tpu.lint import concurrency
+ids = sorted(r.id for r in concurrency.all_rules())
+want = ['APX1001', 'APX1002', 'APX1003', 'APX1004', 'APX1005']
+assert ids == want, f'expected {want}, found {ids}'
+print(f'{len(ids)} concurrency rules registered')
+"
+
 echo "== apexverify spec count: exactly 24 registered"
 # the spec-count gate: a PR that deletes or fails to register an
 # invariant spec must fail HERE, not silently verify less

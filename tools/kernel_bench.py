@@ -629,6 +629,27 @@ def main():
         "speedup": (round(rfl["fleet_off_ms"] / rfl["fleet_on_ms"], 2)
                     if rfl["fleet_on_ms"] else None)})
 
+    # lockwatch overhead: the identical flush-shaped critical section
+    # under a WatchedLock vs a plain Lock with no sink registered
+    # ("kernel" = watched, "oracle" = plain — ~1.0 IS the pass
+    # condition: an unobserved watched lock must be free; the raw
+    # per-acquire surcharge shows up separately as
+    # lockwatch_acquire_ns)
+    from apex_tpu.telemetry.bench import bench_lockwatch_overhead
+    rlw = bench_lockwatch_overhead()
+    rlw["backend"] = backend
+    print(json.dumps(rlw), flush=True)
+    rows.append({
+        "kernel": "lockwatch_overhead",
+        "shape": (f"w{rlw['lockwatch_window']}x"
+                  f"{rlw['lockwatch_metrics']}"),
+        "dtype": "f32",
+        "kernel_ms": rlw["lockwatch_on_ms"],
+        "oracle_ms": rlw["lockwatch_off_ms"],
+        "speedup": (round(rlw["lockwatch_off_ms"]
+                          / rlw["lockwatch_on_ms"], 2)
+                    if rlw["lockwatch_on_ms"] else None)})
+
     # autoscaler overhead: the same instrumented step with a
     # FleetController (+ monitor) observing the session vs the bare
     # step ("kernel" = controller-observed, "oracle" = bare — ~1.0 IS
