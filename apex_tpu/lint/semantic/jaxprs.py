@@ -97,23 +97,68 @@ def fp8_convert_counts(jaxpr) -> dict:
     return out
 
 
+def int8_convert_counts(jaxpr) -> dict:
+    """Int8 cast census for the quantized KV arena: how many
+    ``convert_element_type`` equations cast INTO int8 (``to_int8``,
+    the quantize-on-scatter side) and how many cast an int8 operand
+    OUT (``from_int8``, the dequantize-in-gather side).  The
+    ``serving.decode_step_quantized`` spec pins both exactly — one per
+    arena side per step; a refactor that dequantizes per layer (or
+    re-quantizes per consumer) multiplies the cast count silently and
+    shows up here."""
+    import numpy as np
+    i8 = np.dtype("int8")
+    out: dict = {}
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name != "convert_element_type":
+            continue
+        if _np_dtype_or_none(e.params.get("new_dtype", "f4")) == i8:
+            out["to_int8"] = out.get("to_int8", 0) + 1
+        elif any(getattr(iv, "aval", None) is not None
+                 and _np_dtype_or_none(
+                     getattr(iv.aval, "dtype", None)) == i8
+                 for iv in e.invars):
+            out["from_int8"] = out.get("from_int8", 0) + 1
+    return out
+
+
+def _np_dtype_or_none(dtype):
+    """``np.dtype(...)`` that tolerates JAX extended dtypes (typed
+    PRNG keys like ``key<fry>`` have no numpy equivalent — and
+    ``np.dtype`` COERCES them to f64 rather than raising, which would
+    misread every RNG op as a float64 leak) — an extended dtype is by
+    construction not f64/int8, so the census checkers skip it."""
+    import numpy as np
+    from jax import dtypes as _jd
+    try:
+        if dtype is not None and _jd.issubdtype(dtype, _jd.extended):
+            return None
+        return np.dtype(dtype)
+    except TypeError:
+        return None
+
+
 def f64_values(jaxpr) -> List[str]:
     """Evidence of float64 entering the program: any
     ``convert_element_type`` to f64, or any equation output aval in
     f64 (TPU has no f64 units — silent downcast or slow path)."""
     import numpy as np
+    f64 = np.dtype("float64")
     bad: List[str] = []
     for e in iter_eqns(jaxpr):
+        # NB: the None checks are load-bearing — numpy treats None as
+        # "the default dtype" in comparisons, i.e. f64 == None is True
+        nd = _np_dtype_or_none(e.params.get("new_dtype", "f4"))
         if e.primitive.name == "convert_element_type" \
-                and np.dtype(e.params.get("new_dtype", "f4")) == \
-                np.dtype("float64"):
+                and nd is not None and nd == f64:
             bad.append("convert_element_type->float64")
         else:
             for v in e.outvars:
                 aval = getattr(v, "aval", None)
-                if aval is not None and \
-                        getattr(aval, "dtype", None) is not None and \
-                        np.dtype(aval.dtype) == np.dtype("float64"):
+                if aval is None or getattr(aval, "dtype", None) is None:
+                    continue
+                dt = _np_dtype_or_none(aval.dtype)
+                if dt is not None and dt == f64:
                     bad.append(f"{e.primitive.name}: f64 output")
                     break
     return bad

@@ -65,6 +65,9 @@ Supported invariants:
 ``fp8_quantize_counts``  ``{"e4m3": n, "e5m2": m}`` — exact converts INTO
                          each fp8 dtype (quantize ops; casts must not
                          silently multiply)
+``int8_convert_counts``  ``{"to_int8": n, "from_int8": m}`` — exact int8
+                         quantize/dequantize converts (the KV arena's
+                         cast economy: one per arena side per step)
 =====================  =====================================================
 """
 
@@ -287,6 +290,24 @@ def _chk_fp8_quantize_counts(env, expected):
     return "; ".join(bad) or None
 
 
+def _chk_int8_convert_counts(env, expected):
+    """``{"to_int8": n, "from_int8": m}`` — EXACT count of converts
+    into / out of int8 (the serving KV arena's quantize-on-scatter /
+    dequantize-in-gather ops).  Pins the quantized arena's cast
+    economy: one gather-side dequant and one scatter-side quant per
+    arena side per decode step — a refactor that dequantizes per layer
+    or re-quantizes per consumer multiplies these silently."""
+    got = jaxprs.int8_convert_counts(env["jaxpr"])
+    bad = []
+    for side in sorted(set(expected) | set(got)):
+        want = int(expected.get(side, 0))
+        have = int(got.get(side, 0))
+        if want != have:
+            bad.append(f"{side}: expected exactly {want} int8 "
+                       f"convert(s), found {have}")
+    return "; ".join(bad) or None
+
+
 _CHECKERS: Dict[str, Callable] = {
     "no_host_transfer": _chk_no_host_transfer,
     "no_f64": _chk_no_f64,
@@ -303,6 +324,7 @@ _CHECKERS: Dict[str, Callable] = {
     "dus_min": _chk_dus_min,
     "counter": _chk_counter,
     "fp8_quantize_counts": _chk_fp8_quantize_counts,
+    "int8_convert_counts": _chk_int8_convert_counts,
 }
 
 

@@ -645,8 +645,8 @@ def _build_profiler_annotated_step():
     }
 
 
-def _serving_fixture():
-    """Tiny serving geometry shared by the two serving specs."""
+def _serving_fixture(kv_dtype="f32"):
+    """Tiny serving geometry shared by the serving specs."""
     import jax
     from apex_tpu import serving
     cfg = serving.DecoderConfig(vocab_size=32, hidden=8, n_layers=2,
@@ -657,7 +657,7 @@ def _serving_fixture():
                              n_kv_heads=cfg.n_kv_heads,
                              head_dim=cfg.head_dim, page_size=4,
                              n_pages=8, max_slots=2, pages_per_slot=4)
-    return cfg, params, spec, serving.KVArena(spec)
+    return cfg, params, spec, serving.KVArena(spec, dtype=kv_dtype)
 
 
 @register_spec(
@@ -670,8 +670,9 @@ def _serving_fixture():
                 "window) and the arena + slot-state donation is "
                 "pinned as tf.aliasing_output in the lowered HLO — "
                 "exactly every carry buffer the step UPDATES (the "
-                "two pass-through leaves, page_table and active, are "
-                "host-written at admission events only)")
+                "pass-through leaves — page_table, active, the float-"
+                "mode scale stubs and the host-written sampling "
+                "params — alias nothing)")
 def _build_serving_decode_step():
     import jax
     from apex_tpu import serving
@@ -679,7 +680,10 @@ def _build_serving_decode_step():
     state = serving.init_state(arena, window=2)
     fn = serving.decode_window_fn(cfg, spec, window=2)
     # k, v, seq_lens, last_token, budget, out_tokens, n_out, done
-    # update in the window; page_table and active pass through
+    # update in the window; the scale stubs and sampling params pass
+    # through but XLA still trivially aliases their donated buffers —
+    # only page_table and active (gather-feeding reads) end up
+    # unaliased in the lowered HLO, the same two as at seed
     updated = len(jax.tree_util.tree_leaves(state)) - 2
     return {
         "fn": fn, "args": (params, state),
@@ -688,6 +692,70 @@ def _build_serving_decode_step():
             "no_host_transfer": True,
             "no_f64": True,
             "donated_aliases": updated,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "serving.decode_step_quantized",
+    anchor="apex_tpu/serving/steps.py",
+    description="AOT decode window over the INT8 arena: still zero "
+                "host traffic, the scale planes now update alongside "
+                "the pages (two more donated aliases than the float "
+                "window), and the cast economy is pinned EXACTLY — "
+                "one dequantize-in-gather and one quantize-on-scatter "
+                "convert per arena side per step, never per layer or "
+                "per consumer")
+def _build_serving_decode_step_quantized():
+    import jax
+    from apex_tpu import serving
+    cfg, params, spec, arena = _serving_fixture(kv_dtype="int8")
+    state = serving.init_state(arena, window=2)
+    fn = serving.decode_window_fn(cfg, spec, window=2)
+    # same alias set as the float window (leaves - 2: page_table and
+    # active stay unaliased) — but here k_scale/v_scale alias because
+    # the scatter genuinely UPDATES them, not by trivial pass-through
+    updated = len(jax.tree_util.tree_leaves(state)) - 2
+    return {
+        "fn": fn, "args": (params, state),
+        "jit_kwargs": {"donate_argnums": (1,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "donated_aliases": updated,
+            "int8_convert_counts": {"to_int8": 2, "from_int8": 2},
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "serving.sample_step",
+    anchor="apex_tpu/serving/steps.py",
+    description="device-side sampling: the temperature/top-k/top-p "
+                "categorical draw traces to pure device compute — "
+                "zero transfer/callback primitives (the PRNG key "
+                "rides the donated carry, draws fold in the absolute "
+                "position) and exactly ONE shared descending sort "
+                "feeds both nucleus filters")
+def _build_serving_sample_step():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import serving
+    b, v = 2, 32
+    args = (jnp.zeros((b, v), jnp.float32),
+            jnp.zeros((b, 2), jnp.uint32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.full((b,), 0.7, jnp.float32),
+            jnp.full((b,), 5, jnp.int32),
+            jnp.full((b,), 0.9, jnp.float32))
+    return {
+        "fn": serving.sample_tokens, "args": args,
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            "counter": {"sort": 1},
             "no_orphan_collectives": True,
         },
     }
@@ -709,19 +777,23 @@ def _build_serving_prefill_step():
     cfg, params, spec, arena = _serving_fixture()
     bucket = 8
     fn = serving.prefill_fn(cfg, spec, bucket)
-    args = (params, arena.k, arena.v,
+    args = (params, arena.k, arena.v, arena.k_scale, arena.v_scale,
             jnp.zeros((bucket // spec.page_size,), jnp.int32),
-            jnp.zeros((bucket,), jnp.int32), jnp.int32(5))
+            jnp.zeros((bucket,), jnp.int32), jnp.int32(5),
+            jnp.zeros((2,), jnp.uint32), jnp.float32(0.0),
+            jnp.int32(0), jnp.float32(1.0))
     expect = {
         "no_host_transfer": True,
         "no_f64": True,
-        "donated_aliases": 2,       # the K and V arenas, nothing else
+        # the K and V arenas plus both scale planes (pass-through
+        # stubs in float mode, but still trivially aliased)
+        "donated_aliases": 4,
         "no_orphan_collectives": True,
     }
     if op_enabled("attention_f32"):   # dispatch-gate aware, like optim
         expect["pallas_calls"] = cfg.n_layers
     return {"fn": fn, "args": args,
-            "jit_kwargs": {"donate_argnums": (1, 2)},
+            "jit_kwargs": {"donate_argnums": (1, 2, 3, 4)},
             "expect": expect}
 
 

@@ -82,6 +82,26 @@ def _dynamic_quant_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return _symmetric_int8(x, axis=-1)
 
 
+def quantize_kv_int8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-vector symmetric int8 for the serving KV arena: one scale
+    per trailing ``head_dim`` vector (the same ``_symmetric_int8``
+    formula as weights and activations — ONE quantization discipline
+    in the codebase).  Returns ``(q int8, scale f32)`` with the
+    trailing axis dropped from ``scale`` so it stores densely in the
+    arena's page-parallel scale planes.  A token's quantization
+    depends only on its OWN K/V vector, which is what keeps the
+    engine's batch-composition-independence invariant intact under
+    ``kv_dtype="int8"``."""
+    q, scale = _symmetric_int8(x, axis=-1)
+    return q, jnp.squeeze(scale, axis=-1)
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_kv_int8`: f32 values from int8 pages
+    plus the per-vector scale plane (broadcast over ``head_dim``)."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
 def int8_matmul(x: jax.Array, w: QTensor, *,
                 dynamic: Optional[bool] = False) -> jax.Array:
     """``x @ dequant(w)`` with int8 weights; w quantized on axis 0

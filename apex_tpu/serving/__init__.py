@@ -12,15 +12,18 @@ runners, fleet beacons, incident logs, hostmetrics, ``/metrics``.
 
 from apex_tpu.serving.admission import (AdmissionController,  # noqa: F401
                                         AdmissionVerdict, COMPLETED,
-                                        DRAINED, EVICTED, FAILED, SHED)
-from apex_tpu.serving.arena import ArenaSpec, KVArena  # noqa: F401
+                                        DRAINED, EVICTED, FAILED,
+                                        PrefixTrie, SHED)
+from apex_tpu.serving.arena import (ArenaSpec, KVArena,  # noqa: F401
+                                    resolve_kv_dtype)
 from apex_tpu.serving.engine import (DecodeDeadlineExceeded,  # noqa: F401
                                      Engine, Request, RequestResult)
 from apex_tpu.serving.model import (DecoderConfig,  # noqa: F401
-                                    decode_forward, init_params,
-                                    prefill_forward)
+                                    decode_forward, extend_forward,
+                                    init_params, prefill_forward)
 from apex_tpu.serving.replica import ReplicaSet  # noqa: F401
 from apex_tpu.serving.steps import (DecodeState,  # noqa: F401
                                     ServingPrograms, cached_programs,
                                     decode_one, decode_window_fn,
-                                    init_state, prefill_fn)
+                                    extend_fn, init_state, prefill_fn,
+                                    sample_tokens)

@@ -38,7 +38,7 @@ produces ``shed``):
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 # terminal verdicts
 COMPLETED = "completed"
@@ -118,3 +118,112 @@ class AdmissionController:
             return AdmissionVerdict("queue")
         self.shed_count += 1
         return v
+
+
+class PrefixTrie:
+    """Prompt-prefix → arena-page index for prefix sharing.
+
+    Flat-dict "trie": the engine registers each admitted prompt's
+    page-aligned prefixes, keyed on the TOKEN CONTENT of whole pages —
+    two requests share cache iff their prompts agree token-for-token
+    over whole ``page_size`` blocks, which is exactly the granularity
+    the arena can alias.  Two maps:
+
+    - ``_full``: ``tuple(prompt[: (i+1) * page_size]) -> page`` for
+      every FULLY-populated prompt page — pages later requests may
+      alias read-only (their own writes start past the shared span).
+    - ``_tail``: ``tuple(full_prompt) -> page`` — the page holding the
+      registrant's LAST prompt token.  An exact full-prompt match may
+      alias every page including this partially-filled tail (the new
+      request re-feeds only the final token through the extend
+      program, after a COW detaches the tail — the one genuinely
+      divergent write prefix sharing ever makes).
+
+    The trie holds NO refcounts: entries are valid only while their
+    page is live, so the engine prunes eagerly with :meth:`prune` on
+    every list of pages :meth:`~.arena.KVArena.release` actually
+    freed.  A shared page that was merely decrefed stays indexed —
+    later requests keep hitting it."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._full: Dict[Tuple[int, ...], int] = {}
+        self._tail: Dict[Tuple[int, ...], int] = {}
+        # reverse index: page -> keys, so prune() is O(keys-on-page)
+        self._by_page: Dict[int, List[Tuple[str, Tuple[int, ...]]]] = {}
+
+    def __len__(self) -> int:
+        return len(self._full) + len(self._tail)
+
+    def _index(self, kind: str, key: Tuple[int, ...],
+               page: int) -> None:
+        table = self._full if kind == "full" else self._tail
+        old = table.get(key)
+        if old == page:
+            return
+        if old is not None:
+            # re-registration of the same prefix onto new pages (the
+            # old registrant may since have been freed) — drop the old
+            # reverse entry so prune(old) can't kill the new mapping
+            self._by_page[old] = [
+                e for e in self._by_page.get(old, [])
+                if e != (kind, key)]
+        table[key] = page
+        self._by_page.setdefault(page, []).append((kind, key))
+
+    def register(self, prompt: Sequence[int],
+                 pages: Sequence[int]) -> None:
+        """Index an admitted prompt's pages.  ``pages`` is the slot's
+        page row covering the prompt (page i holds prompt tokens
+        ``[i*psz, (i+1)*psz)``)."""
+        prompt = tuple(int(t) for t in prompt)
+        psz = self.page_size
+        n_full = len(prompt) // psz
+        for i in range(min(n_full, len(pages))):
+            self._index("full", prompt[: (i + 1) * psz],
+                        int(pages[i]))
+        last = (len(prompt) - 1) // psz
+        if last < len(pages):
+            self._index("tail", prompt, int(pages[last]))
+
+    def match(self, prompt: Sequence[int]
+              ) -> Tuple[List[int], Optional[int]]:
+        """Longest shareable prefix for ``prompt``.  Returns
+        ``(full_pages, tail_page)``:
+
+        - ``full_pages``: the longest run of fully-covered prefix
+          pages, capped at ``(len(prompt) - 1) // page_size`` so the
+          suffix the new request feeds itself is never empty.
+        - ``tail_page``: on an EXACT full-prompt match, the page
+          holding the last prompt token (to alias + COW); else None.
+        """
+        prompt = tuple(int(t) for t in prompt)
+        psz = self.page_size
+        tail = self._tail.get(prompt)
+        cap = (len(prompt) - 1) // psz
+        full: List[int] = []
+        for i in range(cap):
+            page = self._full.get(prompt[: (i + 1) * psz])
+            if page is None:
+                break
+            full.append(page)
+        if tail is not None and len(full) == cap:
+            return full, tail
+        return full, None
+
+    def prune(self, freed_pages: Sequence[int]) -> None:
+        """Drop every entry pointing at a page the arena just FREED
+        (not merely decrefed) — the eager invalidation that makes
+        holding no refcounts safe."""
+        for page in freed_pages:
+            for kind, key in self._by_page.pop(int(page), []):
+                table = self._full if kind == "full" else self._tail
+                if table.get(key) == int(page):
+                    del table[key]
+
+    def clear(self) -> None:
+        """Full reset (arena rebuild after a lost-arena recovery —
+        every page id is reassigned, the whole index is stale)."""
+        self._full.clear()
+        self._tail.clear()
+        self._by_page.clear()

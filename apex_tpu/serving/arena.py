@@ -17,18 +17,35 @@ trick that keeps the decode program branch-free)::
     page_table : (max_slots, pages_per_slot) i32  — page index per
         slot-local page; unused entries point at the trash page
 
-Page ACCOUNTING is host-side (a free list): the host owns admission
-and eviction, so it owns which pages are free — no device round-trip
-decides placement.  The device only ever consumes the page table the
-host last installed, and the slot-state arrays (``seq_lens``,
-``active``, ...) ride the decode program as donated carry so the host
-reads them back once per flush window (the ``telemetry/ring.py``
-read-once-per-window pattern), never per token.
+Under ``dtype="int8"`` the pages store symmetric int8
+(:func:`~apex_tpu.quantization.quantize_kv_int8`) and a parallel pair
+of f32 SCALE planes rides the same one-shot pack::
+
+    k_scale, v_scale : (n_pages + 1, page_size, n_layers, n_kv_heads)
+
+one scale per cached head-dim vector, quantized on scatter and
+dequantized in the decode gather — per-token HBM drops to
+``head_dim + 4`` bytes per head from ``2 * head_dim`` (bf16), roughly
+doubling resident requests per chip.  In float modes the scale
+attributes are (1,1,1,1) placeholders so every program keeps ONE
+signature.
+
+Page ACCOUNTING is host-side (a free list + per-page REFCOUNTS): the
+host owns admission and eviction, so it owns which pages are free — no
+device round-trip decides placement.  A refcount above 1 means the
+page is aliased by several slots (prefix sharing): release decrefs and
+only a count reaching zero frees; :meth:`cow` detaches one slot's
+alias onto a fresh page before a divergent write.  The device only
+ever consumes the page table the host last installed, and the
+slot-state arrays (``seq_lens``, ``active``, ...) ride the decode
+program as donated carry so the host reads them back once per flush
+window (the ``telemetry/ring.py`` read-once-per-window pattern), never
+per token.
 """
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -67,19 +84,34 @@ class ArenaSpec(NamedTuple):
         return self
 
 
+def resolve_kv_dtype(dtype) -> jnp.dtype:
+    """Accept the table/CLI spellings (``"f32"``/``"bf16"``/``"int8"``)
+    alongside real dtypes — ``ops._dispatch.serving_pref("kv_dtype")``
+    and ``examples/gpt/serve.py --kv-dtype`` both speak strings."""
+    names = {"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": jnp.int8}
+    if isinstance(dtype, str) and dtype in names:
+        return jnp.dtype(names[dtype])
+    return jnp.dtype(dtype)
+
+
 class KVArena:
-    """Device buffers + the host-side page/slot free lists."""
+    """Device buffers + the host-side page/slot accounting."""
 
     def __init__(self, spec: ArenaSpec, dtype=jnp.float32):
         self.spec = spec.validate()
-        self.dtype = jnp.dtype(dtype)
+        self.dtype = resolve_kv_dtype(dtype)
+        self.quantized = self.dtype == jnp.dtype(jnp.int8)
         s = self.spec
         shape = (s.n_pages + 1, s.page_size, s.n_layers,
                  s.n_kv_heads, s.head_dim)
-        # the one-time pack: both arenas and the page table are
-        # allocated HERE and only ever flow through donated programs
+        # the one-time pack: both arenas, the scale planes and the page
+        # table are allocated HERE and only ever flow through donated
+        # programs
         self.k = jnp.zeros(shape, self.dtype)
         self.v = jnp.zeros(shape, self.dtype)
+        scale_shape = shape[:-1] if self.quantized else (1, 1, 1, 1)
+        self.k_scale = jnp.ones(scale_shape, jnp.float32)
+        self.v_scale = jnp.ones(scale_shape, jnp.float32)
         self.page_table = jnp.full((s.max_slots, s.pages_per_slot),
                                    s.trash_page, jnp.int32)
         self._free_pages: List[int] = list(range(s.n_pages))
@@ -88,6 +120,9 @@ class KVArena:
         # device read — the host handed the pages out, it knows them)
         self._slot_pages: List[Optional[List[int]]] = \
             [None] * s.max_slots
+        # per-page alias refcount: 0 = free, 1 = exclusively owned,
+        # >1 = shared (prefix pages aliased by several slots)
+        self._page_refs: List[int] = [0] * s.n_pages
 
     # ---- host-side accounting -------------------------------------------
     @property
@@ -97,6 +132,10 @@ class KVArena:
     @property
     def free_slots(self) -> int:
         return len(self._free_slots)
+
+    def page_ref(self, page: int) -> int:
+        """Current alias refcount of one page (0 = free)."""
+        return self._page_refs[page]
 
     def pages_needed(self, total_tokens: int) -> int:
         """Pages a sequence of ``total_tokens`` (prompt + generation
@@ -108,10 +147,14 @@ class KVArena:
         is the typed ``oom_admission`` shed — queueing cannot help."""
         return self.pages_needed(total_tokens) <= self.spec.pages_per_slot
 
-    def fits_now(self, total_tokens: int) -> bool:
-        return (self._free_slots
-                and self.pages_needed(total_tokens)
-                <= len(self._free_pages))
+    def fits_now(self, total_tokens: int, n_shared: int = 0,
+                 extra: int = 0) -> bool:
+        """Free-capacity check; ``n_shared`` pages come aliased from a
+        prefix match (no fresh allocation) and ``extra`` reserves
+        headroom (the admission-time COW of a shared fork page)."""
+        need = self.pages_needed(total_tokens) - int(n_shared) \
+            + int(extra)
+        return bool(self._free_slots) and need <= len(self._free_pages)
 
     def acquire(self, total_tokens: int) -> tuple:
         """Allocate ``(slot, pages)`` for a sequence of
@@ -125,22 +168,115 @@ class KVArena:
         n = self.pages_needed(total_tokens)
         slot = self._free_slots.pop(0)
         pages = [self._free_pages.pop(0) for _ in range(n)]
+        for p in pages:
+            self._page_refs[p] = 1
         self._slot_pages[slot] = list(pages)
         return slot, pages
 
-    def release(self, slot: int) -> None:
-        """Return a slot's pages to the free list (eviction /
-        completion).  Purely host-side — the host handed the pages
-        out, it knows them; the engine resets the live page-table row
-        to trash so a stale gather can never read another request's
-        pages."""
+    def acquire_shared(self, total_tokens: int,
+                       shared_pages: Sequence[int]) -> tuple:
+        """Allocate a slot whose leading pages ALIAS ``shared_pages``
+        (each increfed, never copied) and whose remainder is fresh.
+        Returns ``(slot, own_pages)`` — the freshly allocated tail
+        only; the slot's full row is ``shared + own`` and
+        :meth:`slot_row` reflects it."""
+        n = self.pages_needed(total_tokens)
+        shared = list(shared_pages)
+        own_n = n - len(shared)
+        if own_n < 0:
+            raise ValueError(
+                f"{len(shared)} shared pages exceed the "
+                f"{n}-page footprint of {total_tokens} tokens")
+        for p in shared:
+            if self._page_refs[p] < 1:
+                raise RuntimeError(
+                    f"acquire_shared() over dead page {p} — the "
+                    "prefix trie must prune freed pages eagerly")
+        if not self.fits_now(total_tokens, n_shared=len(shared)):
+            raise RuntimeError("acquire_shared() without fits_now() — "
+                               "the admission path owns that check")
+        slot = self._free_slots.pop(0)
+        own = [self._free_pages.pop(0) for _ in range(own_n)]
+        for p in shared:
+            self._page_refs[p] += 1
+        for p in own:
+            self._page_refs[p] = 1
+        self._slot_pages[slot] = shared + own
+        return slot, own
+
+    def cow(self, slot: int, index: int) -> tuple:
+        """Copy-on-write detach: the slot is about to WRITE into its
+        ``index``-th page while other slots still alias it.  Allocates
+        a fresh page, moves this slot's reference onto it (decref old,
+        ref-1 new) and returns ``(old_page, new_page)`` — the CALLER
+        copies the device contents (the engine's AOT ``cow_copy``
+        program), because only the caller owns the live buffers."""
         pages = self._slot_pages[slot]
         if pages is None:
-            return
+            raise RuntimeError(f"cow() on unoccupied slot {slot}")
+        old = pages[index]
+        if self._page_refs[old] <= 1:
+            raise RuntimeError(
+                f"cow() on exclusively-owned page {old} — the write "
+                "needs no detach")
+        if not self._free_pages:
+            raise RuntimeError("cow() with no free page — admission "
+                               "reserves COW headroom via fits_now()")
+        new = self._free_pages.pop(0)
+        self._page_refs[old] -= 1
+        self._page_refs[new] = 1
+        pages[index] = new
+        return old, new
+
+    def release(self, slot: int) -> List[int]:
+        """Decref a slot's pages (eviction / completion); a count
+        reaching zero returns the page to the free list.  Returns the
+        pages actually FREED — shared pages another slot still aliases
+        are decremented, never freed, and the caller (the engine's
+        prefix trie) prunes its index only for the freed ones.  Purely
+        host-side — the host handed the pages out, it knows them; the
+        engine resets the live page-table row to trash so a stale
+        gather can never read another request's pages."""
+        pages = self._slot_pages[slot]
+        if pages is None:
+            return []
         self._slot_pages[slot] = None
-        self._free_pages.extend(pages)
+        freed: List[int] = []
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] == 0:
+                self._free_pages.append(p)
+                freed.append(p)
         self._free_slots.append(slot)
         self._free_slots.sort()
+        return freed
+
+    def check_accounting(self) -> None:
+        """The page-conservation invariant, assert-grade: free-list
+        size + live refcounted pages + the trash page always equals
+        ``n_pages + 1``, the free list and the slot rows never overlap,
+        and every page's refcount equals the number of slot rows it
+        appears in.  Called from the engine's debug seams and the
+        fuzz test — a leak or double-free shows up HERE, not as a
+        corrupted decode three windows later."""
+        s = self.spec
+        live = sum(1 for r in self._page_refs if r > 0)
+        total = len(self._free_pages) + live + 1
+        assert total == s.n_pages + 1, (
+            f"page conservation broken: {len(self._free_pages)} free "
+            f"+ {live} live + 1 trash != {s.n_pages + 1}")
+        assert len(set(self._free_pages)) == len(self._free_pages), \
+            "free list holds a duplicate page"
+        refs_seen = [0] * s.n_pages
+        for row in self._slot_pages:
+            for p in (row or []):
+                refs_seen[p] += 1
+        assert refs_seen == self._page_refs, (
+            f"refcounts drifted from slot rows: {self._page_refs} vs "
+            f"counted {refs_seen}")
+        overlap = set(self._free_pages) & {
+            p for row in self._slot_pages for p in (row or [])}
+        assert not overlap, f"pages both free and live: {sorted(overlap)}"
 
     def slot_row(self, slot: int) -> jax.Array:
         """The slot's full page-table row (allocated pages first,
@@ -162,12 +298,34 @@ class KVArena:
         row[:min(len(pages), n)] = pages[:n]
         return jnp.asarray(row)
 
+    # ---- sizing ----------------------------------------------------------
+    def page_bytes(self) -> int:
+        """HBM bytes one page occupies across K and V (+ scale planes
+        under int8) — what a prefix-shared page SAVES per alias."""
+        s = self.spec
+        per = s.page_size * s.n_layers * s.n_kv_heads
+        b = per * s.head_dim * self.k.dtype.itemsize
+        if self.quantized:
+            b += per * self.k_scale.dtype.itemsize
+        return 2 * b
+
+    def bytes_per_token(self) -> float:
+        """HBM bytes per cached token (K + V + scales) — the
+        ``extra.kv_bytes_per_token`` budget-row numerator."""
+        return self.page_bytes() / self.spec.page_size
+
     def describe(self) -> dict:
         """JSON-able layout summary (bench/docs surface)."""
         s = self.spec
+        kv_bytes = int(2 * self.k.size * self.k.dtype.itemsize)
+        if self.quantized:
+            kv_bytes += int(2 * self.k_scale.size
+                            * self.k_scale.dtype.itemsize)
         return {"pages": s.n_pages, "page_size": s.page_size,
                 "max_slots": s.max_slots,
                 "pages_per_slot": s.pages_per_slot,
                 "slot_tokens": s.slot_tokens,
-                "kv_bytes": int(2 * self.k.size * self.k.dtype.itemsize),
+                "kv_bytes": kv_bytes,
+                "kv_bytes_per_token": self.bytes_per_token(),
+                "quantized": self.quantized,
                 "dtype": self.dtype.name}

@@ -103,6 +103,122 @@ def bench_decode_step(n_layers: int = 2, hidden: int = 64,
     return out
 
 
+def bench_kv_quant_gather(n_layers: int = 2, hidden: int = 256,
+                          n_heads: int = 4, max_slots: int = 4,
+                          page_size: int = 8, pages_per_slot: int = 4,
+                          iters: int = 10, reps: int = 3):
+    """Int8 gather+dequantize vs bf16 gather over the paged arena —
+    the ``kernel_bench`` ``kv_quant_gather`` row, plus the measured
+    HBM bytes per cached token both ways (the
+    ``extra.kv_bytes_per_token`` budget row: int8/bf16 ratio, ceiling
+    0.55).  Defaults use head_dim=64 (hidden/n_heads): per token per
+    head per side, int8 stores ``head_dim + 4`` bytes (values + one
+    f32 scale) against bf16's ``2 * head_dim`` — 0.531x at 64, and the
+    ratio only improves with wider heads."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import serving
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.serving.steps import _gather_ctx
+
+    spec = serving.ArenaSpec(
+        n_layers=n_layers, n_kv_heads=n_heads,
+        head_dim=hidden // n_heads, page_size=page_size,
+        n_pages=max_slots * pages_per_slot, max_slots=max_slots,
+        pages_per_slot=pages_per_slot)
+    import numpy as np
+    table = jnp.asarray(np.arange(
+        max_slots * pages_per_slot,
+        dtype=np.int32).reshape(max_slots, pages_per_slot))
+    out = {"kv_gather_ctx": spec.slot_tokens,
+           "kv_gather_slots": max_slots,
+           "kv_gather_head_dim": spec.head_dim}
+    times = {}
+    for name in ("bf16", "int8"):
+        arena = serving.KVArena(spec, dtype=name)
+
+        def gather(k, v, ks, vs, rows, _spec=spec):
+            kk, vv = _gather_ctx(k, v, ks, vs, rows, _spec)
+            return kk.astype(jnp.float32).sum() \
+                + vv.astype(jnp.float32).sum()
+        # one program per storage dtype by design
+        # apexlint: disable-next=APX302
+        times[name] = timeit(jax.jit(gather), arena.k, arena.v,
+                             arena.k_scale, arena.v_scale, table,
+                             iters=iters, reps=reps)
+        out[f"kv_quant_gather_{name}_ms"] = round(times[name], 4)
+        out[f"kv_bytes_per_token_{name}"] = arena.bytes_per_token()
+    out["kv_quant_gather_overhead"] = round(
+        times["int8"] / max(times["bf16"], 1e-9), 3)
+    out["kv_bytes_per_token_ratio"] = round(
+        out["kv_bytes_per_token_int8"]
+        / max(out["kv_bytes_per_token_bf16"], 1e-9), 4)
+    return out
+
+
+def bench_prefix_admission(n_requests: int = 8, n_layers: int = 2,
+                           hidden: int = 64, n_heads: int = 4,
+                           page_size: int = 4, pages_per_slot: int = 8,
+                           prompt_len: int = 12, window: int = 4,
+                           max_new_tokens: int = 4):
+    """N-way shared-prompt admission with prefix sharing ON: every
+    request submits the SAME prompt, the first prefills it, the rest
+    alias its pages and extend one token — the ``prefix_admission``
+    kernel_bench row and the ``extra.prefix_prefill_savings`` budget
+    row (prompt tokens submitted / prompt tokens actually computed;
+    floor 2.0 at 8-way).  Structural, counted from the engine's
+    prefill/extend program counters — wall-clock noise cannot fake
+    it."""
+    import time
+
+    import jax
+
+    from apex_tpu import serving
+
+    cfg, params, spec, _ = _tiny_setup(
+        jax, jax.numpy, n_layers, hidden, n_heads, n_requests,
+        page_size, pages_per_slot, window)
+    # one bucket covering the fixed shared prompt: the bench measures
+    # admission behavior, and the full power-of-two bucket ladder
+    # would only grow AOT-build time, not change what is counted
+    bucket = -(-prompt_len // page_size) * page_size
+    eng = serving.Engine(
+        params, cfg, page_size=page_size,
+        n_pages=spec.n_pages, max_slots=n_requests,
+        pages_per_slot=pages_per_slot, window=window,
+        prefill_buckets=[bucket],
+        prefix_share=True, max_queue=max(n_requests, 8))
+    prompt = [2 + (i % 7) for i in range(prompt_len)]
+    max_new = max(1, min(max_new_tokens,
+                         spec.slot_tokens - prompt_len))
+    for i in range(n_requests):
+        eng.submit(serving.Request(id=f"shared-{i}", prompt=prompt,
+                                   max_new_tokens=max_new))
+    t0 = time.time()
+    results = eng.serve()
+    wall_ms = (time.time() - t0) * 1e3
+    # tokens the admission path actually forwarded: a full prompt per
+    # prefill, one re-fed tail token per exact-match extend
+    computed = eng._n_prefills * prompt_len + eng._n_extends * 1
+    submitted = n_requests * prompt_len
+    out = {
+        "prefix_admission_ms": round(wall_ms, 3),
+        "prefix_requests": n_requests,
+        "prefix_prompt_len": prompt_len,
+        "prefix_n_prefills": eng._n_prefills,
+        "prefix_n_extends": eng._n_extends,
+        "prefix_cow_copies": eng._cow_copies,
+        "prefix_prefill_savings": round(
+            submitted / max(computed, 1), 3),
+        "prefix_completed": sum(
+            1 for r in results.values()
+            if r.verdict == serving.COMPLETED),
+    }
+    eng.close()
+    return out
+
+
 def bench_serving(n_requests: int = 8, n_layers: int = 2,
                   hidden: int = 64, n_heads: int = 4,
                   max_slots: int = 4, page_size: int = 8,

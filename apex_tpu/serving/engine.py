@@ -94,11 +94,22 @@ class DecodeDeadlineExceeded(RuntimeError):
 
 @dataclass
 class Request:
-    """One generation request."""
+    """One generation request.
+
+    ``temperature <= 0`` (the default) decodes greedily; above zero,
+    tokens are categorical draws on device
+    (:func:`~apex_tpu.serving.steps.sample_tokens`) filtered by
+    ``top_k`` (``<= 0`` disables) and ``top_p``, seeded by ``seed`` —
+    the stream depends only on (seed, position), so a seeded request
+    reproduces bit-exactly whatever else shares its batch."""
     id: str
     prompt: Sequence[int]
     max_new_tokens: int = 16
     deadline_s: Optional[float] = None   # per-request wall deadline
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
 
     @property
     def total_tokens(self) -> int:
@@ -106,16 +117,27 @@ class Request:
 
     def ledger_record(self) -> dict:
         """JSON-able form for the replica queue ledger."""
-        return {"id": self.id, "prompt": [int(t) for t in self.prompt],
-                "max_new_tokens": int(self.max_new_tokens),
-                **({"deadline_s": self.deadline_s}
-                   if self.deadline_s is not None else {})}
+        rec = {"id": self.id, "prompt": [int(t) for t in self.prompt],
+               "max_new_tokens": int(self.max_new_tokens),
+               **({"deadline_s": self.deadline_s}
+                  if self.deadline_s is not None else {})}
+        if self.temperature > 0:
+            # sampling params survive replica failover: the claimant's
+            # re-admission continues the same seeded stream
+            rec.update(temperature=float(self.temperature),
+                       top_k=int(self.top_k),
+                       top_p=float(self.top_p), seed=int(self.seed))
+        return rec
 
     @classmethod
     def from_ledger(cls, rec: dict) -> "Request":
         return cls(id=str(rec["id"]), prompt=list(rec["prompt"]),
                    max_new_tokens=int(rec.get("max_new_tokens", 16)),
-                   deadline_s=rec.get("deadline_s"))
+                   deadline_s=rec.get("deadline_s"),
+                   temperature=float(rec.get("temperature", 0.0)),
+                   top_k=int(rec.get("top_k", 0)),
+                   top_p=float(rec.get("top_p", 1.0)),
+                   seed=int(rec.get("seed", 0)))
 
 
 @dataclass
@@ -145,10 +167,13 @@ class Engine:
     """AOT-compiled continuously-batched decode engine (module
     docstring).
 
-    ``page_size`` / ``window`` default to the autotuner's measured
-    serving geometry for this topology
-    (``ops._dispatch.serving_pref``), falling back to the design
-    defaults when no table steers."""
+    ``page_size`` / ``window`` / ``kv_dtype`` / ``prefix_share``
+    default to the autotuner's measured serving preferences for this
+    topology (``ops._dispatch.serving_pref``), falling back to the
+    design defaults (f32 arena, no sharing) when no table steers.
+    ``kv_dtype="int8"`` stores the arena quantized (half the HBM per
+    token); ``prefix_share=True`` compiles the extend/COW programs and
+    admits prompts with a known prefix by aliasing its pages."""
 
     def __init__(self, params, cfg: DecoderConfig,
                  page_size: Optional[int] = None,
@@ -156,7 +181,8 @@ class Engine:
                  pages_per_slot: Optional[int] = None,
                  window: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 kv_dtype=jnp.float32,
+                 kv_dtype=None,
+                 prefix_share: Optional[bool] = None,
                  max_queue: int = 64,
                  queue_high: Optional[int] = None,
                  queue_low: Optional[int] = None,
@@ -171,6 +197,11 @@ class Engine:
             page_size = int(_dispatch.serving_pref("page_size", 8))
         if window is None:
             window = int(_dispatch.serving_pref("decode_window", 8))
+        if kv_dtype is None:
+            kv_dtype = _dispatch.serving_pref("kv_dtype", "f32")
+        if prefix_share is None:
+            prefix_share = bool(_dispatch.serving_pref("prefix_share",
+                                                       False))
         if pages_per_slot is None:
             pages_per_slot = max(1, min(n_pages // max(max_slots, 1),
                                         cfg.max_seq // page_size))
@@ -185,6 +216,7 @@ class Engine:
                 f"the model's position table (max_seq={cfg.max_seq})")
         self.params = params
         self.cfg = cfg
+        self.prefix_share = bool(prefix_share)
         self.arena = KVArena(spec, dtype=kv_dtype)
         # AOT: every program this engine will ever run compiles HERE
         # (memoized — a rebuilt engine over the same params object and
@@ -192,8 +224,11 @@ class Engine:
         from apex_tpu.serving.steps import cached_programs
         self.programs = cached_programs(
             params, cfg, self.arena, window=int(window),
-            prefill_buckets=prefill_buckets)
+            prefill_buckets=prefill_buckets,
+            prefix_share=self.prefix_share)
         self.window = self.programs.window
+        self._trie = (adm.PrefixTrie(spec.page_size)
+                      if self.prefix_share else None)
         self.state = init_state(self.arena, self.window)
         self.admission = adm.AdmissionController(
             max_queue=max_queue, queue_high=queue_high,
@@ -229,6 +264,13 @@ class Engine:
         self._token_ms = collections.deque(maxlen=512)
         self._windows = 0
         self._tokens_total = 0
+        # structural counters (tests assert prefill-call counts; the
+        # prefix gauges ride /metrics cumulatively every window)
+        self._n_prefills = 0
+        self._n_extends = 0
+        self._prefix_hits = 0
+        self._cow_copies = 0
+        self._kv_bytes_saved = 0
         self._attached = False
         if telemetry is not None:
             telemetry.add_observer(self._on_flush)
@@ -446,10 +488,19 @@ class Engine:
         _hostmetrics.emit("serving/evictions", 1)
         self._note_terminal(rid)
 
+    def _release_pages(self, slot: int) -> None:
+        """Arena release + eager trie invalidation — refcounted: a
+        page another slot still aliases is DECREFED, stays indexed,
+        and keeps serving prefix hits; only pages actually freed are
+        pruned (their content is about to be someone else's)."""
+        freed = self.arena.release(slot)
+        if self._trie is not None:
+            self._trie.prune(freed)
+
     def _clear_slot(self, slot: int) -> None:
         """Release a slot's pages and reset its device row — the one
         slot-clearing invariant, shared by eviction and completion."""
-        self.arena.release(slot)
+        self._release_pages(slot)
         self.state = self.state._replace(
             active=self.state.active.at[slot].set(0),
             done=self.state.done.at[slot].set(0),
@@ -467,15 +518,33 @@ class Engine:
         self._admitted_this_window = []
         while self.queue and not self._draining:
             req = self.queue[0]
-            if not self.arena.fits_now(req.total_tokens):
+            # prefix lookup FIRST: shared pages shrink the footprint
+            # the fit check needs (a full arena can still admit a
+            # request that aliases most of its pages).  ``tail`` set
+            # means an exact full-prompt match: alias every page
+            # including the partially-filled last one, budget one COW
+            # page of headroom to detach it.
+            shared: List[int] = []
+            tail: Optional[int] = None
+            if self._trie is not None:
+                shared, tail = self._trie.match(req.prompt)
+            shared_all = shared + ([tail] if tail is not None else [])
+            if not self.arena.fits_now(
+                    req.total_tokens, n_shared=len(shared_all),
+                    extra=1 if tail is not None else 0):
                 break
             self.queue.popleft()
-            slot, pages = self.arena.acquire(req.total_tokens)
-            bucket = self.programs.bucket_for(len(req.prompt))
-            assert bucket is not None     # fits_ever gated at submit
             plen = len(req.prompt)
-            tokens = np.zeros((bucket,), np.int32)
-            tokens[:plen] = np.asarray(list(req.prompt), np.int32)
+            if shared_all:
+                slot, own = self.arena.acquire_shared(
+                    req.total_tokens, shared_all)
+                slot_pages = shared_all + own
+            else:
+                slot, slot_pages = self.arena.acquire(req.total_tokens)
+            # per-request device sampling operands (steps.sample_tokens)
+            samp = (jax.random.PRNGKey(int(req.seed)),
+                    jnp.float32(req.temperature),
+                    jnp.int32(req.top_k), jnp.float32(req.top_p))
             t0 = time.time()
             # bind the dispatch operands NOW, not inside the lambda: an
             # abandoned worker evaluates the thunk AFTER a timeout may
@@ -483,16 +552,29 @@ class Engine:
             # and a late `self.state` read there would hand the stale
             # dispatch the FRESH donated arena — the exact corruption
             # the dispatched flag exists to prevent
-            prefill = self.programs.prefill[bucket]
             params, st = self.params, self.state
-            page_row = self.arena.page_row(bucket, pages)
             try:
-                with _telemetry.span("serving/prefill"):
-                    k, v, first = self._deadline_run(
-                        lambda: prefill(
-                            params, st.k, st.v, page_row,
-                            jnp.asarray(tokens), jnp.int32(plen)),
-                        w, phase="prefill")
+                if shared_all:
+                    k, v, ks, vs, first = self._admit_shared(
+                        req, slot, slot_pages, shared, tail, samp,
+                        w, params, st)
+                else:
+                    bucket = self.programs.bucket_for(plen)
+                    assert bucket is not None   # gated at submit
+                    tokens = np.zeros((bucket,), np.int32)
+                    tokens[:plen] = np.asarray(list(req.prompt),
+                                               np.int32)
+                    prefill = self.programs.prefill[bucket]
+                    page_row = self.arena.page_row(bucket, slot_pages)
+                    with _telemetry.span("serving/prefill"):
+                        k, v, ks, vs, first = self._deadline_run(
+                            lambda: prefill(
+                                params, st.k, st.v, st.k_scale,
+                                st.v_scale, page_row,
+                                jnp.asarray(tokens), jnp.int32(plen),
+                                *samp),
+                            w, phase="prefill")
+                    self._n_prefills += 1
             except DecodeDeadlineExceeded as e:
                 # a wedged PREFILL names its own suspect: the request
                 # being admitted — evict it, leave everyone else alone
@@ -516,7 +598,7 @@ class Engine:
                     # prefill: rebuild and re-place the in-flight batch
                     self._recover_lost_arena([])
                 else:
-                    self.arena.release(slot)
+                    self._release_pages(slot)
                 if not self._active and not self.queue:
                     self._resolve_incident()
                 break
@@ -526,7 +608,7 @@ class Engine:
                 # free the slot before the error surfaces, so nothing
                 # vanishes without a verdict and nothing leaks
                 # (the decode path's handler, mirrored)
-                self.arena.release(slot)
+                self._release_pages(slot)
                 self.results[req.id] = RequestResult(
                     req.id, adm.FAILED, reason="prefill_error",
                     readmitted_from=getattr(req, "_readmitted_from",
@@ -536,7 +618,7 @@ class Engine:
             _hostmetrics.emit("serving/prefill_ms",
                               (time.time() - t0) * 1e3)
             first = int(first)    # one sync per ADMISSION (documented)
-            st = self.state._replace(k=k, v=v)
+            st = self.state._replace(k=k, v=v, k_scale=ks, v_scale=vs)
             done_now = (first == self.cfg.eos_token
                         or req.max_new_tokens <= 1)
             a = _Active(req=req, slot=slot, tokens=[first],
@@ -551,7 +633,16 @@ class Engine:
                 last_token=st.last_token.at[slot].set(first),
                 budget=st.budget.at[slot].set(
                     max(req.max_new_tokens - 1, 0)),
+                rng=st.rng.at[slot].set(samp[0]),
+                temperature=st.temperature.at[slot].set(samp[1]),
+                top_k=st.top_k.at[slot].set(samp[2]),
+                top_p=st.top_p.at[slot].set(samp[3]),
                 done=st.done.at[slot].set(0))
+            if self._trie is not None:
+                # index this prompt's pages for later sharers (the
+                # COW-detached tail included — it holds the same
+                # prompt tokens, recomputed)
+                self._trie.register(req.prompt, slot_pages)
             self._active[slot] = a
             self._admitted_this_window.append(slot)
             _hostmetrics.emit("serving/admitted", 1)
@@ -560,6 +651,63 @@ class Engine:
                 self._complete(slot)
         _hostmetrics.emit("serving/queue_depth", len(self.queue))
         self.admission.note_depth(len(self.queue))
+
+    def _admit_shared(self, req: Request, slot: int,
+                      slot_pages: List[int], shared: List[int],
+                      tail: Optional[int], samp, w: int, params, st):
+        """The prefix-HIT admission dispatch: the request's leading
+        pages alias another request's cache (already increfed by
+        ``acquire_shared``), so only the unshared SUFFIX runs — through
+        the per-bucket extend program instead of a full prefill.  On
+        an exact full-prompt match (``tail`` set) the aliased tail
+        page holds the last prompt token the extend is about to
+        re-feed, so it is COW-detached first (host bookkeeping in
+        ``arena.cow``, device copy via the AOT ``cow_copy`` program) —
+        the one divergent write prefix admission ever makes.  Raises
+        :class:`DecodeDeadlineExceeded` into ``_admit``'s handler like
+        the plain prefill path."""
+        psz = self.arena.spec.page_size
+        if tail is not None:
+            idx = len(shared)
+            old, new = self.arena.cow(slot, idx)
+            slot_pages[idx] = new
+            k, v, ks, vs = self.programs.cow_copy(
+                st.k, st.v, st.k_scale, st.v_scale,
+                jnp.int32(old), jnp.int32(new))
+            st = st._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+            self.state = st
+            self._cow_copies += 1
+            _hostmetrics.emit("serving/cow_copies", 1)
+            start = len(req.prompt) - 1
+        else:
+            # partial match: sharing stops at a page boundary, the
+            # suffix scatters into exclusively-owned pages — no COW
+            start = len(shared) * psz
+        suffix = [int(t) for t in req.prompt][start:]
+        bucket = self.programs.bucket_for(len(suffix))
+        assert bucket is not None    # suffix <= prompt, gated at submit
+        tokens = np.zeros((bucket,), np.int32)
+        tokens[:len(suffix)] = np.asarray(suffix, np.int32)
+        extend = self.programs.extend[bucket]
+        row = self.arena.slot_row(slot)
+        with _telemetry.span("serving/prefill"):
+            out = self._deadline_run(
+                lambda: extend(
+                    params, st.k, st.v, st.k_scale, st.v_scale, row,
+                    jnp.asarray(tokens), jnp.int32(start),
+                    jnp.int32(len(suffix)), *samp),
+                w, phase="prefill")
+        self._n_extends += 1
+        self._prefix_hits += 1
+        # bytes saved = the pages still ALIASED after admission (the
+        # COW-detached tail consumed a fresh page, so it saves compute
+        # but no memory)
+        self._kv_bytes_saved += len(shared) * self.arena.page_bytes()
+        self._event("prefix_hit", id=req.id,
+                    shared_pages=len(shared) + (1 if tail is not None
+                                                else 0),
+                    cow=tail is not None)
+        return out
 
     # ---- decode ----------------------------------------------------------
     def _decode(self, w: int) -> int:
@@ -583,7 +731,7 @@ class Engine:
             # let the error surface
             for slot in sorted(self._active):
                 a = self._active.pop(slot)
-                self.arena.release(slot)
+                self._release_pages(slot)
                 self.results[a.req.id] = RequestResult(
                     a.req.id, adm.FAILED, tokens=list(a.tokens),
                     reason="decode_error",
@@ -712,6 +860,10 @@ class Engine:
         self._active = {}
         self.arena = KVArena(self.arena.spec, dtype=self.arena.dtype)
         self.state = init_state(self.arena, self.window)
+        if self._trie is not None:
+            # every page id was just reassigned: the whole index is
+            # stale — reset; fresh admissions re-register
+            self._trie.clear()
         self._event("arena_rebuilt", survivors=len(survivors))
         _hostmetrics.emit("serving/arena_rebuilds", 1)
         for a in survivors:
@@ -736,11 +888,15 @@ class Engine:
         slot, pages = self.arena.acquire(req.total_tokens)
         tokens = np.zeros((bucket,), np.int32)
         tokens[:len(prefix)] = np.asarray(prefix, np.int32)
-        k, v, _first = self.programs.prefill[bucket](
+        key = jax.random.PRNGKey(int(req.seed))
+        k, v, ks, vs, _first = self.programs.prefill[bucket](
             self.params, self.state.k, self.state.v,
+            self.state.k_scale, self.state.v_scale,
             self.arena.page_row(bucket, pages), jnp.asarray(tokens),
-            jnp.int32(len(prefix)))
-        st = self.state._replace(k=k, v=v)
+            jnp.int32(len(prefix)), key, jnp.float32(req.temperature),
+            jnp.int32(req.top_k), jnp.float32(req.top_p))
+        self._n_prefills += 1
+        st = self.state._replace(k=k, v=v, k_scale=ks, v_scale=vs)
         self.state = st._replace(
             page_table=st.page_table.at[slot].set(
                 self.arena.slot_row(slot)),
@@ -748,6 +904,13 @@ class Engine:
             active=st.active.at[slot].set(1 if remaining > 0 else 0),
             last_token=st.last_token.at[slot].set(int(a.tokens[-1])),
             budget=st.budget.at[slot].set(max(remaining, 0)),
+            # the same (seed, position) keys: a seeded stream's
+            # remaining draws reproduce bit-exactly through the replay
+            rng=st.rng.at[slot].set(key),
+            temperature=st.temperature.at[slot].set(
+                jnp.float32(req.temperature)),
+            top_k=st.top_k.at[slot].set(jnp.int32(req.top_k)),
+            top_p=st.top_p.at[slot].set(jnp.float32(req.top_p)),
             done=st.done.at[slot].set(0))
         self._active[slot] = _Active(
             req=req, slot=slot, tokens=list(a.tokens),
@@ -861,3 +1024,8 @@ class Engine:
         _hostmetrics.emit("serving/tokens_total", self._tokens_total)
         _hostmetrics.emit("serving/active_slots", len(self._active))
         _hostmetrics.emit("serving/queue_depth", len(self.queue))
+        # cumulative memory-frontier gauges, re-emitted every window so
+        # they are live on /metrics MID-run, not only at the end
+        _hostmetrics.emit("serving/prefix_hits", self._prefix_hits)
+        _hostmetrics.emit("serving/kv_bytes_saved",
+                          self._kv_bytes_saved)

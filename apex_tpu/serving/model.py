@@ -1,10 +1,12 @@
-"""The serving decoder: one causal-transformer forward in two shapes.
+"""The serving decoder: one causal-transformer forward in three shapes.
 
-Serving needs the SAME math twice — once over a whole padded prompt
-(prefill: compute every position's K/V and the first generated token)
-and once per generated token (decode: one query against the cached
-context).  The two paths here are written against one parameter
-layout so their numerics agree: a token's hidden state computed
+Serving needs the SAME math three ways — over a whole padded prompt
+(prefill: compute every position's K/V and the first generated token),
+per generated token (decode: one query against the cached context),
+and over a prompt SUFFIX against an aliased shared prefix (extend:
+the prefix-sharing admission path).  The paths are written against
+one parameter layout so their numerics agree: a token's hidden state
+computed
 incrementally from cached K/V is the same computation the prefill
 pass would have run at that position (per-row layer norms, per-batch-
 element matmuls — nothing couples batch rows, which is what makes a
@@ -152,6 +154,64 @@ def prefill_forward(params, cfg: DecoderConfig, tokens, lengths):
 # ---------------------------------------------------------------------
 # decode: one query token against the gathered cache
 # ---------------------------------------------------------------------
+
+def extend_forward(params, cfg: DecoderConfig, tokens, start, length,
+                   k_ctx, v_ctx):
+    """Multi-token decode over ONE slot: the prefix-sharing admission
+    path.  ``tokens (S,)`` is a padded suffix occupying absolute
+    positions ``start .. start+length-1``; ``k_ctx``/``v_ctx``
+    ``(L, C, KV, D)`` is the slot's gathered (dequantized) cached
+    context, of which only positions ``< start`` are trusted — they
+    hold the shared prefix another request already prefilled.  Each
+    suffix query attends to that cached prefix plus the causally
+    earlier suffix tokens (keys are ``concat(ctx, suffix)``, never a
+    scatter into the gather, so stale entries at positions >= start
+    are simply invisible).
+
+    Returns ``(logits_last (V,) f32, k_sfx (L, S, KV, D), v_sfx)`` —
+    logits at the last REAL suffix position (the first generated
+    token's distribution) and the suffix K/V the caller scatters into
+    the slot's own (post-COW) pages.  Same parameter layout and
+    per-row math as the other two paths: a suffix token's K/V here is
+    the K/V a full prefill would have computed at that position."""
+    s = tokens.shape[0]
+    c = k_ctx.shape[1]
+    hd = cfg.head_dim
+    groups = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / (hd ** 0.5)
+    positions = start + jnp.arange(s)
+    x = params["embed"][tokens] + params["pos"][
+        jnp.clip(positions, 0, cfg.max_seq - 1)]            # (S, H)
+    # visibility: cached entries strictly before the fork point, plus
+    # the causal triangle over the REAL suffix tokens
+    vis_ctx = jnp.broadcast_to(jnp.arange(c)[None, :] < start, (s, c))
+    vis_sfx = (jnp.arange(s)[None, :] <= jnp.arange(s)[:, None]) \
+        & (jnp.arange(s)[None, :] < length)
+    vis = jnp.concatenate([vis_ctx, vis_sfx], axis=1)       # (S, C+S)
+    k_news, v_news = [], []
+    for li, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"])
+        q = (h @ lp["wq"]).reshape(s, cfg.n_kv_heads, groups, hd)
+        k_new = (h @ lp["wk"]).reshape(s, cfg.n_kv_heads, hd)
+        v_new = (h @ lp["wv"]).reshape(s, cfg.n_kv_heads, hd)
+        k_news.append(k_new)
+        v_news.append(v_new)
+        keys = jnp.concatenate([k_ctx[li], k_new], axis=0)  # (C+S,KV,D)
+        vals = jnp.concatenate([v_ctx[li], v_new], axis=0)
+        scores = jnp.einsum("skgd,ckd->skgc", q, keys) * scale
+        scores = jnp.where(vis[:, None, None, :], scores,
+                           jnp.float32(-1e30))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("skgc,ckd->skgd", probs, vals)
+        x = x + out.reshape(s, -1) @ lp["wo"]
+        x = x + _mlp(lp, _ln(x, lp["ln2_w"], lp["ln2_b"]))
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    logits = x @ params["embed"].T                          # (S, V)
+    last = jnp.clip(length - 1, 0, s - 1)
+    return (logits[last].astype(jnp.float32),
+            jnp.stack(k_news),                              # (L,S,KV,D)
+            jnp.stack(v_news))
+
 
 def decode_forward(params, cfg: DecoderConfig, tokens, positions,
                    k_ctx, v_ctx, visible):

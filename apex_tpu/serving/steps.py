@@ -1,30 +1,53 @@
-"""AOT-lowered prefill + decode programs over the paged arena.
+"""AOT-lowered prefill + decode (+ extend/COW) programs over the
+paged arena.
 
-Two programs, both compiled AT ENGINE BUILD (``jax.jit(...).lower()
+The programs all compile AT ENGINE BUILD (``jax.jit(...).lower()
 .compile()`` — the pjit AOT surface), so the serve loop never traces:
 
 **Prefill** (one program per prompt-length shape bucket): run the full
 causal forward over one padded prompt through the flash-attention
 kernels, scatter the prompt's K/V into the slot's pages, and return
-the first generated token.  Buckets are multiples of ``page_size``;
+the first sampled token.  Buckets are multiples of ``page_size``;
 the admission path picks the smallest bucket that fits, so a new
 prompt length is a table lookup, never a compile.
 
 **Decode window** (one program): ``window`` continuously-batched
-greedy decode steps over EVERY slot inside one ``lax.fori_loop`` —
-gather each slot's pages, one dense single-query attention per layer,
-append the token's K/V back into the arena, advance the slot-state
-carry.  Admission/eviction state (``seq_lens``, ``active``, ``done``,
-the per-window token ring) rides the carry as device-side slots: the
-host reads it back with ONE ``device_get`` per window (the
-``telemetry/ring.py`` pattern), never per token, and writes it only
-at admission/eviction events.  Inactive or finished slots stay in the
+decode steps over EVERY slot inside one ``lax.fori_loop`` — gather
+each slot's pages, one dense single-query attention per layer, append
+the token's K/V back into the arena, advance the slot-state carry.
+Admission/eviction state (``seq_lens``, ``active``, ``done``, the
+per-window token ring) rides the carry as device-side slots: the host
+reads it back with ONE ``device_get`` per window (the
+``telemetry/ring.py`` pattern), never per token, and writes it only at
+admission/eviction events.  Inactive or finished slots stay in the
 batch with their writes steered into the arena's trash page —
 branch-free, so the program is one fixed shape regardless of load.
 
-Both programs DONATE the arena and the slot-state carry
-(``donate_argnums``), pinned as ``tf.aliasing_output`` in the lowered
-HLO by the ``serving.decode_step`` / ``serving.prefill_step``
+**Extend** (one program per suffix bucket, built only for
+prefix-sharing engines): the admission path for a request whose
+prompt prefix already lives in the arena — compute K/V for the
+unshared SUFFIX against the aliased cached prefix and scatter it into
+the slot's own pages.  **cow_copy** is the single page-copy program
+the engine runs when a shared page must detach before a write.
+
+Three orthogonal extensions ride the same carry:
+
+- *int8 arena* (``arena.dtype == int8``): the gather DEQUANTIZES
+  (int8 page × f32 per-vector scale plane) and the scatter QUANTIZES
+  (:func:`~apex_tpu.quantization.quantize_kv_int8`) — exactly one
+  convert out of / into int8 per arena side per step, pinned by the
+  ``serving.decode_step_quantized`` apexverify spec.
+- *device-side sampling*: temperature / top-k / top-p categorical
+  draws (:func:`sample_tokens`).  The per-slot PRNG key rides the
+  carry; each draw folds in the absolute POSITION, so a request's
+  stream depends only on its own seed — reproducible bit-exactly
+  across batch compositions, evictions and replays.  ``temperature <=
+  0`` selects the greedy argmax, the default.
+- *prefix sharing*: the extend/cow programs above.
+
+Every program DONATES the arena (+ scale planes) and the slot-state
+carry (``donate_argnums``), pinned as ``tf.aliasing_output`` in the
+lowered HLO by the ``serving.decode_step`` / ``serving.prefill_step``
 apexverify specs: KV never holds two live copies.
 """
 
@@ -35,20 +58,35 @@ from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.quantization import dequantize_kv, quantize_kv_int8
 from apex_tpu.serving.arena import ArenaSpec, KVArena
 from apex_tpu.serving.model import (DecoderConfig, decode_forward,
-                                    prefill_forward)
+                                    extend_forward, prefill_forward)
 
 
 class DecodeState(NamedTuple):
-    """The donated decode carry: arenas + device-side slot state."""
+    """The donated decode carry: arenas + device-side slot state.
+
+    ``k_scale``/``v_scale`` are the int8 arena's per-vector f32 scale
+    planes — (1,1,1,1) placeholders that pass through untouched in
+    float modes, full ``(P+1, psz, L, KV)`` planes updated by every
+    scatter under int8.  ``rng``/``temperature``/``top_k``/``top_p``
+    are host-written at admission (like ``page_table``/``active``) and
+    pass through the window: the draw key is ``fold_in(rng[slot],
+    position)``, so the carry key itself never advances."""
     k: jax.Array            # (P+1, psz, L, KV, D)
     v: jax.Array
+    k_scale: jax.Array      # (P+1, psz, L, KV) f32 | (1,1,1,1) stub
+    v_scale: jax.Array
     page_table: jax.Array   # (B, pps) i32
     seq_lens: jax.Array     # (B,) i32  — tokens currently CACHED
     active: jax.Array       # (B,) i32  — slot occupied
     last_token: jax.Array   # (B,) i32  — token at position seq_lens
     budget: jax.Array       # (B,) i32  — tokens still allowed out
+    rng: jax.Array          # (B, 2) u32 — per-slot PRNG key
+    temperature: jax.Array  # (B,) f32  — <= 0 selects greedy
+    top_k: jax.Array        # (B,) i32  — <= 0 disables the k filter
+    top_p: jax.Array        # (B,) f32
     out_tokens: jax.Array   # (B, W) i32 — this window's emissions
     n_out: jax.Array        # (B,) i32  — emissions this window
     done: jax.Array         # (B,) i32  — EOS / budget exhausted
@@ -58,37 +96,117 @@ def init_state(arena: KVArena, window: int) -> DecodeState:
     s = arena.spec
     zi = jnp.zeros((s.max_slots,), jnp.int32)
     return DecodeState(
-        k=arena.k, v=arena.v, page_table=arena.page_table,
+        k=arena.k, v=arena.v,
+        k_scale=arena.k_scale, v_scale=arena.v_scale,
+        page_table=arena.page_table,
         seq_lens=zi, active=zi, last_token=zi, budget=zi,
+        rng=jnp.zeros((s.max_slots, 2), jnp.uint32),
+        temperature=jnp.zeros((s.max_slots,), jnp.float32),
+        top_k=zi,
+        top_p=jnp.ones((s.max_slots,), jnp.float32),
         out_tokens=jnp.full((s.max_slots, int(window)), -1, jnp.int32),
         n_out=zi, done=zi)
+
+
+# ---------------------------------------------------------------------
+# device-side sampling
+# ---------------------------------------------------------------------
+
+def sample_tokens(logits, rng, positions, temperature, top_k, top_p):
+    """Temperature / top-k / top-p categorical draws, one per slot,
+    entirely on device (zero host traffic — the ``serving.sample_step``
+    apexverify spec pins the traced form).
+
+    ``logits (B, V)``; ``rng (B, 2) u32`` per-slot keys; ``positions
+    (B,) i32``.  The draw key is ``fold_in(rng[b], positions[b])`` —
+    a function of the request's own seed and the absolute position
+    alone, never of batch composition, window phase or neighbours,
+    which is what makes seeded streams reproducible bit-exactly across
+    admissions, evictions and replays.  Both nucleus filters share ONE
+    descending sort; the draw is a Gumbel-max argmax over the masked
+    scaled logits.  ``temperature <= 0`` returns the greedy argmax
+    (the engine default), ``top_k <= 0`` disables the k filter."""
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    srt = -jnp.sort(-scaled, axis=-1)               # descending (B, V)
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(top_k - 1, 0, v - 1)[:, None], axis=-1)
+    keep = jnp.where((top_k > 0)[:, None], scaled >= kth, True)
+    probs = jax.nn.softmax(srt, axis=-1)
+    exclusive = jnp.cumsum(probs, axis=-1) - probs
+    kept_sorted = exclusive < jnp.clip(top_p, 1e-6, 1.0)[:, None]
+    cutoff = jnp.min(jnp.where(kept_sorted, srt, jnp.inf), axis=-1,
+                     keepdims=True)                 # top-1 always kept
+    keep = keep & (scaled >= cutoff)
+
+    def draw(key, p):
+        return jax.random.gumbel(jax.random.fold_in(key, p), (v,))
+
+    g = jax.vmap(draw)(rng, positions)
+    drawn = jnp.argmax(jnp.where(keep, scaled, jnp.float32(-1e30)) + g,
+                       axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, drawn, greedy)
 
 
 # ---------------------------------------------------------------------
 # the pure step functions (what the specs trace)
 # ---------------------------------------------------------------------
 
+def _gather_ctx(k, v, k_scale, v_scale, rows, spec: ArenaSpec):
+    """Page gather + (static) dequantization: ``rows (..., pps)`` of
+    page indices -> per-row linear f32 context ``(..., C, L, KV, D)``.
+    One contiguous read per page; under int8 the scale planes gather
+    along and broadcast over head_dim — the dequantize-in-gather half
+    of the quantized arena's cast economy."""
+    s = spec
+    kk, vv = k[rows], v[rows]         # (..., pps, psz, L, KV, D)
+    if k.dtype == jnp.int8:
+        kk = dequantize_kv(kk, k_scale[rows])
+        vv = dequantize_kv(vv, v_scale[rows])
+    shape = rows.shape[:-1] + (s.pages_per_slot * s.page_size,
+                               s.n_layers, s.n_kv_heads, s.head_dim)
+    return kk.reshape(shape), vv.reshape(shape)
+
+
+def _scatter_kv(state_k, state_v, k_scale, v_scale, page, off,
+                kw, vw):
+    """Arena append at ``(page, off)`` with (static) quantization:
+    ``kw``/``vw`` are f32 values whose leading axes match ``page``.
+    Under int8, one quantize convert per arena side — the scatter half
+    of the cast economy — and the scale planes take the same masked
+    write (trash-page steering covers them too)."""
+    if state_k.dtype == jnp.int8:
+        kq, ks = quantize_kv_int8(kw)
+        vq, vs = quantize_kv_int8(vw)
+        return (state_k.at[page, off].set(kq),
+                state_v.at[page, off].set(vq),
+                k_scale.at[page, off].set(ks),
+                v_scale.at[page, off].set(vs))
+    return (state_k.at[page, off].set(kw.astype(state_k.dtype)),
+            state_v.at[page, off].set(vw.astype(state_v.dtype)),
+            k_scale, v_scale)
+
+
 def decode_one(params, cfg: DecoderConfig, spec: ArenaSpec,
                state: DecodeState, col) -> DecodeState:
-    """One continuously-batched greedy decode step (module docstring).
+    """One continuously-batched decode step (module docstring).
     ``col``: which window column this step's emissions land in."""
     s = spec
-    b, ctx = s.max_slots, s.slot_tokens
+    ctx = s.slot_tokens
     live = (state.active == 1) & (state.done == 0) \
         & (state.seq_lens < ctx)
     pos = jnp.clip(state.seq_lens, 0, ctx - 1)
-    # page gather: one contiguous read per page, reshaped back into
-    # each slot's linear context
-    kk = state.k[state.page_table]         # (B, pps, psz, L, KV, D)
-    vv = state.v[state.page_table]
-    kk = kk.reshape(b, ctx, s.n_layers, s.n_kv_heads, s.head_dim)
-    vv = vv.reshape(b, ctx, s.n_layers, s.n_kv_heads, s.head_dim)
+    kk, vv = _gather_ctx(state.k, state.v, state.k_scale,
+                         state.v_scale, state.page_table, s)
     k_ctx = jnp.moveaxis(kk, 2, 0)         # (L, B, C, KV, D)
     v_ctx = jnp.moveaxis(vv, 2, 0)
     visible = jnp.arange(ctx)[None, :] <= pos[:, None]
     logits, k_new, v_new = decode_forward(
         params, cfg, state.last_token, pos, k_ctx, v_ctx, visible)
-    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    nxt = sample_tokens(logits, state.rng, pos, state.temperature,
+                        state.top_k, state.top_p)
     # append the CURRENT token's K/V at (page, offset); dead slots
     # write into the trash page (branch-free masking)
     page = jnp.take_along_axis(
@@ -97,17 +215,15 @@ def decode_one(params, cfg: DecoderConfig, spec: ArenaSpec,
         axis=1)[:, 0]
     page = jnp.where(live, page, s.trash_page)
     off = pos % s.page_size
-    k = state.k.at[page, off].set(
-        jnp.moveaxis(k_new, 0, 1).astype(state.k.dtype))
-    v = state.v.at[page, off].set(
-        jnp.moveaxis(v_new, 0, 1).astype(state.v.dtype))
+    k, v, k_scale, v_scale = _scatter_kv(
+        state.k, state.v, state.k_scale, state.v_scale, page, off,
+        jnp.moveaxis(k_new, 0, 1), jnp.moveaxis(v_new, 0, 1))
     emitted = live.astype(jnp.int32)
     new_budget = state.budget - emitted
     finished = live & ((nxt == cfg.eos_token) | (new_budget <= 0))
-    return DecodeState(
-        k=k, v=v, page_table=state.page_table,
+    return state._replace(
+        k=k, v=v, k_scale=k_scale, v_scale=v_scale,
         seq_lens=state.seq_lens + emitted,
-        active=state.active,
         last_token=jnp.where(live, nxt, state.last_token),
         budget=new_budget,
         out_tokens=jax.lax.dynamic_update_slice(
@@ -132,23 +248,86 @@ def decode_window_fn(cfg: DecoderConfig, spec: ArenaSpec, window: int):
 
 def prefill_fn(cfg: DecoderConfig, spec: ArenaSpec, bucket: int):
     """The jittable per-bucket prefill program: forward the padded
-    prompt, scatter its K/V pages, return the first greedy token."""
+    prompt, scatter its K/V pages (quantizing under int8), sample the
+    first token at position ``length - 1``'s distribution."""
     if bucket % spec.page_size:
         raise ValueError(f"prefill bucket {bucket} must be a multiple "
                          f"of page_size {spec.page_size}")
     n_pg = bucket // spec.page_size
 
-    def run(params, k, v, pages, tokens, length):
+    def run(params, k, v, k_scale, v_scale, pages, tokens, length,
+            rng, temperature, top_k, top_p):
         logits, kp, vp = prefill_forward(params, cfg, tokens[None],
                                          length[None])
-        first = jnp.argmax(logits[0]).astype(jnp.int32)
+        first = sample_tokens(
+            logits, rng[None], (length - 1)[None], temperature[None],
+            top_k[None], top_p[None])[0]
         def paged(t):                       # (L,1,S,KV,D) -> pages
             t = jnp.transpose(t[:, 0], (1, 0, 2, 3))
             return t.reshape(n_pg, spec.page_size, spec.n_layers,
                              spec.n_kv_heads, spec.head_dim)
-        k = k.at[pages].set(paged(kp).astype(k.dtype))
-        v = v.at[pages].set(paged(vp).astype(v.dtype))
-        return k, v, first
+        if k.dtype == jnp.int8:
+            kq, ks = quantize_kv_int8(paged(kp))
+            vq, vs = quantize_kv_int8(paged(vp))
+            k = k.at[pages].set(kq)
+            v = v.at[pages].set(vq)
+            k_scale = k_scale.at[pages].set(ks)
+            v_scale = v_scale.at[pages].set(vs)
+        else:
+            k = k.at[pages].set(paged(kp).astype(k.dtype))
+            v = v.at[pages].set(paged(vp).astype(v.dtype))
+        return k, v, k_scale, v_scale, first
+    return run
+
+
+def extend_fn(cfg: DecoderConfig, spec: ArenaSpec, bucket: int):
+    """The jittable per-bucket prefix-EXTEND program: a prompt whose
+    leading pages are aliased from the trie computes only its suffix —
+    gather the slot's context (the shared prefix another request
+    prefilled), run the dense suffix forward, scatter the suffix K/V
+    into the slot's own pages (positions ``start ..``; any page the
+    suffix touches is post-COW exclusively owned), and sample the
+    first token.  ``bucket`` bounds the SUFFIX length."""
+    if bucket % spec.page_size:
+        raise ValueError(f"extend bucket {bucket} must be a multiple "
+                         f"of page_size {spec.page_size}")
+    s = spec
+
+    def run(params, k, v, k_scale, v_scale, row, tokens, start,
+            length, rng, temperature, top_k, top_p):
+        kk, vv = _gather_ctx(k, v, k_scale, v_scale, row[None], s)
+        k_ctx = jnp.moveaxis(kk[0], 1, 0)      # (L, C, KV, D)
+        v_ctx = jnp.moveaxis(vv[0], 1, 0)
+        logits, k_sfx, v_sfx = extend_forward(
+            params, cfg, tokens, start, length, k_ctx, v_ctx)
+        first = sample_tokens(
+            logits[None], rng[None], (start + length - 1)[None],
+            temperature[None], top_k[None], top_p[None])[0]
+        positions = start + jnp.arange(bucket)
+        valid = jnp.arange(bucket) < length
+        page = row[jnp.clip(positions // s.page_size, 0,
+                            s.pages_per_slot - 1)]
+        page = jnp.where(valid, page, s.trash_page)
+        off = positions % s.page_size
+        k, v, k_scale, v_scale = _scatter_kv(
+            k, v, k_scale, v_scale, page, off,
+            jnp.moveaxis(k_sfx, 0, 1), jnp.moveaxis(v_sfx, 0, 1))
+        return k, v, k_scale, v_scale, first
+    return run
+
+
+def cow_copy_fn():
+    """The jittable copy-on-write page copy: duplicate page ``src``
+    into ``dst`` across both arenas (+ scale planes when they are
+    real).  Page ids are traced scalars — ONE compile covers every
+    COW this engine will ever do."""
+    def run(k, v, k_scale, v_scale, src, dst):
+        k = k.at[dst].set(k[src])
+        v = v.at[dst].set(v[src])
+        if k_scale.shape[0] == k.shape[0]:     # real planes (int8)
+            k_scale = k_scale.at[dst].set(k_scale[src])
+            v_scale = v_scale.at[dst].set(v_scale[src])
+        return k, v, k_scale, v_scale
     return run
 
 
@@ -162,18 +341,30 @@ def _sds(x):
                                        jnp.asarray(l).dtype), x)
 
 
+# (rng, temperature, top_k, top_p) — the scalar sampling operands every
+# admission-path program takes
+_SAMPLE_SDS = (jax.ShapeDtypeStruct((2,), jnp.uint32),
+               jax.ShapeDtypeStruct((), jnp.float32),
+               jax.ShapeDtypeStruct((), jnp.int32),
+               jax.ShapeDtypeStruct((), jnp.float32))
+
+
 class ServingPrograms:
     """The engine's compiled program set: ONE decode-window executable
-    plus one prefill executable per shape bucket, all lowered and
-    compiled at build time (``serve()`` never traces)."""
+    plus one prefill executable per shape bucket (and, for prefix-
+    sharing engines, one extend executable per bucket plus the COW
+    page copy), all lowered and compiled at build time (``serve()``
+    never traces)."""
 
     def __init__(self, params, cfg: DecoderConfig, arena: KVArena,
                  window: int,
-                 prefill_buckets: Optional[Sequence[int]] = None):
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 prefix_share: bool = False, _base=None):
         spec = arena.spec
         self.cfg = cfg
         self.spec = spec
         self.window = int(window)
+        self.prefix_share = bool(prefix_share)
         if prefill_buckets is None:
             # powers-of-two multiples of page_size up to slot capacity
             prefill_buckets, b = [], spec.page_size
@@ -191,23 +382,67 @@ class ServingPrograms:
                     f"capacity ({spec.slot_tokens})")
         p_sds = _sds(params)
         state_sds = _sds(init_state(arena, self.window))
-        # decode: donate the whole carry (arg 1) — arenas + slot state
-        self.decode = jax.jit(
-            decode_window_fn(cfg, spec, self.window),
-            donate_argnums=(1,)).lower(p_sds, state_sds).compile()
-        self.prefill: Dict[int, object] = {}
+        arena_sds = (_sds(arena.k), _sds(arena.v),
+                     _sds(arena.k_scale), _sds(arena.v_scale))
+        # a sibling program set over the same (params, geometry,
+        # dtype) that differs ONLY in prefix_share shares its decode/
+        # prefill executables outright — extend + COW are additive,
+        # so toggling sharing (a respawned replica, a prefs flip)
+        # never re-pays the base compile
+        reuse = (_base is not None
+                 and _base.window == self.window
+                 and _base.prefill_buckets == self.prefill_buckets)
+        if reuse:
+            self.decode = _base.decode
+            self.prefill: Dict[int, object] = dict(_base.prefill)
+        else:
+            # decode: donate the whole carry (arg 1) — arenas + slot
+            # state
+            self.decode = jax.jit(
+                decode_window_fn(cfg, spec, self.window),
+                donate_argnums=(1,)).lower(p_sds, state_sds).compile()
+            self.prefill = {}
+        self.extend: Dict[int, object] = {}
         for bk in self.prefill_buckets:
-            fn = prefill_fn(cfg, spec, bk)
-            # one AOT compile per shape bucket, ONCE at engine build —
-            # this loop IS the ahead-of-time surface, not a hot path
-            # apexlint: disable-next=APX302
-            self.prefill[bk] = jax.jit(
-                fn, donate_argnums=(1, 2)).lower(
-                p_sds, _sds(arena.k), _sds(arena.v),
-                jax.ShapeDtypeStruct((bk // spec.page_size,),
-                                     jnp.int32),
-                jax.ShapeDtypeStruct((bk,), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32)).compile()
+            if not reuse:
+                fn = prefill_fn(cfg, spec, bk)
+                # one AOT compile per shape bucket, ONCE at engine
+                # build — this loop IS the ahead-of-time surface, not
+                # a hot path
+                # apexlint: disable-next=APX302
+                self.prefill[bk] = jax.jit(
+                    fn, donate_argnums=(1, 2, 3, 4)).lower(
+                    p_sds, *arena_sds,
+                    jax.ShapeDtypeStruct((bk // spec.page_size,),
+                                         jnp.int32),
+                    jax.ShapeDtypeStruct((bk,), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    *_SAMPLE_SDS).compile()
+            if prefix_share:
+                if reuse and _base.prefix_share:
+                    self.extend[bk] = _base.extend[bk]
+                    continue
+                # apexlint: disable-next=APX302
+                self.extend[bk] = jax.jit(
+                    extend_fn(cfg, spec, bk),
+                    donate_argnums=(1, 2, 3, 4)).lower(
+                    p_sds, *arena_sds,
+                    jax.ShapeDtypeStruct((spec.pages_per_slot,),
+                                         jnp.int32),
+                    jax.ShapeDtypeStruct((bk,), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    *_SAMPLE_SDS).compile()
+        self.cow_copy = None
+        if prefix_share:
+            if reuse and _base.prefix_share:
+                self.cow_copy = _base.cow_copy
+            else:
+                self.cow_copy = jax.jit(
+                    cow_copy_fn(), donate_argnums=(0, 1, 2, 3)).lower(
+                    *arena_sds,
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32)).compile()
 
     def bucket_for(self, prompt_len: int) -> Optional[int]:
         for bk in self.prefill_buckets:
@@ -229,17 +464,22 @@ _PROGRAM_CACHE_MAX = 8
 
 def cached_programs(params, cfg: DecoderConfig, arena: KVArena,
                     window: int,
-                    prefill_buckets: Optional[Sequence[int]] = None
-                    ) -> ServingPrograms:
+                    prefill_buckets: Optional[Sequence[int]] = None,
+                    prefix_share: bool = False) -> ServingPrograms:
     """Memoized :class:`ServingPrograms` (module comment above)."""
     key = (id(params), cfg, arena.spec, str(arena.dtype), int(window),
            tuple(prefill_buckets) if prefill_buckets is not None
-           else None)
+           else None, bool(prefix_share))
     progs = _PROGRAM_CACHE.get(key)
     if progs is None:
+        # the sibling set (same everything, prefix_share flipped)
+        # donates its decode/prefill executables — see __init__
+        sibling = _PROGRAM_CACHE.get(key[:-1] + (not key[-1],))
         if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
             _PROGRAM_CACHE.clear()
         progs = ServingPrograms(params, cfg, arena, window=window,
-                                prefill_buckets=prefill_buckets)
+                                prefill_buckets=prefill_buckets,
+                                prefix_share=prefix_share,
+                                _base=sibling)
         _PROGRAM_CACHE[key] = progs
     return progs

@@ -175,9 +175,13 @@ def summarize(path, tail: int = 32, as_json: bool = False,
             anomalies.append(r)
         elif r.get("kind") == "fleet":
             fleet_events.append(r)
-    if not steps:
+    if not steps and not (counters or spans or anomalies
+                          or fleet_events or retraces):
         print(f"{resolved}: no step records", file=out)
         return 1
+    # a step-less run still renders: the serving engine emits only
+    # counters (serving/prefix_hits, serving/kv_bytes_saved, ...) and
+    # events, and those need a summarize surface too
     # a step flushed twice (flush() + close()) keeps the newest record
     by_step = {}
     for r in steps:
@@ -217,12 +221,14 @@ def summarize(path, tail: int = 32, as_json: bool = False,
     print(f"steps recorded: {len(steps)}   overflow steps: {overflows}",
           file=out)
     print("", file=out)
-    show = steps[-tail:] if tail and tail > 0 else steps
-    header = ["step"] + [m.rsplit("/", 1)[-1] if m.count("/") else m
-                         for m in metrics]
-    rows = [[str(r["step"])] + [_fmt_cell(r.get(m)) for m in metrics]
-            for r in show]
-    _render_table(header, rows, out)
+    if steps:
+        show = steps[-tail:] if tail and tail > 0 else steps
+        header = ["step"] + [m.rsplit("/", 1)[-1] if m.count("/") else m
+                             for m in metrics]
+        rows = [[str(r["step"])]
+                + [_fmt_cell(r.get(m)) for m in metrics]
+                for r in show]
+        _render_table(header, rows, out)
     if anomalies:
         # the watchdog's anomaly timeline: detections (kind:"anomaly")
         # interleaved with the actions taken (kind:"watchdog") in

@@ -59,7 +59,29 @@ def parse_args(argv=None):
     p.add_argument("--deadline-s", type=float, default=None,
                    help="decode-window deadline (default 30, or 0.2 "
                         "when injecting the hang)")
+    p.add_argument("--kv-dtype", default=None,
+                   choices=("f32", "bf16", "int8"),
+                   help="KV arena storage dtype; int8 stores "
+                        "quantized pages + per-vector f32 scales "
+                        "(~half the HBM per cached token)")
+    p.add_argument("--sample", default=None, metavar="TEMP:TOP_P",
+                   help="device-side sampling, e.g. 0.8:0.95 — each "
+                        "request draws seeded temperature/top-p "
+                        "samples on device (default: greedy)")
+    p.add_argument("--shared-system-prompt", action="store_true",
+                   help="prefix every request with one shared system "
+                        "prompt and enable refcounted prefix sharing: "
+                        "the prefix prefills ONCE, later requests "
+                        "alias its pages (watch "
+                        "apex_tpu_serving_prefix_hits / "
+                        "_kv_bytes_saved on /metrics)")
     return p.parse_args(argv)
+
+
+def parse_sample(spec):
+    """``TEMP:TOP_P`` -> (temperature, top_p)."""
+    temp, _, top_p = spec.partition(":")
+    return float(temp), float(top_p) if top_p else 1.0
 
 
 def main(argv=None):
@@ -92,7 +114,9 @@ def main(argv=None):
     eng = serving.Engine(params, cfg, page_size=4, n_pages=32,
                          max_slots=2, pages_per_slot=8, window=4,
                          telemetry=tel, decode_deadline_s=deadline,
-                         flush_every=1)
+                         flush_every=1, kv_dtype=args.kv_dtype,
+                         prefix_share=(True if args.shared_system_prompt
+                                       else None))
     print(f"engine: {eng.arena.describe()}  "
           f"prefill buckets {eng.programs.prefill_buckets}  "
           f"decode window {eng.window}")
@@ -104,10 +128,19 @@ def main(argv=None):
             "hung_decode", at_step=args.inject_hung_decode_at,
             delay_s=max(0.5, 3 * deadline))]).install()
 
+    samp = {}
+    if args.sample is not None:
+        temp, top_p = parse_sample(args.sample)
+        samp = dict(temperature=temp, top_p=top_p)
+    # the shared system prompt spans two full pages (page_size 4), so
+    # every later request aliases them instead of re-prefilling
+    system = [7, 8, 9, 10, 11, 12, 13, 14, 15] \
+        if args.shared_system_prompt else []
     for i in range(args.requests):
         eng.submit(serving.Request(
-            id=f"req-{i}", prompt=[2 + (i % 7), 3 + (i % 5), 4],
-            max_new_tokens=args.max_new_tokens))
+            id=f"req-{i}",
+            prompt=system + [2 + (i % 7), 3 + (i % 5), 4],
+            max_new_tokens=args.max_new_tokens, seed=i, **samp))
     results = eng.serve()
 
     evicted = [r for r in results.values()
@@ -143,6 +176,11 @@ def main(argv=None):
         state = ("closed" if eng.incidents.current is None
                  else "OPEN")
         print(f"incident chain: {eng.incidents.history[0]} [{state}]")
+    if args.shared_system_prompt:
+        print(f"prefix sharing: {eng._prefix_hits} hit(s), "
+              f"{eng._n_prefills} prefill(s), "
+              f"{eng._cow_copies} cow cop(ies), "
+              f"{eng._kv_bytes_saved} KV bytes saved")
 
     eng.close()
     if tel is not None:

@@ -52,6 +52,8 @@ def _good_table(key="cpu-8"):
         "attn_block_cap": {"128": 512},
         "pipeline": {"max_bucket_bytes": 1 << 25,
                      "reduce_decompose": "reduce_scatter"},
+        "serving": {"page_size": 8, "decode_window": 8,
+                    "kv_dtype": "int8", "prefix_share": True},
     }
 
 
@@ -86,6 +88,12 @@ class TestValidateTable:
         (lambda d: d["pipeline"].update(max_bucket_bytes=-4),
          "max_bucket_bytes"),
         (lambda d: d["topology"].pop("key"), "string 'key'"),
+        (lambda d: d["serving"].update(page_size=0),
+         "serving.page_size"),
+        (lambda d: d["serving"].update(kv_dtype="fp4"),
+         "serving.kv_dtype"),
+        (lambda d: d["serving"].update(prefix_share="yes"),
+         "serving.prefix_share"),
     ])
     def test_each_violation_fails_fast(self, mutate, needle):
         doc = _good_table()
@@ -350,12 +358,19 @@ class TestTopologySelection:
         doc["pipeline"] = {"max_bucket_bytes": "lots",
                            "reduce_decompose": "reduce_scatter",
                            "unknown_knob": 7}
+        doc["serving"] = {"page_size": 16, "decode_window": "wide",
+                          "kv_dtype": "fp4", "prefix_share": "yes"}
         _write(root / f"dispatch_prefs.{key}.json", doc)
         t = _dispatch.dispatch_tables()
         assert t.attn_block_cap == {"128": 256}
         # bad max_bucket_bytes dropped, good reduce_decompose kept
         assert t.pipeline == {"reduce_decompose": "reduce_scatter"}
         assert _dispatch.pipeline_pref("max_bucket_bytes") is None
+        # serving: good page_size kept; out-of-domain kv_dtype,
+        # non-bool prefix_share, and non-int window all dropped
+        assert t.serving == {"page_size": 16}
+        assert _dispatch.serving_pref("kv_dtype", "f32") == "f32"
+        assert _dispatch.serving_pref("prefix_share", False) is False
         # the routing table survived its siblings' bad entries
         assert not _dispatch.op_enabled("multi_tensor")
 

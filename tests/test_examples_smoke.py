@@ -332,6 +332,75 @@ def test_serve_gpt_chaos_scrape_and_incident_timeline(tmp_path,
         and "incident_resolved" in evs
 
 
+def test_serve_gpt_shared_prefix_int8_gauges_live_and_summarize(
+        tmp_path, capsys):
+    """The serving memory frontier demo: --shared-system-prompt +
+    --kv-dtype int8 + --sample decodes with --port while a background
+    scraper polls /metrics.  A MID-RUN scrape must carry the prefix-
+    sharing gauges (``apex_tpu_serving_prefix_hits`` /
+    ``_kv_bytes_saved``), and ``telemetry summarize`` renders the same
+    counters afterwards — the step-less serving run has a summarize
+    surface too."""
+    import socket
+    import threading
+    import urllib.request
+
+    tel = str(tmp_path / "telemetry")
+    with socket.socket() as s:                # pick a free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    samples, stop = [], threading.Event()
+
+    def scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1) as r:
+                    body = r.read().decode()
+                g = {}
+                for line in body.splitlines():
+                    if not line.startswith("#") and " " in line \
+                            and "{" not in line:
+                        n, v = line.rsplit(" ", 1)
+                        g[n] = float(v)
+                samples.append(g)
+            except OSError:
+                pass                          # server not up/gone yet
+            stop.wait(0.005)
+
+    t = threading.Thread(target=scrape, daemon=True)
+    t.start()
+    try:
+        _run("examples/gpt/serve.py",
+             ["--requests", "5", "--max-new-tokens", "10",
+              "--kv-dtype", "int8", "--sample", "0.8:0.95",
+              "--shared-system-prompt",
+              "--telemetry-dir", tel, "--port", str(port)])
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    out = capsys.readouterr().out
+    assert "'quantized': True" in out and "'dtype': 'int8'" in out
+    assert "prefix sharing:" in out
+    assert "OK:" in out
+    assert len(samples) > 2                   # genuinely scraped live
+    # a MID-RUN scrape carries the prefix-sharing gauges
+    mid = [g for g in samples
+           if "apex_tpu_serving_prefix_hits" in g]
+    assert mid, "no scrape saw the prefix gauges"
+    assert any(g.get("apex_tpu_serving_kv_bytes_saved", 0) > 0
+               for g in samples)
+    last = samples[-1]
+    assert last.get("apex_tpu_serving_prefix_hits", 0) >= 1
+    # ...and the counters land on the summarize surface afterwards
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["summarize", tel]) == 0
+    summary = capsys.readouterr().out
+    assert "serving/prefix_hits" in summary
+    assert "serving/kv_bytes_saved" in summary
+
+
 def test_imagenet_preempt_and_resume(tmp_path, capsys):
     """The imagenet example's save path rides the same resilience
     manager: --checkpoint-dir rotates bucket-native checkpoints and a

@@ -69,6 +69,10 @@ SCHEMA_VERSION = 2
 
 _REDUCE_CHOICES = ("psum", "reduce_scatter")
 
+# keep in sync with apex_tpu.ops._dispatch.KV_DTYPE_CHOICES; duplicated
+# so --validate stays jax-free.
+_KV_DTYPE_CHOICES = ("f32", "bf16", "int8")
+
 
 def _load_sibling(name):
     """Import a sibling tools/ module (tools/ is not a package)."""
@@ -172,6 +176,13 @@ def validate_table(doc, *, per_topology: bool, path: str = "") -> list:
                              or srv[k] <= 0):
                 err(f"serving.{k} must be a positive integer, "
                     f"found {srv[k]!r}")
+        if "kv_dtype" in srv and srv["kv_dtype"] not in _KV_DTYPE_CHOICES:
+            err(f"serving.kv_dtype must be one of {_KV_DTYPE_CHOICES}, "
+                f"found {srv['kv_dtype']!r}")
+        if "prefix_share" in srv \
+                and not isinstance(srv["prefix_share"], bool):
+            err(f"serving.prefix_share must be a JSON boolean, "
+                f"found {srv['prefix_share']!r}")
 
     topo = doc.get("topology")
     if topo is not None:
@@ -295,6 +306,11 @@ def smoke_config() -> dict:
         "serving_window_candidates": [4, 8],
         "serving_layers": 2, "serving_hidden": 32,
         "serving_heads": 2, "serving_slots": 2, "serving_ctx": 16,
+        # the kv-dtype leg pins head_dim=64 (hidden/heads): the bytes
+        # ratio is structural in head_dim and the budget ceiling (0.55)
+        # is stamped at the production width, not the smoke width
+        "serving_quant_hidden": 256, "serving_quant_heads": 4,
+        "serving_share_requests": 4,
         "device_check_families": ["multi_tensor"],
     }
 
@@ -324,6 +340,8 @@ def full_config() -> dict:
         "serving_window_candidates": [8, 16, 32],
         "serving_layers": 8, "serving_hidden": 512,
         "serving_heads": 8, "serving_slots": 16, "serving_ctx": 1024,
+        "serving_quant_hidden": 512, "serving_quant_heads": 8,
+        "serving_share_requests": 8,
         "device_check_families": ["multi_tensor", "welford",
                                   "layer_norm", "pipeline", "fp8"],
     }
@@ -862,6 +880,76 @@ def sweep_serving_geometry(cfg, noise_pct: float) -> list:
     return [rec]
 
 
+_SERVING_MEMORY_MEMO = {}
+
+
+def _serving_memory_benches(cfg):
+    """Run (once per config) the two serving-memory benches that both
+    the sweep and the budget restamp consume — each builds and
+    compiles its own engine, so re-running them for the budget rows
+    would double the sweep's compile bill for identical numbers."""
+    from apex_tpu.serving.bench import bench_kv_quant_gather, \
+        bench_prefix_admission
+    key = (cfg["serving_layers"], cfg["serving_quant_hidden"],
+           cfg["serving_quant_heads"], cfg["serving_slots"],
+           cfg["serving_hidden"], cfg["serving_heads"],
+           cfg["serving_share_requests"], cfg["iters"], cfg["reps"])
+    if key not in _SERVING_MEMORY_MEMO:
+        rq = bench_kv_quant_gather(
+            n_layers=cfg["serving_layers"],
+            hidden=cfg["serving_quant_hidden"],
+            n_heads=cfg["serving_quant_heads"],
+            max_slots=cfg["serving_slots"], page_size=8,
+            pages_per_slot=2, iters=cfg["iters"], reps=cfg["reps"])
+        rp = bench_prefix_admission(
+            n_requests=cfg["serving_share_requests"],
+            n_layers=cfg["serving_layers"],
+            hidden=cfg["serving_hidden"],
+            n_heads=cfg["serving_heads"], page_size=4,
+            pages_per_slot=8, prompt_len=12, window=4)
+        _SERVING_MEMORY_MEMO[key] = (rq, rp)
+    return _SERVING_MEMORY_MEMO[key]
+
+
+def sweep_serving_memory(cfg, noise_pct: float) -> list:
+    """Serving memory frontier: kv_dtype and prefix_share.
+
+    kv_dtype weighs the int8 gather+dequantize leg against the bf16
+    gather (bench_kv_quant_gather) — the bytes halving is structural,
+    so int8 wins unless its cast overhead exceeds the noise floor (the
+    memory is free; only the compute tax can disqualify it).
+    prefix_share is graded structurally: an N-way shared-prompt serve
+    (bench_prefix_admission) must show prefill savings at or above the
+    budget floor (2.0) with every request completed — wall clock never
+    decides, the engine's prefill/extend counters do."""
+    rq, rp = _serving_memory_benches(cfg)
+    rec_q = {"space": "serving.kv_dtype", "family": "serving",
+             "shape": f"b{rq['kv_gather_slots']}"
+                      f"ctx{rq['kv_gather_ctx']}"
+                      f"d{rq['kv_gather_head_dim']}",
+             "dtype": "int8", "noise_floor_pct": noise_pct,
+             "candidates_ms": {
+                 "bf16": rq["kv_quant_gather_bf16_ms"],
+                 "int8": rq["kv_quant_gather_int8_ms"]},
+             "kv_bytes_per_token_ratio": rq["kv_bytes_per_token_ratio"]}
+    if rq["kv_quant_gather_int8_ms"] <= \
+            rq["kv_quant_gather_bf16_ms"] * (1.0 + noise_pct / 100.0):
+        rec_q["decision"] = {"serving": {"kv_dtype": "int8"}}
+
+    n_req = cfg["serving_share_requests"]
+    rec_p = {"space": "serving.prefix_share", "family": "serving",
+             "shape": f"n{n_req}p{rp['prefix_prompt_len']}",
+             "dtype": "f32", "noise_floor_pct": noise_pct,
+             "candidates_ms": {
+                 "shared": rp["prefix_admission_ms"]},
+             "prefix_prefill_savings": rp["prefix_prefill_savings"],
+             "prefix_completed": rp["prefix_completed"]}
+    if rp["prefix_prefill_savings"] >= 2.0 \
+            and rp["prefix_completed"] == n_req:
+        rec_p["decision"] = {"serving": {"prefix_share": True}}
+    return [rec_q, rec_p]
+
+
 def measure_budget_rows(cfg) -> dict:
     """Sweep measurements that ground perf_budget rows (dotted metric
     path -> value).  grad_accum_n8_speedup comes from the same flat-vs-
@@ -883,6 +971,9 @@ def measure_budget_rows(cfg) -> dict:
         window=8)
     out["extra.decode_tokens_per_sec"] = s["decode_tokens_per_sec"]
     out["extra.serving_p99_ms"] = s["serving_p99_ms"]
+    q, p = _serving_memory_benches(cfg)
+    out["extra.kv_bytes_per_token"] = q["kv_bytes_per_token_ratio"]
+    out["extra.prefix_prefill_savings"] = p["prefix_prefill_savings"]
     return out
 
 
@@ -970,6 +1061,10 @@ def demonstrate_decision_changes(doc) -> list:
                 "page_size")
             out["serving:decode_window"] = _dispatch.serving_pref(
                 "decode_window")
+            out["serving:kv_dtype"] = _dispatch.serving_pref(
+                "kv_dtype", "f32")
+            out["serving:prefix_share"] = _dispatch.serving_pref(
+                "prefix_share", False)
             return out
 
         before = snapshot()
@@ -1021,6 +1116,7 @@ def run_sweep(cfg, out_dir: str, budget_path: str,
         records += sweep_fp8_cadence(cfg, noise_pct, out_dir)
         records += sweep_quantization(cfg, noise_pct)
         records += sweep_serving_geometry(cfg, noise_pct)
+        records += sweep_serving_memory(cfg, noise_pct)
         budget_rows = measure_budget_rows(cfg)
     finally:
         if prev_pin is None:

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # The one-command correctness gate: AST tier (incl. APX204
-# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 24
+# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 26
 # specs) + baseline diff over the package, then the relaxed profile
 # over tests/, examples/ and tools/ (APX101/102 exempt inside test
 # bodies — a test syncing to assert a device value is the point of the
@@ -12,9 +12,12 @@
 # fp8 quantize-convert counts — precision casts cannot silently
 # multiply — with the packed fp8 scale state donated/aliased like
 # every other optimizer slot), and the serving.decode_step /
-# serving.prefill_step specs (the AOT decode window lowers with zero
+# serving.prefill_step / serving.decode_step_quantized /
+# serving.sample_step specs (the AOT decode window lowers with zero
 # host traffic and exact KV-arena donation alias counts; prefill runs
-# one flash pallas_call per decoder layer).
+# one flash pallas_call per decoder layer; the int8 window pins its
+# quantize/dequantize convert counts exactly; the device-side sampler
+# lowers transfer-free with one shared sort).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #
@@ -43,13 +46,13 @@ assert ids == want, f'expected {want}, found {ids}'
 print(f'{len(ids)} concurrency rules registered')
 "
 
-echo "== apexverify spec count: exactly 24 registered"
+echo "== apexverify spec count: exactly 26 registered"
 # the spec-count gate: a PR that deletes or fails to register an
 # invariant spec must fail HERE, not silently verify less
 python -c "
 from apex_tpu.lint import semantic
 n = len(semantic.all_specs())
-assert n == 24, f'expected 24 apexverify specs, found {n}'
+assert n == 26, f'expected 26 apexverify specs, found {n}'
 print(f'{n} specs registered')
 "
 

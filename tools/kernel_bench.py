@@ -356,6 +356,48 @@ def main():
                           / rd["decode_step_paged_ms"], 2)
                     if rd["decode_step_paged_ms"] else None)})
 
+    # KV quantization: int8 gather+dequant vs bf16 gather ("kernel" =
+    # int8, "oracle" = bf16) — the memory-frontier trade: ~0.53x the
+    # HBM bytes per cached token (the extra.kv_bytes_per_token budget
+    # ceiling, 0.55) for whatever cast overhead shows here
+    from apex_tpu.serving.bench import bench_kv_quant_gather
+    rq = bench_kv_quant_gather(n_layers=4, hidden=256, n_heads=4,
+                               max_slots=8, page_size=16,
+                               pages_per_slot=8)
+    rq["backend"] = backend
+    print(json.dumps(rq), flush=True)
+    rows.append({
+        "kernel": "kv_quant_gather",
+        "shape": (f"b{rq['kv_gather_slots']}ctx{rq['kv_gather_ctx']}"
+                  f"d{rq['kv_gather_head_dim']}"),
+        "dtype": "int8",
+        "kernel_ms": rq["kv_quant_gather_int8_ms"],
+        "oracle_ms": rq["kv_quant_gather_bf16_ms"],
+        "speedup": (round(rq["kv_quant_gather_bf16_ms"]
+                          / rq["kv_quant_gather_int8_ms"], 2)
+                    if rq["kv_quant_gather_int8_ms"] else None)})
+
+    # prefix-sharing admission: 8 requests, one shared prompt — the
+    # structural prefill-savings factor (extra.prefix_prefill_savings
+    # floor 2.0) plus the admission wall clock; "oracle" here is the
+    # no-sharing cost model (n_requests full prefills), folded into
+    # the savings number rather than a second timed leg
+    from apex_tpu.serving.bench import bench_prefix_admission
+    rp = bench_prefix_admission(n_requests=8, n_layers=4, hidden=256,
+                                n_heads=8, page_size=16,
+                                pages_per_slot=8, prompt_len=48,
+                                window=8)
+    rp["backend"] = backend
+    print(json.dumps(rp), flush=True)
+    rows.append({
+        "kernel": "prefix_admission",
+        "shape": (f"n{rp['prefix_requests']}"
+                  f"p{rp['prefix_prompt_len']}"),
+        "dtype": "f32",
+        "kernel_ms": rp["prefix_admission_ms"],
+        "oracle_ms": None,
+        "speedup": rp.get("prefix_prefill_savings")})
+
     # flash geometry sweep: find the best sequence-block cap per shape
     # (re-jit per cap — the env knob is read at trace time), then
     # record the per-head-dim winner in dispatch_prefs.json so the
