@@ -798,6 +798,150 @@ def _build_serving_prefill_step():
 
 
 @register_spec(
+    "serving.spec_decode_step",
+    anchor="apex_tpu/serving/steps.py",
+    description="speculative decode window (self-drafting, K=2): the "
+                "n-gram drafter, dense K+1-position verify forward and "
+                "branch-free accept/rollback all lower to pure device "
+                "compute with ZERO transfer/callback primitives — the "
+                "one-device_get-per-window contract survives "
+                "speculation — and exactly ONE shared sort feeds the "
+                "whole verify pass's sampling (all K+1 positions drawn "
+                "in one batched sample_tokens call, keys folded per "
+                "absolute position)")
+def _build_serving_spec_decode_step():
+    import jax
+    from apex_tpu import serving
+    cfg, params, spec, arena = _serving_fixture()
+    state = serving.init_state(arena, window=2, spec_k=2)
+    fn = serving.decode_window_fn(cfg, spec, window=2, spec_k=2)
+    return {
+        "fn": fn, "args": (params, state),
+        "jit_kwargs": {"donate_argnums": (1,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            # measured: 15 of the 19 donated carry leaves alias —
+            # two fewer than the K=0 window's 17 (leaves - 2), the
+            # speculative counters reset from fresh zeros each window
+            "donated_aliases": 15,
+            "counter": {"sort": 1},
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "serving.decode_step_w8",
+    anchor="apex_tpu/serving/model.py",
+    description="AOT decode window over INT8 serving weights: the six "
+                "decoder matmul planes (wq/wk/wv/wo/w1/w2) dequantize "
+                "exactly once per use site — 6 x n_layers from_int8 "
+                "converts, ZERO to_int8 (weights quantize at engine "
+                "build, never in the step) — with zero host traffic "
+                "and the same donated-carry alias set as the float-"
+                "weight window (params are never donated)")
+def _build_serving_decode_step_w8():
+    import jax
+    from apex_tpu import serving
+    cfg, params, spec, arena = _serving_fixture()
+    wp = serving.quantize_serving_params(params, "int8")
+    state = serving.init_state(arena, window=2)
+    fn = serving.decode_window_fn(cfg, spec, window=2)
+    return {
+        "fn": fn, "args": (wp, state),
+        "jit_kwargs": {"donate_argnums": (1,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            # same 17 (leaves - 2) as serving.decode_step: weight
+            # quantization changes the params operand, not the carry
+            "donated_aliases": 17,
+            # 6 matmul weight planes x 2 layers, counted once in the
+            # fori body; no quantize converts anywhere in the step
+            "int8_convert_counts": {"to_int8": 0, "from_int8": 12},
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "serving.spec_decode_step_quantized",
+    anchor="apex_tpu/serving/steps.py",
+    description="speculative decode window at int8 KV x int8 weights "
+                "(the full memory-frontier stack): cast economy pinned "
+                "on BOTH sides — per layer, the verify insert round-"
+                "trips its fresh K/V through arena storage semantics "
+                "(2 to_int8 + 2 from_int8 each of 2 layers) on top of "
+                "the window's one dequantize-gather (2) and one "
+                "quantize-scatter (2), plus 6 weight dequants per "
+                "layer — and still zero host traffic")
+def _build_serving_spec_decode_step_quantized():
+    import jax
+    from apex_tpu import serving
+    cfg, params, spec, arena = _serving_fixture(kv_dtype="int8")
+    wp = serving.quantize_serving_params(params, "int8")
+    state = serving.init_state(arena, window=2, spec_k=2)
+    fn = serving.decode_window_fn(cfg, spec, window=2, spec_k=2)
+    return {
+        "fn": fn, "args": (wp, state),
+        "jit_kwargs": {"donate_argnums": (1,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            # same 15 as the float spec window (the scale planes
+            # alias — the scatter genuinely updates them)
+            "donated_aliases": 15,
+            # to_int8: 2 scatter + 2/layer x 2 verify round-trip = 6;
+            # from_int8: 2 gather + 2/layer x 2 round-trip
+            #            + 6/layer x 2 weights = 18
+            "int8_convert_counts": {"to_int8": 6, "from_int8": 18},
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
+    "serving.prefill_batched",
+    anchor="apex_tpu/serving/steps.py",
+    description="batched multi-request prefill: B queued prompts "
+                "drain through ONE padded-bucket program call — one "
+                "flash-attention pallas_call per decoder layer for the "
+                "whole group, K/V pages scattered into the DONATED "
+                "arena (all four arena buffers aliased), per-request "
+                "first tokens sampled device-side, zero host traffic")
+def _build_serving_prefill_batched():
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import serving
+    from apex_tpu.ops._dispatch import op_enabled
+    cfg, params, spec, arena = _serving_fixture()
+    nb, bucket = 2, 8
+    fn = serving.prefill_batch_fn(cfg, spec, bucket, nb)
+    args = (params, arena.k, arena.v, arena.k_scale, arena.v_scale,
+            jnp.zeros((nb, bucket // spec.page_size), jnp.int32),
+            jnp.zeros((nb, bucket), jnp.int32),
+            jnp.full((nb,), 5, jnp.int32),
+            jnp.zeros((nb, 2), jnp.uint32),
+            jnp.zeros((nb,), jnp.float32),
+            jnp.zeros((nb,), jnp.int32),
+            jnp.ones((nb,), jnp.float32))
+    expect = {
+        "no_host_transfer": True,
+        "no_f64": True,
+        # the K and V arenas plus both scale planes, exactly as the
+        # serial serving.prefill_step
+        "donated_aliases": 4,
+        "no_orphan_collectives": True,
+    }
+    if op_enabled("attention_f32"):   # dispatch-gate aware, like optim
+        expect["pallas_calls"] = cfg.n_layers
+    return {"fn": fn, "args": args,
+            "jit_kwargs": {"donate_argnums": (1, 2, 3, 4)},
+            "expect": expect}
+
+
+@register_spec(
     "ddp.all_reduce_flat_buffers",
     anchor="apex_tpu/parallel/distributed.py",
     description="bucket-granular DDP all-reduce under shard_map: "

@@ -118,7 +118,10 @@ def int8_matmul(x: jax.Array, w: QTensor, *,
     """
     if dynamic is None:
         from apex_tpu.ops._dispatch import quantization_pref
-        dynamic = bool(quantization_pref("int8_dynamic", False))
+        # host-side dispatch-table read at TRACE time, never a traced
+        # value (serving reaches here jit-side with an explicit bool)
+        dynamic = bool(quantization_pref(   # apexlint: disable=APX101
+            "int8_dynamic", False))
     if not dynamic:
         return jax.lax.dot_general(
             x, dequantize(w, x.dtype), (((x.ndim - 1,), (0,)), ((), ())),

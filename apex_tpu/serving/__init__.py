@@ -19,11 +19,15 @@ from apex_tpu.serving.arena import (ArenaSpec, KVArena,  # noqa: F401
 from apex_tpu.serving.engine import (DecodeDeadlineExceeded,  # noqa: F401
                                      Engine, Request, RequestResult)
 from apex_tpu.serving.model import (DecoderConfig,  # noqa: F401
+                                    cached_serving_params,
                                     decode_forward, extend_forward,
-                                    init_params, prefill_forward)
+                                    init_params, prefill_forward,
+                                    quantize_serving_params,
+                                    verify_forward)
 from apex_tpu.serving.replica import ReplicaSet  # noqa: F401
 from apex_tpu.serving.steps import (DecodeState,  # noqa: F401
                                     ServingPrograms, cached_programs,
-                                    decode_one, decode_window_fn,
-                                    extend_fn, init_state, prefill_fn,
-                                    sample_tokens)
+                                    decode_one, decode_spec_one,
+                                    decode_window_fn, extend_fn,
+                                    init_state, prefill_batch_fn,
+                                    prefill_fn, sample_tokens)

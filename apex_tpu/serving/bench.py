@@ -19,8 +19,20 @@ proves the harness, not performance).
 from __future__ import annotations
 
 
+# memoized per geometry: repeated benches at one shape hand engines
+# the SAME params object, so every build past the first hits the
+# compiled-program caches in serving.steps (params are keyed by
+# identity there).  Everything returned is immutable — config, jax
+# arrays — and no bench donates the shared state buffers.
+_TINY_SETUP_MEMO: dict = {}
+
+
 def _tiny_setup(jax, jnp, n_layers, hidden, n_heads, max_slots,
                 page_size, pages_per_slot, window):
+    key = (n_layers, hidden, n_heads, max_slots, page_size,
+           pages_per_slot, window)
+    if key in _TINY_SETUP_MEMO:
+        return _TINY_SETUP_MEMO[key]
     from apex_tpu import serving
     cfg = serving.DecoderConfig(
         vocab_size=128, hidden=hidden, n_layers=n_layers,
@@ -44,7 +56,8 @@ def _tiny_setup(jax, jnp, n_layers, hidden, n_heads, max_slots,
         active=jnp.ones((max_slots,), jnp.int32),
         last_token=jnp.full((max_slots,), 7, jnp.int32),
         budget=jnp.full((max_slots,), 10_000, jnp.int32))
-    return cfg, params, spec, state
+    _TINY_SETUP_MEMO[key] = (cfg, params, spec, state)
+    return _TINY_SETUP_MEMO[key]
 
 
 def bench_decode_step(n_layers: int = 2, hidden: int = 64,
@@ -217,6 +230,119 @@ def bench_prefix_admission(n_requests: int = 8, n_layers: int = 2,
     }
     eng.close()
     return out
+
+
+def bench_spec_decode(n_requests: int = 4, n_layers: int = 2,
+                      hidden: int = 64, n_heads: int = 4,
+                      page_size: int = 4, pages_per_slot: int = 8,
+                      window: int = 4, spec_k: int = 4,
+                      max_new_tokens: int = 12):
+    """Self-drafting speculative decode on the REPETITIVE-SUFFIX
+    fixture: every prompt ends in a short repeating n-gram, so the
+    suffix-period drafter's proposals agree with the verified tokens
+    and the accept rate is high by construction — the
+    ``spec_verify_step`` kernel_bench row and the
+    ``extra.spec_accept_rate`` budget row (accepted drafts / drafted,
+    from the engine's ``serving/spec_accepted`` / ``spec_drafted``
+    counters; structural, wall-clock noise cannot fake it)."""
+    import time
+
+    import jax
+
+    from apex_tpu import serving
+
+    cfg, params, spec, _ = _tiny_setup(
+        jax, jax.numpy, n_layers, hidden, n_heads, n_requests,
+        page_size, pages_per_slot, window)
+
+    def run(k):
+        eng = serving.Engine(
+            params, cfg, page_size=page_size, n_pages=spec.n_pages,
+            max_slots=n_requests, pages_per_slot=pages_per_slot,
+            window=window, prefill_buckets=[8], spec_k=k,
+            max_queue=max(n_requests, 8))
+        max_new = max(1, min(max_new_tokens, spec.slot_tokens - 8))
+        for i in range(n_requests):
+            # period-2 suffix: the gram-2 drafter locks onto it
+            eng.submit(serving.Request(
+                id=f"spec-{i}", prompt=[2 + i, 5, 6, 5, 6, 5, 6, 5],
+                max_new_tokens=max_new))
+        t0 = time.time()
+        results = eng.serve()
+        wall_ms = (time.time() - t0) * 1e3
+        toks = {r.id: tuple(r.tokens) for r in results.values()}
+        drafted, accepted = eng._spec_drafted, eng._spec_accepted
+        eng.close()
+        return wall_ms, toks, drafted, accepted
+
+    spec_ms, spec_toks, drafted, accepted = run(spec_k)
+    plain_ms, plain_toks, _, _ = run(0)
+    out = {
+        "spec_verify_step_ms": round(spec_ms, 3),
+        "spec_plain_window_ms": round(plain_ms, 3),
+        "spec_k": spec_k,
+        "spec_drafted": drafted,
+        "spec_accepted": accepted,
+        "spec_accept_rate": round(accepted / max(drafted, 1), 4),
+        # the free oracle: greedy spec decode must emit the plain
+        # greedy stream bit-exactly
+        "spec_bit_exact": int(spec_toks == plain_toks),
+    }
+    return out
+
+
+def bench_batched_prefill(n_requests: int = 4, n_layers: int = 2,
+                          hidden: int = 64, n_heads: int = 4,
+                          page_size: int = 4, pages_per_slot: int = 8,
+                          window: int = 4, prefill_batch: int = 4,
+                          max_new_tokens: int = 4):
+    """B same-bucket requests admitted through ONE padded batched
+    prefill call vs B serial calls — the ``extra.
+    batched_prefill_speedup`` budget row (requests prefilled /
+    prefill PROGRAM invocations; counted from engine counters so it
+    grades with a zero noise band on CPU) and the batched half of the
+    kernel_bench serving rows."""
+    import time
+
+    import jax
+
+    from apex_tpu import serving
+
+    cfg, params, spec, _ = _tiny_setup(
+        jax, jax.numpy, n_layers, hidden, n_heads, n_requests,
+        page_size, pages_per_slot, window)
+
+    def run(b):
+        eng = serving.Engine(
+            params, cfg, page_size=page_size, n_pages=spec.n_pages,
+            max_slots=n_requests, pages_per_slot=pages_per_slot,
+            window=window, prefill_buckets=[4], prefill_batch=b,
+            max_queue=max(n_requests, 8))
+        max_new = max(1, min(max_new_tokens, spec.slot_tokens - 4))
+        for i in range(n_requests):
+            eng.submit(serving.Request(
+                id=f"bp-{i}", prompt=[2 + (i % 5), 3, 4],
+                max_new_tokens=max_new))
+        t0 = time.time()
+        results = eng.serve()
+        wall_ms = (time.time() - t0) * 1e3
+        toks = {r.id: tuple(r.tokens) for r in results.values()}
+        counts = (eng._n_prefills, eng._n_prefill_calls)
+        eng.close()
+        return wall_ms, toks, counts
+
+    b_ms, b_toks, (b_reqs, b_calls) = run(prefill_batch)
+    s_ms, s_toks, (s_reqs, s_calls) = run(1)
+    return {
+        "batched_prefill_ms": round(b_ms, 3),
+        "serial_prefill_ms": round(s_ms, 3),
+        "batched_prefill_b": prefill_batch,
+        "batched_prefill_requests": b_reqs,
+        "batched_prefill_calls": b_calls,
+        "serial_prefill_calls": s_calls,
+        "batched_prefill_speedup": round(b_reqs / max(b_calls, 1), 3),
+        "batched_prefill_bit_exact": int(b_toks == s_toks),
+    }
 
 
 def bench_serving(n_requests: int = 8, n_layers: int = 2,

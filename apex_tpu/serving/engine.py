@@ -167,13 +167,20 @@ class Engine:
     """AOT-compiled continuously-batched decode engine (module
     docstring).
 
-    ``page_size`` / ``window`` / ``kv_dtype`` / ``prefix_share``
-    default to the autotuner's measured serving preferences for this
-    topology (``ops._dispatch.serving_pref``), falling back to the
-    design defaults (f32 arena, no sharing) when no table steers.
-    ``kv_dtype="int8"`` stores the arena quantized (half the HBM per
-    token); ``prefix_share=True`` compiles the extend/COW programs and
-    admits prompts with a known prefix by aliasing its pages."""
+    ``page_size`` / ``window`` / ``kv_dtype`` / ``prefix_share`` /
+    ``spec_k`` / ``weight_dtype`` / ``prefill_batch`` default to the
+    autotuner's measured serving preferences for this topology
+    (``ops._dispatch.serving_pref``), falling back to the design
+    defaults (f32 arena, no sharing, no speculation, f32 weights,
+    serial prefill) when no table steers.  ``kv_dtype="int8"`` stores
+    the arena quantized (half the HBM per token); ``prefix_share=True``
+    compiles the extend/COW programs and admits prompts with a known
+    prefix by aliasing its pages; ``spec_k > 0`` turns on in-window
+    self-drafting speculative decoding (greedy output stays bit-exact
+    for any K); ``weight_dtype="int8"`` serves the decoder matmul
+    weights quantized per-channel (half the weight HBM per verify
+    pass); ``prefill_batch > 1`` drains up to B queued same-bucket
+    requests into one batched prefill program call."""
 
     def __init__(self, params, cfg: DecoderConfig,
                  page_size: Optional[int] = None,
@@ -183,6 +190,9 @@ class Engine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  kv_dtype=None,
                  prefix_share: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 weight_dtype: Optional[str] = None,
+                 prefill_batch: Optional[int] = None,
                  max_queue: int = 64,
                  queue_high: Optional[int] = None,
                  queue_low: Optional[int] = None,
@@ -202,6 +212,14 @@ class Engine:
         if prefix_share is None:
             prefix_share = bool(_dispatch.serving_pref("prefix_share",
                                                        False))
+        if spec_k is None:
+            spec_k = int(_dispatch.serving_pref("spec_k", 0))
+        if weight_dtype is None:
+            weight_dtype = str(_dispatch.serving_pref("weight_dtype",
+                                                      "f32"))
+        if prefill_batch is None:
+            prefill_batch = int(_dispatch.serving_pref("prefill_batch",
+                                                       1))
         if pages_per_slot is None:
             pages_per_slot = max(1, min(n_pages // max(max_slots, 1),
                                         cfg.max_seq // page_size))
@@ -214,22 +232,33 @@ class Engine:
             raise ValueError(
                 f"slot capacity ({spec.slot_tokens} tokens) exceeds "
                 f"the model's position table (max_seq={cfg.max_seq})")
-        self.params = params
         self.cfg = cfg
         self.prefix_share = bool(prefix_share)
+        self.spec_k = max(0, int(spec_k))
+        self.weight_dtype = str(weight_dtype)
+        self.prefill_batch = max(1, min(int(prefill_batch),
+                                        int(max_slots)))
+        # serving weights: the decoder matmul weights wrap as QTensors
+        # at build — int8 per-channel quantized, or float stubs keeping
+        # ONE params structure (and so one program signature) across
+        # weight_dtype modes.  Memoized on the caller's params identity
+        # so rebuilt engines keep hitting the program cache below.
+        from apex_tpu.serving.model import cached_serving_params
+        self.params = cached_serving_params(params, self.weight_dtype)
         self.arena = KVArena(spec, dtype=kv_dtype)
         # AOT: every program this engine will ever run compiles HERE
         # (memoized — a rebuilt engine over the same params object and
         # geometry reuses the compiled set)
         from apex_tpu.serving.steps import cached_programs
         self.programs = cached_programs(
-            params, cfg, self.arena, window=int(window),
+            self.params, cfg, self.arena, window=int(window),
             prefill_buckets=prefill_buckets,
-            prefix_share=self.prefix_share)
+            prefix_share=self.prefix_share, spec_k=self.spec_k,
+            prefill_batch=self.prefill_batch)
         self.window = self.programs.window
         self._trie = (adm.PrefixTrie(spec.page_size)
                       if self.prefix_share else None)
-        self.state = init_state(self.arena, self.window)
+        self.state = init_state(self.arena, self.window, self.spec_k)
         self.admission = adm.AdmissionController(
             max_queue=max_queue, queue_high=queue_high,
             queue_low=queue_low)
@@ -266,11 +295,14 @@ class Engine:
         self._tokens_total = 0
         # structural counters (tests assert prefill-call counts; the
         # prefix gauges ride /metrics cumulatively every window)
-        self._n_prefills = 0
+        self._n_prefills = 0        # requests prefilled
+        self._n_prefill_calls = 0   # prefill PROGRAM invocations
         self._n_extends = 0
         self._prefix_hits = 0
         self._cow_copies = 0
         self._kv_bytes_saved = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self._attached = False
         if telemetry is not None:
             telemetry.add_observer(self._on_flush)
@@ -533,124 +565,272 @@ class Engine:
                     req.total_tokens, n_shared=len(shared_all),
                     extra=1 if tail is not None else 0):
                 break
-            self.queue.popleft()
-            plen = len(req.prompt)
-            if shared_all:
-                slot, own = self.arena.acquire_shared(
-                    req.total_tokens, shared_all)
-                slot_pages = shared_all + own
+            if self.prefill_batch > 1 and not shared_all:
+                ok = self._admit_batch(w)
             else:
-                slot, slot_pages = self.arena.acquire(req.total_tokens)
-            # per-request device sampling operands (steps.sample_tokens)
+                ok = self._admit_one(w, req, shared, tail, shared_all)
+            if not ok:
+                break
+        _hostmetrics.emit("serving/queue_depth", len(self.queue))
+        self.admission.note_depth(len(self.queue))
+
+    def _place_request(self, req: Request, slot: int,
+                       slot_pages: List[int], first: int, samp,
+                       w: int) -> None:
+        """Per-request slot-state placement after a successful
+        prefill/extend dispatch — shared by serial and batched
+        admission so the carry writes cannot drift between them.
+        ``self.state`` must already hold the dispatch's returned
+        arenas."""
+        plen = len(req.prompt)
+        st = self.state
+        done_now = (first == self.cfg.eos_token
+                    or req.max_new_tokens <= 1)
+        # history ring seed: token at position t in column t — the
+        # prompt, then the first sampled token at position plen (what
+        # the in-window drafter reads)
+        hist = np.zeros((self.arena.spec.slot_tokens + 2,), np.int32)
+        hist[:plen] = np.asarray(list(req.prompt), np.int32)
+        hist[plen] = first
+        a = _Active(req=req, slot=slot, tokens=[first],
+                    admitted_t=time.time(), admitted_window=w,
+                    readmitted_from=getattr(
+                        req, "_readmitted_from", None))
+        self.state = st._replace(
+            page_table=st.page_table.at[slot].set(
+                self.arena.slot_row(slot)),
+            seq_lens=st.seq_lens.at[slot].set(plen),
+            active=st.active.at[slot].set(0 if done_now else 1),
+            last_token=st.last_token.at[slot].set(first),
+            budget=st.budget.at[slot].set(
+                max(req.max_new_tokens - 1, 0)),
+            rng=st.rng.at[slot].set(samp[0]),
+            temperature=st.temperature.at[slot].set(samp[1]),
+            top_k=st.top_k.at[slot].set(samp[2]),
+            top_p=st.top_p.at[slot].set(samp[3]),
+            done=st.done.at[slot].set(0),
+            history=st.history.at[slot].set(jnp.asarray(hist)))
+        if self._trie is not None:
+            # index this prompt's pages for later sharers (the
+            # COW-detached tail included — it holds the same
+            # prompt tokens, recomputed)
+            self._trie.register(req.prompt, slot_pages)
+        self._active[slot] = a
+        self._admitted_this_window.append(slot)
+        _hostmetrics.emit("serving/admitted", 1)
+        self._tokens_total += 1
+        if done_now:
+            self._complete(slot)
+
+    def _admit_one(self, w: int, req: Request, shared: List[int],
+                   tail: Optional[int], shared_all: List[int]) -> bool:
+        """Admit the queue head through the serial prefill (or
+        prefix-extend) program.  Returns False when admission must
+        stop for this window (a wedged prefill)."""
+        self.queue.popleft()
+        plen = len(req.prompt)
+        if shared_all:
+            slot, own = self.arena.acquire_shared(
+                req.total_tokens, shared_all)
+            slot_pages = shared_all + own
+        else:
+            slot, slot_pages = self.arena.acquire(req.total_tokens)
+        # per-request device sampling operands (steps.sample_tokens)
+        samp = (jax.random.PRNGKey(int(req.seed)),
+                jnp.float32(req.temperature),
+                jnp.int32(req.top_k), jnp.float32(req.top_p))
+        t0 = time.time()
+        # bind the dispatch operands NOW, not inside the lambda: an
+        # abandoned worker evaluates the thunk AFTER a timeout may
+        # have rebuilt self.state/self.arena (_recover_lost_arena),
+        # and a late `self.state` read there would hand the stale
+        # dispatch the FRESH donated arena — the exact corruption
+        # the dispatched flag exists to prevent
+        params, st = self.params, self.state
+        try:
+            if shared_all:
+                k, v, ks, vs, first = self._admit_shared(
+                    req, slot, slot_pages, shared, tail, samp,
+                    w, params, st)
+            else:
+                bucket = self.programs.bucket_for(plen)
+                assert bucket is not None   # gated at submit
+                tokens = np.zeros((bucket,), np.int32)
+                tokens[:plen] = np.asarray(list(req.prompt),
+                                           np.int32)
+                prefill = self.programs.prefill[bucket]
+                page_row = self.arena.page_row(bucket, slot_pages)
+                with _telemetry.span("serving/prefill"):
+                    k, v, ks, vs, first = self._deadline_run(
+                        lambda: prefill(
+                            params, st.k, st.v, st.k_scale,
+                            st.v_scale, page_row,
+                            jnp.asarray(tokens), jnp.int32(plen),
+                            *samp),
+                        w, phase="prefill")
+                self._n_prefills += 1
+                self._n_prefill_calls += 1
+        except DecodeDeadlineExceeded as e:
+            # a wedged PREFILL names its own suspect: the request
+            # being admitted — evict it, leave everyone else alone
+            self.incidents.open("hung_decode")
+            if not (self._incident_cause == "replica_death"
+                    and self._readmitted_pending):
+                # same cause-preservation rule as
+                # _handle_hung_decode: an unresolved failover
+                # chain keeps its closure semantics
+                self._incident_cause = "hung_decode"
+            e.suspects = [req.id]
+            self._event("hung_decode", deadline_s=e.deadline_s,
+                        phase="prefill", suspects=e.suspects,
+                        dispatched=e.dispatched)
+            _hostmetrics.emit("serving/hung_decode", 1)
+            self._record_evicted(
+                req.id, adm.REASON_HUNG_DECODE, [],
+                getattr(req, "_readmitted_from", None))
+            if e.dispatched:
+                # the arenas were consumed by the abandoned
+                # prefill: rebuild and re-place the in-flight batch
+                self._recover_lost_arena([])
+            else:
+                self._release_pages(slot)
+            if not self._active and not self.queue:
+                self._resolve_incident()
+            return False
+        except Exception:
+            # a non-deadline prefill failure: the request was
+            # already popped and its slot acquired — type it and
+            # free the slot before the error surfaces, so nothing
+            # vanishes without a verdict and nothing leaks
+            # (the decode path's handler, mirrored)
+            self._release_pages(slot)
+            self.results[req.id] = RequestResult(
+                req.id, adm.FAILED, reason="prefill_error",
+                readmitted_from=getattr(req, "_readmitted_from",
+                                        None))
+            self._note_terminal(req.id)
+            raise
+        _hostmetrics.emit("serving/prefill_ms",
+                          (time.time() - t0) * 1e3)
+        first = int(first)    # one sync per ADMISSION (documented)
+        self.state = self.state._replace(k=k, v=v, k_scale=ks,
+                                         v_scale=vs)
+        self._place_request(req, slot, slot_pages, first, samp, w)
+        return True
+
+    def _admit_batch(self, w: int) -> bool:
+        """Admit up to ``prefill_batch`` queue-head requests through
+        ONE padded-bucket batched prefill call.  The group is strictly
+        FIFO and homogeneous: collection stops at the first head that
+        targets a different bucket, hits the prefix trie (the extend
+        path is serial), or no longer fits — those re-enter through
+        the outer admission loop.  Unused program rows pad with length
+        0 and all-trash page rows.  Returns False when admission must
+        stop for this window (a wedged prefill)."""
+        nb = self.prefill_batch
+        spec = self.arena.spec
+        bucket = self.programs.bucket_for(len(self.queue[0].prompt))
+        assert bucket is not None   # gated at submit
+        group: List[tuple] = []     # (req, slot, slot_pages)
+        while self.queue and len(group) < nb:
+            req = self.queue[0]
+            if group:
+                if self._trie is not None:
+                    sh, tl = self._trie.match(req.prompt)
+                    if sh or tl is not None:
+                        break
+                if self.programs.bucket_for(len(req.prompt)) != bucket:
+                    break
+                if not self.arena.fits_now(req.total_tokens):
+                    break
+            self.queue.popleft()
+            slot, pages = self.arena.acquire(req.total_tokens)
+            group.append((req, slot, pages))
+        n = len(group)
+        tokens = np.zeros((nb, bucket), np.int32)
+        lengths = np.zeros((nb,), np.int32)
+        page_rows = np.full((nb, bucket // spec.page_size),
+                            spec.trash_page, np.int32)
+        rngs = np.zeros((nb, 2), np.uint32)
+        temps = np.zeros((nb,), np.float32)
+        top_ks = np.zeros((nb,), np.int32)
+        top_ps = np.ones((nb,), np.float32)
+        samps = []
+        for i, (req, slot, pages) in enumerate(group):
+            plen = len(req.prompt)
+            tokens[i, :plen] = np.asarray(list(req.prompt), np.int32)
+            lengths[i] = plen
+            npg = min(len(pages), bucket // spec.page_size)
+            page_rows[i, :npg] = pages[:npg]
             samp = (jax.random.PRNGKey(int(req.seed)),
                     jnp.float32(req.temperature),
                     jnp.int32(req.top_k), jnp.float32(req.top_p))
-            t0 = time.time()
-            # bind the dispatch operands NOW, not inside the lambda: an
-            # abandoned worker evaluates the thunk AFTER a timeout may
-            # have rebuilt self.state/self.arena (_recover_lost_arena),
-            # and a late `self.state` read there would hand the stale
-            # dispatch the FRESH donated arena — the exact corruption
-            # the dispatched flag exists to prevent
-            params, st = self.params, self.state
-            try:
-                if shared_all:
-                    k, v, ks, vs, first = self._admit_shared(
-                        req, slot, slot_pages, shared, tail, samp,
-                        w, params, st)
-                else:
-                    bucket = self.programs.bucket_for(plen)
-                    assert bucket is not None   # gated at submit
-                    tokens = np.zeros((bucket,), np.int32)
-                    tokens[:plen] = np.asarray(list(req.prompt),
-                                               np.int32)
-                    prefill = self.programs.prefill[bucket]
-                    page_row = self.arena.page_row(bucket, slot_pages)
-                    with _telemetry.span("serving/prefill"):
-                        k, v, ks, vs, first = self._deadline_run(
-                            lambda: prefill(
-                                params, st.k, st.v, st.k_scale,
-                                st.v_scale, page_row,
-                                jnp.asarray(tokens), jnp.int32(plen),
-                                *samp),
-                            w, phase="prefill")
-                    self._n_prefills += 1
-            except DecodeDeadlineExceeded as e:
-                # a wedged PREFILL names its own suspect: the request
-                # being admitted — evict it, leave everyone else alone
-                self.incidents.open("hung_decode")
-                if not (self._incident_cause == "replica_death"
-                        and self._readmitted_pending):
-                    # same cause-preservation rule as
-                    # _handle_hung_decode: an unresolved failover
-                    # chain keeps its closure semantics
-                    self._incident_cause = "hung_decode"
-                e.suspects = [req.id]
-                self._event("hung_decode", deadline_s=e.deadline_s,
-                            phase="prefill", suspects=e.suspects,
-                            dispatched=e.dispatched)
-                _hostmetrics.emit("serving/hung_decode", 1)
+            samps.append(samp)
+            rngs[i] = np.asarray(samp[0])
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+        prog = self.programs.prefill_batched[bucket]
+        t0 = time.time()
+        params, st = self.params, self.state   # bind NOW (_admit_one)
+        try:
+            with _telemetry.span("serving/prefill"):
+                k, v, ks, vs, firsts = self._deadline_run(
+                    lambda: prog(
+                        params, st.k, st.v, st.k_scale, st.v_scale,
+                        jnp.asarray(page_rows), jnp.asarray(tokens),
+                        jnp.asarray(lengths), jnp.asarray(rngs),
+                        jnp.asarray(temps), jnp.asarray(top_ks),
+                        jnp.asarray(top_ps)),
+                    w, phase="prefill")
+        except DecodeDeadlineExceeded as e:
+            # a wedged batched PREFILL suspects the whole group
+            self.incidents.open("hung_decode")
+            if not (self._incident_cause == "replica_death"
+                    and self._readmitted_pending):
+                self._incident_cause = "hung_decode"
+            e.suspects = [req.id for req, _, _ in group]
+            self._event("hung_decode", deadline_s=e.deadline_s,
+                        phase="prefill", suspects=e.suspects,
+                        dispatched=e.dispatched)
+            _hostmetrics.emit("serving/hung_decode", 1)
+            for req, _, _ in group:
                 self._record_evicted(
                     req.id, adm.REASON_HUNG_DECODE, [],
                     getattr(req, "_readmitted_from", None))
-                if e.dispatched:
-                    # the arenas were consumed by the abandoned
-                    # prefill: rebuild and re-place the in-flight batch
-                    self._recover_lost_arena([])
-                else:
+            if e.dispatched:
+                self._recover_lost_arena([])
+            else:
+                for _, slot, _ in group:
                     self._release_pages(slot)
-                if not self._active and not self.queue:
-                    self._resolve_incident()
-                break
-            except Exception:
-                # a non-deadline prefill failure: the request was
-                # already popped and its slot acquired — type it and
-                # free the slot before the error surfaces, so nothing
-                # vanishes without a verdict and nothing leaks
-                # (the decode path's handler, mirrored)
+            if not self._active and not self.queue:
+                self._resolve_incident()
+            return False
+        except Exception:
+            # a non-deadline prefill failure: every group member was
+            # popped with its slot acquired — type them all and free
+            # the slots before the error surfaces (_admit_one mirrored)
+            for req, slot, _ in group:
                 self._release_pages(slot)
                 self.results[req.id] = RequestResult(
                     req.id, adm.FAILED, reason="prefill_error",
                     readmitted_from=getattr(req, "_readmitted_from",
                                             None))
                 self._note_terminal(req.id)
-                raise
-            _hostmetrics.emit("serving/prefill_ms",
-                              (time.time() - t0) * 1e3)
-            first = int(first)    # one sync per ADMISSION (documented)
-            st = self.state._replace(k=k, v=v, k_scale=ks, v_scale=vs)
-            done_now = (first == self.cfg.eos_token
-                        or req.max_new_tokens <= 1)
-            a = _Active(req=req, slot=slot, tokens=[first],
-                        admitted_t=time.time(), admitted_window=w,
-                        readmitted_from=getattr(
-                            req, "_readmitted_from", None))
-            self.state = st._replace(
-                page_table=st.page_table.at[slot].set(
-                    self.arena.slot_row(slot)),
-                seq_lens=st.seq_lens.at[slot].set(plen),
-                active=st.active.at[slot].set(0 if done_now else 1),
-                last_token=st.last_token.at[slot].set(first),
-                budget=st.budget.at[slot].set(
-                    max(req.max_new_tokens - 1, 0)),
-                rng=st.rng.at[slot].set(samp[0]),
-                temperature=st.temperature.at[slot].set(samp[1]),
-                top_k=st.top_k.at[slot].set(samp[2]),
-                top_p=st.top_p.at[slot].set(samp[3]),
-                done=st.done.at[slot].set(0))
-            if self._trie is not None:
-                # index this prompt's pages for later sharers (the
-                # COW-detached tail included — it holds the same
-                # prompt tokens, recomputed)
-                self._trie.register(req.prompt, slot_pages)
-            self._active[slot] = a
-            self._admitted_this_window.append(slot)
-            _hostmetrics.emit("serving/admitted", 1)
-            self._tokens_total += 1
-            if done_now:
-                self._complete(slot)
-        _hostmetrics.emit("serving/queue_depth", len(self.queue))
-        self.admission.note_depth(len(self.queue))
+            raise
+        self._n_prefills += n
+        self._n_prefill_calls += 1
+        _hostmetrics.emit("serving/prefill_ms",
+                          (time.time() - t0) * 1e3)
+        # one sync per admission GROUP (the serial path's one-per-
+        # admission, amortized over the batch)
+        firsts = jax.device_get(firsts)  # apexlint: disable=APX101
+        self.state = self.state._replace(k=k, v=v, k_scale=ks,
+                                         v_scale=vs)
+        for i, (req, slot, pages) in enumerate(group):
+            self._place_request(req, slot, pages, int(firsts[i]),
+                                samps[i], w)
+        return True
 
     def _admit_shared(self, req: Request, slot: int,
                       slot_pages: List[int], shared: List[int],
@@ -745,9 +925,14 @@ class Engine:
         if self._incident_cause == "hung_decode":
             self._resolve_incident()
         # THE window read-back: one device_get of the slot state
-        out_tokens, n_out, done = jax.device_get(
+        out_tokens, n_out, done, n_dr, n_ac = jax.device_get(
             (self.state.out_tokens, self.state.n_out,
-             self.state.done))   # apexlint: disable=APX101
+             self.state.done, self.state.n_drafted,
+             self.state.n_accepted))   # apexlint: disable=APX101
+        # per-window speculation tallies (reset inside the window
+        # program; zeros when spec_k == 0)
+        self._spec_drafted += int(n_dr.sum())
+        self._spec_accepted += int(n_ac.sum())
         emitted = 0
         for slot in sorted(self._active):
             a = self._active[slot]
@@ -859,7 +1044,7 @@ class Engine:
         survivors = [self._active[s] for s in sorted(self._active)]
         self._active = {}
         self.arena = KVArena(self.arena.spec, dtype=self.arena.dtype)
-        self.state = init_state(self.arena, self.window)
+        self.state = init_state(self.arena, self.window, self.spec_k)
         if self._trie is not None:
             # every page id was just reassigned: the whole index is
             # stale — reset; fresh admissions re-register
@@ -896,6 +1081,13 @@ class Engine:
             jnp.int32(len(prefix)), key, jnp.float32(req.temperature),
             jnp.int32(req.top_k), jnp.float32(req.top_p))
         self._n_prefills += 1
+        self._n_prefill_calls += 1
+        # drafter history re-seed: the replayed prefix IS the token-
+        # at-position record, with the pending last token at its
+        # position (len(prefix))
+        hist = np.zeros((self.arena.spec.slot_tokens + 2,), np.int32)
+        hist[:len(prefix)] = np.asarray(prefix, np.int32)
+        hist[len(prefix)] = int(a.tokens[-1])
         st = self.state._replace(k=k, v=v, k_scale=ks, v_scale=vs)
         self.state = st._replace(
             page_table=st.page_table.at[slot].set(
@@ -911,7 +1103,8 @@ class Engine:
                 jnp.float32(req.temperature)),
             top_k=st.top_k.at[slot].set(jnp.int32(req.top_k)),
             top_p=st.top_p.at[slot].set(jnp.float32(req.top_p)),
-            done=st.done.at[slot].set(0))
+            done=st.done.at[slot].set(0),
+            history=st.history.at[slot].set(jnp.asarray(hist)))
         self._active[slot] = _Active(
             req=req, slot=slot, tokens=list(a.tokens),
             admitted_t=a.admitted_t, admitted_window=self._windows,
@@ -1029,3 +1222,7 @@ class Engine:
         _hostmetrics.emit("serving/prefix_hits", self._prefix_hits)
         _hostmetrics.emit("serving/kv_bytes_saved",
                           self._kv_bytes_saved)
+        # cumulative speculation gauges — the accept-rate budget row
+        # and the examples smoke test scrape these mid-run
+        _hostmetrics.emit("serving/spec_drafted", self._spec_drafted)
+        _hostmetrics.emit("serving/spec_accepted", self._spec_accepted)

@@ -22,6 +22,21 @@ slot's gathered pages: the query length is 1, so there is no score
 matrix to tile and the masked-dense form is the natural XLA program
 (the ``serving.decode_step`` spec pins it free of host traffic).
 
+Speculative decoding's VERIFY pass is the fourth shape, and it is the
+same math again: :func:`verify_forward` flattens ``(B, K+1)`` draft
+positions into ``B*(K+1)`` pseudo-slots and runs the identical
+single-query decode over them — batch-composition independence is
+exactly what makes the K+1-position verification bit-exact against
+K+1 sequential decode steps.
+
+Weights may be served quantized (``weight_dtype="int8"``): the decoder
+matmul weights become :class:`~apex_tpu.quantization.QTensor`\\ s with
+per-channel scales (``QuantDense``'s discipline), and every matmul
+routes through :func:`_mm`, which dequantizes into the dot operand
+(weight-only int8 — halved weight HBM per step).  Float modes wrap the
+same structure with stub ``(1, 1)`` scale planes so ONE program
+signature serves every ``weight_dtype`` (the KV scale-stub trick).
+
 Parameters are a plain pytree (no framework module): the engine AOT-
 lowers both steps at build time, and a plain dict of arrays keeps the
 lowering surface minimal.  The LM head is tied to the embedding.
@@ -35,6 +50,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.attention import flash_attention, packed_segment_ids
+from apex_tpu.quantization import (QTensor, dequantize_kv, int8_matmul,
+                                   quantize_int8, quantize_kv_int8)
 
 
 class DecoderConfig(NamedTuple):
@@ -104,9 +121,77 @@ def _ln(x, w, b):
     return (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
 
 
+def _mm(x, w):
+    """Matmul against a possibly-quantized weight.  Plain arrays take
+    the plain dot; int8 :class:`QTensor`\\ s take the weight-only int8
+    path (dequant fused into the dot operand); float-stub QTensors
+    (``scale`` is the ``(1, 1)`` placeholder) take the plain dot over
+    ``q`` — bitwise the un-wrapped program, so ``weight_dtype="f32"``
+    engines keep the quantized signature at zero numeric cost."""
+    if isinstance(w, QTensor):
+        if w.q.dtype == jnp.int8:
+            return int8_matmul(x, w, dynamic=False)
+        return x @ w.q
+    return x @ w
+
+
+# the decoder matmul weights quantize_serving_params wraps — per-layer
+# projections only; embeddings, positions, norms and biases stay float
+_QUANT_WEIGHTS = ("wq", "wk", "wv", "wo", "w1", "w2")
+
+
+def quantize_serving_params(params: dict, weight_dtype: str = "f32") -> dict:
+    """Wrap the decoder matmul weights for serving at ``weight_dtype``.
+
+    ``int8``: symmetric per-output-channel scales over the contraction
+    axis — :class:`~apex_tpu.quantization.QuantDense`'s exact
+    discipline (weights are already stored ``(In, Out)``, so this is
+    ``quantize_int8(w, axis=0)`` with no transpose).  ``f32``: the same
+    QTensor structure with the weight as ``q`` and a ``(1, 1)`` stub
+    scale plane, so both modes present ONE params pytree structure to
+    the AOT lowering (the KV-arena scale-stub trick)."""
+    if weight_dtype not in ("f32", "int8"):
+        raise ValueError(f"weight_dtype {weight_dtype!r}: "
+                         "expected 'f32' or 'int8'")
+
+    def wrap(w):
+        if weight_dtype == "int8":
+            return quantize_int8(w, axis=0)
+        return QTensor(q=w, scale=jnp.ones((1, 1), jnp.float32))
+
+    out = dict(params)
+    out["layers"] = [
+        {k: (wrap(v) if k in _QUANT_WEIGHTS else v)
+         for k, v in lp.items()}
+        for lp in params["layers"]]
+    return out
+
+
+# Memoized on params IDENTITY (the cached_programs discipline): the
+# wrapped pytree's own id keys the compiled-program cache, so repeated
+# engine builds over the same params object must get the same wrapped
+# object back.  The cached entry pins the source params ref so its id
+# stays valid for the cache's lifetime.
+_QPARAMS_CACHE: dict = {}
+_QPARAMS_CACHE_MAX = 8
+
+
+def cached_serving_params(params: dict, weight_dtype: str = "f32") -> dict:
+    """Memoized :func:`quantize_serving_params` (comment above)."""
+    key = (id(params), str(weight_dtype))
+    hit = _QPARAMS_CACHE.get(key)
+    if hit is not None:
+        return hit[1]
+    if len(_QPARAMS_CACHE) >= _QPARAMS_CACHE_MAX:
+        _QPARAMS_CACHE.clear()
+    wrapped = quantize_serving_params(params, weight_dtype)
+    _QPARAMS_CACHE[key] = (params, wrapped)
+    return wrapped
+
+
 def _mlp(lp, h):
-    return jax.nn.gelu(h @ lp["w1"] + lp["b1"],
-                       approximate=True) @ lp["w2"] + lp["b2"]
+    return _mm(jax.nn.gelu(_mm(h, lp["w1"]) + lp["b1"],
+                           approximate=True), lp["w2"]) + lp["b2"]
 
 
 # ---------------------------------------------------------------------
@@ -128,9 +213,9 @@ def prefill_forward(params, cfg: DecoderConfig, tokens, lengths):
     ks, vs = [], []
     for lp in params["layers"]:
         h = _ln(x, lp["ln1_w"], lp["ln1_b"])
-        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+        q = _mm(h, lp["wq"]).reshape(b, s, cfg.n_heads, hd)
+        k = _mm(h, lp["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+        v = _mm(h, lp["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
         ks.append(k)
         vs.append(v)
         attn = flash_attention(
@@ -139,7 +224,7 @@ def prefill_forward(params, cfg: DecoderConfig, tokens, lengths):
             jnp.transpose(v, (0, 2, 1, 3)),
             causal=True, segment_ids=packed_segment_ids(seg))
         attn = jnp.transpose(attn, (0, 2, 1, 3)).reshape(b, s, -1)
-        x = x + attn @ lp["wo"]
+        x = x + _mm(attn, lp["wo"])
         x = x + _mlp(lp, _ln(x, lp["ln2_w"], lp["ln2_b"]))
     x = _ln(x, params["lnf_w"], params["lnf_b"])
     logits = x @ params["embed"].T                          # (B, S, V)
@@ -191,9 +276,9 @@ def extend_forward(params, cfg: DecoderConfig, tokens, start, length,
     k_news, v_news = [], []
     for li, lp in enumerate(params["layers"]):
         h = _ln(x, lp["ln1_w"], lp["ln1_b"])
-        q = (h @ lp["wq"]).reshape(s, cfg.n_kv_heads, groups, hd)
-        k_new = (h @ lp["wk"]).reshape(s, cfg.n_kv_heads, hd)
-        v_new = (h @ lp["wv"]).reshape(s, cfg.n_kv_heads, hd)
+        q = _mm(h, lp["wq"]).reshape(s, cfg.n_kv_heads, groups, hd)
+        k_new = _mm(h, lp["wk"]).reshape(s, cfg.n_kv_heads, hd)
+        v_new = _mm(h, lp["wv"]).reshape(s, cfg.n_kv_heads, hd)
         k_news.append(k_new)
         v_news.append(v_new)
         keys = jnp.concatenate([k_ctx[li], k_new], axis=0)  # (C+S,KV,D)
@@ -203,7 +288,7 @@ def extend_forward(params, cfg: DecoderConfig, tokens, start, length,
                            jnp.float32(-1e30))
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         out = jnp.einsum("skgc,ckd->skgd", probs, vals)
-        x = x + out.reshape(s, -1) @ lp["wo"]
+        x = x + _mm(out.reshape(s, -1), lp["wo"])
         x = x + _mlp(lp, _ln(x, lp["ln2_w"], lp["ln2_b"]))
     x = _ln(x, params["lnf_w"], params["lnf_b"])
     logits = x @ params["embed"].T                          # (S, V)
@@ -211,6 +296,44 @@ def extend_forward(params, cfg: DecoderConfig, tokens, start, length,
     return (logits[last].astype(jnp.float32),
             jnp.stack(k_news),                              # (L,S,KV,D)
             jnp.stack(v_news))
+
+
+def _decode_core(params, cfg: DecoderConfig, tokens, positions,
+                 visible, insert):
+    """The single-query decode body shared by :func:`decode_forward`
+    and :func:`verify_forward`: per-row embedding + position, and per
+    layer one dense masked attention over whatever context ``insert``
+    supplies.  ``insert(li, k_new, v_new) -> (kk, vv)`` returns layer
+    ``li``'s ``(B, C, KV, D)`` keys/values with this step's own (and,
+    for verification, the draft positions') K/V placed — the only
+    thing that differs between the two callers.  Nothing here couples
+    batch rows, so a flattened ``B*(K+1)`` verify batch computes each
+    row bit-exactly as the ``(B,)`` decode batch would."""
+    b = tokens.shape[0]
+    hd = cfg.head_dim
+    groups = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / (hd ** 0.5)
+    x = params["embed"][tokens] + params["pos"][
+        jnp.clip(positions, 0, cfg.max_seq - 1)]            # (B, H)
+    k_news, v_news = [], []
+    for li, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"])
+        q = _mm(h, lp["wq"]).reshape(b, cfg.n_kv_heads, groups, hd)
+        k_new = _mm(h, lp["wk"]).reshape(b, cfg.n_kv_heads, hd)
+        v_new = _mm(h, lp["wv"]).reshape(b, cfg.n_kv_heads, hd)
+        k_news.append(k_new)
+        v_news.append(v_new)
+        kk, vv = insert(li, k_new, v_new)                   # (B,C,KV,D)
+        scores = jnp.einsum("bkgd,bckd->bkgc", q, kk) * scale
+        scores = jnp.where(visible[:, None, None, :], scores,
+                           jnp.float32(-1e30))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bkgc,bckd->bkgd", probs, vv)
+        x = x + _mm(out.reshape(b, -1), lp["wo"])
+        x = x + _mlp(lp, _ln(x, lp["ln2_w"], lp["ln2_b"]))
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    logits = x @ params["embed"].T                          # (B, V) f32
+    return logits, jnp.stack(k_news), jnp.stack(v_news)
 
 
 def decode_forward(params, cfg: DecoderConfig, tokens, positions,
@@ -227,32 +350,74 @@ def decode_forward(params, cfg: DecoderConfig, tokens, positions,
     Returns ``(logits (B, V) f32, k_new (L, B, KV, D), v_new)`` —
     the caller scatters ``k_new``/``v_new`` into the paged arena."""
     b = tokens.shape[0]
-    hd = cfg.head_dim
-    groups = cfg.n_heads // cfg.n_kv_heads
-    scale = 1.0 / (hd ** 0.5)
-    x = params["embed"][tokens] + params["pos"][
-        jnp.clip(positions, 0, cfg.max_seq - 1)]            # (B, H)
-    k_news, v_news = [], []
-    for li, lp in enumerate(params["layers"]):
-        h = _ln(x, lp["ln1_w"], lp["ln1_b"])
-        q = (h @ lp["wq"]).reshape(b, cfg.n_kv_heads, groups, hd)
-        k_new = (h @ lp["wk"]).reshape(b, cfg.n_kv_heads, hd)
-        v_new = (h @ lp["wv"]).reshape(b, cfg.n_kv_heads, hd)
-        k_news.append(k_new)
-        v_news.append(v_new)
-        kk = k_ctx[li]                                      # (B,C,KV,D)
-        vv = v_ctx[li]
+
+    def insert(li, k_new, v_new):
         # insert the current token's K/V at its own position so the
         # causal self term is present (the arena write happens after)
-        kk = kk.at[jnp.arange(b), positions].set(k_new)
-        vv = vv.at[jnp.arange(b), positions].set(v_new)
-        scores = jnp.einsum("bkgd,bckd->bkgc", q, kk) * scale
-        scores = jnp.where(visible[:, None, None, :], scores,
-                           jnp.float32(-1e30))
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        out = jnp.einsum("bkgc,bckd->bkgd", probs, vv)
-        x = x + out.reshape(b, -1) @ lp["wo"]
-        x = x + _mlp(lp, _ln(x, lp["ln2_w"], lp["ln2_b"]))
-    x = _ln(x, params["lnf_w"], params["lnf_b"])
-    logits = x @ params["embed"].T                          # (B, V) f32
-    return logits, jnp.stack(k_news), jnp.stack(v_news)
+        kk = k_ctx[li].at[jnp.arange(b), positions].set(k_new)
+        vv = v_ctx[li].at[jnp.arange(b), positions].set(v_new)
+        return kk, vv
+
+    return _decode_core(params, cfg, tokens, positions, visible, insert)
+
+
+def verify_forward(params, cfg: DecoderConfig, tokens, positions,
+                   k_ctx, v_ctx, quantized: bool = False):
+    """Score all K+1 speculative positions of every slot in ONE dense
+    forward.
+
+    ``tokens (B, J)`` / ``positions (B, J)`` (J = K+1, positions
+    already clipped into the context): column 0 is the slot's real
+    ``last_token`` at position ``seq_lens``; columns 1..K are drafts.
+    ``k_ctx``/``v_ctx`` ``(L, B, C, KV, D)`` is the same gathered
+    context a plain decode step sees.  The flatten-to-pseudo-slots
+    construction IS the bit-exactness argument: row ``(b, j)`` becomes
+    an independent batch row whose context holds, for every earlier
+    speculative position ``p..p+j-1``, the value the ARENA would hold
+    had those steps committed sequentially — the fed tokens' K/V as
+    stored (`quantized=True` roundtrips them through the int8
+    page format; float arenas store exactly, so the roundtrip is the
+    buffer dtype cast the ``.set`` already performs) — plus its own
+    FRESH K/V at ``p+j`` (inserted last, exactly like
+    :func:`decode_forward`'s self term).  Positions beyond ``p+j``
+    are masked by ``visible``, so each row reproduces the sequential
+    decode step for its position bit for bit.
+
+    Returns ``(logits (B, J, V) f32, k_new (L, B, J, KV, D), v_new)``.
+    """
+    b, j = tokens.shape
+    n = b * j
+    c = k_ctx.shape[2]
+    kv, hd = cfg.n_kv_heads, cfg.head_dim
+    slot = jnp.repeat(jnp.arange(b), j)                     # (n,)
+    rows = jnp.arange(n)
+    pos = positions.reshape(n)
+    visible = jnp.arange(c)[None, :] <= pos[:, None]        # (n, C)
+
+    def as_stored(x):
+        # what the arena would return for this K/V vector: int8 pages
+        # roundtrip through quantize/dequantize, float pages store the
+        # value (modulo the buffer-dtype cast .set applies below)
+        if not quantized:
+            return x
+        return dequantize_kv(*quantize_kv_int8(x))
+
+    def insert(li, k_new, v_new):
+        pos_s = positions[slot]                             # (n, J)
+        ka = as_stored(k_new).reshape(b, j, kv, hd)[slot]   # (n,J,KV,D)
+        va = as_stored(v_new).reshape(b, j, kv, hd)[slot]
+        kk = k_ctx[li][slot]                                # (n,C,KV,D)
+        vv = v_ctx[li][slot]
+        kk = kk.at[rows[:, None], pos_s].set(ka.astype(kk.dtype))
+        vv = vv.at[rows[:, None], pos_s].set(va.astype(vv.dtype))
+        # own position last: the fresh self term wins over the stored
+        # form, exactly as in the sequential step
+        kk = kk.at[rows, pos].set(k_new)
+        vv = vv.at[rows, pos].set(v_new)
+        return kk, vv
+
+    logits, k_news, v_news = _decode_core(
+        params, cfg, tokens.reshape(n), pos, visible, insert)
+    return (logits.reshape(b, j, -1),
+            k_news.reshape(k_news.shape[0], b, j, kv, hd),
+            v_news.reshape(v_news.shape[0], b, j, kv, hd))

@@ -30,7 +30,24 @@ unshared SUFFIX against the aliased cached prefix and scatter it into
 the slot's own pages.  **cow_copy** is the single page-copy program
 the engine runs when a shared page must detach before a write.
 
-Three orthogonal extensions ride the same carry:
+**Speculative decoding** (``spec_k > 0``) swaps the window body for
+:func:`decode_spec_one`: an n-gram/suffix drafter over the per-slot
+token ring proposes K tokens, ONE dense verify forward scores all K+1
+positions (:func:`~apex_tpu.serving.model.verify_forward` — the same
+per-slot math as single-query decode over flattened pseudo-slots), and
+a branch-free accept commits the longest agreeing prefix — KV scatter,
+``seq_lens``, the rings and the budget all advance by the accepted
+count, with rejected positions steered into the trash page/columns.
+Carry shape, donation arity and the one-device_get-per-window contract
+are unchanged, and greedy output is bit-exact vs the plain window for
+any K.
+
+**Batched prefill** (``prefill_batch > 1``) adds one batched prefill
+executable per bucket (:func:`prefill_batch_fn`): admission drains up
+to B queued requests into a single padded-bucket program call instead
+of B serial calls.
+
+Orthogonal extensions ride the same carry:
 
 - *int8 arena* (``arena.dtype == int8``): the gather DEQUANTIZES
   (int8 page × f32 per-vector scale plane) and the scatter QUANTIZES
@@ -61,7 +78,8 @@ import jax.numpy as jnp
 from apex_tpu.quantization import dequantize_kv, quantize_kv_int8
 from apex_tpu.serving.arena import ArenaSpec, KVArena
 from apex_tpu.serving.model import (DecoderConfig, decode_forward,
-                                    extend_forward, prefill_forward)
+                                    extend_forward, prefill_forward,
+                                    verify_forward)
 
 
 class DecodeState(NamedTuple):
@@ -90,11 +108,26 @@ class DecodeState(NamedTuple):
     out_tokens: jax.Array   # (B, W) i32 — this window's emissions
     n_out: jax.Array        # (B,) i32  — emissions this window
     done: jax.Array         # (B,) i32  — EOS / budget exhausted
+    history: jax.Array      # (B, ctx+2) i32 — token at position t in
+    #                         column t (prompt + emissions); column
+    #                         ctx+1 is the ring's own trash column for
+    #                         uncommitted speculative writes.  Host-
+    #                         written at admission, device-advanced by
+    #                         the accepted count under speculation;
+    #                         pass-through (trivially aliased) at K=0.
+    n_drafted: jax.Array    # (B,) i32 — draft tokens proposed this
+    #                         window (spec decode only; else 0)
+    n_accepted: jax.Array   # (B,) i32 — drafts accepted this window
 
 
-def init_state(arena: KVArena, window: int) -> DecodeState:
+def init_state(arena: KVArena, window: int,
+               spec_k: int = 0) -> DecodeState:
     s = arena.spec
     zi = jnp.zeros((s.max_slots,), jnp.int32)
+    # speculative windows emit up to K+1 tokens per iteration and need
+    # one trash column for rejected positions; K=0 keeps the exact
+    # (B, window) ring of the plain engine
+    w_out = int(window) * (int(spec_k) + 1) + (1 if spec_k else 0)
     return DecodeState(
         k=arena.k, v=arena.v,
         k_scale=arena.k_scale, v_scale=arena.v_scale,
@@ -104,8 +137,14 @@ def init_state(arena: KVArena, window: int) -> DecodeState:
         temperature=jnp.zeros((s.max_slots,), jnp.float32),
         top_k=zi,
         top_p=jnp.ones((s.max_slots,), jnp.float32),
-        out_tokens=jnp.full((s.max_slots, int(window)), -1, jnp.int32),
-        n_out=zi, done=zi)
+        out_tokens=jnp.full((s.max_slots, w_out), -1, jnp.int32),
+        n_out=zi, done=zi,
+        history=jnp.zeros((s.max_slots, s.slot_tokens + 2), jnp.int32),
+        # distinct buffers, NOT `zi`: admission never writes these
+        # leaves, and donating one buffer through two carry slots is an
+        # XLA execute error ("donate the same buffer twice")
+        n_drafted=jnp.zeros((s.max_slots,), jnp.int32),
+        n_accepted=jnp.zeros((s.max_slots,), jnp.int32))
 
 
 # ---------------------------------------------------------------------
@@ -233,16 +272,172 @@ def decode_one(params, cfg: DecoderConfig, spec: ArenaSpec,
         done=state.done | finished.astype(jnp.int32))
 
 
-def decode_window_fn(cfg: DecoderConfig, spec: ArenaSpec, window: int):
+# ---------------------------------------------------------------------
+# self-drafting speculative decoding (in-window)
+# ---------------------------------------------------------------------
+
+def _draft_tokens(history, pos, k: int, max_period: int):
+    """Suffix-period n-gram drafter over the per-slot token ring.
+
+    ``history (B, Hc)`` holds the token at position ``t`` in column
+    ``t``; ``pos (B,)`` is each slot's current position (its
+    ``last_token`` lives there).  For each slot, find the smallest
+    period ``pi <= max_period`` whose lagged bigram matches the
+    current suffix (``history[pos - i] == history[pos - pi - i]`` for
+    ``i in {0, 1}``), falling back to ``pi = 1`` (repeat the last
+    token); draft token ``j`` (1-based) is the history entry at
+    ``pos + j - pi * ceil(j / pi)`` — continue the detected cycle.
+    Entirely branch-free gathers/compares: no sort, no host traffic,
+    and cost independent of whether any slot's suffix repeats."""
+    b, hc = history.shape
+    gram = 2
+    pis = jnp.arange(1, max_period + 1)                      # (P,)
+    offs = jnp.arange(gram)                                  # (g,)
+    cur = jnp.take_along_axis(
+        history, jnp.clip(pos[:, None] - offs[None, :], 0, hc - 1),
+        axis=1)                                              # (B, g)
+    lag_idx = (pos[:, None, None] - pis[None, :, None]
+               - offs[None, None, :])                        # (B, P, g)
+    lag = jnp.take_along_axis(
+        history, jnp.clip(lag_idx, 0, hc - 1).reshape(b, -1),
+        axis=1).reshape(b, max_period, gram)
+    valid = (pos[:, None] - pis[None, :] - (gram - 1)) >= 0  # (B, P)
+    match = valid & jnp.all(cur[:, None, :] == lag, axis=-1)
+    big = jnp.int32(max_period + 1)
+    pi = jnp.min(jnp.where(match, pis, big), axis=-1)
+    pi = jnp.where(pi > max_period, 1, pi).astype(jnp.int32)
+    js = jnp.arange(1, k + 1)                                # (K,)
+    steps = (js[None, :] + pi[:, None] - 1) // pi[:, None]
+    src = pos[:, None] + js[None, :] - pi[:, None] * steps   # (B, K)
+    return jnp.take_along_axis(
+        history, jnp.clip(src, 0, hc - 1), axis=1)
+
+
+def decode_spec_one(params, cfg: DecoderConfig, spec: ArenaSpec,
+                    spec_k: int, state: DecodeState,
+                    col) -> DecodeState:
+    """One speculative decode iteration: draft K tokens from the
+    history ring, verify all K+1 positions in ONE dense forward
+    (:func:`~apex_tpu.serving.model.verify_forward`), and commit the
+    longest agreeing prefix branch-free.  Everything — KV scatter,
+    ``seq_lens``, the emission/history rings, budget — advances by the
+    accepted count; rejected positions steer into the arena's trash
+    page and the rings' trash columns, so the carry shape and the
+    zero-per-token-host-sync contract match the plain window exactly.
+    Greedy output is bit-exact vs :func:`decode_one` for any K: each
+    verified position samples from the identical logits with the
+    identical ``fold_in(rng, position)`` key sequential decode would
+    use, so the accepted prefix IS the sequential stream (and the PRNG
+    fold advances by the accepted count automatically)."""
+    s = spec
+    ctx = s.slot_tokens
+    kq = int(spec_k)
+    jn = kq + 1
+    b = state.seq_lens.shape[0]
+    wring = state.out_tokens.shape[1]
+    live = (state.active == 1) & (state.done == 0) \
+        & (state.seq_lens < ctx)
+    p = jnp.clip(state.seq_lens, 0, ctx - 1)
+    drafts = _draft_tokens(state.history, p, kq,
+                           max_period=min(8, ctx - 1))       # (B, K)
+    fed = jnp.concatenate([state.last_token[:, None], drafts],
+                          axis=1)                            # (B, J)
+    positions = p[:, None] + jnp.arange(jn)[None, :]
+    pos_c = jnp.clip(positions, 0, ctx - 1)
+    kk, vv = _gather_ctx(state.k, state.v, state.k_scale,
+                         state.v_scale, state.page_table, s)
+    k_ctx = jnp.moveaxis(kk, 2, 0)         # (L, B, C, KV, D)
+    v_ctx = jnp.moveaxis(vv, 2, 0)
+    logits, k_new, v_new = verify_forward(
+        params, cfg, fed, pos_c, k_ctx, v_ctx,
+        quantized=state.k.dtype == jnp.int8)
+    # sample every position with the key sequential decode would use:
+    # fold_in(slot rng, absolute position) — the per-position draws
+    # are independent of K and of how many drafts commit
+    samp = sample_tokens(
+        logits.reshape(b * jn, -1),
+        jnp.repeat(state.rng, jn, axis=0),
+        pos_c.reshape(-1),
+        jnp.repeat(state.temperature, jn),
+        jnp.repeat(state.top_k, jn),
+        jnp.repeat(state.top_p, jn)).reshape(b, jn)          # (B, J)
+    # longest agreeing prefix: position j's sample must equal draft j
+    matched = (drafts == samp[:, :kq]).astype(jnp.int32)
+    n_acc = 1 + jnp.sum(jnp.cumprod(matched, axis=1), axis=1)
+    # caps: never outrun the slot's context or its emission budget,
+    # and stop at (including) the first sampled EOS
+    cap = jnp.minimum(jnp.maximum(ctx - state.seq_lens, 0),
+                      jnp.maximum(state.budget, 0))
+    first_eos = jnp.min(
+        jnp.where(samp == cfg.eos_token,
+                  jnp.arange(jn)[None, :], jn), axis=1)
+    m = jnp.minimum(jnp.minimum(n_acc, cap), first_eos + 1)
+    m = jnp.where(live, m, 0)                                # (B,)
+    commit = jnp.arange(jn)[None, :] < m[:, None]            # (B, J)
+    # scatter the committed fed tokens' K/V at positions p..p+m-1;
+    # rejected and dead-slot writes go to the trash page
+    page = jnp.take_along_axis(
+        state.page_table,
+        jnp.clip(pos_c // s.page_size, 0, s.pages_per_slot - 1),
+        axis=1)                                              # (B, J)
+    page = jnp.where(commit, page, s.trash_page)
+    off = pos_c % s.page_size
+    k, v, k_scale, v_scale = _scatter_kv(
+        state.k, state.v, state.k_scale, state.v_scale, page, off,
+        jnp.moveaxis(k_new, 0, 2), jnp.moveaxis(v_new, 0, 2))
+    # rings: committed sample j is the token at position p+j+1;
+    # rejects land in each ring's trash column
+    rows = jnp.arange(b)[:, None]
+    hidx = jnp.where(commit, pos_c + 1, ctx + 1)
+    history = state.history.at[rows, hidx].set(
+        jnp.where(commit, samp, 0))
+    oidx = jnp.where(commit,
+                     state.n_out[:, None] + jnp.arange(jn)[None, :],
+                     wring - 1)
+    out_tokens = state.out_tokens.at[rows, oidx].set(
+        jnp.where(commit, samp, -1))
+    last = jnp.take_along_axis(
+        samp, jnp.clip(m - 1, 0, jn - 1)[:, None], axis=1)[:, 0]
+    new_budget = state.budget - m
+    eos_in = (first_eos + 1) <= m
+    finished = live & (eos_in | (new_budget <= 0))
+    return state._replace(
+        k=k, v=v, k_scale=k_scale, v_scale=v_scale,
+        seq_lens=state.seq_lens + m,
+        last_token=jnp.where(live & (m > 0), last, state.last_token),
+        budget=new_budget,
+        out_tokens=out_tokens,
+        n_out=state.n_out + m,
+        done=state.done | finished.astype(jnp.int32),
+        history=history,
+        n_drafted=state.n_drafted + jnp.where(live, kq, 0),
+        n_accepted=state.n_accepted + jnp.where(live, m - 1, 0))
+
+
+def decode_window_fn(cfg: DecoderConfig, spec: ArenaSpec, window: int,
+                     spec_k: int = 0):
     """The jittable window program: reset the emission ring, run
-    ``window`` steps in one ``fori_loop``."""
+    ``window`` steps in one ``fori_loop``.  ``spec_k > 0`` swaps the
+    body for :func:`decode_spec_one` (and resets the per-window
+    draft/accept counters); ``spec_k == 0`` is the plain program
+    unchanged — the speculative carry fields pass through untouched."""
+    k = int(spec_k)
+
     def run(params, state: DecodeState) -> DecodeState:
         state = state._replace(
             out_tokens=jnp.full_like(state.out_tokens, -1),
             n_out=jnp.zeros_like(state.n_out))
-        return jax.lax.fori_loop(
-            0, int(window),
-            lambda i, st: decode_one(params, cfg, spec, st, i), state)
+        if k:
+            state = state._replace(
+                n_drafted=jnp.zeros_like(state.n_drafted),
+                n_accepted=jnp.zeros_like(state.n_accepted))
+
+            def body(i, st):
+                return decode_spec_one(params, cfg, spec, k, st, i)
+        else:
+            def body(i, st):
+                return decode_one(params, cfg, spec, st, i)
+        return jax.lax.fori_loop(0, int(window), body, state)
     return run
 
 
@@ -277,6 +472,48 @@ def prefill_fn(cfg: DecoderConfig, spec: ArenaSpec, bucket: int):
             k = k.at[pages].set(paged(kp).astype(k.dtype))
             v = v.at[pages].set(paged(vp).astype(v.dtype))
         return k, v, k_scale, v_scale, first
+    return run
+
+
+def prefill_batch_fn(cfg: DecoderConfig, spec: ArenaSpec, bucket: int,
+                     nbatch: int):
+    """The jittable BATCHED per-bucket prefill program: up to
+    ``nbatch`` queued prompts forward through one padded-bucket call
+    (:func:`~apex_tpu.serving.model.prefill_forward` is already
+    batched, and its per-row ``segment_ids`` mask cross-request
+    attention), scatter every row's K/V pages, sample every first
+    token.  Unused rows ride along with ``length 0`` and all-trash
+    page rows — branch-free padding, one fixed shape per (bucket,
+    nbatch).  Per-row math is identical to :func:`prefill_fn`'s
+    single-request program (batch-composition independence), so
+    admission through this path is bit-exact vs serial admission."""
+    if bucket % spec.page_size:
+        raise ValueError(f"prefill bucket {bucket} must be a multiple "
+                         f"of page_size {spec.page_size}")
+    n_pg = bucket // spec.page_size
+
+    def run(params, k, v, k_scale, v_scale, pages, tokens, lengths,
+            rng, temperature, top_k, top_p):
+        # tokens (N, bucket), lengths (N,), pages (N, n_pg)
+        logits, kp, vp = prefill_forward(params, cfg, tokens, lengths)
+        firsts = sample_tokens(logits, rng, lengths - 1, temperature,
+                               top_k, top_p)                 # (N,)
+        def paged(t):                   # (L,N,S,KV,D) -> page blocks
+            t = jnp.transpose(t, (1, 2, 0, 3, 4))   # (N, S, L, KV, D)
+            return t.reshape(t.shape[0], n_pg, spec.page_size,
+                             spec.n_layers, spec.n_kv_heads,
+                             spec.head_dim)
+        if k.dtype == jnp.int8:
+            kq, ks = quantize_kv_int8(paged(kp))
+            vq, vs = quantize_kv_int8(paged(vp))
+            k = k.at[pages].set(kq)
+            v = v.at[pages].set(vq)
+            k_scale = k_scale.at[pages].set(ks)
+            v_scale = v_scale.at[pages].set(vs)
+        else:
+            k = k.at[pages].set(paged(kp).astype(k.dtype))
+            v = v.at[pages].set(paged(vp).astype(v.dtype))
+        return k, v, k_scale, v_scale, firsts
     return run
 
 
@@ -349,6 +586,26 @@ _SAMPLE_SDS = (jax.ShapeDtypeStruct((2,), jnp.uint32),
                jax.ShapeDtypeStruct((), jnp.float32))
 
 
+# per-EXECUTABLE memo: program sets that differ in one knob still share
+# every executable they have in common — a prefill_batch=2 set reuses
+# the plain set's decode window and single-prefill executables, a
+# spec_k set reuses its prefills, a prefix_share sibling reuses
+# everything but extend/COW.  Compiled executables are stateless, so
+# sharing across sets (and engines) is safe by the same argument as
+# the set-level cache below.
+_EXEC_CACHE: dict = {}
+_EXEC_CACHE_MAX = 256
+
+
+def _exec(key, build):
+    ex = _EXEC_CACHE.get(key)
+    if ex is None:
+        if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+            _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+        ex = _EXEC_CACHE[key] = build()
+    return ex
+
+
 class ServingPrograms:
     """The engine's compiled program set: ONE decode-window executable
     plus one prefill executable per shape bucket (and, for prefix-
@@ -359,12 +616,15 @@ class ServingPrograms:
     def __init__(self, params, cfg: DecoderConfig, arena: KVArena,
                  window: int,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 prefix_share: bool = False, _base=None):
+                 prefix_share: bool = False, spec_k: int = 0,
+                 prefill_batch: int = 1):
         spec = arena.spec
         self.cfg = cfg
         self.spec = spec
         self.window = int(window)
         self.prefix_share = bool(prefix_share)
+        self.spec_k = int(spec_k)
+        self.prefill_batch = int(prefill_batch)
         if prefill_buckets is None:
             # powers-of-two multiples of page_size up to slot capacity
             prefill_buckets, b = [], spec.page_size
@@ -381,68 +641,94 @@ class ServingPrograms:
                     f"page_size ({spec.page_size}) within slot "
                     f"capacity ({spec.slot_tokens})")
         p_sds = _sds(params)
-        state_sds = _sds(init_state(arena, self.window))
+        state_sds = _sds(init_state(arena, self.window, self.spec_k))
         arena_sds = (_sds(arena.k), _sds(arena.v),
                      _sds(arena.k_scale), _sds(arena.v_scale))
-        # a sibling program set over the same (params, geometry,
-        # dtype) that differs ONLY in prefix_share shares its decode/
-        # prefill executables outright — extend + COW are additive,
-        # so toggling sharing (a respawned replica, a prefs flip)
-        # never re-pays the base compile
-        reuse = (_base is not None
-                 and _base.window == self.window
-                 and _base.prefill_buckets == self.prefill_buckets)
-        if reuse:
-            self.decode = _base.decode
-            self.prefill: Dict[int, object] = dict(_base.prefill)
-        else:
+        # every compile below routes through the per-executable memo:
+        # sets that differ in one knob (prefix_share toggled by a
+        # respawned replica, a prefill_batch or spec_k prefs flip)
+        # re-pay only the programs that knob actually changes
+        ek = (id(params), cfg, spec, str(arena.dtype))
+
+        def build_decode():
             # decode: donate the whole carry (arg 1) — arenas + slot
             # state
-            self.decode = jax.jit(
-                decode_window_fn(cfg, spec, self.window),
+            return jax.jit(
+                decode_window_fn(cfg, spec, self.window, self.spec_k),
                 donate_argnums=(1,)).lower(p_sds, state_sds).compile()
-            self.prefill = {}
+
+        def build_prefill(bk):
+            # apexlint: disable-next=APX302
+            return jax.jit(
+                prefill_fn(cfg, spec, bk),
+                donate_argnums=(1, 2, 3, 4)).lower(
+                p_sds, *arena_sds,
+                jax.ShapeDtypeStruct((bk // spec.page_size,),
+                                     jnp.int32),
+                jax.ShapeDtypeStruct((bk,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                *_SAMPLE_SDS).compile()
+
+        def build_prefill_batched(bk, nb):
+            # apexlint: disable-next=APX302
+            return jax.jit(
+                prefill_batch_fn(cfg, spec, bk, nb),
+                donate_argnums=(1, 2, 3, 4)).lower(
+                p_sds, *arena_sds,
+                jax.ShapeDtypeStruct(
+                    (nb, bk // spec.page_size), jnp.int32),
+                jax.ShapeDtypeStruct((nb, bk), jnp.int32),
+                jax.ShapeDtypeStruct((nb,), jnp.int32),
+                jax.ShapeDtypeStruct((nb, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((nb,), jnp.float32),
+                jax.ShapeDtypeStruct((nb,), jnp.int32),
+                jax.ShapeDtypeStruct((nb,), jnp.float32),
+                ).compile()
+
+        def build_extend(bk):
+            # apexlint: disable-next=APX302
+            return jax.jit(
+                extend_fn(cfg, spec, bk),
+                donate_argnums=(1, 2, 3, 4)).lower(
+                p_sds, *arena_sds,
+                jax.ShapeDtypeStruct((spec.pages_per_slot,),
+                                     jnp.int32),
+                jax.ShapeDtypeStruct((bk,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                *_SAMPLE_SDS).compile()
+
+        def build_cow():
+            return jax.jit(
+                cow_copy_fn(), donate_argnums=(0, 1, 2, 3)).lower(
+                *arena_sds,
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32)).compile()
+
+        self.decode = _exec(
+            ek + ("decode", self.window, self.spec_k), build_decode)
+        self.prefill: Dict[int, object] = {}
+        self.prefill_batched: Dict[int, object] = {}
         self.extend: Dict[int, object] = {}
         for bk in self.prefill_buckets:
-            if not reuse:
-                fn = prefill_fn(cfg, spec, bk)
-                # one AOT compile per shape bucket, ONCE at engine
-                # build — this loop IS the ahead-of-time surface, not
-                # a hot path
-                # apexlint: disable-next=APX302
-                self.prefill[bk] = jax.jit(
-                    fn, donate_argnums=(1, 2, 3, 4)).lower(
-                    p_sds, *arena_sds,
-                    jax.ShapeDtypeStruct((bk // spec.page_size,),
-                                         jnp.int32),
-                    jax.ShapeDtypeStruct((bk,), jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32),
-                    *_SAMPLE_SDS).compile()
+            # one AOT compile per shape bucket, ONCE at engine build —
+            # this loop IS the ahead-of-time surface, not a hot path
+            self.prefill[bk] = _exec(
+                ek + ("prefill", bk), lambda bk=bk: build_prefill(bk))
+            if self.prefill_batch > 1:
+                nb = self.prefill_batch
+                self.prefill_batched[bk] = _exec(
+                    ek + ("prefill_batched", bk, nb),
+                    lambda bk=bk, nb=nb: build_prefill_batched(bk, nb))
             if prefix_share:
-                if reuse and _base.prefix_share:
-                    self.extend[bk] = _base.extend[bk]
-                    continue
-                # apexlint: disable-next=APX302
-                self.extend[bk] = jax.jit(
-                    extend_fn(cfg, spec, bk),
-                    donate_argnums=(1, 2, 3, 4)).lower(
-                    p_sds, *arena_sds,
-                    jax.ShapeDtypeStruct((spec.pages_per_slot,),
-                                         jnp.int32),
-                    jax.ShapeDtypeStruct((bk,), jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32),
-                    *_SAMPLE_SDS).compile()
+                self.extend[bk] = _exec(
+                    ek + ("extend", bk), lambda bk=bk: build_extend(bk))
         self.cow_copy = None
         if prefix_share:
-            if reuse and _base.prefix_share:
-                self.cow_copy = _base.cow_copy
-            else:
-                self.cow_copy = jax.jit(
-                    cow_copy_fn(), donate_argnums=(0, 1, 2, 3)).lower(
-                    *arena_sds,
-                    jax.ShapeDtypeStruct((), jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32)).compile()
+            # COW touches only the arenas — keyed on geometry + dtype,
+            # not params
+            self.cow_copy = _exec((spec, str(arena.dtype), "cow"),
+                                  build_cow)
 
     def bucket_for(self, prompt_len: int) -> Optional[int]:
         for bk in self.prefill_buckets:
@@ -457,29 +743,31 @@ class ServingPrograms:
 # program set — repeated engine builds (tests, respawned replicas)
 # skip the AOT compiles.  Keyed on params IDENTITY deliberately: value
 # equality over a whole pytree costs more than the compile it saves,
-# and a params reload is exactly the case that must recompile.
+# and a params reload is exactly the case that must recompile.  A set
+# evicted here keeps costing little to rebuild: its executables stay
+# in _EXEC_CACHE (evict-oldest, never wholesale) until they age out.
 _PROGRAM_CACHE: dict = {}
-_PROGRAM_CACHE_MAX = 8
+_PROGRAM_CACHE_MAX = 32
 
 
 def cached_programs(params, cfg: DecoderConfig, arena: KVArena,
                     window: int,
                     prefill_buckets: Optional[Sequence[int]] = None,
-                    prefix_share: bool = False) -> ServingPrograms:
+                    prefix_share: bool = False, spec_k: int = 0,
+                    prefill_batch: int = 1) -> ServingPrograms:
     """Memoized :class:`ServingPrograms` (module comment above)."""
     key = (id(params), cfg, arena.spec, str(arena.dtype), int(window),
            tuple(prefill_buckets) if prefill_buckets is not None
-           else None, bool(prefix_share))
+           else None, int(spec_k), int(prefill_batch),
+           bool(prefix_share))
     progs = _PROGRAM_CACHE.get(key)
     if progs is None:
-        # the sibling set (same everything, prefix_share flipped)
-        # donates its decode/prefill executables — see __init__
-        sibling = _PROGRAM_CACHE.get(key[:-1] + (not key[-1],))
         if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
-            _PROGRAM_CACHE.clear()
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
         progs = ServingPrograms(params, cfg, arena, window=window,
                                 prefill_buckets=prefill_buckets,
                                 prefix_share=prefix_share,
-                                _base=sibling)
+                                spec_k=spec_k,
+                                prefill_batch=prefill_batch)
         _PROGRAM_CACHE[key] = progs
     return progs

@@ -64,6 +64,25 @@ def parse_args(argv=None):
                    help="KV arena storage dtype; int8 stores "
                         "quantized pages + per-vector f32 scales "
                         "(~half the HBM per cached token)")
+    p.add_argument("--speculate", type=int, default=None, metavar="K",
+                   help="self-drafting speculative decoding: draft up "
+                        "to K tokens per decode-window iteration from "
+                        "each slot's recent token ring and verify them "
+                        "in ONE dense pass — greedy output stays "
+                        "bit-exact for any K (watch "
+                        "apex_tpu_serving_spec_accepted / _drafted "
+                        "on /metrics)")
+    p.add_argument("--weight-dtype", default=None,
+                   choices=("f32", "int8"),
+                   help="decoder matmul weight storage; int8 "
+                        "quantizes at engine build with per-channel "
+                        "scales (weight-only: dequant folds into the "
+                        "dot)")
+    p.add_argument("--prefill-batch", type=int, default=None,
+                   metavar="B",
+                   help="admission drains up to B queued same-bucket "
+                        "requests into ONE padded batched prefill "
+                        "call")
     p.add_argument("--sample", default=None, metavar="TEMP:TOP_P",
                    help="device-side sampling, e.g. 0.8:0.95 — each "
                         "request draws seeded temperature/top-p "
@@ -115,6 +134,9 @@ def main(argv=None):
                          max_slots=2, pages_per_slot=8, window=4,
                          telemetry=tel, decode_deadline_s=deadline,
                          flush_every=1, kv_dtype=args.kv_dtype,
+                         spec_k=args.speculate,
+                         weight_dtype=args.weight_dtype,
+                         prefill_batch=args.prefill_batch,
                          prefix_share=(True if args.shared_system_prompt
                                        else None))
     print(f"engine: {eng.arena.describe()}  "
@@ -176,6 +198,15 @@ def main(argv=None):
         state = ("closed" if eng.incidents.current is None
                  else "OPEN")
         print(f"incident chain: {eng.incidents.history[0]} [{state}]")
+    if eng.spec_k:
+        rate = (eng._spec_accepted / eng._spec_drafted
+                if eng._spec_drafted else 0.0)
+        print(f"speculation: K={eng.spec_k}, "
+              f"{eng._spec_accepted}/{eng._spec_drafted} drafts "
+              f"accepted ({rate:.2f})")
+    if eng.prefill_batch > 1:
+        print(f"batched prefill: {eng._n_prefills} request(s) in "
+              f"{eng._n_prefill_calls} program call(s)")
     if args.shared_system_prompt:
         print(f"prefix sharing: {eng._prefix_hits} hit(s), "
               f"{eng._n_prefills} prefill(s), "

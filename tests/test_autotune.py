@@ -53,7 +53,9 @@ def _good_table(key="cpu-8"):
         "pipeline": {"max_bucket_bytes": 1 << 25,
                      "reduce_decompose": "reduce_scatter"},
         "serving": {"page_size": 8, "decode_window": 8,
-                    "kv_dtype": "int8", "prefix_share": True},
+                    "kv_dtype": "int8", "prefix_share": True,
+                    "spec_k": 4, "weight_dtype": "int8",
+                    "prefill_batch": 4},
     }
 
 
@@ -73,6 +75,15 @@ class TestValidateTable:
 
     def test_shipped_tables_validate(self):
         assert at.validate_paths() == []
+
+    def test_spec_k_zero_is_valid(self):
+        # spec_k is the one serving integer where 0 is a VALID value
+        # (speculation off) — it must not ride the positive-int check
+        doc = _good_table()
+        doc["serving"]["spec_k"] = 0
+        assert at.validate_table(
+            doc, per_topology=True,
+            path="x/dispatch_prefs.cpu-8.json") == []
 
     @pytest.mark.parametrize("mutate,needle", [
         (lambda d: d.pop("methodology"), "methodology"),
@@ -94,6 +105,12 @@ class TestValidateTable:
          "serving.kv_dtype"),
         (lambda d: d["serving"].update(prefix_share="yes"),
          "serving.prefix_share"),
+        (lambda d: d["serving"].update(spec_k=-1),
+         "serving.spec_k"),
+        (lambda d: d["serving"].update(weight_dtype="fp4"),
+         "serving.weight_dtype"),
+        (lambda d: d["serving"].update(prefill_batch=0),
+         "serving.prefill_batch"),
     ])
     def test_each_violation_fails_fast(self, mutate, needle):
         doc = _good_table()

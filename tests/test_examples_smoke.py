@@ -401,6 +401,67 @@ def test_serve_gpt_shared_prefix_int8_gauges_live_and_summarize(
     assert "serving/kv_bytes_saved" in summary
 
 
+def test_serve_gpt_speculative_int8_weights_gauges_live(
+        tmp_path, capsys):
+    """The compute frontier demo: --speculate + --weight-dtype int8 +
+    --prefill-batch decodes with --port while a background scraper
+    polls /metrics.  A MID-RUN scrape must carry the speculation
+    counters (``apex_tpu_serving_spec_accepted`` / ``_drafted``), and
+    the final stdout summary reports the accept rate and the batched
+    prefill program-call count."""
+    import socket
+    import threading
+    import urllib.request
+
+    tel = str(tmp_path / "telemetry")
+    with socket.socket() as s:                # pick a free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    samples, stop = [], threading.Event()
+
+    def scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1) as r:
+                    body = r.read().decode()
+                g = {}
+                for line in body.splitlines():
+                    if not line.startswith("#") and " " in line \
+                            and "{" not in line:
+                        n, v = line.rsplit(" ", 1)
+                        g[n] = float(v)
+                samples.append(g)
+            except OSError:
+                pass                          # server not up/gone yet
+            stop.wait(0.005)
+
+    t = threading.Thread(target=scrape, daemon=True)
+    t.start()
+    try:
+        _run("examples/gpt/serve.py",
+             ["--requests", "4", "--max-new-tokens", "8",
+              "--speculate", "2", "--weight-dtype", "int8",
+              "--prefill-batch", "2",
+              "--telemetry-dir", tel, "--port", str(port)])
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    out = capsys.readouterr().out
+    assert "speculation: K=2" in out
+    assert "batched prefill:" in out
+    assert "OK:" in out
+    assert len(samples) > 2                   # genuinely scraped live
+    # a MID-RUN scrape carries the speculation counters
+    mid = [g for g in samples
+           if "apex_tpu_serving_spec_accepted" in g]
+    assert mid, "no scrape saw the speculation counters"
+    last = samples[-1]
+    assert last.get("apex_tpu_serving_spec_drafted", 0) > 0
+    assert last.get("apex_tpu_serving_spec_accepted", 0) >= 0
+
+
 def test_imagenet_preempt_and_resume(tmp_path, capsys):
     """The imagenet example's save path rides the same resilience
     manager: --checkpoint-dir rotates bucket-native checkpoints and a

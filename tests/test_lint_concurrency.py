@@ -384,7 +384,8 @@ def test_engine_deadline_thunks_bind_state_before_submission():
     must capture programs/params/state BEFORE submission — a thunk
     reading `self.*` late can race replica-failover recovery swapping
     those attributes and execute half-old, half-new state.  Pin the
-    closure shape: no lambda under _admit/_decode closes over self."""
+    closure shape: no lambda under the admission paths (_admit_one /
+    _admit_batch) or _decode closes over self."""
     import types
 
     from apex_tpu.serving.engine import Engine
@@ -398,7 +399,8 @@ def test_engine_deadline_thunks_bind_state_before_submission():
                 out.extend(lambdas_of(k))
         return out
 
-    for meth, want in (("_admit", {"prefill", "params", "st"}),
+    for meth, want in (("_admit_one", {"prefill", "params", "st"}),
+                       ("_admit_batch", {"prog", "params", "st"}),
                        ("_decode", {"decode", "params", "st"})):
         lams = lambdas_of(getattr(Engine, meth).__code__)
         assert lams, f"{meth} lost its deadline thunk"

@@ -72,6 +72,7 @@ _REDUCE_CHOICES = ("psum", "reduce_scatter")
 # keep in sync with apex_tpu.ops._dispatch.KV_DTYPE_CHOICES; duplicated
 # so --validate stays jax-free.
 _KV_DTYPE_CHOICES = ("f32", "bf16", "int8")
+_WEIGHT_DTYPE_CHOICES = ("f32", "int8")
 
 
 def _load_sibling(name):
@@ -170,15 +171,27 @@ def validate_table(doc, *, per_topology: bool, path: str = "") -> list:
     if not isinstance(srv, dict):
         err("serving must be an object")
     else:
-        for k in ("page_size", "decode_window"):
+        for k in ("page_size", "decode_window", "prefill_batch"):
             if k in srv and (not isinstance(srv[k], int)
                              or isinstance(srv[k], bool)
                              or srv[k] <= 0):
                 err(f"serving.{k} must be a positive integer, "
                     f"found {srv[k]!r}")
+        # spec_k is the one serving integer where 0 is a VALID value
+        # (speculation off), so it cannot ride the positive-int loop
+        if "spec_k" in srv and (not isinstance(srv["spec_k"], int)
+                                or isinstance(srv["spec_k"], bool)
+                                or srv["spec_k"] < 0):
+            err(f"serving.spec_k must be a non-negative integer, "
+                f"found {srv['spec_k']!r}")
         if "kv_dtype" in srv and srv["kv_dtype"] not in _KV_DTYPE_CHOICES:
             err(f"serving.kv_dtype must be one of {_KV_DTYPE_CHOICES}, "
                 f"found {srv['kv_dtype']!r}")
+        if "weight_dtype" in srv \
+                and srv["weight_dtype"] not in _WEIGHT_DTYPE_CHOICES:
+            err(f"serving.weight_dtype must be one of "
+                f"{_WEIGHT_DTYPE_CHOICES}, "
+                f"found {srv['weight_dtype']!r}")
         if "prefix_share" in srv \
                 and not isinstance(srv["prefix_share"], bool):
             err(f"serving.prefix_share must be a JSON boolean, "
@@ -311,6 +324,10 @@ def smoke_config() -> dict:
         # is stamped at the production width, not the smoke width
         "serving_quant_hidden": 256, "serving_quant_heads": 4,
         "serving_share_requests": 4,
+        # one non-zero K: the smoke proves the sweep plumbing + the
+        # bit-exact oracle; the K frontier itself is a --full question
+        "serving_spec_candidates": [0, 2],
+        "serving_prefill_batch": 2,
         "device_check_families": ["multi_tensor"],
     }
 
@@ -342,6 +359,8 @@ def full_config() -> dict:
         "serving_heads": 8, "serving_slots": 16, "serving_ctx": 1024,
         "serving_quant_hidden": 512, "serving_quant_heads": 8,
         "serving_share_requests": 8,
+        "serving_spec_candidates": [0, 2, 4, 8],
+        "serving_prefill_batch": 4,
         "device_check_families": ["multi_tensor", "welford",
                                   "layer_norm", "pipeline", "fp8"],
     }
@@ -950,6 +969,128 @@ def sweep_serving_memory(cfg, noise_pct: float) -> list:
     return [rec_q, rec_p]
 
 
+_SERVING_COMPUTE_MEMO = {}
+
+
+def _serving_compute_benches(cfg):
+    """Run (once per config) the speculative-decode and batched-
+    prefill benches that both the compute sweep and the budget
+    restamp consume — each builds and AOT-compiles engines, the most
+    expensive fixtures in the sweep."""
+    from apex_tpu.serving.bench import bench_batched_prefill, \
+        bench_spec_decode
+    key = (cfg["serving_layers"], cfg["serving_hidden"],
+           cfg["serving_heads"],
+           tuple(cfg["serving_spec_candidates"]),
+           cfg["serving_prefill_batch"])
+    if key not in _SERVING_COMPUTE_MEMO:
+        spec_runs = {}
+        for k in cfg["serving_spec_candidates"]:
+            if k == 0:
+                continue    # the K=0 leg rides every spec run
+            spec_runs[k] = bench_spec_decode(
+                n_requests=cfg["serving_slots"],
+                n_layers=cfg["serving_layers"],
+                hidden=cfg["serving_hidden"],
+                n_heads=cfg["serving_heads"], spec_k=k)
+        rb = bench_batched_prefill(
+            n_requests=cfg["serving_prefill_batch"],
+            n_layers=cfg["serving_layers"],
+            hidden=cfg["serving_hidden"],
+            n_heads=cfg["serving_heads"],
+            prefill_batch=cfg["serving_prefill_batch"])
+        _SERVING_COMPUTE_MEMO[key] = (spec_runs, rb)
+    return _SERVING_COMPUTE_MEMO[key]
+
+
+def sweep_serving_compute(cfg, noise_pct: float) -> list:
+    """Serving compute frontier: spec_k, weight_dtype and
+    prefill_batch.
+
+    spec_k weighs each candidate K's speculative window wall-clock
+    against the plain window on the repetitive-suffix fixture — a K
+    only becomes the table's decision when it beats K=0 beyond the
+    noise floor AND its greedy stream stayed bit-exact (the free
+    oracle; a K that ever diverges is a bug, not a slow candidate).
+    weight_dtype times the decode window with int8-quantized matmul
+    weights against f32 — the HBM halving is structural, so int8 wins
+    unless its dequant tax exceeds the noise floor (the kv_dtype
+    rule, applied to the weight planes).  prefill_batch is graded
+    structurally from program-invocation counters: B requests must
+    drain through ONE call with the serial stream reproduced
+    bit-exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import serving
+    from apex_tpu.benchlib import timeit
+    from apex_tpu.serving.bench import _tiny_setup
+
+    spec_runs, rb = _serving_compute_benches(cfg)
+
+    # --- serving.spec_k -------------------------------------------------
+    cands_ms = {"k0": None}
+    exact = True
+    for k, r in sorted(spec_runs.items()):
+        cands_ms[f"k{k}"] = r["spec_verify_step_ms"]
+        if cands_ms["k0"] is None:
+            cands_ms["k0"] = r["spec_plain_window_ms"]
+        exact = exact and bool(r["spec_bit_exact"])
+    rec_k = {"space": "serving.spec_k", "family": "serving",
+             "shape": f"L{cfg['serving_layers']}"
+                      f"h{cfg['serving_hidden']}",
+             "dtype": "f32", "noise_floor_pct": noise_pct,
+             "candidates_ms": cands_ms,
+             "spec_accept_rates": {
+                 f"k{k}": r["spec_accept_rate"]
+                 for k, r in sorted(spec_runs.items())},
+             "spec_bit_exact": int(exact)}
+    timed = {k: r["spec_verify_step_ms"]
+             for k, r in spec_runs.items()}
+    if timed and exact:
+        best = min(timed, key=timed.get)
+        if timed[best] < cands_ms["k0"] * (1.0 - noise_pct / 100.0):
+            rec_k["decision"] = {"serving": {"spec_k": best}}
+
+    # --- serving.weight_dtype -------------------------------------------
+    cfg2, params, spec2, state = _tiny_setup(
+        jax, jnp, cfg["serving_layers"], cfg["serving_hidden"],
+        cfg["serving_heads"], cfg["serving_slots"], 8,
+        max(1, cfg["serving_ctx"] // 8), 8)
+    win = serving.decode_window_fn(cfg2, spec2, 8)
+    times = {}
+    for wd in ("f32", "int8"):
+        wp = serving.quantize_serving_params(params, wd)
+        # one program per weight dtype by design
+        # apexlint: disable-next=APX302
+        times[wd] = timeit(jax.jit(win), wp, state,
+                           iters=cfg["iters"], reps=cfg["reps"])
+    rec_w = {"space": "serving.weight_dtype", "family": "serving",
+             "shape": f"L{cfg['serving_layers']}"
+                      f"h{cfg['serving_hidden']}",
+             "dtype": "int8", "noise_floor_pct": noise_pct,
+             "candidates_ms": {k: round(v, 4)
+                               for k, v in times.items()}}
+    if times["int8"] <= times["f32"] * (1.0 + noise_pct / 100.0):
+        rec_w["decision"] = {"serving": {"weight_dtype": "int8"}}
+
+    # --- serving.prefill_batch ------------------------------------------
+    b = cfg["serving_prefill_batch"]
+    rec_b = {"space": "serving.prefill_batch", "family": "serving",
+             "shape": f"b{b}", "dtype": "f32",
+             "noise_floor_pct": noise_pct,
+             "candidates_ms": {
+                 "batched": rb["batched_prefill_ms"],
+                 "serial": rb["serial_prefill_ms"]},
+             "batched_prefill_speedup": rb["batched_prefill_speedup"],
+             "batched_prefill_bit_exact":
+                 rb["batched_prefill_bit_exact"]}
+    if rb["batched_prefill_speedup"] >= 1.5 \
+            and rb["batched_prefill_bit_exact"]:
+        rec_b["decision"] = {"serving": {"prefill_batch": b}}
+    return [rec_k, rec_w, rec_b]
+
+
 def measure_budget_rows(cfg) -> dict:
     """Sweep measurements that ground perf_budget rows (dotted metric
     path -> value).  grad_accum_n8_speedup comes from the same flat-vs-
@@ -974,6 +1115,14 @@ def measure_budget_rows(cfg) -> dict:
     q, p = _serving_memory_benches(cfg)
     out["extra.kv_bytes_per_token"] = q["kv_bytes_per_token_ratio"]
     out["extra.prefix_prefill_savings"] = p["prefix_prefill_savings"]
+    spec_runs, rb = _serving_compute_benches(cfg)
+    if spec_runs:
+        # the largest candidate K: the budget floor grades the
+        # drafter's ceiling on the repetitive-suffix fixture
+        out["extra.spec_accept_rate"] = \
+            spec_runs[max(spec_runs)]["spec_accept_rate"]
+    out["extra.batched_prefill_speedup"] = \
+        rb["batched_prefill_speedup"]
     return out
 
 
@@ -1065,6 +1214,12 @@ def demonstrate_decision_changes(doc) -> list:
                 "kv_dtype", "f32")
             out["serving:prefix_share"] = _dispatch.serving_pref(
                 "prefix_share", False)
+            out["serving:spec_k"] = _dispatch.serving_pref(
+                "spec_k", 0)
+            out["serving:weight_dtype"] = _dispatch.serving_pref(
+                "weight_dtype", "f32")
+            out["serving:prefill_batch"] = _dispatch.serving_pref(
+                "prefill_batch", 1)
             return out
 
         before = snapshot()
@@ -1117,6 +1272,7 @@ def run_sweep(cfg, out_dir: str, budget_path: str,
         records += sweep_quantization(cfg, noise_pct)
         records += sweep_serving_geometry(cfg, noise_pct)
         records += sweep_serving_memory(cfg, noise_pct)
+        records += sweep_serving_compute(cfg, noise_pct)
         budget_rows = measure_budget_rows(cfg)
     finally:
         if prev_pin is None:

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # The one-command correctness gate: AST tier (incl. APX204
-# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 26
+# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 30
 # specs) + baseline diff over the package, then the relaxed profile
 # over tests/, examples/ and tools/ (APX101/102 exempt inside test
 # bodies — a test syncing to assert a device value is the point of the
@@ -17,7 +17,13 @@
 # host traffic and exact KV-arena donation alias counts; prefill runs
 # one flash pallas_call per decoder layer; the int8 window pins its
 # quantize/dequantize convert counts exactly; the device-side sampler
-# lowers transfer-free with one shared sort).
+# lowers transfer-free with one shared sort), plus the PR-18 serving
+# quartet — serving.spec_decode_step / spec_decode_step_quantized
+# (speculative decode windows stay zero-host-traffic with exact
+# donation and int8 cast counts in both kv x weight dtype modes),
+# serving.decode_step_w8 (int8 weights dequantize once per matmul
+# plane, never quantize in-step) and serving.prefill_batched (B
+# prompts, one program call, same arena donation as serial prefill).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #
@@ -46,13 +52,13 @@ assert ids == want, f'expected {want}, found {ids}'
 print(f'{len(ids)} concurrency rules registered')
 "
 
-echo "== apexverify spec count: exactly 26 registered"
+echo "== apexverify spec count: exactly 30 registered"
 # the spec-count gate: a PR that deletes or fails to register an
 # invariant spec must fail HERE, not silently verify less
 python -c "
 from apex_tpu.lint import semantic
 n = len(semantic.all_specs())
-assert n == 26, f'expected 26 apexverify specs, found {n}'
+assert n == 30, f'expected 30 apexverify specs, found {n}'
 print(f'{n} specs registered')
 "
 

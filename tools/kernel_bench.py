@@ -398,6 +398,52 @@ def main():
         "oracle_ms": None,
         "speedup": rp.get("prefix_prefill_savings")})
 
+    # speculative verify step: the K-token self-drafting decode window
+    # vs the plain (K=0) window on the repetitive-suffix fixture
+    # ("kernel" = speculative, "oracle" = plain); speedup is the
+    # structural accept rate (extra.spec_accept_rate budget floor) —
+    # the wall-clock ratio only pays off where the forward is
+    # bandwidth-bound, which CPU is not
+    from apex_tpu.serving.bench import bench_spec_decode
+    rs = bench_spec_decode(n_requests=4, n_layers=4, hidden=256,
+                           n_heads=8, page_size=8, pages_per_slot=8,
+                           window=8, spec_k=4)
+    rs["backend"] = backend
+    print(json.dumps(rs), flush=True)
+    rows.append({
+        "kernel": "spec_verify_step",
+        "shape": f"k{rs['spec_k']}", "dtype": "f32",
+        "kernel_ms": rs["spec_verify_step_ms"],
+        "oracle_ms": rs["spec_plain_window_ms"],
+        "speedup": rs.get("spec_accept_rate")})
+
+    # int8 weight matmul: the weight-only dequant-into-dot serving
+    # path vs the plain f32 dot at decode-ish shape ("kernel" = int8,
+    # "oracle" = f32) — halves weight HBM per verify pass; the compute
+    # tax shows here
+    from apex_tpu.benchlib import timeit as _timeit
+    from apex_tpu.quantization import int8_matmul, quantize_int8
+    m, k_, n = 8, 1024, 1024
+    x = jax.random.normal(jax.random.key(11), (m, k_), jnp.float32)
+    w = jax.random.normal(jax.random.key(12), (k_, n), jnp.float32)
+    wq = quantize_int8(w, axis=0)
+    # one program per weight dtype by design
+    # apexlint: disable-next=APX302
+    int8_ms = _timeit(jax.jit(lambda x: int8_matmul(x, wq)), x)
+    # apexlint: disable-next=APX302
+    f32_ms = _timeit(jax.jit(lambda x: x @ w), x)
+    rw = {"int8_weight_matmul_ms": round(int8_ms, 4),
+          "f32_weight_matmul_ms": round(f32_ms, 4),
+          "int8_weight_matmul_shape": f"{m}x{k_}x{n}",
+          "backend": backend}
+    print(json.dumps(rw), flush=True)
+    rows.append({
+        "kernel": "int8_weight_matmul",
+        "shape": rw["int8_weight_matmul_shape"], "dtype": "int8",
+        "kernel_ms": rw["int8_weight_matmul_ms"],
+        "oracle_ms": rw["f32_weight_matmul_ms"],
+        "speedup": (round(f32_ms / int8_ms, 2) if int8_ms else None)})
+
     # flash geometry sweep: find the best sequence-block cap per shape
     # (re-jit per cap — the env knob is read at trace time), then
     # record the per-head-dim winner in dispatch_prefs.json so the

@@ -49,6 +49,15 @@ numbers must be defended, while the cached pre-flat-pipeline rounds
 (whose capture date the budget was stamped from) stay report-only so
 they cannot block the PRs that will re-measure them.  The chosen mode
 and its reason are always printed.
+
+Every hardware round additionally prints its **measurement age**
+(capture timestamp + days since) — the cached rounds re-serve the
+2026-07-31 window, and that staleness should be visible in every
+``tools/check.sh`` run, not only in ROADMAP prose.  When the newest
+hardware data predates the budget's ``stamped_at`` by more than
+``--stale-days`` (default 14), the gate prints a WARNING: the budget
+is defending numbers nobody has re-measured in that long.  Neither
+the age lines nor the warning change the exit code.
 """
 
 from __future__ import annotations
@@ -176,6 +185,29 @@ def _check(name: str, spec: dict,
     return verdict
 
 
+def parse_when(when) -> Optional["datetime.datetime"]:
+    """Parse the bench stamp format (``2026-07-31T03:41:18Z``); None
+    for anything else — a malformed stamp degrades to "no age", never
+    a traceback out of the gate."""
+    import datetime
+    try:
+        return datetime.datetime.strptime(when, "%Y-%m-%dT%H:%M:%SZ")
+    except (TypeError, ValueError):
+        return None
+
+
+def age_days(when, now=None) -> Optional[int]:
+    """Whole days between a bench capture stamp and ``now`` (UTC)."""
+    import datetime
+    t = parse_when(when)
+    if t is None:
+        return None
+    if now is None:
+        now = datetime.datetime.now(datetime.timezone.utc) \
+            .replace(tzinfo=None)
+    return (now - t).days
+
+
 def round_when(parsed: dict) -> Optional[str]:
     """ISO capture timestamp of one bench line: live rounds carry
     ``measured_at``; cached rounds re-serve the original window's
@@ -240,6 +272,10 @@ def main(argv=None) -> int:
     ap.add_argument("--gate", action="store_true",
                     help="force gating regardless of round/stamp dates")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--stale-days", type=int, default=14,
+                    help="warn when the newest hardware data predates "
+                         "the budget stamp by more than this many "
+                         "days (warning only — never the exit code)")
     args = ap.parse_args(argv)
 
     try:
@@ -280,16 +316,53 @@ def main(argv=None) -> int:
     regressions = [v for v in verdicts
                    if v["status"] in ("regression", "stale")]
 
+    # measurement ages: when each hardware round's data was actually
+    # captured (cached rounds re-serve their original window's stamp),
+    # plus a staleness warning when the newest hardware data predates
+    # the budget stamp by more than --stale-days — report-only, the
+    # exit code never depends on either
+    hw = hardware_rounds(rounds)
+    ages = [{"round": n, "backend": p.get("backend"),
+             "measured_at": round_when(p),
+             "age_days": age_days(round_when(p))} for n, p in hw]
+    stale_warning = None
+    if hw:
+        stamped_dt = parse_when(budget.get("stamped_at"))
+        newest_dt = parse_when(round_when(hw[-1][1]))
+        if stamped_dt and newest_dt:
+            behind = (stamped_dt - newest_dt).days
+            if behind > args.stale_days:
+                stale_warning = (
+                    f"WARNING: newest hardware data "
+                    f"({round_when(hw[-1][1])}) predates the budget "
+                    f"stamp ({budget.get('stamped_at')}) by {behind} "
+                    f"days (> {args.stale_days}) — the budget defends "
+                    "numbers nobody has re-measured; run bench.py on "
+                    "hardware")
+
     if args.json:
         print(json.dumps({"verdicts": verdicts,
                           "hardware_rounds":
-                          [n for n, _ in hardware_rounds(rounds)],
+                          [n for n, _ in hw],
+                          "measurement_ages": ages,
+                          "stale_warning": stale_warning,
                           "regressions": len(regressions),
                           "gating": gating, "mode_reason": reason}))
     else:
-        hw = hardware_rounds(rounds)
         print(f"perf_gate: {len(hw)} hardware round(s) "
               f"{[n for n, _ in hw]} of {len(rounds)} total")
+        for a in ages:
+            if a["measured_at"]:
+                line = (f"  r{a['round']:02d} {a['backend']}: "
+                        f"measured {a['measured_at']}")
+                if a["age_days"] is not None:
+                    line += f" ({a['age_days']} day(s) ago)"
+            else:
+                line = (f"  r{a['round']:02d} {a['backend']}: "
+                        "no capture timestamp")
+            print(line)
+        if stale_warning:
+            print(f"perf_gate: {stale_warning}")
         print(f"perf_gate: {reason}")
         for v in verdicts:
             line = f"  {v['status']:<10} {v['metric']}"
