@@ -942,6 +942,50 @@ def _build_serving_prefill_batched():
 
 
 @register_spec(
+    "serving.traced_decode_step",
+    anchor="apex_tpu/serving/engine.py",
+    description="request tracing is free on device: a decode window "
+                "traced WHILE a live RequestTracer records enqueue/"
+                "admit/decode-window events lowers to the exact same "
+                "program as the untraced spec — zero transfer or "
+                "callback prims added, donation arity unchanged (the "
+                "tracer is host-side bookkeeping only)")
+def _build_serving_traced_decode_step():
+    import jax
+    from apex_tpu import serving
+    from apex_tpu.telemetry.reqtrace import RequestTracer
+    cfg, params, spec, arena = _serving_fixture()
+    state = serving.init_state(arena, window=2)
+    fn = serving.decode_window_fn(cfg, spec, window=2)
+    tracer = RequestTracer(host=0)
+
+    def traced(params, state):
+        # Live tracer bookkeeping exactly as the engine interleaves
+        # it around the device call — all host-side, so it must not
+        # contribute a single prim to the lowered program.
+        tracer.enqueue("spec-req", t=0.0)
+        tracer.admit("spec-req", window=0, slot=0, mode="prefill",
+                     queue_ms=0.0, t=0.0)
+        out = fn(params, state)
+        tracer.decode_window("spec-req", 1, 2, t=0.0)
+        return out
+
+    updated = len(jax.tree_util.tree_leaves(state)) - 2
+    return {
+        "fn": traced, "args": (params, state),
+        "jit_kwargs": {"donate_argnums": (1,)},
+        "expect": {
+            "no_host_transfer": True,
+            "no_f64": True,
+            # identical donation arity to serving.decode_step —
+            # tracing changed nothing in the program
+            "donated_aliases": updated,
+            "no_orphan_collectives": True,
+        },
+    }
+
+
+@register_spec(
     "ddp.all_reduce_flat_buffers",
     anchor="apex_tpu/parallel/distributed.py",
     description="bucket-granular DDP all-reduce under shard_map: "

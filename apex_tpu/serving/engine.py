@@ -45,6 +45,14 @@ Observability: ``serving/*`` host counters ride the hostmetrics sinks
 event records ride the telemetry session's flush into the JSONL and
 the merged incident timeline, and prefill/decode wall time is
 attributed through :func:`telemetry.span` (the PR-8 profiler surface).
+Request-level: a :class:`~apex_tpu.telemetry.reqtrace.RequestTracer`
+assembles one lifecycle trace per request from the host facts the
+engine already holds (submit stamp, admission dispatch walls, the
+window read-back counts) — ZERO added device syncs, pinned by the
+``serving.traced_decode_step`` apexverify spec — closing each into a
+``kind:"reqtrace"`` record at verdict time and streaming TTFT / e2e /
+queue-wait / inter-token SLO histograms that render as Prometheus
+histograms on ``/metrics`` (``kind:"hist"`` records ride the flush).
 """
 
 from __future__ import annotations
@@ -68,6 +76,7 @@ from apex_tpu.serving.model import DecoderConfig
 from apex_tpu.serving.steps import init_state
 from apex_tpu.telemetry import hostmetrics as _hostmetrics
 from apex_tpu.telemetry.incident import IncidentLog
+from apex_tpu.telemetry.reqtrace import RequestTracer
 
 
 class DecodeDeadlineExceeded(RuntimeError):
@@ -110,6 +119,10 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
+    # stamped by submit(); rides the queue ledger so a failover
+    # re-admission's trace keeps the ORIGINAL enqueue time — the
+    # merged timeline's cross-host request lane starts here
+    enqueued_t: Optional[float] = None
 
     @property
     def total_tokens(self) -> int:
@@ -121,6 +134,8 @@ class Request:
                "max_new_tokens": int(self.max_new_tokens),
                **({"deadline_s": self.deadline_s}
                   if self.deadline_s is not None else {})}
+        if self.enqueued_t is not None:
+            rec["enqueued_t"] = round(float(self.enqueued_t), 6)
         if self.temperature > 0:
             # sampling params survive replica failover: the claimant's
             # re-admission continues the same seeded stream
@@ -137,7 +152,8 @@ class Request:
                    temperature=float(rec.get("temperature", 0.0)),
                    top_k=int(rec.get("top_k", 0)),
                    top_p=float(rec.get("top_p", 1.0)),
-                   seed=int(rec.get("seed", 0)))
+                   seed=int(rec.get("seed", 0)),
+                   enqueued_t=rec.get("enqueued_t"))
 
 
 @dataclass
@@ -201,7 +217,8 @@ class Engine:
                  telemetry=None, replica=None, controller=None,
                  guard=None, incidents: Optional[IncidentLog] = None,
                  flush_every: int = 4,
-                 results_cap: int = 65536):
+                 results_cap: int = 65536,
+                 trace: bool = True):
         from apex_tpu.ops import _dispatch
         if page_size is None:
             page_size = int(_dispatch.serving_pref("page_size", 8))
@@ -271,6 +288,15 @@ class Engine:
         self.flush_every = max(1, int(flush_every))
         self.incidents = (replica.incidents if replica is not None
                           else (incidents or IncidentLog()))
+        # request-level lifecycle traces + SLO histograms: pure host
+        # bookkeeping off events the loop already generates (zero
+        # added device syncs — serving.traced_decode_step pins it).
+        # ``trace=False`` is the bare engine the reqtrace_overhead
+        # bench row compares against.
+        self.tracer: Optional[RequestTracer] = (
+            RequestTracer(host=(replica.host if replica is not None
+                                else None))
+            if trace else None)
         self.queue: collections.deque = collections.deque()
         # every verdict is retained for the caller, but only up to
         # results_cap: a long-lived server must not hold the full
@@ -310,6 +336,14 @@ class Engine:
 
     # ---- lifecycle -------------------------------------------------------
     def close(self) -> None:
+        if self.tracer is not None and self.tracer.open_ids():
+            # traces still open at teardown (this replica dying with
+            # requests in flight): flush them as PARTIAL records — the
+            # claimant's terminal trace for the same id completes the
+            # cross-host lane in the merged timeline
+            for rec in self.tracer.drain_open(self._windows):
+                self.incidents.tag(rec)
+                self._event_records.append(rec)
         if self._attached and self.telemetry is not None:
             if self._event_records:
                 try:
@@ -329,6 +363,10 @@ class Engine:
     def _on_flush(self, records) -> List[dict]:
         out = list(self._event_records)
         self._event_records.clear()
+        if self.tracer is not None:
+            # cumulative SLO histogram snapshots ride every flush —
+            # newest per (host, name) wins downstream, like counters
+            out.extend(self.tracer.hist_records(step=self._windows))
         return out
 
     def _event(self, event: str, **fields) -> None:
@@ -362,6 +400,14 @@ class Engine:
                         from_host=readmitted_from)
             _hostmetrics.emit("serving/readmitted", 1)
             req._readmitted_from = readmitted_from  # type: ignore
+        if req.enqueued_t is None:
+            req.enqueued_t = time.time()
+        if self.tracer is not None:
+            # for a re-admission, enqueued_t came off the dead host's
+            # queue ledger: the lane starts on the ORIGINAL clock
+            self.tracer.enqueue(req.id, t=req.enqueued_t,
+                                window=self._windows,
+                                readmitted_from=readmitted_from)
         # placeable = fits a slot's pages AND a compiled prefill
         # bucket covers the prompt (custom bucket lists may stop short
         # of slot capacity) — either failure is the typed oom shed,
@@ -576,12 +622,15 @@ class Engine:
 
     def _place_request(self, req: Request, slot: int,
                        slot_pages: List[int], first: int, samp,
-                       w: int) -> None:
+                       w: int, mode: str = "prefill",
+                       t_dispatch: Optional[float] = None) -> None:
         """Per-request slot-state placement after a successful
         prefill/extend dispatch — shared by serial and batched
         admission so the carry writes cannot drift between them.
         ``self.state`` must already hold the dispatch's returned
-        arenas."""
+        arenas.  ``mode`` names the admission path for the trace
+        (``prefill`` / ``extend`` / ``batched``); ``t_dispatch`` is
+        the dispatch-start wall, bounding queue wait."""
         plen = len(req.prompt)
         st = self.state
         done_now = (first == self.cfg.eos_token
@@ -617,6 +666,16 @@ class Engine:
             self._trie.register(req.prompt, slot_pages)
         self._active[slot] = a
         self._admitted_this_window.append(slot)
+        if self.tracer is not None:
+            # admitted_t is the TTFT point: the first token exists.
+            # BEFORE the done_now completion below — a one-token
+            # request's trace still reads enqueue -> admit -> verdict.
+            enq = req.enqueued_t if req.enqueued_t is not None \
+                else a.admitted_t
+            t0 = t_dispatch if t_dispatch is not None else a.admitted_t
+            self.tracer.admit(req.id, window=w, slot=slot, mode=mode,
+                              queue_ms=max(0.0, (t0 - enq) * 1e3),
+                              t=a.admitted_t)
         _hostmetrics.emit("serving/admitted", 1)
         self._tokens_total += 1
         if done_now:
@@ -715,7 +774,9 @@ class Engine:
         first = int(first)    # one sync per ADMISSION (documented)
         self.state = self.state._replace(k=k, v=v, k_scale=ks,
                                          v_scale=vs)
-        self._place_request(req, slot, slot_pages, first, samp, w)
+        self._place_request(req, slot, slot_pages, first, samp, w,
+                            mode="extend" if shared_all else "prefill",
+                            t_dispatch=t0)
         return True
 
     def _admit_batch(self, w: int) -> bool:
@@ -829,7 +890,8 @@ class Engine:
                                          v_scale=vs)
         for i, (req, slot, pages) in enumerate(group):
             self._place_request(req, slot, pages, int(firsts[i]),
-                                samps[i], w)
+                                samps[i], w, mode="batched",
+                                t_dispatch=t0)
         return True
 
     def _admit_shared(self, req: Request, slot: int,
@@ -887,6 +949,12 @@ class Engine:
                     shared_pages=len(shared) + (1 if tail is not None
                                                 else 0),
                     cow=tail is not None)
+        if self.tracer is not None:
+            self.tracer.note(
+                req.id, "prefix_hit", window=w,
+                shared_pages=len(shared) + (1 if tail is not None
+                                            else 0),
+                cow=tail is not None)
         return out
 
     # ---- decode ----------------------------------------------------------
@@ -940,6 +1008,14 @@ class Engine:
             emitted += n
             a.tokens.extend(int(t) for t in out_tokens[slot, :n]
                             if t >= 0)
+            if self.tracer is not None:
+                # one trace event per window the request was LIVE in
+                # (n == 0 included: a stalled slot is a trace fact),
+                # counts straight off THE window read-back above —
+                # no extra sync.  BEFORE _complete pops the slot.
+                self.tracer.decode_window(
+                    a.req.id, w, n, drafted=int(n_dr[slot]),
+                    accepted=int(n_ac[slot]))
             if int(done[slot]):
                 self._complete(slot)
         return emitted
@@ -1109,6 +1185,9 @@ class Engine:
             req=req, slot=slot, tokens=list(a.tokens),
             admitted_t=a.admitted_t, admitted_window=self._windows,
             readmitted_from=a.readmitted_from)
+        if self.tracer is not None:
+            self.tracer.note(req.id, "replay", window=self._windows,
+                             tokens_done=len(a.tokens))
         if remaining <= 0:
             self._complete(slot)
 
@@ -1140,9 +1219,21 @@ class Engine:
 
     def _note_terminal(self, rid: str) -> None:
         """Terminal-verdict bookkeeping, called by EVERY path that
-        records a result: a replica-failover incident closes once all
-        re-admitted requests have verdicts, and the results ledger is
-        pruned oldest-first past ``results_cap``."""
+        records a result: the request's lifecycle trace closes into
+        its ``kind:"reqtrace"`` record (hooked HERE, once, so a new
+        verdict path cannot forget its traces), a replica-failover
+        incident closes once all re-admitted requests have verdicts,
+        and the results ledger is pruned oldest-first past
+        ``results_cap``."""
+        if self.tracer is not None:
+            r = self.results.get(rid)
+            if r is not None:
+                rec = self.tracer.verdict(
+                    rid, r.verdict, window=self._windows,
+                    reason=r.reason, incident_id=r.incident_id,
+                    readmitted_from=r.readmitted_from,
+                    n_tokens=len(r.tokens))
+                self._event_records.append(rec)
         self._readmitted_pending.discard(rid)
         if self._incident_cause == "replica_death" \
                 and not self._readmitted_pending:
@@ -1205,6 +1296,12 @@ class Engine:
         if emitted > 0 and wall_s > 0:
             per_tok = wall_s * 1e3 / emitted
             self._token_ms.extend([per_tok] * min(emitted, 32))
+            if self.tracer is not None:
+                # the window's amortized per-token latency, weighted
+                # by (capped) token count — the inter-arrival SLO
+                # histogram's streaming intake
+                self.tracer.slo.observe("serving/intertoken_ms",
+                                        per_tok, n=min(emitted, 64))
             _hostmetrics.emit("serving/tokens_per_sec",
                               emitted / wall_s)
         if self._token_ms:

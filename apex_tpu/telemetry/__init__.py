@@ -42,6 +42,13 @@ flags as APX101 (and whose runtime twin is APX102).  Core invariant:
   capture windows, device-time attribution (compute / collective /
   transfer / idle + overlap fraction), cost-model MFU, and
   ``python -m apex_tpu.telemetry profile <trace_dir>``.
+- :mod:`reqtrace` + :mod:`hist`: request-level lifecycle traces for
+  the serving path (enqueue -> admit -> decode windows -> typed
+  verdict, ``kind:"reqtrace"`` records) and fixed-bucket log-scale
+  SLO histograms (TTFT / e2e / inter-token / queue wait,
+  ``kind:"hist"``) — streaming per replica, merged across run dirs,
+  rendered as Prometheus histograms on ``/metrics`` and as async
+  request lanes in the chrome trace.
 
 See docs/observability.md for the producer -> metric wiring table and
 the design rationale.
@@ -52,7 +59,10 @@ from apex_tpu.telemetry._tape import emit as emit_metric
 from apex_tpu.telemetry.emitters import (CsvEmitter, Emitter,
                                          JsonlEmitter, StepLogger)
 from apex_tpu.telemetry.export import MetricsServer
+from apex_tpu.telemetry.hist import (HistogramSet, LatencyHistogram,
+                                     prometheus_histogram_lines)
 from apex_tpu.telemetry.incident import IncidentLog
+from apex_tpu.telemetry.reqtrace import RequestTracer, trace_gaps
 from apex_tpu.telemetry.lockwatch import WatchedLock
 from apex_tpu.telemetry.retrace import RetraceCounter
 from apex_tpu.telemetry.ring import MetricRing
@@ -64,5 +74,7 @@ __all__ = [
     "Emitter", "JsonlEmitter", "CsvEmitter", "StepLogger",
     "MetricsServer", "IncidentLog",
     "RetraceCounter", "WatchedLock", "span", "emit_metric",
+    "LatencyHistogram", "HistogramSet", "prometheus_histogram_lines",
+    "RequestTracer", "trace_gaps",
     "profiler",
 ]

@@ -130,6 +130,81 @@ def _fleet_row(r: dict) -> List[str]:
     return [step, event, str(r.get("host", "-")), detail]
 
 
+def _slo_section(reqtraces: List[dict],
+                 hist_recs: List[dict]) -> Optional[dict]:
+    """The per-run serving SLO summary: verdict counts (by reason),
+    latency quantiles off the ``kind:"hist"`` snapshots (merged when
+    several replicas contribute — associative, order-free), and
+    tokens/sec over the traced span.  None when the run served
+    nothing."""
+    if not reqtraces and not hist_recs:
+        return None
+    from apex_tpu.telemetry import hist as _hist
+    verdicts: dict = {}
+    reasons: dict = {}
+    tok_total = 0
+    t_lo = t_hi = None
+    for r in reqtraces:
+        v = r.get("verdict")
+        if v is None:
+            continue        # open partial (a dead replica's shard)
+        verdicts[v] = verdicts.get(v, 0) + 1
+        if r.get("reason"):
+            key = (v, r["reason"])
+            reasons[key] = reasons.get(key, 0) + 1
+        tok_total += int(r.get("tokens", 0))
+        enq = r.get("enqueue_t")
+        if isinstance(enq, (int, float)):
+            t_lo = enq if t_lo is None else min(t_lo, enq)
+        tv = r.get("t")
+        if isinstance(tv, (int, float)):
+            t_hi = tv if t_hi is None else max(t_hi, tv)
+    by_name: dict = {}
+    for rec in hist_recs:
+        by_name.setdefault(rec.get("name", ""), []).append(rec)
+    latency: dict = {}
+    for name in sorted(by_name):
+        try:
+            h = _hist.merge_records(by_name[name])
+        except (KeyError, TypeError, ValueError):
+            continue      # torn/foreign hist record
+        if h is None or h.count == 0:
+            continue
+        latency[name] = {"count": int(h.count),
+                         "p50": round(h.quantile(0.5), 3),
+                         "p99": round(h.quantile(0.99), 3)}
+    out = {"requests": sum(verdicts.values()), "verdicts": verdicts,
+           "reasons": {f"{v}:{r}": n
+                       for (v, r), n in sorted(reasons.items())},
+           "latency_ms": latency, "tokens": tok_total}
+    if t_lo is not None and t_hi is not None and t_hi > t_lo:
+        out["tokens_per_sec"] = round(tok_total / (t_hi - t_lo), 3)
+    return out
+
+
+def _render_slo(slo: dict, out) -> None:
+    tps = slo.get("tokens_per_sec")
+    print(f"\nserving SLO: {slo['requests']} request(s), "
+          f"{slo['tokens']} token(s)"
+          + (f", {_fmt_cell(tps)} tokens/sec" if tps is not None
+             else ""), file=out)
+    if slo["verdicts"]:
+        rows = []
+        for v in sorted(slo["verdicts"]):
+            why = ", ".join(
+                f"{k.split(':', 1)[1]}={n}"
+                for k, n in sorted(slo["reasons"].items())
+                if k.startswith(v + ":"))
+            rows.append([v, str(slo["verdicts"][v]), why or "-"])
+        _render_table(["verdict", "count", "by reason"], rows, out)
+    if slo["latency_ms"]:
+        _render_table(
+            ["latency", "count", "p50_ms", "p99_ms"],
+            [[n.rsplit("/", 1)[-1], str(q["count"]),
+              _fmt_cell(q["p50"]), _fmt_cell(q["p99"])]
+             for n, q in sorted(slo["latency_ms"].items())], out)
+
+
 def _render_table(header: List[str], rows: List[List[str]], out) -> None:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(header)]
@@ -164,6 +239,8 @@ def summarize(path, tail: int = 32, as_json: bool = False,
     # every one is a timeline row
     spans, counters, retraces, anomalies = {}, {}, {}, []
     fleet_events: List[dict] = []
+    reqtraces: List[dict] = []
+    hists: dict = {}
     for r in records:
         if r.get("kind") == "span":
             spans[r["name"]] = r
@@ -175,10 +252,17 @@ def summarize(path, tail: int = 32, as_json: bool = False,
             anomalies.append(r)
         elif r.get("kind") == "fleet":
             fleet_events.append(r)
+        elif r.get("kind") == "reqtrace":
+            reqtraces.append(r)
+        elif r.get("kind") == "hist":
+            # cumulative snapshot: newest per name wins
+            hists[r.get("name", "")] = r
     if not steps and not (counters or spans or anomalies
-                          or fleet_events or retraces):
+                          or fleet_events or retraces
+                          or reqtraces or hists):
         print(f"{resolved}: no step records", file=out)
         return 1
+    slo = _slo_section(reqtraces, list(hists.values()))
     # a step-less run still renders: the serving engine emits only
     # counters (serving/prefix_hits, serving/kv_bytes_saved, ...) and
     # events, and those need a summarize surface too
@@ -207,6 +291,7 @@ def summarize(path, tail: int = 32, as_json: bool = False,
                    "anomalies": anomalies,
                    "fleet": fleet_events,
                    "perf": perf,
+                   "serving": slo,
                    "spans": sorted(spans.values(),
                                    key=lambda r: r["name"]),
                    "counters": sorted(counters.values(),
@@ -249,6 +334,8 @@ def summarize(path, tail: int = 32, as_json: bool = False,
             [_fleet_row(r)
              for r in sorted(fleet_events,
                              key=lambda r: r.get("step", 0))], out)
+    if slo is not None:
+        _render_slo(slo, out)
     if spans:
         print("\nspans (cumulative):", file=out)
         _render_table(
@@ -296,6 +383,8 @@ def _summarize_merged(paths: List[str], tail: int, as_json: bool,
     spans, counters, retraces = {}, {}, {}
     anomalies: List[dict] = []
     fleet_events: List[dict] = []
+    reqtraces: List[dict] = []
+    hists: dict = {}
     for r in merged["records"]:
         key = (r.get("host", 0), r.get("name", ""))
         if r.get("kind") == "span":
@@ -308,7 +397,15 @@ def _summarize_merged(paths: List[str], tail: int, as_json: bool,
             anomalies.append(r)
         elif r.get("kind") == "fleet":
             fleet_events.append(r)
-    if not steps:
+        elif r.get("kind") == "reqtrace":
+            reqtraces.append(r)
+        elif r.get("kind") == "hist":
+            # newest cumulative snapshot per (host, name); the SLO
+            # section then merges ACROSS hosts (associative fold)
+            hists[key] = r
+    slo = _slo_section(reqtraces, [hists[k] for k in sorted(hists)])
+    if not steps and slo is None and not (counters or anomalies
+                                          or fleet_events):
         print(f"{' '.join(merged['sources'])}: no step records",
               file=out)
         return 1
@@ -322,6 +419,7 @@ def _summarize_merged(paths: List[str], tail: int, as_json: bool,
                    "offsets": merged["offsets"],
                    "steps": steps, "overflow_steps": overflows,
                    "anomalies": anomalies, "fleet": fleet_events,
+                   "serving": slo,
                    "spans": [spans[k] for k in sorted(spans)],
                    "counters": [counters[k] for k in sorted(counters)],
                    "retraces": [retraces[k]
@@ -333,13 +431,15 @@ def _summarize_merged(paths: List[str], tail: int, as_json: bool,
     print(f"steps recorded: {len(steps)}   overflow steps: "
           f"{overflows}", file=out)
     print("", file=out)
-    show = steps[-tail:] if tail and tail > 0 else steps
-    header = ["host", "step"] + [m.rsplit("/", 1)[-1]
-                                 if m.count("/") else m
-                                 for m in metrics]
-    rows = [[str(r.get("host", "-")), str(r["step"])]
-            + [_fmt_cell(r.get(m)) for m in metrics] for r in show]
-    _render_table(header, rows, out)
+    if steps:
+        show = steps[-tail:] if tail and tail > 0 else steps
+        header = ["host", "step"] + [m.rsplit("/", 1)[-1]
+                                     if m.count("/") else m
+                                     for m in metrics]
+        rows = [[str(r.get("host", "-")), str(r["step"])]
+                + [_fmt_cell(r.get(m)) for m in metrics]
+                for r in show]
+        _render_table(header, rows, out)
     if anomalies:
         print("\nanomaly timeline:", file=out)
         _render_table(
@@ -352,6 +452,8 @@ def _summarize_merged(paths: List[str], tail: int, as_json: bool,
             ["host", "step", "event", "subject", "detail"],
             [[str(r.get("host", "-"))] + _fleet_row(r)
              for r in fleet_events], out)
+    if slo is not None:
+        _render_slo(slo, out)
     if counters:
         print("\ncounters (cumulative, per host):", file=out)
         _render_table(

@@ -13,8 +13,12 @@ FleetController`'s load signals — need the same numbers LIVE.  The
   metric (loss, amp/*, optim/*, fp8/*), every hostmetrics counter
   (ckpt/*, fleet/*, perf/*) as last-value gauge PLUS a monotonic
   ``_total`` sum, watchdog / fleet / autoscaler event counts by kind,
-  the open-incident flag with its id as a label, and
-  ``apex_tpu_exported_step`` (the newest flushed step);
+  the open-incident flag with its id as a label,
+  ``apex_tpu_exported_step`` (the newest flushed step), and — the
+  third metric class — full Prometheus HISTOGRAMS
+  (``_bucket{le=...}`` / ``_sum`` / ``_count``) for the serving SLO
+  latencies (TTFT, e2e, inter-token, queue wait), republished from
+  the ``kind:"hist"`` snapshots the engine's tracer flushes;
 - ``GET /healthz`` — a tiny JSON liveness document.
 
 **Zero added per-step device syncs** is the hard contract (the
@@ -52,6 +56,7 @@ from typing import Dict, List, Optional, Tuple
 
 from apex_tpu.telemetry import hostmetrics as _hostmetrics
 from apex_tpu.telemetry.emitters import Emitter
+from apex_tpu.telemetry.hist import prometheus_histogram_lines
 
 METRIC_PREFIX = "apex_tpu_"
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
@@ -113,6 +118,10 @@ class MetricsServer(Emitter):
         self._labeled: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                             float] = {}
         self._totals: Dict[str, float] = {}
+        # the third metric class: newest cumulative histogram snapshot
+        # per metric (kind:"hist" records), rendered as Prometheus
+        # _bucket/_sum/_count series after the gauges
+        self._hists: Dict[str, dict] = {}
         self._exported_step = -1
         self._publishes = 0
         self._started = time.time()
@@ -258,6 +267,24 @@ class MetricsServer(Emitter):
                     # decode / drain / failover) count by kind like
                     # the fleet's, and thread the same incident gauge
                     self._bump(f"serving_{r.get('event', 'unknown')}")
+                elif kind == "hist":
+                    # histogram snapshot: CUMULATIVE since engine
+                    # start, so newest-wins replacement (not a merge)
+                    # is the correct fold, exactly like gauges
+                    key = metric_name(r.get("name", "hist"),
+                                      self.prefix)
+                    self._hists[key] = {
+                        "le": list(r.get("le", [])),
+                        "counts": list(r.get("counts", [])),
+                        "sum": float(r.get("sum", 0.0)),
+                        "count": int(r.get("count", 0))}
+                    continue
+                elif kind == "reqtrace":
+                    # per-request terminal traces: count verdicts by
+                    # type (the SLO table's numerators, scrapeable)
+                    self._bump(
+                        f"reqtrace_{r.get('verdict', 'open')}")
+                    continue
                 else:
                     continue
                 iid = r.get("incident_id")
@@ -291,7 +318,15 @@ class MetricsServer(Emitter):
                 float(self._publishes)
             gauges[self.prefix + "up"] = 1.0
             labeled = dict(self._labeled)
-        return render_prometheus(gauges, labeled)
+            hists = {k: dict(v) for k, v in self._hists.items()}
+        out = render_prometheus(gauges, labeled)
+        if hists:
+            lines: List[str] = []
+            for name in sorted(hists):
+                lines.extend(
+                    prometheus_histogram_lines(name, hists[name]))
+            out += "\n".join(lines) + "\n"
+        return out
 
     def health(self) -> dict:
         with self._lock:

@@ -1,0 +1,227 @@
+"""Fixed-bucket log-scale latency histograms for the serving SLOs.
+
+The serving engine needs a per-request latency story — TTFT, e2e,
+per-token inter-arrival, queue wait — and gauges cannot carry one: a
+``p99`` computed from a bounded deque forgets the tail the moment it
+rotates, and two replicas' deques cannot be combined after the fact.
+A histogram with FIXED log-scale bucket bounds fixes both at once:
+
+- **streaming** — ``observe`` is a bisect + three adds; memory is one
+  small int array per metric regardless of request volume;
+- **mergeable** — two histograms over the same bounds merge by
+  elementwise addition, which is associative and commutative, so N
+  replicas' run dirs fold into one fleet histogram in any order
+  (``timeline``/``summarize`` do exactly this);
+- **bounded error** — a quantile estimate interpolated inside its
+  bucket is off by at most that bucket's width, and log-scale bounds
+  make the width proportional to the value (constant RELATIVE error),
+  which is the right shape for latencies spanning 0.25 ms to minutes;
+- **scrapeable** — the bucket layout IS the Prometheus histogram
+  exposition model (cumulative ``_bucket{le=...}`` + ``_sum`` +
+  ``_count``), so the live ``/metrics`` endpoint renders it verbatim
+  and PromQL's ``histogram_quantile`` agrees with :meth:`quantile`.
+
+Stdlib-only by design: ``summarize``/``timeline`` run on a login host
+with no jax, and the engine's observe path must never touch a device.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# 22 powers of two from 0.25 ms to ~8.7 min: every latency this stack
+# can plausibly produce lands in a real bucket (the +Inf overflow
+# bucket exists, but a sample there estimates poorly).  FIXED across
+# the fleet — merge requires identical bounds, and a schema'd constant
+# is what makes two replicas' records mergeable a week apart.
+DEFAULT_BOUNDS_MS: Tuple[float, ...] = tuple(
+    0.25 * (2.0 ** i) for i in range(22))
+
+# the serving SLO set: one histogram per latency the ISSUE's SLO table
+# renders (reqtrace observes the first, second and fourth at verdict
+# time; the engine observes inter-arrival at window boundaries)
+SLO_HISTOGRAMS: Tuple[str, ...] = (
+    "serving/ttft_ms", "serving/e2e_ms",
+    "serving/intertoken_ms", "serving/queue_ms")
+
+
+def _fmt_bound(b: float) -> str:
+    """Exposition-format a ``le`` bound (``0.25``, ``4096``, never
+    ``4.096e+03`` — Prometheus parses either, humans diff the text)."""
+    f = float(b)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return f"{f:.10g}"
+
+
+class LatencyHistogram:
+    """One fixed-bucket streaming histogram (module docstring).
+
+    ``counts`` has ``len(bounds) + 1`` entries: ``counts[i]`` holds
+    observations ``v <= bounds[i]`` exclusive of earlier buckets, and
+    the final entry is the ``+Inf`` overflow."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_BOUNDS_MS):
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError("histogram bounds must strictly ascend")
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    # ---- intake ----------------------------------------------------------
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``n`` observations of ``value`` (n > 1 amortizes a
+        window's worth of identical per-token samples in one call)."""
+        v = float(value)
+        n = int(n)
+        if n <= 0:
+            return
+        self.counts[bisect.bisect_left(self.bounds, v)] += n
+        self.sum += v * n
+        self.count += n
+
+    # ---- merge (associative + commutative) -------------------------------
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Elementwise fold of ``other`` into self; returns self so
+        merges chain.  Bounds must match exactly — mergeability across
+        replicas is the point of the fixed scheme."""
+        if other.bounds != self.bounds:
+            raise ValueError(
+                f"cannot merge histograms with different bounds "
+                f"({len(self.bounds)} vs {len(other.bounds)} buckets)")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.sum += other.sum
+        self.count += other.count
+        return self
+
+    # ---- estimates -------------------------------------------------------
+    def quantile(self, q: float) -> float:
+        """Quantile estimate by linear interpolation inside the target
+        bucket — within one bucket width of the exact order statistic
+        (the overflow bucket clamps to the largest bound: past the
+        scheme's range the estimate degrades to a floor, never a
+        fabrication)."""
+        if self.count <= 0:
+            return 0.0
+        target = max(1.0, min(float(q), 1.0) * self.count)
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target and c > 0:
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                return lo + (hi - lo) * (target - (cum - c)) / c
+        return self.bounds[-1]
+
+    def bucket_width(self, value: float) -> float:
+        """Width of the bucket ``value`` falls in — the quantile
+        estimate's error bound at that value."""
+        i = bisect.bisect_left(self.bounds, float(value))
+        if i >= len(self.bounds):
+            return float("inf")
+        lo = self.bounds[i - 1] if i > 0 else 0.0
+        return self.bounds[i] - lo
+
+    # ---- records (ride the telemetry flush; merge across run dirs) -------
+    def to_record(self, name: str, step: Optional[int] = None,
+                  t: Optional[float] = None) -> dict:
+        """Cumulative JSONL snapshot — ``kind:"hist"``, newest per
+        (host, name) wins downstream, exactly like counter records."""
+        rec = {"kind": "hist", "name": name,
+               "le": [float(b) for b in self.bounds],
+               "counts": list(self.counts),
+               "sum": round(self.sum, 6), "count": int(self.count)}
+        if step is not None:
+            rec["step"] = int(step)
+        rec["t"] = round(time.time() if t is None else float(t), 3)
+        return rec
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "LatencyHistogram":
+        h = cls(bounds=rec["le"])
+        counts = [int(c) for c in rec.get("counts", [])]
+        if len(counts) != len(h.counts):
+            raise ValueError("hist record counts/bounds mismatch")
+        h.counts = counts
+        h.sum = float(rec.get("sum", 0.0))
+        h.count = int(rec.get("count", sum(counts)))
+        return h
+
+
+def merge_records(records: Iterable[dict]) -> Optional[LatencyHistogram]:
+    """Fold N ``kind:"hist"`` records (one per replica) into one
+    histogram; None when the iterable is empty.  Associativity of
+    :meth:`LatencyHistogram.merge` makes the fold order irrelevant."""
+    out: Optional[LatencyHistogram] = None
+    for rec in records:
+        h = LatencyHistogram.from_record(rec)
+        out = h if out is None else out.merge(h)
+    return out
+
+
+def prometheus_histogram_lines(metric: str, rec: dict) -> List[str]:
+    """Render one hist record (or :meth:`to_record` output) in the
+    Prometheus histogram exposition format: ``# TYPE``, CUMULATIVE
+    ``_bucket{le=...}`` counts ending in ``le="+Inf"``, then ``_sum``
+    and ``_count`` (``_count`` == the +Inf bucket, by construction)."""
+    bounds = rec.get("le") or []
+    counts = rec.get("counts") or []
+    lines = [f"# TYPE {metric} histogram"]
+    cum = 0
+    for b, c in zip(bounds, counts):
+        cum += int(c)
+        lines.append(f'{metric}_bucket{{le="{_fmt_bound(b)}"}} {cum}')
+    if len(counts) > len(bounds):
+        cum += int(counts[len(bounds)])
+    lines.append(f'{metric}_bucket{{le="+Inf"}} {cum}')
+    s = float(rec.get("sum", 0.0))
+    lines.append(f"{metric}_sum {s:.10g}")
+    lines.append(f"{metric}_count {int(rec.get('count', cum))}")
+    return lines
+
+
+class HistogramSet:
+    """The per-replica SLO histogram bundle: one
+    :class:`LatencyHistogram` per named latency, aggregated
+    streamingly and snapshotted as records at flush cadence."""
+
+    def __init__(self, names: Sequence[str] = SLO_HISTOGRAMS):
+        self._hists: Dict[str, LatencyHistogram] = {
+            n: LatencyHistogram() for n in names}
+
+    def observe(self, name: str, value: float, n: int = 1) -> None:
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = LatencyHistogram()
+        h.observe(value, n=n)
+
+    def hist(self, name: str) -> LatencyHistogram:
+        return self._hists[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._hists)
+
+    def records(self, step: Optional[int] = None,
+                t: Optional[float] = None) -> List[dict]:
+        """Snapshot records for every NON-EMPTY histogram (a training
+        run with no serving engine attached emits nothing)."""
+        return [self._hists[n].to_record(n, step=step, t=t)
+                for n in sorted(self._hists)
+                if self._hists[n].count > 0]
+
+    def merge(self, other: "HistogramSet") -> "HistogramSet":
+        for n, h in other._hists.items():
+            if n in self._hists:
+                self._hists[n].merge(h)
+            else:
+                mine = self._hists[n] = LatencyHistogram(h.bounds)
+                mine.merge(h)
+        return self

@@ -1,0 +1,243 @@
+"""Request-level lifecycle traces for the serving path.
+
+Every request the engine ever sees gets ONE trace: enqueue ->
+admit/alias/COW -> prefill-or-extend -> each decode window it was live
+in (with per-window token and speculation-accept counts) -> a typed
+verdict.  The trace is assembled PURELY from host-side facts the
+engine already holds at window boundaries — the submit stamp, the
+admission dispatch wall times, the per-slot counts of the one
+``device_get`` per window — so tracing adds ZERO device syncs (the
+``serving.traced_decode_step`` apexverify spec pins the traced window
+program unchanged: no transfer/callback primitives, same donation
+arity).
+
+Each terminal verdict emits one ``kind:"reqtrace"`` JSONL record
+carrying the full event list plus the derived latencies (TTFT, e2e,
+queue wait), and observes those latencies into the shared
+:class:`~apex_tpu.telemetry.hist.HistogramSet` — the streaming SLO
+histograms the live ``/metrics`` endpoint renders.  Failover
+continuity: the ORIGINAL enqueue stamp rides the replica queue ledger
+(``Request.enqueued_t``), so a re-admitted request's trace on the
+claimant starts at the dead host's submit time and the merged
+timeline renders one request lane spanning both hosts under the
+failover's incident id.  An engine closing with traces still open
+drains them as partial (``"open": true``) records — the dead host's
+shard of that cross-host lane.
+
+Stdlib-only: ``timeline``/``summarize`` consume these records on a
+login host with no jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional
+
+from apex_tpu.telemetry.hist import HistogramSet
+
+# mirrors apex_tpu.serving.admission's verdict constants — duplicated
+# as strings so this module (and the stdlib-only timeline/summarize
+# consumers above it) never imports the serving package
+COMPLETED = "completed"
+TERMINAL_VERDICTS = ("completed", "shed", "evicted", "drained",
+                     "failed")
+
+
+def _now(t: Optional[float]) -> float:
+    return time.time() if t is None else float(t)
+
+
+class RequestTracer:
+    """Per-replica trace assembly (module docstring).  One open trace
+    per in-flight request id; a verdict closes it into a record.
+
+    The engine drives it from exactly the places it already does host
+    bookkeeping: ``submit`` -> :meth:`enqueue`, slot placement ->
+    :meth:`admit`, the prefix-hit/COW admission -> :meth:`note`, the
+    window read-back -> :meth:`decode_window`, every verdict path ->
+    :meth:`verdict` (hooked once, in ``_note_terminal``, so a new
+    verdict path cannot forget to close its traces)."""
+
+    def __init__(self, host: Optional[int] = None, keep: int = 4096):
+        self.host = host
+        self.slo = HistogramSet()
+        self._open: Dict[str, dict] = {}
+        # terminal records, bounded like the engine's results ledger —
+        # a long-lived server must not hold every trace it ever closed
+        self.records: collections.deque = collections.deque(maxlen=keep)
+
+    # ---- lifecycle events ------------------------------------------------
+    def enqueue(self, rid: str, t: Optional[float] = None,
+                window: int = 0,
+                readmitted_from: Optional[int] = None) -> None:
+        """Open the trace at submit time.  For a failover re-admission
+        ``t`` is the ORIGINAL enqueue stamp off the queue ledger — the
+        lane starts on the dead host's clock, not the claimant's."""
+        t = _now(t)
+        tr = {"id": rid, "enqueue_t": t, "events": []}
+        if readmitted_from is not None:
+            tr["readmitted_from"] = int(readmitted_from)
+        self._open[rid] = tr
+        ev = {"phase": "enqueue", "t": round(t, 6), "step": int(window)}
+        if readmitted_from is not None:
+            ev["readmitted_from"] = int(readmitted_from)
+        tr["events"].append(ev)
+
+    def note(self, rid: str, phase: str, window: int = 0,
+             t: Optional[float] = None, **fields) -> None:
+        """Append one free-form lifecycle event (``prefix_hit`` with
+        its COW flag, ``replay`` after an arena rebuild, ...)."""
+        tr = self._open.get(rid)
+        if tr is None:
+            return
+        tr["events"].append({"phase": phase, "t": round(_now(t), 6),
+                             "step": int(window), **fields})
+
+    def admit(self, rid: str, window: int, slot: int, mode: str,
+              queue_ms: float, t: Optional[float] = None) -> None:
+        """Admission complete: the request holds a slot and its FIRST
+        token exists (prefill/extend sampled it) — ``t`` is therefore
+        the TTFT point.  ``queue_ms`` is enqueue -> dispatch start
+        (wait only, prefill excluded); ``mode`` names the path
+        (``prefill`` / ``extend`` / ``batched``)."""
+        tr = self._open.get(rid)
+        if tr is None:
+            return
+        t = _now(t)
+        tr["admit_t"] = t
+        tr["queue_ms"] = round(max(0.0, float(queue_ms)), 3)
+        tr["events"].append({
+            "phase": "admit", "t": round(t, 6), "step": int(window),
+            "slot": int(slot), "mode": mode,
+            "queue_ms": tr["queue_ms"]})
+
+    def decode_window(self, rid: str, window: int, tokens: int,
+                      drafted: int = 0, accepted: int = 0,
+                      t: Optional[float] = None) -> None:
+        """One event per decode window the request was LIVE in —
+        emitted token count and speculation tallies off the window's
+        single read-back, zero extra syncs."""
+        tr = self._open.get(rid)
+        if tr is None:
+            return
+        ev = {"phase": "decode_window", "t": round(_now(t), 6),
+              "step": int(window), "tokens": int(tokens)}
+        if drafted or accepted:
+            ev["drafted"] = int(drafted)
+            ev["accepted"] = int(accepted)
+        tr["events"].append(ev)
+
+    # ---- closure ---------------------------------------------------------
+    def verdict(self, rid: str, verdict: str, window: int = 0,
+                reason: str = "", incident_id: Optional[str] = None,
+                readmitted_from: Optional[int] = None,
+                n_tokens: int = 0,
+                t: Optional[float] = None) -> dict:
+        """Close the trace into its terminal record: derive the
+        latencies, observe them into the SLO histograms, return the
+        ``kind:"reqtrace"`` record for the caller to flush.  A verdict
+        for an id with no open trace still returns a record — its
+        missing ``enqueue`` is a GAP :func:`trace_gaps` reports, never
+        a silent drop."""
+        t = _now(t)
+        tr = self._open.pop(rid, None) or {"id": rid, "events": []}
+        ev = {"phase": "verdict", "t": round(t, 6),
+              "step": int(window), "verdict": verdict}
+        if reason:
+            ev["reason"] = reason
+        tr["events"].append(ev)
+        rec = {"kind": "reqtrace", "id": rid, "step": int(window),
+               "t": round(t, 3), "verdict": verdict,
+               "tokens": int(n_tokens), "events": tr["events"]}
+        if reason:
+            rec["reason"] = reason
+        if incident_id is not None:
+            rec["incident_id"] = incident_id
+        if readmitted_from is None:
+            readmitted_from = tr.get("readmitted_from")
+        if readmitted_from is not None:
+            rec["readmitted_from"] = int(readmitted_from)
+        if self.host is not None:
+            rec["host"] = int(self.host)
+        enq = tr.get("enqueue_t")
+        if enq is not None:
+            rec["enqueue_t"] = round(float(enq), 6)
+            rec["e2e_ms"] = round(max(0.0, (t - enq) * 1e3), 3)
+            self.slo.observe("serving/e2e_ms", rec["e2e_ms"])
+        adm_t = tr.get("admit_t")
+        if adm_t is not None and enq is not None:
+            rec["ttft_ms"] = round(max(0.0, (adm_t - enq) * 1e3), 3)
+            rec["queue_ms"] = tr.get("queue_ms", 0.0)
+            self.slo.observe("serving/ttft_ms", rec["ttft_ms"])
+            self.slo.observe("serving/queue_ms", rec["queue_ms"])
+        self.records.append(rec)
+        return rec
+
+    def drain_open(self, window: int = 0) -> List[dict]:
+        """Engine teardown with traces still open (a replica dying
+        mid-queue): emit each as a PARTIAL record — no verdict, marked
+        ``"open"`` — so the claimant's terminal trace for the same id
+        can complete the cross-host lane in the merged timeline."""
+        out = []
+        for rid in sorted(self._open):
+            tr = self._open.pop(rid)
+            rec = {"kind": "reqtrace", "id": rid, "open": True,
+                   "step": int(window), "events": tr["events"]}
+            if tr.get("enqueue_t") is not None:
+                rec["enqueue_t"] = round(float(tr["enqueue_t"]), 6)
+                rec["t"] = round(float(tr["enqueue_t"]), 3)
+            if self.host is not None:
+                rec["host"] = int(self.host)
+            out.append(rec)
+        return out
+
+    def open_ids(self) -> List[str]:
+        return sorted(self._open)
+
+    def hist_records(self, step: Optional[int] = None) -> List[dict]:
+        """The SLO histograms' cumulative snapshots — ride the same
+        flush as the trace records."""
+        return self.slo.records(step=step)
+
+
+def trace_gaps(rec: dict) -> List[str]:
+    """Validate one terminal trace record's completeness; returns the
+    list of gaps (empty == gap-free).  The chaos-matrix contract: a
+    request with a verdict has an unbroken lifecycle — an enqueue
+    first, monotone timestamps, strictly increasing decode windows,
+    an admission whenever tokens were produced, and the verdict
+    last."""
+    gaps: List[str] = []
+    evs = rec.get("events") or []
+    phases = [e.get("phase") for e in evs]
+    if not phases or phases[0] != "enqueue":
+        gaps.append("missing enqueue")
+    if phases.count("enqueue") > 1:
+        gaps.append("duplicate enqueue")
+    verdict = rec.get("verdict")
+    if verdict is None:
+        gaps.append("missing verdict")
+    else:
+        if verdict not in TERMINAL_VERDICTS:
+            gaps.append(f"unknown verdict {verdict!r}")
+        if not phases or phases[-1] != "verdict":
+            gaps.append("verdict not last")
+        if phases.count("verdict") > 1:
+            gaps.append("duplicate verdict")
+    ts = [e.get("t") for e in evs
+          if isinstance(e.get("t"), (int, float))]
+    if any(b < a - 1e-6 for a, b in zip(ts, ts[1:])):
+        gaps.append("non-monotone timestamps")
+    wins = [e.get("step") for e in evs
+            if e.get("phase") == "decode_window"]
+    if any(b <= a for a, b in zip(wins, wins[1:])):
+        gaps.append("decode windows not increasing")
+    admitted = "admit" in phases
+    if wins and not admitted:
+        gaps.append("decode window without admit")
+    if verdict == COMPLETED and not admitted:
+        gaps.append("completed without admit")
+    if int(rec.get("tokens", 0)) > 0 and not admitted:
+        gaps.append("tokens without admit")
+    return gaps

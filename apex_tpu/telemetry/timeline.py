@@ -35,6 +35,15 @@ Merging rules (stdlib only — runs on a login host with no jax):
 so host-side incidents load into Perfetto NEXT TO the PR-8 device
 captures — step time collapse and the beacon gap that caused it on
 one screen.
+
+Request lanes: ``kind:"reqtrace"`` records (one per request verdict,
+plus ``"open"`` partials from a replica that died mid-flight) group by
+request id into lanes.  Nested lifecycle stamps get the SAME per-host
+clock-offset correction as top-level ``t``, and the chrome trace
+renders each lane as one ASYNC span (``ph:"b"/"n"/"e"`` joined by
+``cat`` + ``id``) — Perfetto joins the phases across process (host)
+boundaries, so a request re-admitted after failover renders as ONE
+lane spanning two hosts under the failover's incident id.
 """
 
 from __future__ import annotations
@@ -179,6 +188,21 @@ def merge_run_dirs(paths: Sequence[str]) -> Optional[dict]:
                 est = _interp_wall(clock, int(rec["step"]))
                 if est is not None:
                     rec["t"] = round(est - off, 3)
+            if kind == "reqtrace" and off:
+                # the nested lifecycle stamps get the same correction
+                # as top-level t — a cross-host request lane must not
+                # jitter by clock skew (copied: loaded records may be
+                # shared with another consumer)
+                if isinstance(rec.get("enqueue_t"), (int, float)):
+                    rec["enqueue_t"] = round(
+                        float(rec["enqueue_t"]) - off, 6)
+                fixed = []
+                for e in (rec.get("events") or []):
+                    e = dict(e)
+                    if isinstance(e.get("t"), (int, float)):
+                        e["t"] = round(float(e["t"]) - off, 6)
+                    fixed.append(e)
+                rec["events"] = fixed
             rec["_seq"] = idx            # stable within-host order
             merged.append(rec)
     steps = [steps_by_key[k] for k in sorted(steps_by_key,
@@ -206,10 +230,58 @@ def _event_label(rec: dict) -> str:
     return f"{kind}:{rec.get('event', rec.get('action', '?'))}"
 
 
+def request_lanes(records: Sequence[dict]) -> List[dict]:
+    """Group ``kind:"reqtrace"`` records into per-request LANES.  A
+    request that crossed a failover contributes one partial (open)
+    segment from the dead host and one terminal segment from the
+    claimant — same id, so they land in one lane whose ``hosts`` spans
+    both.  The newest terminal segment supplies the verdict fields."""
+    lanes: Dict[str, dict] = {}
+    order: List[str] = []
+    for r in records:
+        if r.get("kind") != "reqtrace" or r.get("id") is None:
+            continue
+        rid = str(r["id"])
+        lane = lanes.get(rid)
+        if lane is None:
+            lane = lanes[rid] = {"id": rid, "hosts": set(),
+                                 "segments": []}
+            order.append(rid)
+        lane["segments"].append(r)
+        if r.get("host") is not None:
+            lane["hosts"].add(int(r["host"]))
+    out: List[dict] = []
+    for rid in order:
+        lane = lanes[rid]
+        lane["hosts"] = sorted(lane["hosts"])
+        ts = [e["t"] for seg in lane["segments"]
+              for e in (seg.get("events") or [])
+              if isinstance(e.get("t"), (int, float))]
+        ts += [seg["enqueue_t"] for seg in lane["segments"]
+               if isinstance(seg.get("enqueue_t"), (int, float))]
+        lane["t_start"] = round(min(ts), 6) if ts else None
+        lane["t_end"] = round(max(ts), 6) if ts else None
+        term = None
+        for seg in lane["segments"]:     # ordered: newest wins
+            if seg.get("verdict") is not None:
+                term = seg
+        if term is not None:
+            lane["verdict"] = term["verdict"]
+            lane["verdict_host"] = term.get("host")
+            for k in ("reason", "incident_id", "readmitted_from",
+                      "ttft_ms", "e2e_ms", "queue_ms", "tokens"):
+                if term.get(k) is not None:
+                    lane[k] = term[k]
+        else:
+            lane["open"] = True
+        out.append(lane)
+    return out
+
+
 def build(paths: Sequence[str]) -> Optional[dict]:
-    """The timeline document: the merge plus incident grouping.
-    ``incidents`` is ordered by first appearance; events carrying no
-    incident id land in ``ungrouped``."""
+    """The timeline document: the merge plus incident grouping plus
+    request lanes.  ``incidents`` is ordered by first appearance;
+    events carrying no incident id land in ``ungrouped``."""
     merged = merge_run_dirs(paths)
     if merged is None:
         return None
@@ -243,7 +315,8 @@ def build(paths: Sequence[str]) -> Optional[dict]:
             "offsets": merged["offsets"],
             "n_steps": len(merged["steps"]),
             "incidents": list(incidents.values()),
-            "ungrouped": ungrouped}
+            "ungrouped": ungrouped,
+            "requests": request_lanes(merged["records"])}
 
 
 # ---------------------------------------------------------------------
@@ -271,7 +344,8 @@ def render_text(doc: dict, out) -> None:
     if nontrivial:
         print(f"clock offsets vs host {doc['hosts'][0]} (s): "
               f"{nontrivial}", file=out)
-    if not doc["incidents"] and not doc["ungrouped"]:
+    if not doc["incidents"] and not doc["ungrouped"] \
+            and not doc.get("requests"):
         print("no incidents, no events — a quiet run", file=out)
         return
     for inc in doc["incidents"]:
@@ -286,16 +360,34 @@ def render_text(doc: dict, out) -> None:
         print("\nevents outside any incident:", file=out)
         _render_table(["step", "host", "event", "detail"],
                       [_row(r) for r in doc["ungrouped"]], out)
+    if doc.get("requests"):
+        print(f"\nrequest lanes ({len(doc['requests'])}):", file=out)
+        rows = []
+        for lane in doc["requests"]:
+            rows.append([
+                lane["id"],
+                ",".join(str(h) for h in lane["hosts"]) or "-",
+                lane.get("verdict", "OPEN"),
+                _fmt(lane.get("ttft_ms")),
+                _fmt(lane.get("e2e_ms")),
+                _fmt(lane.get("tokens")),
+                lane.get("incident_id") or "-"])
+        _render_table(["request", "hosts", "verdict", "ttft_ms",
+                       "e2e_ms", "tokens", "incident"], rows, out)
 
 
 def chrome_trace(doc: dict) -> dict:
     """The merged timeline as a Chrome trace document (one process
     per host, an ``X`` span per incident per host, an instant per
-    event) — loads in Perfetto/chrome://tracing next to the PR-8
-    device captures."""
+    event, an ASYNC ``b``/``n``/``e`` lane per request id) — loads in
+    Perfetto/chrome://tracing next to the PR-8 device captures.
+    Async phases join on ``(cat, id)`` ACROSS processes, which is how
+    a failover re-admission renders as one lane spanning two hosts."""
     stamps = [r["t"] for inc in doc["incidents"]
               for r in inc["events"] if "t" in r]
     stamps += [r["t"] for r in doc["ungrouped"] if "t" in r]
+    stamps += [lane["t_start"] for lane in doc.get("requests", [])
+               if lane.get("t_start") is not None]
     t0 = min(stamps) if stamps else 0.0
 
     def ts(rec: dict) -> float:
@@ -336,4 +428,42 @@ def chrome_trace(doc: dict) -> dict:
             "ts": ts(r),
             "args": {k: v for k, v in r.items()
                      if k not in ("kind", "host")}})
+    for lane in doc.get("requests", []):
+        if lane.get("t_start") is None:
+            continue
+        rid = lane["id"]
+        segs = lane["segments"]
+        start_pid = segs[0].get("host", 0)
+        end_pid = lane.get("verdict_host")
+        if end_pid is None:
+            end_pid = segs[-1].get("host", 0)
+        name = f"req {rid}"
+        args = {k: lane[k] for k in ("verdict", "reason",
+                                     "incident_id", "readmitted_from",
+                                     "ttft_ms", "e2e_ms", "tokens")
+                if lane.get(k) is not None}
+        events.append({"name": name, "ph": "b", "cat": "request",
+                       "id": rid, "pid": start_pid, "tid": 0,
+                       "ts": (lane["t_start"] - t0) * 1e6,
+                       "args": args})
+        for seg in segs:
+            for e in (seg.get("events") or []):
+                phase = e.get("phase")
+                # instants for the notable lifecycle points (admit,
+                # COW/prefix hit, replay, verdict — the per-window
+                # decode events stay in the record, not the render)
+                if phase not in ("admit", "prefix_hit", "replay",
+                                 "verdict"):
+                    continue
+                if not isinstance(e.get("t"), (int, float)):
+                    continue
+                events.append({
+                    "name": phase, "ph": "n", "cat": "request",
+                    "id": rid, "pid": seg.get("host", 0), "tid": 0,
+                    "ts": (e["t"] - t0) * 1e6,
+                    "args": {k: v for k, v in e.items()
+                             if k != "t"}})
+        events.append({"name": name, "ph": "e", "cat": "request",
+                       "id": rid, "pid": end_pid, "tid": 0,
+                       "ts": (lane["t_end"] - t0) * 1e6, "args": {}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -10,7 +10,13 @@ point:
 - ``--port PORT`` serves LIVE ``/metrics`` (Prometheus text) +
   ``/healthz`` while requests decode — scrape it mid-run and watch
   ``apex_tpu_serving_*`` gauges (queue depth, tokens/sec, p50/p99
-  token latency, evictions) move;
+  token latency, evictions) move, plus the SLO histograms
+  (``apex_tpu_serving_ttft_ms_bucket`` et al.);
+- ``--trace-dir DIR`` records per-request lifecycle traces (enqueue
+  -> admit -> decode windows -> typed verdict) and prints an SLO
+  quantile summary; the dir doubles as the telemetry run dir when
+  ``--telemetry-dir`` is absent, so ``python -m apex_tpu.telemetry
+  summarize DIR`` renders the per-run SLO table afterwards;
 - ``--inject-hung-decode-at W`` wedges the decode dispatch of serve
   window W: the deadline-armed runner converts the hang into a typed
   ``DecodeDeadlineExceeded``, the engine evicts ONLY the suspect
@@ -47,10 +53,15 @@ def parse_args(argv=None):
                    help="record serving telemetry (events + counters) "
                         "under this directory; inspect with "
                         "`python -m apex_tpu.telemetry timeline DIR`")
+    p.add_argument("--trace-dir", default=None,
+                   help="record request-level traces: dumps "
+                        "reqtrace.jsonl + prints the SLO quantile "
+                        "summary; doubles as the telemetry run dir "
+                        "when --telemetry-dir is absent")
     p.add_argument("--port", type=int, default=None, metavar="PORT",
                    help="serve live /metrics + /healthz on this port "
                         "while decoding (0 = ephemeral; needs "
-                        "--telemetry-dir)")
+                        "--telemetry-dir or --trace-dir)")
     p.add_argument("--inject-hung-decode-at", type=int, default=None,
                    metavar="W",
                    help="chaos: wedge the decode dispatch of serve "
@@ -115,15 +126,18 @@ def main(argv=None):
                                 max_seq=64, eos_token=1)
     params = serving.init_params(jax.random.key(0), cfg)
 
-    tel = telemetry.Telemetry(args.telemetry_dir, window=8,
-                              retrace=False) \
-        if args.telemetry_dir else None
+    # --trace-dir doubles as the telemetry run dir so a single flag
+    # gets traces on disk AND the reqtrace/hist records riding the
+    # telemetry JSONL for `telemetry summarize` / `timeline`
+    tel_dir = args.telemetry_dir or args.trace_dir
+    tel = telemetry.Telemetry(tel_dir, window=8, retrace=False) \
+        if tel_dir else None
     metrics_srv = None
     if args.port is not None:
         if tel is None:
-            raise SystemExit("--port needs --telemetry-dir (the "
-                             "exporter republishes the telemetry "
-                             "session's flushes)")
+            raise SystemExit("--port needs --telemetry-dir or "
+                             "--trace-dir (the exporter republishes "
+                             "the telemetry session's flushes)")
         metrics_srv = telemetry.MetricsServer(telemetry=tel,
                                               port=args.port)
         print(f"serving live metrics at {metrics_srv.url}/metrics")
@@ -214,13 +228,33 @@ def main(argv=None):
               f"{eng._kv_bytes_saved} KV bytes saved")
 
     eng.close()
+    if args.trace_dir and eng.tracer is not None:
+        import json
+        os.makedirs(args.trace_dir, exist_ok=True)
+        path = os.path.join(args.trace_dir, "reqtrace.jsonl")
+        with open(path, "w") as f:
+            for rec in eng.tracer.records:
+                f.write(json.dumps(rec) + "\n")
+        print(f"request traces written to {path}")
+
+        def q(name, p):
+            return eng.tracer.slo.hist(name).quantile(p)
+        print("SLO summary (histogram quantiles, ms):")
+        for name in ("serving/ttft_ms", "serving/e2e_ms",
+                     "serving/intertoken_ms", "serving/queue_ms"):
+            h = eng.tracer.slo.hist(name)
+            if h.count:
+                short = name.rsplit("/", 1)[-1]
+                print(f"  {short:>14}: n={h.count:<4d} "
+                      f"p50={q(name, 0.5):9.3f} "
+                      f"p99={q(name, 0.99):9.3f}")
     if tel is not None:
         tel.close()                  # also stops the metrics server
         if metrics_srv is not None:
             metrics_srv.close()      # idempotent
-        print(f"telemetry written to {args.telemetry_dir} — inspect "
+        print(f"telemetry written to {tel_dir} — inspect "
               f"with: python -m apex_tpu.telemetry timeline "
-              f"{args.telemetry_dir}")
+              f"{tel_dir}")
 
     completed = counts.get(serving.COMPLETED, 0)
     assert completed >= args.requests - 1, counts

@@ -79,7 +79,10 @@ SUITES = {
     # the serving path: paged KV arena, AOT prefill/decode programs,
     # the continuous-batching engine and its chaos matrix (hung
     # decode, shed, drain, replica failover)
-    "run_serving": ["tests/test_serving.py"],
+    "run_serving": ["tests/test_serving.py",
+                    # request-level lifecycle traces + SLO histograms
+                    # (gap-free under chaos, cross-host failover lanes)
+                    "tests/test_reqtrace.py"],
     # run-time training telemetry (metric ring, emitters, spans,
     # retrace counter) + the pyprof nvtx/prof satellites + the live
     # /metrics exporter

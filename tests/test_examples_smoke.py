@@ -462,6 +462,76 @@ def test_serve_gpt_speculative_int8_weights_gauges_live(
     assert last.get("apex_tpu_serving_spec_accepted", 0) >= 0
 
 
+def test_serve_gpt_trace_dir_slo_histograms_live(tmp_path, capsys):
+    """The observability acceptance flow: --trace-dir records request
+    lifecycle traces while --port serves live metrics.  A MID-RUN
+    scrape must carry the Prometheus SLO histograms
+    (``apex_tpu_serving_ttft_ms_bucket``), the dumped reqtrace.jsonl
+    must be gap-free for every request, and ``telemetry summarize``
+    renders the per-run SLO table off the same dir."""
+    import json as _json
+    import os
+    import socket
+    import threading
+    import urllib.request
+
+    trace = str(tmp_path / "trace")
+    with socket.socket() as s:                # pick a free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    bodies, stop = [], threading.Event()
+
+    def scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1) as r:
+                    bodies.append(r.read().decode())
+            except OSError:
+                pass                          # server not up/gone yet
+            stop.wait(0.005)
+
+    t = threading.Thread(target=scrape, daemon=True)
+    t.start()
+    try:
+        _run("examples/gpt/serve.py",
+             ["--requests", "4", "--max-new-tokens", "8",
+              "--trace-dir", trace, "--port", str(port)])
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    out = capsys.readouterr().out
+    assert "request traces written to" in out
+    assert "SLO summary" in out
+    assert "OK:" in out
+    assert len(bodies) > 2                    # genuinely scraped live
+    # a MID-RUN scrape carries the Prometheus SLO histograms — the
+    # third metric class next to gauges and counters
+    mid = [b for b in bodies
+           if "apex_tpu_serving_ttft_ms_bucket" in b]
+    assert mid, "no scrape saw the SLO histograms"
+    last = mid[-1]
+    assert "# TYPE apex_tpu_serving_ttft_ms histogram" in last
+    assert 'apex_tpu_serving_ttft_ms_bucket{le="+Inf"}' in last
+    assert "apex_tpu_serving_ttft_ms_sum" in last
+    assert "apex_tpu_serving_ttft_ms_count" in last
+    # the dumped trace file is gap-free for every request
+    from apex_tpu.telemetry import trace_gaps
+    with open(os.path.join(trace, "reqtrace.jsonl")) as f:
+        recs = [_json.loads(line) for line in f]
+    assert len(recs) == 4
+    for rec in recs:
+        assert rec["verdict"] == "completed"
+        assert trace_gaps(rec) == [], rec
+    # ...and the SLO table renders off the same dir
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    assert telemetry_cli(["summarize", trace]) == 0
+    summary = capsys.readouterr().out
+    assert "serving SLO:" in summary
+    assert "ttft_ms" in summary
+
+
 def test_imagenet_preempt_and_resume(tmp_path, capsys):
     """The imagenet example's save path rides the same resilience
     manager: --checkpoint-dir rotates bucket-native checkpoints and a

@@ -1,0 +1,454 @@
+"""Request-level tracing + SLO histograms (docs/observability.md).
+
+The observability contract under test:
+
+- fixed log-scale histogram quantiles are within one bucket width of
+  the exact order statistic, and merges are associative/commutative
+  (N replicas fold in any order);
+- every request the engine verdicts has a GAP-FREE lifecycle trace
+  (enqueue -> admit -> decode windows -> verdict), under chaos too;
+- a failover re-admission's trace rides the replica queue ledger:
+  the merged timeline renders ONE request lane spanning both hosts
+  under the failover's incident id;
+- the live ``/metrics`` endpoint renders the histograms in the
+  Prometheus exposition format, and ``telemetry summarize`` renders
+  the per-run SLO table;
+- tracing is free: the traced engine emits a bit-exact token stream
+  at ~1.0x the untraced wall time (kernel_bench ``reqtrace_overhead``).
+"""
+
+import json
+import os
+import random
+import time
+
+import jax
+import pytest
+
+from apex_tpu import serving
+from apex_tpu.resilience import fleet as fleet_mod
+from apex_tpu.resilience.faults import FaultInjector, FaultSpec
+from apex_tpu.serving import admission as adm
+from apex_tpu.telemetry.hist import (DEFAULT_BOUNDS_MS, HistogramSet,
+                                     LatencyHistogram, merge_records,
+                                     prometheus_histogram_lines)
+from apex_tpu.telemetry.reqtrace import RequestTracer, trace_gaps
+
+CFG = serving.DecoderConfig(vocab_size=64, hidden=16, n_layers=2,
+                            n_heads=2, n_kv_heads=2, ffn=32,
+                            max_seq=32, eos_token=1)
+PARAMS = serving.init_params(jax.random.key(0), CFG)
+
+
+def make_engine(multi_replica=False, **kw):
+    """Same tiny geometry as test_serving (shared compile cache)."""
+    kw.setdefault("page_size", 4)
+    kw.setdefault("n_pages", 16)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("pages_per_slot", 4)
+    kw.setdefault("window", 4)
+    kw.setdefault("prefill_buckets", [4, 8])
+    replica = None
+    cleanup = []
+    if multi_replica:
+        channel = fleet_mod.LocalChannel()
+        mon = fleet_mod.FleetMonitor(
+            channel=channel, host=0, n_hosts=2,
+            slow_after_steps=2, dead_after_steps=4,
+            slow_after_s=None, dead_after_s=None,
+            agreement_timeout_s=0.2)
+        sim = fleet_mod.SimulatedPeers(channel, hosts=[1]).attach(mon)
+        replica = serving.ReplicaSet(mon).attach_simulation(sim)
+        replica._channel_for_test = channel
+        cleanup.append(mon.close)
+    eng = serving.Engine(PARAMS, CFG, replica=replica, **kw)
+    eng._cleanup_for_test = cleanup
+    return eng
+
+
+def close_engine(eng):
+    eng.close()
+    for fn in getattr(eng, "_cleanup_for_test", []):
+        fn()
+
+
+def _write_run(dirpath, host, records):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "telemetry.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "schema", "version": 2,
+                            "host": host}) + "\n")
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# histograms: bounded-error quantiles, associative merges, exposition
+# ---------------------------------------------------------------------------
+
+def test_hist_quantile_within_one_bucket_width():
+    rng = random.Random(0)
+    vals = [rng.lognormvariate(3.0, 1.5) for _ in range(500)]
+    h = LatencyHistogram()
+    for v in vals:
+        h.observe(v)
+    ordered = sorted(vals)
+    for q in (0.5, 0.9, 0.99):
+        exact = ordered[max(0, int(q * len(vals)) - 1)]
+        est = h.quantile(q)
+        width = h.bucket_width(exact)
+        assert abs(est - exact) <= width + 1e-9, (q, est, exact, width)
+
+
+def test_hist_quantile_edge_cases():
+    h = LatencyHistogram()
+    assert h.quantile(0.5) == 0.0          # empty: never fabricates
+    h.observe(1e9)                         # past the scheme's range
+    assert h.quantile(0.99) == DEFAULT_BOUNDS_MS[-1]   # clamps, floor
+
+
+def test_hist_merge_associative_and_commutative():
+    rng = random.Random(1)
+    parts = []
+    for _ in range(3):
+        h = LatencyHistogram()
+        for _ in range(50):
+            h.observe(rng.uniform(0.1, 5000.0))
+        parts.append(h.to_record("serving/e2e_ms"))
+
+    def fold(order):
+        return merge_records([parts[i] for i in order])
+
+    a, b = fold([0, 1, 2]), fold([2, 0, 1])
+    assert a.counts == b.counts
+    assert a.count == b.count
+    assert abs(a.sum - b.sum) < 1e-6
+    # merging a fold-of-two with the third == folding all three
+    ab = merge_records(parts[:2]).merge(
+        LatencyHistogram.from_record(parts[2]))
+    assert ab.counts == a.counts
+    with pytest.raises(ValueError):
+        LatencyHistogram(bounds=(1.0, 2.0)).merge(LatencyHistogram())
+
+
+def test_hist_record_roundtrip():
+    h = LatencyHistogram()
+    for v in (0.3, 7.0, 120.0, 120.0):
+        h.observe(v)
+    rec = h.to_record("serving/ttft_ms", step=3)
+    assert rec["kind"] == "hist" and rec["step"] == 3
+    back = LatencyHistogram.from_record(rec)
+    assert back.counts == h.counts and back.count == 4
+    assert abs(back.sum - h.sum) < 1e-6
+
+
+def test_hist_prometheus_exposition_well_formed():
+    h = LatencyHistogram()
+    for v in (0.2, 3.0, 50.0):
+        h.observe(v)
+    lines = prometheus_histogram_lines(
+        "apex_tpu_serving_ttft_ms", h.to_record("serving/ttft_ms"))
+    assert lines[0] == "# TYPE apex_tpu_serving_ttft_ms histogram"
+    buckets = [ln for ln in lines if "_bucket{le=" in ln]
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert cums == sorted(cums)            # CUMULATIVE, monotone
+    assert buckets[-1].startswith(
+        'apex_tpu_serving_ttft_ms_bucket{le="+Inf"}')
+    assert cums[-1] == 3
+    assert any(ln.startswith("apex_tpu_serving_ttft_ms_sum ")
+               for ln in lines)
+    assert "apex_tpu_serving_ttft_ms_count 3" in lines
+
+
+def test_histogram_set_auto_names_and_nonempty_records():
+    hs = HistogramSet()
+    hs.observe("serving/ttft_ms", 12.0)
+    hs.observe("custom/lat_ms", 1.0)       # unknown name auto-creates
+    recs = hs.records(step=7)
+    names = {r["name"] for r in recs}
+    assert names == {"serving/ttft_ms", "custom/lat_ms"}  # empty skip
+    assert all(r["step"] == 7 for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# tracer: lifecycle assembly, gap detection, drain-open partials
+# ---------------------------------------------------------------------------
+
+def test_tracer_lifecycle_gap_free_and_latencies():
+    tr = RequestTracer(host=0)
+    tr.enqueue("r1", t=100.0)
+    tr.admit("r1", window=1, slot=0, mode="prefill",
+             queue_ms=500.0, t=100.5)
+    tr.decode_window("r1", 1, 2, t=100.6)
+    tr.decode_window("r1", 2, 2, drafted=2, accepted=1, t=100.7)
+    rec = tr.verdict("r1", "completed", window=2, n_tokens=5, t=100.8)
+    assert trace_gaps(rec) == []
+    assert rec["ttft_ms"] == pytest.approx(500.0)
+    assert rec["e2e_ms"] == pytest.approx(800.0)
+    assert rec["queue_ms"] == pytest.approx(500.0)
+    assert rec["host"] == 0 and rec["tokens"] == 5
+    spec_ev = [e for e in rec["events"] if e.get("drafted")]
+    assert spec_ev and spec_ev[0]["accepted"] == 1
+    # the latencies landed in the streaming SLO histograms
+    assert tr.slo.hist("serving/ttft_ms").count == 1
+    assert tr.slo.hist("serving/e2e_ms").count == 1
+    assert tr.slo.hist("serving/queue_ms").count == 1
+    assert tr.hist_records(step=2)
+    assert tr.open_ids() == []
+
+
+def test_trace_gaps_detects_broken_lifecycles():
+    tr = RequestTracer()
+    # verdict with no open trace: a record still comes back, gapped
+    rec = tr.verdict("ghost", "completed", n_tokens=3)
+    gaps = trace_gaps(rec)
+    assert "missing enqueue" in gaps
+    assert "completed without admit" in gaps
+    assert "tokens without admit" in gaps
+    assert trace_gaps({"id": "x", "verdict": "nope", "events": [
+        {"phase": "enqueue", "t": 1.0, "step": 0},
+        {"phase": "verdict", "t": 2.0, "step": 0}]}) \
+        == ["unknown verdict 'nope'"]
+    assert "non-monotone timestamps" in trace_gaps(
+        {"id": "x", "verdict": "completed", "events": [
+            {"phase": "enqueue", "t": 5.0, "step": 0},
+            {"phase": "admit", "t": 1.0, "step": 0},
+            {"phase": "verdict", "t": 6.0, "step": 0}]})
+    assert "decode windows not increasing" in trace_gaps(
+        {"id": "x", "verdict": "completed", "events": [
+            {"phase": "enqueue", "t": 1.0, "step": 0},
+            {"phase": "admit", "t": 2.0, "step": 1},
+            {"phase": "decode_window", "t": 3.0, "step": 2},
+            {"phase": "decode_window", "t": 4.0, "step": 2},
+            {"phase": "verdict", "t": 5.0, "step": 2}]})
+    assert "verdict not last" in trace_gaps(
+        {"id": "x", "verdict": "shed", "events": [
+            {"phase": "enqueue", "t": 1.0, "step": 0},
+            {"phase": "verdict", "t": 2.0, "step": 0},
+            {"phase": "admit", "t": 3.0, "step": 0}]})
+
+
+def test_tracer_drain_open_emits_partials():
+    tr = RequestTracer(host=1)
+    tr.enqueue("a", t=10.0)
+    tr.enqueue("b", t=11.0)
+    tr.admit("a", window=0, slot=0, mode="prefill",
+             queue_ms=1.0, t=10.1)
+    parts = tr.drain_open(window=3)
+    assert [p["id"] for p in parts] == ["a", "b"]
+    for p in parts:
+        assert p["open"] is True and p["host"] == 1
+        assert "verdict" not in p
+        assert p["events"][0]["phase"] == "enqueue"
+    assert tr.open_ids() == []
+    # partials carry NO latency observations (no verdict happened)
+    assert tr.slo.hist("serving/e2e_ms").count == 0
+
+
+# ---------------------------------------------------------------------------
+# timeline: request lanes, skew correction, cross-host failover
+# ---------------------------------------------------------------------------
+
+def test_request_lanes_cross_host_synthetic():
+    from apex_tpu.telemetry import timeline as tl
+    dead = RequestTracer(host=1)
+    dead.enqueue("req", t=50.0)
+    (partial,) = dead.drain_open(window=2)
+    claim = RequestTracer(host=0)
+    claim.enqueue("req", t=50.0, readmitted_from=1)
+    claim.admit("req", window=5, slot=0, mode="prefill",
+                queue_ms=2000.0, t=52.0)
+    claim.decode_window("req", 5, 3, t=52.1)
+    term = claim.verdict("req", "completed", window=6, n_tokens=3,
+                         incident_id="inc-001-host_dead-h1.1-e0",
+                         t=52.2)
+    (lane,) = tl.request_lanes([partial, term])
+    assert lane["hosts"] == [0, 1]          # ONE lane, both hosts
+    assert lane["verdict"] == "completed"
+    assert lane["verdict_host"] == 0
+    assert lane["incident_id"] == "inc-001-host_dead-h1.1-e0"
+    assert lane["readmitted_from"] == 1
+    assert lane["t_start"] == pytest.approx(50.0)
+    assert lane["t_end"] == pytest.approx(52.2)
+
+
+def test_merge_run_dirs_corrects_nested_trace_stamps(tmp_path):
+    from apex_tpu.telemetry import timeline as tl
+    clock0 = [{"kind": "clock", "step": 0, "wall_time": 100.0},
+              {"kind": "clock", "step": 10, "wall_time": 110.0}]
+    clock1 = [{"kind": "clock", "step": 0, "wall_time": 105.0},
+              {"kind": "clock", "step": 10, "wall_time": 115.0}]
+    rec1 = {"kind": "reqtrace", "id": "r", "step": 4, "t": 107.0,
+            "verdict": "completed", "tokens": 1, "host": 1,
+            "enqueue_t": 106.0, "events": [
+                {"phase": "enqueue", "t": 106.0, "step": 3},
+                {"phase": "admit", "t": 106.5, "step": 4},
+                {"phase": "verdict", "t": 107.0, "step": 4}]}
+    _write_run(str(tmp_path / "h0"), 0, clock0)
+    _write_run(str(tmp_path / "h1"), 1, clock1 + [rec1])
+    merged = tl.merge_run_dirs([str(tmp_path / "h0"),
+                                str(tmp_path / "h1")])
+    assert merged["offsets"]["1"] == pytest.approx(5.0)
+    (out,) = [r for r in merged["records"]
+              if r.get("kind") == "reqtrace"]
+    # host 1's clock runs 5s fast: every stamp — top-level, enqueue,
+    # and each NESTED lifecycle event — lands on the reference clock
+    assert out["t"] == pytest.approx(102.0)
+    assert out["enqueue_t"] == pytest.approx(101.0)
+    assert [e["t"] for e in out["events"]] == \
+        pytest.approx([101.0, 101.5, 102.0])
+    # the source record was not mutated by the correction
+    assert rec1["events"][0]["t"] == pytest.approx(106.0)
+
+
+# ---------------------------------------------------------------------------
+# the engine end-to-end: chaos traces, failover lane, /metrics, bench
+# ---------------------------------------------------------------------------
+
+def test_chaos_hung_decode_traces_gap_free():
+    eng = make_engine(decode_deadline_s=0.15)
+    inj = FaultInjector(
+        [FaultSpec("hung_decode", at_step=2, delay_s=0.5)]).install()
+    try:
+        eng.submit(serving.Request(id="healthy", prompt=[5, 6, 7],
+                                   max_new_tokens=10))
+        eng.step_window()
+        eng.submit(serving.Request(id="suspect", prompt=[9, 10],
+                                   max_new_tokens=10))
+        res = eng.serve()
+    finally:
+        inj.uninstall()
+    traces = {r["id"]: r for r in eng.tracer.records}
+    close_engine(eng)
+    # EVERY verdicted request has a gap-free trace — chaos included
+    assert set(traces) == set(res)
+    for rid, r in res.items():
+        rec = traces[rid]
+        assert rec["verdict"] == r.verdict
+        assert trace_gaps(rec) == [], (rid, trace_gaps(rec))
+    assert res["suspect"].verdict == adm.EVICTED
+    assert traces["suspect"]["reason"] == adm.REASON_HUNG_DECODE
+    assert traces["suspect"]["incident_id"] is not None
+    # decode windows were recorded off the window read-back
+    assert any(e["phase"] == "decode_window"
+               for e in traces["healthy"]["events"])
+    assert traces["healthy"]["ttft_ms"] >= 0
+    assert eng.tracer.hist_records()
+
+
+def test_failover_lane_spans_hosts_end_to_end(tmp_path):
+    """The cross-host request lane, for real: the dead replica's
+    queue ledger carries the ORIGINAL enqueue stamp, the claimant
+    re-admits and completes, and the merged two-dir timeline renders
+    one lane spanning both hosts under the failover incident id."""
+    from apex_tpu.telemetry import timeline as tl
+    t_orig = round(time.time() - 5.0, 6)
+    eng = make_engine(multi_replica=True)
+    eng.replica._channel_for_test.put(
+        "serving_queue/1",
+        {"host": 1, "requests": [
+            {"id": "peer-a", "prompt": [7, 8], "max_new_tokens": 4,
+             "enqueued_t": t_orig}]})
+    inj = FaultInjector(
+        [FaultSpec("replica_death", at_step=2, target=1)]).install()
+    try:
+        eng.submit(serving.Request(id="mine", prompt=[5],
+                                   max_new_tokens=8))
+        res = eng.serve(min_windows=12)
+    finally:
+        inj.uninstall()
+    claimant_recs = list(eng.tracer.records)
+    close_engine(eng)
+    assert res["peer-a"].verdict == adm.COMPLETED
+    term = {r["id"]: r for r in claimant_recs}["peer-a"]
+    # the ledger stamp survived re-admission: the claimant's trace
+    # starts at the DEAD host's submit time
+    assert term["enqueue_t"] == pytest.approx(t_orig, abs=1e-3)
+    assert term["readmitted_from"] == 1
+    assert term["incident_id"] == "inc-001-host_dead-h1.1-e0"
+    assert trace_gaps(term) == []
+
+    # the dead host's shard: its engine died with the trace open
+    dead = RequestTracer(host=1)
+    dead.enqueue("peer-a", t=t_orig)
+    dead_parts = dead.drain_open(window=1)
+    _write_run(str(tmp_path / "h1"), 1, dead_parts)
+    _write_run(str(tmp_path / "h0"), 0, claimant_recs)
+    doc = tl.build([str(tmp_path / "h0"), str(tmp_path / "h1")])
+    (lane,) = [ln for ln in doc["requests"] if ln["id"] == "peer-a"]
+    assert lane["hosts"] == [0, 1]
+    assert lane["verdict"] == "completed"
+    assert lane["verdict_host"] == 0
+    assert lane["incident_id"] == "inc-001-host_dead-h1.1-e0"
+    # ...and the chrome trace opens the async lane on the dead host's
+    # pid and closes it on the claimant's
+    events = tl.chrome_trace(doc)["traceEvents"]
+    req = [e for e in events if e.get("cat") == "request"
+           and e.get("id") == "peer-a"]
+    phases = {e["ph"]: e for e in req}
+    assert set(phases) == {"b", "n", "e"}
+    assert phases["b"]["pid"] == 1 and phases["e"]["pid"] == 0
+
+
+def test_metrics_server_renders_histograms_and_trace_counters():
+    from apex_tpu.telemetry.export import MetricsServer
+    h = LatencyHistogram()
+    for v in (1.0, 8.0, 300.0):
+        h.observe(v)
+    tr = RequestTracer(host=0)
+    tr.enqueue("q", t=1.0)
+    tr.admit("q", window=0, slot=0, mode="prefill",
+             queue_ms=0.5, t=1.01)
+    rec = tr.verdict("q", "completed", n_tokens=2, t=1.05)
+    srv = MetricsServer(port=0)
+    try:
+        srv.emit([h.to_record("serving/ttft_ms", step=1), rec])
+        # a NEWER cumulative snapshot replaces, never double-counts
+        h.observe(9000.0)
+        srv.emit([h.to_record("serving/ttft_ms", step=2)])
+        body = srv.render()
+    finally:
+        srv.close()
+    assert "# TYPE apex_tpu_serving_ttft_ms histogram" in body
+    assert 'apex_tpu_serving_ttft_ms_bucket{le="+Inf"} 4' in body
+    assert "apex_tpu_serving_ttft_ms_count 4" in body
+    assert "apex_tpu_reqtrace_completed_events_total 1" in body
+
+
+def test_summarize_renders_slo_table(tmp_path, capsys):
+    from apex_tpu.telemetry.cli import main as telemetry_cli
+    tr = RequestTracer(host=0)
+    for i, t0 in enumerate((100.0, 100.2)):
+        rid = f"r{i}"
+        tr.enqueue(rid, t=t0)
+        tr.admit(rid, window=0, slot=i, mode="prefill",
+                 queue_ms=40.0, t=t0 + 0.04)
+        tr.decode_window(rid, 1, 3, t=t0 + 0.1)
+        tr.verdict(rid, "completed", window=1, n_tokens=3,
+                   t=t0 + 0.2)
+    run = str(tmp_path / "run")
+    _write_run(run, 0, list(tr.records) + tr.hist_records(step=1))
+    assert telemetry_cli(["summarize", run]) == 0
+    out = capsys.readouterr().out
+    assert "serving SLO: 2 request(s), 6 token(s)" in out
+    assert "completed" in out
+    assert "ttft_ms" in out and "p99_ms" in out
+    # --json carries the same section structurally
+    assert telemetry_cli(["summarize", run, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["serving"]["requests"] == 2
+    assert doc["serving"]["verdicts"] == {"completed": 2}
+    assert doc["serving"]["latency_ms"]["serving/ttft_ms"]["count"] == 2
+
+
+def test_bench_reqtrace_overhead_smoke():
+    """The kernel_bench ``reqtrace_overhead`` row's harness, tiny:
+    tracing must not perturb the token stream (bit-exact oracle); the
+    ratio itself is wall-clock noise on CPU, so only sanity-check it."""
+    from apex_tpu.serving.bench import bench_reqtrace_overhead
+    r = bench_reqtrace_overhead(n_requests=2, n_layers=1, hidden=16,
+                                n_heads=2, page_size=4,
+                                pages_per_slot=2, window=2,
+                                max_new_tokens=3)
+    assert r["reqtrace_on_ms"] > 0 and r["reqtrace_off_ms"] > 0
+    assert r["reqtrace_traces"] == 2
+    assert r["reqtrace_bit_exact"] == 1

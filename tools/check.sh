@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # The one-command correctness gate: AST tier (incl. APX204
-# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 30
+# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 31
 # specs) + baseline diff over the package, then the relaxed profile
 # over tests/, examples/ and tools/ (APX101/102 exempt inside test
 # bodies — a test syncing to assert a device value is the point of the
@@ -23,7 +23,10 @@
 # donation and int8 cast counts in both kv x weight dtype modes),
 # serving.decode_step_w8 (int8 weights dequantize once per matmul
 # plane, never quantize in-step) and serving.prefill_batched (B
-# prompts, one program call, same arena donation as serial prefill).
+# prompts, one program call, same arena donation as serial prefill),
+# and serving.traced_decode_step (a decode window traced while a live
+# RequestTracer records request lifecycle events lowers to the exact
+# same program — request tracing is host-side-only, zero added prims).
 #
 #   tools/check.sh            # everything (CI / pre-merge)
 #
@@ -52,13 +55,13 @@ assert ids == want, f'expected {want}, found {ids}'
 print(f'{len(ids)} concurrency rules registered')
 "
 
-echo "== apexverify spec count: exactly 30 registered"
+echo "== apexverify spec count: exactly 31 registered"
 # the spec-count gate: a PR that deletes or fails to register an
 # invariant spec must fail HERE, not silently verify less
 python -c "
 from apex_tpu.lint import semantic
 n = len(semantic.all_specs())
-assert n == 30, f'expected 30 apexverify specs, found {n}'
+assert n == 31, f'expected 31 apexverify specs, found {n}'
 print(f'{n} specs registered')
 "
 
