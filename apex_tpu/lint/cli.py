@@ -16,10 +16,21 @@ without blocking while CI gates on the diff.
 whole-project thread-root discovery, shared-mutable-state and
 lock-domain analysis (APX1001-APX1005).  Its findings diff against the
 shipped apex_tpu/lint/concurrency/baseline.json; an explicit
-``--baseline FILE`` overrides BOTH tiers' defaults.  With
-``--write-baseline``, exactly one tier flag (or an explicit file) must
-name the target — anything ambiguous exits 2 rather than guessing
-which shipped baseline to overwrite.
+``--baseline FILE`` overrides BOTH tiers' defaults.
+
+``--cost`` additionally runs apexcost (the static program-cost tier):
+every apexverify spec gets a cost card (donation-aware peak live
+bytes, bytes moved, collective payload, transfers, FLOPs) diffed
+against the committed apex_tpu/lint/cost/ledger.json; growth beyond a
+card's tolerance band gates as APX903 with the offending buffers
+named.  ``--write-ledger`` (or ``--write-baseline --cost``)
+regenerates the ledger.
+
+With ``--write-baseline``, exactly one tier flag (or an explicit
+file) must name the target — anything ambiguous exits 2 rather than
+guessing which shipped baseline/ledger to overwrite.  The three tier
+targets are --semantic (semantic/baseline.json), --concurrency
+(concurrency/baseline.json) and --cost (cost/ledger.json).
 """
 
 from __future__ import annotations
@@ -63,6 +74,14 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="also run apexrace: interprocedural thread-"
                         "root / shared-state / lock-domain analysis "
                         "(APX1001-APX1005) after the AST tier")
+    p.add_argument("--cost", action="store_true",
+                   help="also run apexcost: build a static cost card "
+                        "per apexverify spec and diff it against the "
+                        "committed cost ledger (APX903/APX904)")
+    p.add_argument("--write-ledger", action="store_true",
+                   help="rebuild apex_tpu/lint/cost/ledger.json from "
+                        "the current spec registry and exit "
+                        "(equivalent to --write-baseline --cost)")
     p.add_argument("--baseline", default=None, metavar="FILE",
                    help="findings baseline JSON (default: the shipped "
                         "apex_tpu/lint/semantic/baseline.json when "
@@ -93,6 +112,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         for spec in all_specs():
             print(f"{spec.name}  [{spec.anchor}]\n    {spec.description}")
         return 0
+
+    # --write-baseline target resolution happens BEFORE any linting:
+    # an ambiguous multi-tier target must exit 2 immediately, and the
+    # cost-ledger target needs no AST pass at all
+    tier_targets = [f for f, on in (("--semantic", args.semantic),
+                                    ("--concurrency", args.concurrency),
+                                    ("--cost", args.cost)) if on]
+    if args.write_baseline and args.baseline is None \
+            and len(tier_targets) > 1:
+        print("apexlint: --write-baseline with "
+              f"{' and '.join(tier_targets)} is ambiguous — use an "
+              "explicit --baseline FILE (or exactly one tier flag)",
+              file=sys.stderr)
+        return 2
+    if args.write_ledger or (args.write_baseline
+                             and args.baseline is None and args.cost
+                             and len(tier_targets) == 1):
+        from apex_tpu.lint import cost as _cost
+        n, errors = _cost.write_ledger()
+        if errors:
+            for name, err in sorted(errors.items()):
+                print(f"apexcost: {name}: {err}", file=sys.stderr)
+            print(f"apexcost: {len(errors)} spec(s) failed to build — "
+                  f"ledger NOT written", file=sys.stderr)
+            return 1
+        print(f"apexcost: wrote {n} cost card(s) to "
+              f"{_cost.ledger.DEFAULT_LEDGER}")
+        return 0
+
     if not args.paths:
         print("usage: python -m apex_tpu.lint <paths> "
               "(try --list-rules)", file=sys.stderr)
@@ -104,6 +152,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     known = {rid.upper() for rid, _, _ in rule_catalog()}
     known |= {"APX901", "APX902"}   # semantic tier (apexverify)
+    known |= {"APX903", "APX904"}   # cost tier (apexcost)
     from apex_tpu.lint import concurrency as _conc
     known |= {i.upper() for i in _conc.rule_ids()}   # apexrace
     for flag, ids in (("--select", _csv(args.select)),
@@ -149,6 +198,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                           key=lambda f: (f.path, f.line, f.col,
                                          f.rule_id))
 
+    cost_cards = None
+    if args.cost:
+        from apex_tpu.lint import cost as _cost
+        cost_findings, cost_cards, cost_notes, _ = _cost.run_cost()
+        sel, ign = _csv(args.select), _csv(args.ignore)
+        if sel:
+            su = {s.upper() for s in sel}
+            cost_findings = [f for f in cost_findings
+                             if f.rule_id.upper() in su]
+        if ign:
+            iu = {s.upper() for s in ign}
+            cost_findings = [f for f in cost_findings
+                             if f.rule_id.upper() not in iu]
+        for note in cost_notes:
+            print(f"apexcost: note: {note}", file=sys.stderr)
+        findings = sorted(findings + cost_findings,
+                          key=lambda f: (f.path, f.line, f.col,
+                                         f.rule_id))
+
     from apex_tpu.lint.semantic import baseline as bl
 
     if args.write_baseline:
@@ -157,13 +225,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"apexlint: wrote {len(findings)} finding(s) to "
                   f"baseline {args.baseline}")
             return 0
-        if args.semantic and args.concurrency:
-            # two shipped baselines would both be candidate targets;
-            # refuse to guess which package file to overwrite
-            print("apexlint: --write-baseline with both --semantic "
-                  "and --concurrency requires an explicit "
-                  "--baseline FILE", file=sys.stderr)
-            return 2
+        # multi-tier ambiguity and the --cost (ledger) target were
+        # resolved before linting; only single findings-tier targets
+        # reach here
         if args.semantic:
             from apex_tpu.lint.semantic.baseline import DEFAULT_BASELINE
             bl.save(DEFAULT_BASELINE, findings)
@@ -180,8 +244,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # never default here: an AST-only run would silently
         # overwrite a SHIPPED package baseline
         print("apexlint: --write-baseline requires --baseline FILE "
-              "(or exactly one of --semantic/--concurrency, which "
-              "targets that tier's shipped baseline)", file=sys.stderr)
+              "(or exactly one of --semantic/--concurrency/--cost, "
+              "which targets that tier's shipped baseline/ledger)",
+              file=sys.stderr)
         return 2
 
     def _note_stale(stale):
@@ -220,7 +285,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     render = render_json if args.json else render_text
     print(render(findings, len(files), specs_checked=specs_checked,
-                 baselined=baselined))
+                 baselined=baselined, cost_cards=cost_cards))
     return 1 if findings else 0
 
 

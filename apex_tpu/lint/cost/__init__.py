@@ -1,0 +1,128 @@
+"""apexcost — the static program-cost tier (tier 4 of the lint gate).
+
+For every apexverify-traced entry point this tier emits a **cost
+card** (donation-aware peak live bytes, HBM bytes moved, collective
+payload bytes, transfer count, XLA cost-analysis FLOPs) and diffs it
+against the committed :data:`~apex_tpu.lint.cost.ledger.DEFAULT_LEDGER`.
+Unexplained growth in peak bytes, collective payload or transfer
+count gates ``tools/check.sh`` with a card-vs-card diff naming the
+offending buffers; ``python -m apex_tpu.lint --write-ledger``
+re-accepts the current tree.
+
+Rule ids:
+
+* **APX903** ``cost-regression`` — a card regressed vs its ledger
+  entry (or has no entry / fails a structural cross-check such as the
+  serving arena-geometry fit).
+* **APX904** ``cost-card-error`` — a spec's cost card could not be
+  built, or the ledger itself is malformed; the tier must fail loudly
+  rather than silently verify less.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.lint.findings import ERROR, Finding
+from apex_tpu.lint.cost import ledger
+from apex_tpu.lint.cost.cards import (build_card, build_cards,
+                                      render_cards_text)
+from apex_tpu.lint.semantic.registry import all_specs, get_spec
+
+RULE_COST = ("APX903", "cost-regression")
+RULE_COST_ERROR = ("APX904", "cost-card-error")
+
+__all__ = ["run_cost", "build_card", "build_cards",
+           "render_cards_text", "write_ledger", "ledger",
+           "RULE_COST", "RULE_COST_ERROR"]
+
+_ANCHOR = "apex_tpu/lint/cost/ledger.json"
+
+
+def _anchor(name: str) -> str:
+    try:
+        return get_spec(name).anchor
+    except KeyError:
+        return _ANCHOR
+
+
+def _finding(rule, path: str, message: str) -> Finding:
+    return Finding(path=path, line=1, col=0, rule_id=rule[0],
+                   rule_name=rule[1], message=message, severity=ERROR)
+
+
+def _arena_fit_findings(cards: Dict[str, dict]) -> List[Finding]:
+    """The serving cross-check: a decode window's peak must FIT the
+    arena geometry it was built for.  If the donated arena were
+    double-buffered (a lost donation, a defensive copy), the peak
+    would reach input_bytes + arena_bytes; staying strictly below
+    proves single-generation arena storage."""
+    out: List[Finding] = []
+    for name in sorted(cards):
+        extras = cards[name].get("extras") or {}
+        arena = int(extras.get("arena_bytes", 0))
+        if not arena:
+            continue
+        peak = int(cards[name]["peak_bytes"])
+        budget = int(cards[name]["input_bytes"]) + arena
+        if peak >= budget:
+            out.append(_finding(
+                RULE_COST, _anchor(name),
+                f"[{name}] peak {peak}B does not fit the arena "
+                f"geometry: inputs ({cards[name]['input_bytes']}B) + "
+                f"one arena generation ({arena}B) = {budget}B — the "
+                f"donated KV arena appears double-buffered"))
+    return out
+
+
+def run_cost(names: Optional[List[str]] = None,
+             ledger_path: Optional[str] = None
+             ) -> Tuple[List[Finding], Dict[str, dict], List[str],
+                        float]:
+    """Run the cost tier: build cards, cross-check, diff vs ledger.
+
+    Returns ``(findings, cards, notes, elapsed)`` — the same shape
+    family as :func:`apex_tpu.lint.semantic.run_semantic`, plus the
+    cards (for rendering) and non-gating notes (for stderr)."""
+    t0 = time.perf_counter()
+    path = ledger_path if ledger_path is not None \
+        else ledger.DEFAULT_LEDGER
+    cards, errors = build_cards(names)
+    findings: List[Finding] = [
+        _finding(RULE_COST_ERROR, _anchor(name),
+                 f"[{name}] cost card build failed: {err}")
+        for name, err in sorted(errors.items())]
+    findings.extend(_arena_fit_findings(cards))
+    notes: List[str] = []
+    if not os.path.exists(path):
+        findings.append(_finding(
+            RULE_COST, _ANCHOR,
+            f"no cost ledger at {path} — run `python -m apex_tpu.lint "
+            f"--write-ledger` to enroll the current tree"))
+    else:
+        try:
+            doc = ledger.load(path)
+        except (ValueError, OSError) as e:
+            findings.append(_finding(
+                RULE_COST_ERROR, _ANCHOR,
+                f"cost ledger unreadable: {e}"))
+        else:
+            gating, notes = ledger.diff(cards, doc)
+            findings.extend(
+                _finding(RULE_COST, _anchor(name), f"[{name}] {msg}")
+                for name, msg in gating)
+    return findings, cards, notes, time.perf_counter() - t0
+
+
+def write_ledger(path: Optional[str] = None,
+                 names: Optional[List[str]] = None) -> Tuple[int, Dict[str, str]]:
+    """Regenerate the ledger from the current registry.  Returns
+    ``(cards_written, errors)``; on any builder error NOTHING is
+    written — a partial ledger would silently drop coverage."""
+    cards, errors = build_cards(names)
+    if errors:
+        return 0, errors
+    ledger.save(path or ledger.DEFAULT_LEDGER, cards)
+    return len(cards), {}

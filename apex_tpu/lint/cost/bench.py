@@ -1,0 +1,34 @@
+"""Bench hook: how long does one cost card cost?
+
+``tools/kernel_bench.py`` reports ``cost_extract_ms`` — the amortized
+per-card ledger-build time — so a liveness-analyzer slowdown shows up
+in the same table as the kernels it audits.  Tier-1 smokes call
+:func:`bench_cost_extract` with a small ``limit`` (tracing two specs,
+not thirty-one) to keep the suite fast.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.lint.cost.cards import timed_build
+from apex_tpu.lint.semantic.registry import all_specs
+
+
+def bench_cost_extract(limit: Optional[int] = None,
+                       flops: bool = False) -> dict:
+    """Build cost cards for the first ``limit`` registry specs (all
+    when None) and report amortized per-card milliseconds.  FLOPs
+    default OFF here: the bench times the analyzer, not XLA's
+    compile."""
+    names = [s.name for s in all_specs()]
+    if limit is not None:
+        names = names[:max(1, int(limit))]
+    cards, errors, elapsed = timed_build(names, flops=flops)
+    n = max(1, len(cards))
+    return {
+        "cost_extract_ms": round(elapsed * 1000.0 / n, 3),
+        "cost_total_ms": round(elapsed * 1000.0, 3),
+        "cost_specs": len(cards),
+        "cost_errors": len(errors),
+    }

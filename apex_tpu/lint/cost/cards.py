@@ -1,0 +1,131 @@
+"""Cost-card construction: one card per apexverify spec.
+
+A **cost card** is the static cost surface of one traced entry point
+— the numbers :mod:`apex_tpu.lint.cost.liveness` extracts from the
+spec's jaxpr, plus XLA cost-analysis FLOPs through the
+:func:`apex_tpu.telemetry.profiler.mfu.step_flops` seam (only for
+specs that ship ``fn``/``args``; the ready-jaxpr telemetry specs have
+no compilable callable, so their ``flops`` is ``null``).
+
+Builders may attach a ``cost_meta`` dict next to ``expect`` (the
+semantic verifier ignores it); cards.py turns it into the ledger's
+``extras``:
+
+* ``{"serving_slots": N, "arena_bytes": B}`` →
+  ``extras.serving_hbm_bytes_per_slot`` (donated carry bytes — arena
+  pages + scale planes + slot state — divided by decode slots) and
+  ``extras.arena_bytes`` for the arena-geometry fit check;
+* ``{"ddp_step": true}`` → ``extras.ddp_collective_bytes_per_step``
+  (the static twin of the ``ddp/bytes_allreduced`` telemetry float).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.lint.cost import liveness
+from apex_tpu.lint.semantic.registry import all_specs, get_spec
+
+
+def _spec_flops(env: dict) -> Optional[float]:
+    """XLA cost-analysis FLOPs for a buildable spec, None-tolerant on
+    every backend (CPU may not report flops; that is data, not an
+    error)."""
+    import jax
+    # the package re-exports the mfu() *function*; import the module
+    from apex_tpu.telemetry.profiler.mfu import step_flops
+    try:
+        jitted = jax.jit(env["fn"], **(env.get("jit_kwargs") or {}))
+        v = step_flops(jitted, *env["args"])
+        return float(v) if v is not None else None
+    except Exception:
+        return None
+
+
+def build_card(spec, flops: bool = True) -> dict:
+    """Build one spec's cost card (raises on builder/trace failure —
+    the caller decides whether that gates)."""
+    import jax
+    env = dict(spec.builder())
+    if "jaxpr" in env:
+        jaxpr = env["jaxpr"]
+        donated: frozenset = frozenset()
+    else:
+        args = env["args"]
+        jaxpr = jax.make_jaxpr(env["fn"])(*args)
+        donated = liveness.donated_flat_indices(
+            args, (env.get("jit_kwargs") or {}).get("donate_argnums"))
+    report = liveness.analyze(jaxpr, donated)
+    card = {
+        "peak_bytes": report.peak_bytes,
+        "peak_buffers": report.peak_buffers,
+        "bytes_moved": report.bytes_moved,
+        "collective_bytes": report.collective_bytes,
+        "collective_payloads": dict(sorted(
+            report.collective_payloads.items())),
+        "transfers": report.transfers,
+        "input_bytes": report.input_bytes,
+        "donated_bytes": report.donated_bytes,
+        "output_bytes": report.output_bytes,
+        "flops": (_spec_flops(env)
+                  if flops and "fn" in env else None),
+    }
+    meta = env.get("cost_meta") or {}
+    extras: Dict[str, float] = {}
+    if "serving_slots" in meta:
+        slots = max(1, int(meta["serving_slots"]))
+        extras["serving_hbm_bytes_per_slot"] = \
+            report.donated_bytes // slots
+        extras["arena_bytes"] = int(meta.get("arena_bytes", 0))
+    if meta.get("ddp_step"):
+        extras["ddp_collective_bytes_per_step"] = \
+            report.collective_bytes
+    if extras:
+        card["extras"] = extras
+    return card
+
+
+def build_cards(names: Optional[List[str]] = None, flops: bool = True
+                ) -> Tuple[Dict[str, dict], Dict[str, str]]:
+    """Cards for the named specs (default: the whole registry).
+    Returns ``(cards, errors)`` — a spec whose builder or trace fails
+    lands in ``errors`` with the exception text, never aborts the
+    sweep."""
+    specs = ([get_spec(n) for n in names] if names is not None
+             else list(all_specs()))
+    cards: Dict[str, dict] = {}
+    errors: Dict[str, str] = {}
+    for spec in specs:
+        try:
+            cards[spec.name] = build_card(spec, flops=flops)
+        except Exception as e:   # one broken builder must not hide
+            errors[spec.name] = f"{type(e).__name__}: {e}"   # the rest
+    return cards, errors
+
+
+def render_cards_text(cards: Dict[str, dict],
+                      ledger_path: Optional[str] = None) -> str:
+    """The ``--cost`` text table: one row per entry point."""
+    lines = [f"apexcost: {len(cards)} cost card(s)"
+             + (f" vs ledger {ledger_path}" if ledger_path else "")]
+    head = (f"  {'spec':<36} {'peak_B':>10} {'moved_B':>11} "
+            f"{'coll_B':>8} {'xfer':>4} {'flops':>12}")
+    lines.append(head)
+    for name in sorted(cards):
+        c = cards[name]
+        fl = c.get("flops")
+        lines.append(
+            f"  {name:<36} {c['peak_bytes']:>10} "
+            f"{c['bytes_moved']:>11} {c['collective_bytes']:>8} "
+            f"{c['transfers']:>4} "
+            f"{(format(fl, '.3g') if fl is not None else '-'):>12}")
+    return "\n".join(lines)
+
+
+def timed_build(names: Optional[List[str]] = None, flops: bool = True
+                ) -> Tuple[Dict[str, dict], Dict[str, str], float]:
+    """(cards, errors, elapsed_seconds) — the bench/ledger entry."""
+    t0 = time.perf_counter()
+    cards, errors = build_cards(names, flops=flops)
+    return cards, errors, time.perf_counter() - t0

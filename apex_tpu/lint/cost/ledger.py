@@ -1,0 +1,199 @@
+"""The committed cost ledger: schema, load/save, card-vs-card diff.
+
+Same workflow as the semantic findings baseline
+(:mod:`apex_tpu.lint.semantic.baseline`), but the unit of record is a
+whole **cost card** per traced entry point, not a finding key.  The
+shipped ``apex_tpu/lint/cost/ledger.json`` is the accepted cost
+surface of the repo; ``python -m apex_tpu.lint --write-ledger``
+regenerates it, and ``--cost`` diffs fresh cards against it.
+
+Gating rules (:func:`diff`):
+
+* a card with **no ledger entry** gates — new entry points must be
+  enrolled deliberately via ``--write-ledger``;
+* growth in ``peak_bytes``, ``collective_bytes`` or ``transfers``
+  beyond the entry's ``tolerance_pct`` band (default 0 — these are
+  deterministic program facts, not measurements) gates, and the
+  message names the offending buffers / collectives from the
+  card-vs-card diff;
+* ``bytes_moved`` and ``flops`` are report-only context: they move
+  with every legitimate refactor, so they inform the diff message but
+  never gate on their own;
+* shrinkage and stale entries are non-gating notes — an improvement
+  or a removed spec just means the ledger wants a ``--write-ledger``
+  refresh.
+
+``save`` preserves any hand-set per-entry ``tolerance_pct`` across
+regeneration, exactly as baseline ``save`` preserves nothing it
+doesn't own.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+SCHEMA_VERSION = 1
+
+DEFAULT_LEDGER = os.path.join(os.path.dirname(__file__), "ledger.json")
+
+# card fields whose growth beyond tolerance gates check.sh
+GATED_FIELDS = ("peak_bytes", "collective_bytes", "transfers")
+
+_COMMENT = ("apexcost ledger: accepted static cost cards per "
+            "apexverify spec. Regenerate with `python -m "
+            "apex_tpu.lint --write-ledger`; per-entry tolerance_pct "
+            "(default 0) widens the gate band and survives "
+            "regeneration.")
+
+
+def load(path: str = DEFAULT_LEDGER) -> dict:
+    """Parse a ledger document, validating the schema envelope.
+    Raises ``ValueError`` on anything malformed — a hand-edited ledger
+    must fail loudly, not be silently discarded."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    errs = validate(doc, path)
+    if errs:
+        raise ValueError("; ".join(errs))
+    return doc
+
+
+def validate(doc, path: str = "<ledger>") -> List[str]:
+    """Schema errors for a parsed ledger document (empty = valid).
+    Shared with ``tools/autotune.py --validate``, so the rules stay
+    stdlib-expressible: no jsonschema in the container."""
+    errs: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"{path}: ledger must be a JSON object"]
+    if doc.get("schema") != SCHEMA_VERSION:
+        errs.append(f"{path}: schema must be {SCHEMA_VERSION}, "
+                    f"got {doc.get('schema')!r}")
+    cards = doc.get("cards")
+    if not isinstance(cards, dict) or not cards:
+        errs.append(f"{path}: 'cards' must be a non-empty object")
+        return errs
+    for name, card in cards.items():
+        if not isinstance(card, dict):
+            errs.append(f"{path}: card {name!r} must be an object")
+            continue
+        for field in GATED_FIELDS + ("bytes_moved",):
+            v = card.get(field)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{path}: card {name!r}.{field} must be a "
+                            f"non-negative integer, got {v!r}")
+        tol = card.get("tolerance_pct", 0)
+        if not isinstance(tol, (int, float)) or isinstance(tol, bool) \
+                or tol < 0:
+            errs.append(f"{path}: card {name!r}.tolerance_pct must be "
+                        f"a non-negative number, got {tol!r}")
+        pb = card.get("peak_buffers", [])
+        if not isinstance(pb, list) or any(
+                not (isinstance(b, dict) and isinstance(b.get("label"),
+                                                        str)
+                     and isinstance(b.get("bytes"), int))
+                for b in pb):
+            errs.append(f"{path}: card {name!r}.peak_buffers must be a "
+                        f"list of {{label, bytes}} objects")
+    return errs
+
+
+def save(path: str, cards: Dict[str, dict]) -> None:
+    """Write the ledger, preserving per-entry ``tolerance_pct`` from
+    any existing document at ``path``."""
+    old_tol: Dict[str, float] = {}
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                old = json.load(fh)
+            for name, card in (old.get("cards") or {}).items():
+                if isinstance(card, dict) and "tolerance_pct" in card:
+                    old_tol[name] = card["tolerance_pct"]
+        except (OSError, ValueError):
+            pass   # regenerating over a corrupt ledger is the cure
+    out_cards: Dict[str, dict] = {}
+    for name in sorted(cards):
+        card = dict(cards[name])
+        if name in old_tol:
+            card["tolerance_pct"] = old_tol[name]
+        out_cards[name] = card
+    doc = {"_comment": _COMMENT, "schema": SCHEMA_VERSION,
+           "cards": out_cards}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def _buffer_diff(new: List[dict], old: List[dict]) -> str:
+    """Name the buffers behind a peak regression: the multiset
+    difference of the two cards' peak-buffer lists."""
+    def counts(bufs):
+        c: Dict[Tuple[str, int], int] = {}
+        for b in bufs or ():
+            k = (b.get("label", "?"), int(b.get("bytes", 0)))
+            c[k] = c.get(k, 0) + 1
+        return c
+    nc, oc = counts(new), counts(old)
+    grown = []
+    for k in sorted(nc, key=lambda k: (-k[1], k[0])):
+        extra = nc[k] - oc.get(k, 0)
+        if extra > 0:
+            label, nbytes = k
+            grown.append(f"{label} ({nbytes}B"
+                         + (f" x{extra}" if extra > 1 else "") + ")")
+    return ", ".join(grown[:4]) if grown else "(peak point moved)"
+
+
+def _collective_diff(new: Dict[str, int], old: Dict[str, int]) -> str:
+    parts = []
+    for prim in sorted(set(new) | set(old)):
+        nv, ov = int(new.get(prim, 0)), int(old.get(prim, 0))
+        if nv != ov:
+            parts.append(f"{prim} {ov}B -> {nv}B")
+    return ", ".join(parts) if parts else "(per-prim mix unchanged)"
+
+
+def diff(cards: Dict[str, dict], doc: dict
+         ) -> Tuple[List[Tuple[str, str]], List[str]]:
+    """Fresh cards vs the committed ledger.
+
+    Returns ``(gating, notes)``: ``gating`` is ``(spec_name,
+    message)`` pairs that must fail check.sh; ``notes`` are
+    informational lines (shrinkage, stale entries) for stderr."""
+    old_cards: Dict[str, dict] = doc.get("cards", {})
+    gating: List[Tuple[str, str]] = []
+    notes: List[str] = []
+    for name in sorted(cards):
+        card = cards[name]
+        old = old_cards.get(name)
+        if old is None:
+            gating.append((name, "no ledger entry for this entry "
+                           "point (run --write-ledger to enroll it)"))
+            continue
+        tol = float(old.get("tolerance_pct", 0.0))
+        for field in GATED_FIELDS:
+            nv = int(card.get(field, 0))
+            ov = int(old.get(field, 0))
+            allowed = ov * (1.0 + tol / 100.0)
+            if nv > allowed:
+                msg = (f"{field} grew {ov} -> {nv} "
+                       f"(+{nv - ov}, tolerance {tol:g}%)")
+                if field == "peak_bytes":
+                    msg += ("; offending buffers: "
+                            + _buffer_diff(card.get("peak_buffers"),
+                                           old.get("peak_buffers")))
+                elif field == "collective_bytes":
+                    msg += ("; payload diff: "
+                            + _collective_diff(
+                                card.get("collective_payloads", {}),
+                                old.get("collective_payloads", {})))
+                gating.append((name, msg))
+            elif nv < ov:
+                notes.append(f"{name}: {field} shrank {ov} -> {nv} "
+                             f"(improvement; refresh with "
+                             f"--write-ledger)")
+    for name in sorted(set(old_cards) - set(cards)):
+        notes.append(f"stale ledger entry (spec no longer "
+                     f"registered): {name}")
+    return gating, notes

@@ -1,0 +1,305 @@
+"""Donation-aware buffer-lifetime analysis over jaxprs (apexcost).
+
+The semantic tier proves *structural* facts about a program (zero
+transfer prims, N pallas_calls, donation aliased); this module turns
+the same jaxpr into *cost* facts — hardware-independent byte counts a
+regression gate can diff:
+
+* **peak live device bytes** — classic interval liveness over the
+  top-level equations.  Every buffer gets a ``[birth, death]``
+  interval; caller-owned inputs (non-donated args, closure constants)
+  live for the whole program because XLA may never free them, while
+  DONATED inputs and intermediates die at their last use.  An
+  equation whose output matches a same-size dying reusable input is
+  collapsed as an in-place update (the buffer reuse
+  ``tf.aliasing_output`` records at the HLO level): the output
+  inherits the input's storage instead of allocating a second
+  generation.  This is exactly the fixture pair the tests pin — a
+  donated ``x.at[i].set(v)`` peaks at ONE buffer, while a defensive
+  copy (the source read again later) peaks at two, the difference
+  being the buffer size to the byte.
+* **bytes moved** — the fusion-blind HBM traffic proxy: every
+  equation reads its operands and writes its outputs once;
+  ``scan`` bodies multiply by the trip count.  Structural, not a
+  bandwidth claim: its value is in the DIFF (a refactor that doubles
+  it doubled real traffic too).
+* **collective payload bytes** — operand bytes entering each named-
+  axis collective (``axis_index`` excluded: it moves nothing).  The
+  static twin of the ``ddp/bytes_allreduced`` telemetry float.
+* **transfer count** — host-transfer equations
+  (:data:`~apex_tpu.lint.semantic.jaxprs.HOST_TRANSFER_MARKERS`).
+
+Sub-jaxprs (pjit bodies, scan/while/cond branches, custom_vjp calls)
+are walked with the same discovery rule as
+:func:`apex_tpu.lint.semantic.jaxprs.iter_eqns`; a call-like equation
+contributes ``max(0, inner_peak - boundary_bytes)`` of *extra* peak at
+its program point (its operands/results are already counted at the
+outer level), with ``pjit``'s own ``donated_invars`` threaded through.
+
+Everything here is deterministic over a jaxpr: same program, same
+bytes — that determinism is what lets ``ledger.json`` gate with a
+zero noise band on any backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+from apex_tpu.lint.semantic.jaxprs import (COLLECTIVE_PRIMS,
+                                           HOST_TRANSFER_MARKERS,
+                                           _as_jaxpr)
+
+# collectives that move payload; axis_index only materializes an index
+PAYLOAD_COLLECTIVES = COLLECTIVE_PRIMS - {"axis_index"}
+
+
+def elt_bytes(dtype) -> int:
+    """Bytes per element, tolerating JAX extended dtypes: a typed PRNG
+    key (``key<fry>``) has no numpy equivalent but occupies the base
+    uint32 pair on device — 8 bytes, never a crash."""
+    import numpy as np
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 8
+
+
+def aval_bytes(aval) -> int:
+    """Device bytes of one abstract value (0 for non-array avals such
+    as abstract tokens, and for symbolic dims we cannot size)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    try:
+        for s in shape:
+            n *= int(s)
+    except (TypeError, ValueError):
+        return 0
+    return n * elt_bytes(dtype)
+
+
+def _is_literal(v) -> bool:
+    return not hasattr(v, "count") and hasattr(v, "val")
+
+
+def _sub_jaxprs(eqn) -> Iterable:
+    """The sub-jaxprs an equation carries in its params (same
+    discovery rule as jaxprs.iter_eqns, yielded one level deep)."""
+    for v in eqn.params.values():
+        for j in (v if isinstance(v, (list, tuple)) else [v]):
+            if hasattr(j, "jaxpr"):
+                yield j.jaxpr
+            elif hasattr(j, "eqns"):
+                yield j
+
+
+def _eqn_inner_donated(eqn) -> FrozenSet[int]:
+    """pjit records which of the call's operands are donated; other
+    call-like primitives don't, so their bodies analyze conservatively
+    (nothing donated)."""
+    return frozenset(i for i, d in
+                     enumerate(eqn.params.get("donated_invars", ()))
+                     if d)
+
+
+def _label(src: str, aval) -> str:
+    """Stable buffer label for ledger diffs: producer + dtype[shape].
+    Deliberately free of variable ids, which drift with every
+    unrelated trace change."""
+    dtype = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", ())
+    dt = getattr(dtype, "name", str(dtype))
+    return f"{src}:{dt}[{','.join(str(s) for s in shape)}]"
+
+
+@dataclasses.dataclass
+class CostReport:
+    """The liveness analyzer's verdict over one jaxpr."""
+
+    peak_bytes: int = 0
+    peak_point: int = 0
+    peak_buffers: List[dict] = dataclasses.field(default_factory=list)
+    bytes_moved: int = 0
+    collective_bytes: int = 0
+    collective_payloads: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    transfers: int = 0
+    input_bytes: int = 0
+    donated_bytes: int = 0
+    output_bytes: int = 0
+    n_eqns: int = 0
+
+
+@dataclasses.dataclass
+class _Buf:
+    birth: int
+    death: int
+    nbytes: int
+    label: str
+
+
+def _peak(jaxpr, donated: FrozenSet[int]
+          ) -> Tuple[int, int, List[_Buf]]:
+    """(peak_bytes, peak_point, buffers) for one (sub)jaxpr scope.
+
+    Linear scan with in-place collapse: at equation ``i``, each output
+    greedily pairs with a same-byte-size REUSABLE input whose last use
+    is ``i`` (reusable = donated program input or an intermediate —
+    never a caller-owned arg or constant); the paired output starts at
+    ``i + 1`` so the shared storage is counted once at the update
+    point.  Call-like equations add ``max(0, inner_peak - boundary)``
+    of extra bytes at their point."""
+    j = _as_jaxpr(jaxpr)
+    eqns = j.eqns
+    n = len(eqns)
+
+    last_use: Dict[int, int] = {}
+    for i, e in enumerate(eqns):
+        for v in e.invars:
+            if not _is_literal(v):
+                last_use[id(v)] = i
+    out_ids = {id(v) for v in j.outvars if not _is_literal(v)}
+
+    bufs: Dict[int, _Buf] = {}
+    reusable: set = set()
+
+    for v in j.constvars:
+        bufs[id(v)] = _Buf(0, n, aval_bytes(v.aval),
+                           _label("const", v.aval))
+    for idx, v in enumerate(j.invars):
+        nbytes = aval_bytes(v.aval)
+        if idx in donated and id(v) not in out_ids:
+            # donated: freed after its last read (or reused in place)
+            death = last_use.get(id(v), 0)
+            reusable.add(id(v))
+        else:
+            # caller-owned: XLA cannot free it inside the program
+            death = n
+        bufs[id(v)] = _Buf(0, death, nbytes, _label(f"in{idx}", v.aval))
+
+    extra = [0] * max(n, 1)
+    for i, e in enumerate(eqns):
+        # in-place collapse: dying reusable operands, largest first
+        dying = []
+        seen = set()
+        for v in e.invars:
+            if _is_literal(v) or id(v) in seen:
+                continue
+            seen.add(id(v))
+            b = bufs.get(id(v))
+            if b is not None and id(v) in reusable and b.death == i:
+                dying.append((b.nbytes, id(v)))
+        dying.sort(reverse=True)
+        for o in sorted(e.outvars, key=lambda v: -aval_bytes(v.aval)):
+            if _is_literal(o):
+                continue
+            nbytes = aval_bytes(o.aval)
+            death = n if id(o) in out_ids else last_use.get(id(o), i)
+            birth = i
+            for k, (bb, vid) in enumerate(dying):
+                if bb == nbytes:
+                    birth = i + 1      # reuses the dying operand
+                    del dying[k]
+                    break
+            bufs[id(o)] = _Buf(birth, death, nbytes,
+                               _label(e.primitive.name, o.aval))
+            reusable.add(id(o))
+        # nested temporaries beyond the operand/result boundary
+        for sub in _sub_jaxprs(e):
+            inner_peak, _, _ = _peak(sub, _eqn_inner_donated(e))
+            sj = _as_jaxpr(sub)
+            boundary = (sum(aval_bytes(v.aval) for v in sj.invars)
+                        + sum(aval_bytes(v.aval) for v in sj.outvars
+                              if not _is_literal(v)))
+            extra[i] += max(0, inner_peak - boundary)
+
+    if n == 0:
+        live0 = sum(b.nbytes for b in bufs.values())
+        top = sorted(bufs.values(), key=lambda b: -b.nbytes)
+        return live0, 0, top
+
+    delta = [0] * (n + 1)
+    for b in bufs.values():
+        if b.death < b.birth or b.birth >= n:
+            continue
+        delta[b.birth] += b.nbytes
+        delta[min(b.death, n - 1) + 1] -= b.nbytes
+    peak, point, live = 0, 0, 0
+    for i in range(n):
+        live += delta[i]
+        if live + extra[i] > peak:
+            peak, point = live + extra[i], i
+    at_peak = [b for b in bufs.values()
+               if b.birth <= point <= b.death and b.nbytes > 0]
+    at_peak.sort(key=lambda b: (-b.nbytes, b.label))
+    return peak, point, at_peak
+
+
+def _traffic(jaxpr, mult: int, report: CostReport) -> None:
+    """Accumulate bytes-moved / collective-payload / transfer counts,
+    multiplying scan bodies by their trip count (a window's per-token
+    traffic happens ``length`` times per step)."""
+    j = _as_jaxpr(jaxpr)
+    for e in j.eqns:
+        name = e.primitive.name
+        io = (sum(aval_bytes(v.aval) for v in e.invars
+                  if not _is_literal(v))
+              + sum(aval_bytes(v.aval) for v in e.outvars
+                    if not _is_literal(v)))
+        report.bytes_moved += mult * io
+        if name in PAYLOAD_COLLECTIVES:
+            payload = mult * sum(aval_bytes(v.aval) for v in e.invars
+                                 if not _is_literal(v))
+            report.collective_bytes += payload
+            report.collective_payloads[name] = \
+                report.collective_payloads.get(name, 0) + payload
+        if any(m in name for m in HOST_TRANSFER_MARKERS):
+            report.transfers += 1
+        inner_mult = mult
+        if name == "scan":
+            try:
+                inner_mult = mult * max(1, int(e.params.get("length", 1)))
+            except (TypeError, ValueError):
+                inner_mult = mult
+        for sub in _sub_jaxprs(e):
+            _traffic(sub, inner_mult, report)
+
+
+def analyze(jaxpr, donated: Optional[Iterable[int]] = None) -> CostReport:
+    """Full cost report for ``jaxpr`` with the given donated top-level
+    input positions (flat invar indices)."""
+    donated_set = frozenset(donated or ())
+    j = _as_jaxpr(jaxpr)
+    report = CostReport(n_eqns=len(j.eqns))
+    report.input_bytes = sum(aval_bytes(v.aval) for v in j.invars)
+    report.donated_bytes = sum(aval_bytes(v.aval)
+                               for i, v in enumerate(j.invars)
+                               if i in donated_set)
+    report.output_bytes = sum(aval_bytes(v.aval) for v in j.outvars
+                              if not _is_literal(v))
+    peak, point, at_peak = _peak(jaxpr, donated_set)
+    report.peak_bytes = peak
+    report.peak_point = point
+    report.peak_buffers = [{"label": b.label, "bytes": b.nbytes}
+                           for b in at_peak[:8]]
+    _traffic(jaxpr, 1, report)
+    return report
+
+
+def donated_flat_indices(args, donate_argnums) -> FrozenSet[int]:
+    """Map per-argument ``donate_argnums`` onto flat invar positions
+    of ``jax.make_jaxpr(fn)(*args)`` — pytree args flatten in order,
+    so a donated arg covers a contiguous leaf range."""
+    import jax
+    donate = set(donate_argnums or ())
+    out: set = set()
+    pos = 0
+    for i, a in enumerate(args):
+        n = len(jax.tree_util.tree_leaves(a))
+        if i in donate:
+            out.update(range(pos, pos + n))
+        pos += n
+    return frozenset(out)

@@ -16,9 +16,14 @@ from apex_tpu.lint.findings import Finding, sort_key
 
 def render_text(findings: Sequence[Finding], files_checked: int,
                 specs_checked=None,
-                baselined: Sequence[Finding] = ()) -> str:
+                baselined: Sequence[Finding] = (),
+                cost_cards=None) -> str:
+    lines: List[str] = []
+    if cost_cards is not None:
+        from apex_tpu.lint.cost.cards import render_cards_text
+        lines.append(render_cards_text(cost_cards))
     findings = sorted(findings, key=sort_key)
-    lines: List[str] = [f.format() for f in findings]
+    lines.extend(f.format() for f in findings)
     # accepted debt stays VISIBLE (docs/lint.md: "reported but never
     # gate") — tagged so it can't be mistaken for a gating finding
     lines.extend(f"{f.format()}  [baselined]"
@@ -44,7 +49,8 @@ def render_text(findings: Sequence[Finding], files_checked: int,
 
 def render_json(findings: Sequence[Finding], files_checked: int,
                 specs_checked=None,
-                baselined: Sequence[Finding] = ()) -> str:
+                baselined: Sequence[Finding] = (),
+                cost_cards=None) -> str:
     # deterministic order regardless of rule/file scheduling: sorted
     # by (path, line, col, rule) like the engine's contract
     findings = sorted(findings, key=sort_key)
@@ -58,4 +64,7 @@ def render_json(findings: Sequence[Finding], files_checked: int,
     }
     if specs_checked is not None:
         payload["specs_checked"] = specs_checked
+    if cost_cards is not None:
+        payload["cost_cards"] = cost_cards
+        payload["cost_cards_checked"] = len(cost_cards)
     return json.dumps(payload, indent=2, sort_keys=True)

@@ -694,6 +694,15 @@ def _build_serving_decode_step():
             "donated_aliases": updated,
             "no_orphan_collectives": True,
         },
+        # apexcost: grade serving HBM per decode slot from the donated
+        # carry (arena pages + scale planes + slot state), and pin the
+        # arena geometry for the peak-fits-arena cross-check
+        "cost_meta": {
+            "serving_slots": spec.max_slots,
+            "arena_bytes": int(arena.k.nbytes + arena.v.nbytes
+                               + arena.k_scale.nbytes
+                               + arena.v_scale.nbytes),
+        },
     }
 
 
@@ -1017,4 +1026,8 @@ def _build_all_reduce_flat():
             "collective_axes": {comm.AXIS_DATA},
             "no_orphan_collectives": True,
         },
+        # apexcost: this card's static collective bytes become the
+        # extra.ddp_collective_bytes_per_step perf-budget row and are
+        # cross-checked against ddp/bytes_allreduced telemetry
+        "cost_meta": {"ddp_step": True},
     }

@@ -41,8 +41,15 @@ def _emit_reduce_telemetry(bufs) -> None:
     """Report collective payload: bytes all-reduced this step (summed
     over calls) and the number of collectives issued.  Shapes/dtypes
     are static, so this is host arithmetic at trace time — nothing is
-    added to the compiled program beyond two ring-slot constants."""
-    nbytes = sum(int(b.size) * jnp.dtype(b.dtype).itemsize for b in bufs)
+    added to the compiled program beyond two ring-slot constants.
+
+    Both reduce paths cast to float32 BEFORE the collective (see
+    reduce_leaf / _reduce_one_flat_buffer), so the wire payload is
+    4 bytes per element regardless of the leaf's storage dtype —
+    counting input-dtype bytes under-reported bf16 leaves by half
+    until apexcost's static analysis cross-checked this figure
+    (tests/test_lint_cost.py pins the agreement)."""
+    nbytes = sum(int(b.size) * 4 for b in bufs)
     _tape.emit("ddp/bytes_allreduced", float(nbytes), reduce="sum")
     _tape.emit("ddp/buckets", float(len(bufs)), reduce="sum")
 

@@ -76,6 +76,9 @@ SUITES = {
     # apexrace: thread-root/shared-state/lock-domain analysis over the
     # whole package + the races it surfaced (regression tests)
     "run_lint_concurrency": ["tests/test_lint_concurrency.py"],
+    # apexcost: donation-aware liveness cost cards + the committed
+    # ledger diff gate + the ddp telemetry cross-check
+    "run_lint_cost": ["tests/test_lint_cost.py"],
     # the serving path: paged KV arena, AOT prefill/decode programs,
     # the continuous-batching engine and its chaos matrix (hung
     # decode, shed, drain, replica failover)

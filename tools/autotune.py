@@ -229,24 +229,50 @@ def validate_table(doc, *, per_topology: bool, path: str = "") -> list:
     return errs
 
 
+LEDGER_PATH = _os.path.join(_ROOT, "apex_tpu", "lint", "cost",
+                            "ledger.json")
+
+
+def _ledger_schema():
+    """The apexcost ledger schema validator, loaded from its module
+    FILE so --validate stays jax-free (importing the apex_tpu.lint
+    package would pull the whole lint stack; ledger.py itself is
+    stdlib-only)."""
+    import importlib.util
+    p = _os.path.join(_ROOT, "apex_tpu", "lint", "cost", "ledger.py")
+    spec = importlib.util.spec_from_file_location("_apexcost_ledger", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def validate_paths(paths=None) -> list:
-    """Validate every shipped dispatch_prefs*.json (or the given
-    paths); returns all errors.  Unreadable JSON is an error — a
-    hand-edit that truncates the file must fail CI, not degrade to
-    design defaults silently."""
+    """Validate every shipped dispatch_prefs*.json plus the apexcost
+    cost ledger (or the given paths); returns all errors.  Unreadable
+    JSON is an error — a hand-edit that truncates a file must fail CI,
+    not degrade to design defaults silently.  A path named
+    ``ledger.json`` (or any doc carrying a ``cards`` map) validates
+    against the apexcost ledger schema instead of the dispatch-table
+    schema."""
     if not paths:
         paths = sorted(glob.glob(_os.path.join(
             _ROOT, "apex_tpu", "ops", "dispatch_prefs*.json")))
+        paths.append(LEDGER_PATH)
     errs = []
     for p in paths:
-        per_topo = re.fullmatch(r"dispatch_prefs\..+\.json",
-                                _os.path.basename(p)) is not None
+        base = _os.path.basename(p)
         try:
             with open(p, encoding="utf-8") as f:
                 doc = json.load(f)
         except (OSError, ValueError) as e:
             errs.append(f"{p}: unreadable ({e})")
             continue
+        if base == "ledger.json" or (isinstance(doc, dict)
+                                     and "cards" in doc):
+            errs.extend(_ledger_schema().validate(doc, p))
+            continue
+        per_topo = re.fullmatch(r"dispatch_prefs\..+\.json",
+                                base) is not None
         errs.extend(validate_table(doc, per_topology=per_topo, path=p))
     return errs
 
@@ -1358,10 +1384,14 @@ def main(argv=None) -> int:
             for e in errs:
                 print(f"autotune --validate: {e}", file=_sys.stderr)
             return 1
-        n = len(args.validate) if args.validate else len(glob.glob(
-            _os.path.join(_ROOT, "apex_tpu", "ops",
-                          "dispatch_prefs*.json")))
-        print(f"autotune --validate: {n} table(s) schema-valid")
+        if args.validate:
+            n, suffix = len(args.validate), ""
+        else:
+            n = len(glob.glob(_os.path.join(
+                _ROOT, "apex_tpu", "ops",
+                "dispatch_prefs*.json"))) + 1
+            suffix = " (incl. the apexcost cost ledger)"
+        print(f"autotune --validate: {n} table(s) schema-valid{suffix}")
         return 0
 
     if args.cpu_smoke:

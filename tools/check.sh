@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # The one-command correctness gate: AST tier (incl. APX204
-# fp8-reduction-without-scale-unapply) + semantic tier (apexverify, 31
-# specs) + baseline diff over the package, then the relaxed profile
+# fp8-reduction-without-scale-unapply) + semantic tier (apexverify,
+# census derived below) + baseline diff over the package, then the
+# relaxed profile
 # over tests/, examples/ and tools/ (APX101/102 exempt inside test
 # bodies — a test syncing to assert a device value is the point of the
 # test).  The semantic tier includes the watchdog.instrumented_step,
@@ -55,14 +56,32 @@ assert ids == want, f'expected {want}, found {ids}'
 print(f'{len(ids)} concurrency rules registered')
 "
 
-echo "== apexverify spec count: exactly 31 registered"
-# the spec-count gate: a PR that deletes or fails to register an
-# invariant spec must fail HERE, not silently verify less
-python -c "
-from apex_tpu.lint import semantic
-n = len(semantic.all_specs())
-assert n == 31, f'expected 31 apexverify specs, found {n}'
-print(f'{n} specs registered')
+echo "== apexcost: static cost ledger (donation-aware liveness, all specs)"
+# tier 4: every apexverify spec's cost card (peak live bytes, bytes
+# moved, collective payload, transfers, FLOPs) diffed against the
+# committed lint/cost/ledger.json with zero tolerance — unexplained
+# growth fails HERE with the offending buffers named; re-accept a
+# deliberate change with `python -m apex_tpu.lint --write-ledger`
+python -m apex_tpu.lint --cost apex_tpu/lint/cost/
+
+echo "== apexverify spec census: derived from --list-specs (floor ${SPEC_FLOOR:=31})"
+# the spec-count gate, DERIVED from the CLI instead of a hand-bumped
+# literal (24->26->30->31 across four PRs — a forgotten bump is a
+# silent gate hole): non-zero, and monotone vs the committed floor
+SPEC_FLOOR="$SPEC_FLOOR" python -c "
+import os, subprocess, sys
+out = subprocess.run(
+    [sys.executable, '-m', 'apex_tpu.lint', '--list-specs'],
+    capture_output=True, text=True, check=True).stdout
+# one non-indented 'name  [anchor]' line per spec (descriptions are
+# indented continuation lines)
+n = sum(1 for l in out.splitlines() if l and not l.startswith(' '))
+floor = int(os.environ['SPEC_FLOOR'])
+assert n > 0, 'no apexverify specs registered'
+assert n >= floor, (
+    f'{n} specs < committed floor {floor} — a spec was deleted or '
+    f'failed to register (raise the floor only with a new spec)')
+print(f'{n} specs registered (committed floor {floor})')
 "
 
 echo "== apexlint relaxed profile: tests/ examples/ tools/"

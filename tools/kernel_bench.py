@@ -780,6 +780,21 @@ def main():
                           / rrt["reqtrace_on_ms"], 2)
                     if rrt["reqtrace_on_ms"] else None)})
 
+    # apexcost ledger-build time: amortized ms per cost card over the
+    # full spec registry — the static-analysis tier's own budget line
+    # (tests/test_lint_cost.py smokes the same hook on a small subset)
+    from apex_tpu.lint.cost.bench import bench_cost_extract
+    rcx = bench_cost_extract()
+    rcx["backend"] = backend
+    print(json.dumps(rcx), flush=True)
+    rows.append({
+        "kernel": "cost_extract",
+        "shape": f"{rcx['cost_specs']}specs",
+        "dtype": "-",
+        "kernel_ms": rcx["cost_extract_ms"],
+        "oracle_ms": None,
+        "speedup": None})
+
     for r in rows:
         r["backend"] = backend
         print(json.dumps(r), flush=True)

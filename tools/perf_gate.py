@@ -37,6 +37,16 @@ positive value): the CPU-fallback liveness lines prove the harness,
 not performance, and a cached round re-served across windows compares
 equal to itself (no false regression while the tunnel is down).
 
+**Structural rows** (``"source": "ledger"`` in the budget) grade from
+the committed apexcost ledger (``apex_tpu/lint/cost/ledger.json``)
+instead of BENCH rounds: ``ledger_entry`` names the cost card,
+``ledger_field`` the dotted field (e.g.
+``extras.serving_hbm_bytes_per_slot``).  Their values are
+deterministic facts of the tree, so they default to a ZERO noise band
+and gate in auto mode regardless of hardware-round recency; only a
+forced ``--report`` waives them.  A vanished card or field grades
+``stale`` (gating) — a deleted ledger must not read as green.
+
 An **empty trajectory** (no ``BENCH_r*.json`` with a parsed bench
 line at all) grades ``no-rounds`` explicitly: one line saying there is
 nothing to grade, exit 0 in auto/report mode (a forced ``--gate``
@@ -72,6 +82,10 @@ from typing import List, Optional, Tuple
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUDGET_PATH = os.path.join(_ROOT, "tools", "perf_budget.json")
+# the apexcost ledger: committed static cost cards, the source for
+# budget rows marked {"source": "ledger"}
+LEDGER_PATH = os.path.join(_ROOT, "apex_tpu", "lint", "cost",
+                           "ledger.json")
 
 _HW_BACKENDS = {"tpu", "tpu-cached"}
 
@@ -185,6 +199,68 @@ def _check(name: str, spec: dict,
     return verdict
 
 
+def load_ledger(path: str = LEDGER_PATH) -> Optional[dict]:
+    """The committed apexcost ledger, or None when absent/unreadable
+    (the --cost lint gate owns failing on THAT; here a missing ledger
+    just grades its rows stale)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _check_ledger(name: str, spec: dict,
+                  ledger_doc: Optional[dict]) -> dict:
+    """Verdict for a STRUCTURAL budget row graded from the apexcost
+    ledger instead of BENCH rounds.  These are deterministic program
+    facts (bytes per decode slot, collective payload per step), so the
+    noise band defaults to zero and the verdict gates in auto mode
+    regardless of hardware-round recency — the value comes from the
+    committed tree, not from a measurement."""
+    direction = spec.get("direction", "lower")
+    noise_pct = float(spec.get("noise_pct", 0.0))
+    limit = spec.get("floor" if direction == "higher" else "ceiling")
+    verdict = {"metric": name, "direction": direction,
+               "noise_pct": noise_pct, "limit": limit,
+               "source": "ledger", "rounds": [],
+               "ledger_entry": spec.get("ledger_entry"),
+               "ledger_field": spec.get("ledger_field")}
+    card = (ledger_doc or {}).get("cards", {}) \
+        .get(spec.get("ledger_entry"))
+    value = metric_value(card, spec.get("ledger_field", "")) \
+        if isinstance(card, dict) else None
+    if value is None:
+        # a vanished card/field must not read as green — same
+        # crashed-leg discipline as the stale trajectory check
+        verdict.update(
+            status="stale",
+            detail=f"ledger entry {spec.get('ledger_entry')!r} does "
+                   f"not report {spec.get('ledger_field')!r} "
+                   "(ledger missing, stale or field removed) — "
+                   "regenerate with `python -m apex_tpu.lint "
+                   "--write-ledger`")
+        return verdict
+    verdict["newest"] = value
+    worse = ((lambda a, b: a < b) if direction == "higher"
+             else (lambda a, b: a > b))
+    band = 1.0 - noise_pct / 100.0
+    if limit is not None:
+        lim = float(limit)
+        threshold = lim * band if direction == "higher" else lim / band
+        if worse(value, threshold):
+            verdict.update(
+                status="regression",
+                detail=f"ledger value {value:g} breaches "
+                       f"{'floor' if direction == 'higher' else 'ceiling'} "
+                       f"{lim:g} (noise band {noise_pct:g}%) — an "
+                       "intended change must restamp the budget row "
+                       "alongside --write-ledger")
+            return verdict
+    verdict["status"] = "ok"
+    return verdict
+
+
 def parse_when(when) -> Optional["datetime.datetime"]:
     """Parse the bench stamp format (``2026-07-31T03:41:18Z``); None
     for anything else — a malformed stamp degrades to "no age", never
@@ -252,11 +328,16 @@ def choose_mode(budget: dict,
                    f"({stamped}); the budget already covers it")
 
 
-def evaluate(budget: dict,
-             rounds: List[Tuple[int, dict]]) -> List[dict]:
+def evaluate(budget: dict, rounds: List[Tuple[int, dict]],
+             ledger_doc: Optional[dict] = None) -> List[dict]:
     hw = hardware_rounds(rounds)
-    return [_check(name, spec, hw)
-            for name, spec in sorted(budget.get("metrics", {}).items())]
+    out = []
+    for name, spec in sorted(budget.get("metrics", {}).items()):
+        if spec.get("source") == "ledger":
+            out.append(_check_ledger(name, spec, ledger_doc))
+        else:
+            out.append(_check(name, spec, hw))
+    return out
 
 
 def main(argv=None) -> int:
@@ -286,7 +367,15 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rounds = load_rounds(args.root)
-    if not rounds:
+    ledger_doc = load_ledger()
+    ledger_rows = {n for n, s in budget.get("metrics", {}).items()
+                   if s.get("source") == "ledger"}
+    if not rounds and ledger_rows:
+        # structural ledger rows grade even on an empty BENCH
+        # trajectory — they come from the committed tree, not from
+        # measurements; hardware rows still report no-rounds below
+        pass
+    elif not rounds:
         # an EMPTY trajectory is its own explicit verdict, not an
         # N-way "no hardware round reports this metric" chorus: there
         # is literally nothing to grade, say so in one line and exit
@@ -310,11 +399,16 @@ def main(argv=None) -> int:
         gating, reason = True, "gating: forced by --gate"
     else:
         gating, reason = choose_mode(budget, rounds)
-    verdicts = evaluate(budget, rounds)
+    verdicts = evaluate(budget, rounds, ledger_doc)
     # stale (metric vanished from the newest hardware round) gates
     # like a regression: a crashed leg must not pass
     regressions = [v for v in verdicts
                    if v["status"] in ("regression", "stale")]
+    # ledger-sourced rows gate unconditionally in auto mode: their
+    # values are deterministic facts of the committed tree, so there
+    # is no "stale hardware" excuse — only --report waives them
+    structural = [v for v in regressions
+                  if v.get("source") == "ledger"]
 
     # measurement ages: when each hardware round's data was actually
     # captured (cached rounds re-serve their original window's stamp),
@@ -347,6 +441,7 @@ def main(argv=None) -> int:
                           "measurement_ages": ages,
                           "stale_warning": stale_warning,
                           "regressions": len(regressions),
+                          "structural_regressions": len(structural),
                           "gating": gating, "mode_reason": reason}))
     else:
         print(f"perf_gate: {len(hw)} hardware round(s) "
@@ -367,8 +462,11 @@ def main(argv=None) -> int:
         for v in verdicts:
             line = f"  {v['status']:<10} {v['metric']}"
             if v.get("newest") is not None:
-                line += (f"  newest={v['newest']:g} "
-                         f"(r{v['newest_round']:02d})")
+                line += f"  newest={v['newest']:g}"
+                if v.get("newest_round") is not None:
+                    line += f" (r{v['newest_round']:02d})"
+                elif v.get("source") == "ledger":
+                    line += " (ledger)"
             if v.get("limit") is not None:
                 kind = ("floor" if v["direction"] == "higher"
                         else "ceiling")
@@ -377,11 +475,15 @@ def main(argv=None) -> int:
                 line += f"  [{v['detail']}]"
             print(line)
         if regressions:
+            tag = "" if gating else (
+                " (report-only, not gating)" if not structural
+                else " (structural ledger row(s) gate regardless)")
             print(f"perf_gate: {len(regressions)} above-noise "
-                  "regression(s)"
-                  + ("" if gating else " (report-only, not gating)"))
+                  f"regression(s){tag}")
         else:
             print("perf_gate: trajectory clean")
+    if structural and not args.report:
+        return 1
     return 0 if (not gating or not regressions) else 1
 
 
